@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Tests never require TPU hardware; multi-chip sharding paths are exercised on
+a virtual 8-device CPU backend (SURVEY.md §4: the "fake backend" enabling
+multi-device tests without a TPU). The driver's multichip dry-run uses the
+same mechanism.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TPU_ENGINE_TEST", "1")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,43 @@
+"""Implementation matrix for core-component tests.
+
+Each core component has a pure-Python implementation and (once built) a
+native C++ one behind the same Python API. Tests parametrize over whichever
+are available so both stay semantically locked together.
+"""
+
+from tpu_engine.core.lru_cache import LRUCache as PyLRUCache
+from tpu_engine.core.consistent_hash import ConsistentHash as PyConsistentHash
+from tpu_engine.core.circuit_breaker import CircuitBreaker as PyCircuitBreaker
+
+
+def _native():
+    try:
+        from tpu_engine.core import native  # noqa
+
+        return native if native.available() else None
+    except Exception:
+        return None
+
+
+def lru_impls():
+    impls = [("python", PyLRUCache)]
+    nat = _native()
+    if nat is not None:
+        impls.append(("native", nat.NativeLRUCache))
+    return impls
+
+
+def ring_impls():
+    impls = [("python", PyConsistentHash)]
+    nat = _native()
+    if nat is not None:
+        impls.append(("native", nat.NativeConsistentHash))
+    return impls
+
+
+def breaker_impls():
+    impls = [("python", PyCircuitBreaker)]
+    nat = _native()
+    if nat is not None:
+        impls.append(("native", nat.NativeCircuitBreaker))
+    return impls

@@ -1,0 +1,96 @@
+"""Circuit breaker state machine (reference src/circuit_breaker.cpp, untested there)."""
+
+import time
+
+import pytest
+
+from tests.impl_params import breaker_impls
+from tpu_engine.core.circuit_breaker import CircuitState
+
+
+@pytest.fixture(params=breaker_impls(), ids=lambda p: p[0])
+def make_breaker(request):
+    return request.param[1]
+
+
+def state_of(b) -> str:
+    s = b.state
+    return s.value if isinstance(s, CircuitState) else str(s)
+
+
+def test_starts_closed_and_allows(make_breaker):
+    b = make_breaker(5, 2, 30.0)
+    assert state_of(b) == "CLOSED"
+    assert b.allow_request()
+
+
+def test_opens_after_consecutive_failures(make_breaker):
+    b = make_breaker(5, 2, 30.0)
+    for _ in range(4):
+        b.record_failure()
+    assert state_of(b) == "CLOSED"
+    b.record_failure()
+    assert state_of(b) == "OPEN"
+    assert not b.allow_request()
+
+
+def test_success_resets_consecutive_failure_count(make_breaker):
+    # Reference semantics: recordSuccess in CLOSED zeroes failure_count
+    # (circuit_breaker.cpp:26-37) ⇒ threshold counts *consecutive* failures.
+    b = make_breaker(5, 2, 30.0)
+    for _ in range(4):
+        b.record_failure()
+    b.record_success()
+    for _ in range(4):
+        b.record_failure()
+    assert state_of(b) == "CLOSED"
+    b.record_failure()
+    assert state_of(b) == "OPEN"
+
+
+def test_open_to_half_open_after_timeout(make_breaker):
+    b = make_breaker(2, 2, 0.1)
+    b.record_failure()
+    b.record_failure()
+    assert state_of(b) == "OPEN"
+    assert not b.allow_request()
+    time.sleep(0.15)
+    assert b.allow_request()  # transitions to HALF_OPEN and allows the probe
+    assert state_of(b) == "HALF_OPEN"
+
+
+def test_half_open_failure_reopens_immediately(make_breaker):
+    b = make_breaker(2, 2, 0.1)
+    b.record_failure()
+    b.record_failure()
+    time.sleep(0.15)
+    assert b.allow_request()
+    b.record_failure()  # any failure in HALF_OPEN → OPEN (cpp:44-46)
+    assert state_of(b) == "OPEN"
+    assert not b.allow_request()
+
+
+def test_half_open_closes_after_success_threshold(make_breaker):
+    b = make_breaker(2, 2, 0.1)
+    b.record_failure()
+    b.record_failure()
+    time.sleep(0.15)
+    assert b.allow_request()
+    b.record_success()
+    assert state_of(b) == "HALF_OPEN"
+    b.record_success()
+    assert state_of(b) == "CLOSED"
+    assert b.failure_count == 0
+    assert b.allow_request()
+
+
+def test_failure_timer_restarts_on_new_failure(make_breaker):
+    b = make_breaker(1, 1, 0.2)
+    b.record_failure()
+    assert state_of(b) == "OPEN"
+    time.sleep(0.12)
+    b.record_failure()  # refreshes last_failure_time
+    time.sleep(0.12)
+    assert not b.allow_request()  # 0.12 < 0.2 since the refresh
+    time.sleep(0.12)
+    assert b.allow_request()

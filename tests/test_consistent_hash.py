@@ -1,0 +1,92 @@
+"""Consistent-hash ring semantics (reference src/consistent_hash.cpp, untested there)."""
+
+import pytest
+
+from tests.impl_params import ring_impls
+from tpu_engine.core.consistent_hash import fnv1a_32
+
+
+@pytest.fixture(params=ring_impls(), ids=lambda p: p[0])
+def make_ring(request):
+    return request.param[1]
+
+
+def test_fnv1a_reference_vectors():
+    # Standard FNV-1a 32-bit test vectors (same constants as
+    # reference consistent_hash.cpp:6-14).
+    assert fnv1a_32("") == 2166136261
+    assert fnv1a_32("a") == 0xE40C292C
+    assert fnv1a_32("foobar") == 0xBF9CF968
+
+
+def test_empty_ring_raises(make_ring):
+    r = make_ring(150)
+    with pytest.raises(Exception):
+        r.get_node("key")
+
+
+def test_single_node_gets_everything(make_ring):
+    r = make_ring(150)
+    r.add_node("w1")
+    for i in range(50):
+        assert r.get_node(f"req_{i}") == "w1"
+
+
+def test_deterministic_mapping(make_ring):
+    r1, r2 = make_ring(150), make_ring(150)
+    for n in ["w1", "w2", "w3"]:
+        r1.add_node(n)
+        r2.add_node(n)
+    keys = [f"req_{i}" for i in range(200)]
+    assert [r1.get_node(k) for k in keys] == [r2.get_node(k) for k in keys]
+
+
+def test_distribution_roughly_balanced(make_ring):
+    r = make_ring(150)
+    nodes = ["w1", "w2", "w3"]
+    for n in nodes:
+        r.add_node(n)
+    dist = r.get_distribution([f"req_{i}" for i in range(3000)])
+    assert set(dist) == set(nodes)
+    for n in nodes:
+        # 150 vnodes/node should keep each share within ~2x of fair.
+        assert 0.15 <= dist[n] / 3000 <= 0.60
+
+
+def test_remove_node_only_remaps_its_keys(make_ring):
+    r = make_ring(150)
+    for n in ["w1", "w2", "w3"]:
+        r.add_node(n)
+    keys = [f"req_{i}" for i in range(500)]
+    before = {k: r.get_node(k) for k in keys}
+    r.remove_node("w2")
+    after = {k: r.get_node(k) for k in keys}
+    for k in keys:
+        if before[k] != "w2":
+            # Consistency property: keys not on the removed node don't move.
+            assert after[k] == before[k]
+        else:
+            assert after[k] in ("w1", "w3")
+
+
+def test_get_all_nodes_ring_order_dedup(make_ring):
+    r = make_ring(150)
+    for n in ["w3", "w1", "w2"]:
+        r.add_node(n)
+    allnodes = r.get_all_nodes()
+    assert sorted(allnodes) == ["w1", "w2", "w3"]
+    assert len(allnodes) == 3
+    # Ring order is stable regardless of insertion order.
+    r2 = make_ring(150)
+    for n in ["w1", "w2", "w3"]:
+        r2.add_node(n)
+    assert r2.get_all_nodes() == allnodes
+
+
+def test_size_counts_physical_nodes(make_ring):
+    r = make_ring(150)
+    r.add_node("w1")
+    r.add_node("w2")
+    assert r.size() == 2
+    r.remove_node("w1")
+    assert r.size() == 1

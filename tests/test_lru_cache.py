@@ -1,0 +1,110 @@
+"""LRU cache semantics — the unit tests the reference never had (SURVEY.md §4).
+
+Parametrized over the Python and native C++ implementations once the native
+library is built (see tests/impl_params.py).
+"""
+
+import threading
+
+import pytest
+
+from tests.impl_params import lru_impls
+
+
+@pytest.fixture(params=lru_impls(), ids=lambda p: p[0])
+def make_cache(request):
+    return request.param[1]
+
+
+def test_put_get_roundtrip(make_cache):
+    c = make_cache(4)
+    c.put(b"a", [1.0])
+    assert c.get(b"a") == [1.0]
+    assert c.size() == 1
+
+
+def test_miss_returns_none_and_counts(make_cache):
+    c = make_cache(4)
+    assert c.get(b"missing") is None
+    c.put(b"a", 1)
+    assert c.get(b"a") == 1
+    assert c.hits == 1
+    assert c.misses == 1
+    assert c.hit_rate() == pytest.approx(0.5)
+
+
+def test_eviction_is_lru_order(make_cache):
+    c = make_cache(2)
+    c.put(b"a", 1)
+    c.put(b"b", 2)
+    assert c.get(b"a") == 1  # promotes a to MRU
+    c.put(b"c", 3)  # evicts b (LRU)
+    assert c.get(b"b") is None
+    assert c.get(b"a") == 1
+    assert c.get(b"c") == 3
+
+
+def test_put_existing_updates_and_promotes(make_cache):
+    c = make_cache(2)
+    c.put(b"a", 1)
+    c.put(b"b", 2)
+    c.put(b"a", 10)  # update + promote; must not evict
+    c.put(b"c", 3)  # evicts b
+    assert c.get(b"a") == 10
+    assert c.get(b"b") is None
+    assert c.get(b"c") == 3
+
+
+def test_capacity_bound(make_cache):
+    c = make_cache(8)
+    for i in range(100):
+        c.put(str(i).encode(), i)
+    assert c.size() == 8
+    assert c.capacity == 8
+
+
+def test_clear_resets_state_and_counters(make_cache):
+    c = make_cache(4)
+    c.put(b"a", 1)
+    c.get(b"a")
+    c.get(b"x")
+    c.clear()
+    assert c.size() == 0
+    assert c.hits == 0
+    assert c.misses == 0
+    assert c.hit_rate() == 0.0
+
+
+def test_full_key_equality_no_sampled_hash_confusion(make_cache):
+    # The reference's VectorHash sampled only first/middle/last elements
+    # (lru_cache.h:84-96). Keys differing only in other positions must still
+    # be distinct entries.
+    c = make_cache(16)
+    k1 = bytes([0, 1, 2, 3, 4, 5, 6, 7, 8])
+    k2 = bytes([0, 9, 2, 3, 4, 5, 6, 9, 8])  # same first/middle/last
+    c.put(k1, "v1")
+    c.put(k2, "v2")
+    assert c.get(k1) == "v1"
+    assert c.get(k2) == "v2"
+
+
+def test_thread_safety_smoke(make_cache):
+    c = make_cache(64)
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(500):
+                key = str((tid * 31 + i) % 100).encode()
+                c.put(key, i)
+                c.get(key)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert c.size() <= 64

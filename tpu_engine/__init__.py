@@ -1,0 +1,22 @@
+"""tpu_engine — a TPU-native distributed inference serving framework.
+
+Built from scratch with the capabilities of the reference system
+`AbhiramDodda/distributed-inference-engine-cpp` (a C++17 gateway/worker ONNX
+serving stack), re-designed TPU-first:
+
+- compute path: JAX/XLA with shape-bucketed compiled-executable caches,
+  bfloat16 on the MXU, and Pallas kernels for hot ops;
+- scale-out: ``jax.sharding.Mesh`` + ``pjit``/``shard_map`` over ICI/DCN
+  instead of HTTP fan-out to replica processes;
+- runtime core (LRU result cache, consistent-hash ring, circuit breaker,
+  batch queue): native C++ (``tpu_engine/native``) with ctypes bindings and
+  pure-Python fallbacks;
+- external API: wire-compatible with the reference's ``POST /infer``,
+  ``GET /health``, ``GET /stats`` JSON schemas so its ``benchmark.py`` and
+  ``diagnostics.sh`` run unmodified.
+
+See ``SURVEY.md`` at the repo root for the reference's structural analysis
+and the parity inventory this package implements.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Pure-logic runtime cores: LRU cache, consistent-hash ring, circuit breaker.
+
+These are the pure-Python reference implementations. Native C++ equivalents
+with identical semantics live in ``tpu_engine/native`` and are exposed via
+``tpu_engine.core.native`` (ctypes) when the shared library has been built;
+``tests/impl_params.py`` runs the same test suite against both.
+"""
+
+from tpu_engine.core.lru_cache import LRUCache
+from tpu_engine.core.consistent_hash import ConsistentHash
+from tpu_engine.core.circuit_breaker import CircuitBreaker, CircuitState
+
+__all__ = ["LRUCache", "ConsistentHash", "CircuitBreaker", "CircuitState"]

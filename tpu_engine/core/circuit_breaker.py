@@ -1,0 +1,98 @@
+"""Per-lane circuit breaker (CLOSED → OPEN → HALF_OPEN state machine).
+
+Capability parity with the reference breaker
+(``/root/reference/src/circuit_breaker.cpp:1-69`` /
+``include/circuit_breaker.h:1-44``), semantics preserved exactly because the
+fault-injection benchmark scenario depends on them:
+
+- ``failure_threshold`` counts *consecutive* failures — any success while
+  CLOSED resets the count (reference ``circuit_breaker.cpp:26-37``);
+- OPEN transitions to HALF_OPEN after ``timeout`` elapses since the last
+  failure, letting one probe stream through (``:12-24``);
+- any failure while HALF_OPEN reopens immediately (``:39-47``);
+- ``success_threshold`` consecutive HALF_OPEN successes close the circuit.
+
+In the TPU-native gateway these guard per-chip dispatch lanes: the failure
+signals are XLA/PJRT errors and dispatch timeouts rather than HTTP errors
+(SURVEY.md §5 "failure detection").
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+
+
+class CircuitState(enum.Enum):
+    CLOSED = "CLOSED"
+    OPEN = "OPEN"
+    HALF_OPEN = "HALF_OPEN"
+
+
+class CircuitBreaker:
+    """Thread-safe breaker; defaults mirror the reference gateway config
+    (5 failures / 2 successes / 30 s, ``gateway.cpp:19-23``)."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        success_threshold: int = 2,
+        timeout_seconds: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self._failure_threshold = int(failure_threshold)
+        self._success_threshold = int(success_threshold)
+        self._timeout = float(timeout_seconds)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CircuitState.CLOSED
+        self._failure_count = 0
+        self._success_count = 0
+        self._last_failure_time = clock()
+
+    def allow_request(self) -> bool:
+        with self._lock:
+            if self._state is CircuitState.OPEN:
+                if self._clock() - self._last_failure_time >= self._timeout:
+                    self._state = CircuitState.HALF_OPEN
+                    self._success_count = 0
+                    return True
+                return False
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state is CircuitState.HALF_OPEN:
+                self._success_count += 1
+                if self._success_count >= self._success_threshold:
+                    self._state = CircuitState.CLOSED
+                    self._failure_count = 0
+            else:
+                self._failure_count = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failure_count += 1
+            self._last_failure_time = self._clock()
+            if (
+                self._failure_count >= self._failure_threshold
+                or self._state is CircuitState.HALF_OPEN
+            ):
+                self._state = CircuitState.OPEN
+
+    @property
+    def state(self) -> CircuitState:
+        return self._state
+
+    @property
+    def failure_count(self) -> int:
+        return self._failure_count
+
+    @property
+    def success_count(self) -> int:
+        return self._success_count
+
+    def state_name(self) -> str:
+        """String form used by ``GET /stats`` (reference ``gateway.cpp:67-74``)."""
+        return self._state.value

@@ -1,0 +1,115 @@
+"""Consistent-hash routing ring (FNV-1a, virtual nodes).
+
+Capability parity with the reference ring
+(``/root/reference/src/consistent_hash.cpp:1-70`` /
+``include/consistent_hash.h:1-25``): 32-bit FNV-1a over ``"{node}#{i}"``
+virtual-node labels (150 vnodes per physical node by default), clockwise
+``lower_bound`` lookup with wraparound, ring-order node enumeration, and a
+distribution probe for testing.
+
+In the TPU-native deployment the "nodes" are dispatch lanes — one per TPU
+chip or per replica group on a ``jax.sharding.Mesh`` — rather than remote
+HTTP workers; see ``tpu_engine.serving.gateway``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Sequence
+
+_FNV_OFFSET = 2166136261
+_FNV_PRIME = 16777619
+_MASK32 = 0xFFFFFFFF
+
+
+def fnv1a_32(key: str) -> int:
+    """32-bit FNV-1a, identical constants to reference ``consistent_hash.cpp:6-14``."""
+    h = _FNV_OFFSET
+    for b in key.encode("utf-8"):
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK32
+    return h
+
+
+class ConsistentHash:
+    """Hash ring mapping request keys to node names.
+
+    Ring storage is a sorted list of vnode hashes plus a hash→node dict;
+    hash collisions overwrite, matching the reference's ``std::map`` insert
+    (``consistent_hash.cpp:16-23``).
+    """
+
+    DEFAULT_VIRTUAL_NODES = 150  # reference include/consistent_hash.h:12
+
+    def __init__(self, virtual_nodes: int = DEFAULT_VIRTUAL_NODES):
+        self._virtual_nodes = int(virtual_nodes)
+        self._ring: Dict[int, str] = {}
+        self._sorted_hashes: List[int] = []
+        self._lock = threading.Lock()
+
+    @property
+    def virtual_nodes(self) -> int:
+        return self._virtual_nodes
+
+    def add_node(self, node: str) -> None:
+        """Insert ``virtual_nodes`` vnodes labelled ``node#i`` (reference ``:16-23``)."""
+        with self._lock:
+            for i in range(self._virtual_nodes):
+                h = fnv1a_32(f"{node}#{i}")
+                if h not in self._ring:
+                    bisect.insort(self._sorted_hashes, h)
+                self._ring[h] = node
+
+    def remove_node(self, node: str) -> None:
+        """Erase the node's vnodes (reference ``:25-32``) — enables elastic scale-down,
+        which the reference declared but never wired up (SURVEY.md §5)."""
+        with self._lock:
+            for i in range(self._virtual_nodes):
+                h = fnv1a_32(f"{node}#{i}")
+                if self._ring.get(h) == node:
+                    del self._ring[h]
+                    idx = bisect.bisect_left(self._sorted_hashes, h)
+                    if idx < len(self._sorted_hashes) and self._sorted_hashes[idx] == h:
+                        self._sorted_hashes.pop(idx)
+
+    def get_node(self, key: str) -> str:
+        """First vnode clockwise of ``hash(key)``, wrapping to ring start
+        (reference ``:34-45``)."""
+        with self._lock:
+            if not self._sorted_hashes:
+                raise RuntimeError("hash ring is empty")
+            h = fnv1a_32(key)
+            idx = bisect.bisect_left(self._sorted_hashes, h)
+            if idx == len(self._sorted_hashes):
+                idx = 0
+            return self._ring[self._sorted_hashes[idx]]
+
+    def get_all_nodes(self) -> List[str]:
+        """Distinct nodes in ring order, first-occurrence dedup (reference ``:47-59``).
+
+        Ring order is the failover order used by the gateway
+        (``gateway.cpp:51-59``).
+        """
+        with self._lock:
+            seen = set()
+            out: List[str] = []
+            for h in self._sorted_hashes:
+                n = self._ring[h]
+                if n not in seen:
+                    seen.add(n)
+                    out.append(n)
+            return out
+
+    def size(self) -> int:
+        """Number of distinct physical nodes."""
+        return len(set(self._ring.values()))
+
+    def get_distribution(self, keys: Sequence[str]) -> Dict[str, int]:
+        """Per-node assignment counts over ``keys`` — the test/debug probe the
+        reference shipped but never called (``consistent_hash.cpp:61-70``)."""
+        counts: Dict[str, int] = {}
+        for k in keys:
+            n = self.get_node(k)
+            counts[n] = counts.get(n, 0) + 1
+        return counts

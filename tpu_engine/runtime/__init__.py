@@ -1,0 +1,5 @@
+"""Execution runtime: dynamic batcher and the shape-bucketed JAX engine."""
+
+from tpu_engine.runtime.batch_processor import BatchProcessor, BatcherMetrics
+
+__all__ = ["BatchProcessor", "BatcherMetrics"]

@@ -1,0 +1,50 @@
+"""Configuration for workers and the gateway.
+
+The reference hardcodes every tunable at compile time (cache 1000 entries
+``worker_node.cpp:33``; batch 32 / 20 ms ``:35-36``; breaker 5/2/30 s
+``gateway.cpp:20-22``; 150 vnodes ``consistent_hash.h:12``; gateway port 8000
+``gateway.cpp:198``; 5 s client timeouts ``:32-33``) and tells users to edit
+the source (``README.md:302-320``). Here the same defaults are real config:
+dataclasses overridable from CLI flags and environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    port: int = 8001
+    node_id: str = "worker_1"
+    model: str = "resnet50"  # registry name, see tpu_engine.models.registry
+    model_path: Optional[str] = None  # optional weights checkpoint
+    cache_capacity: int = 1000          # reference worker_node.cpp:33
+    max_batch_size: int = 32            # reference worker_node.cpp:35
+    batch_timeout_ms: float = 20.0      # reference worker_node.cpp:36
+    batch_linger_ms: float = 0.0        # TPU extension: accumulation window
+    dtype: str = "bfloat16"             # MXU-native compute dtype
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32)
+    fake_cached_latency_us: int = 50    # reference worker_node.cpp:65
+
+    @classmethod
+    def from_env(cls, **overrides) -> "WorkerConfig":
+        cfg = cls(**overrides)
+        # $MODEL_PATH honored like the reference (worker_node.cpp:154-168).
+        env_model = os.environ.get("MODEL_PATH")
+        if env_model and not cfg.model_path:
+            cfg.model_path = env_model
+        return cfg
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    port: int = 8000                    # reference gateway.cpp:198
+    virtual_nodes: int = 150            # reference consistent_hash.h:12
+    failure_threshold: int = 5          # reference gateway.cpp:20
+    success_threshold: int = 2          # reference gateway.cpp:21
+    breaker_timeout_s: float = 30.0     # reference gateway.cpp:22
+    worker_timeout_s: float = 5.0       # reference gateway.cpp:32-33
+    default_worker_port: int = 8080     # reference parseUrl gateway.cpp:139,147
