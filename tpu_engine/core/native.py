@@ -1,0 +1,346 @@
+"""ctypes bindings for the native C++ runtime core (libtpucore.so).
+
+Exposes ``NativeLRUCache``, ``NativeConsistentHash``, ``NativeCircuitBreaker``
+and ``NativeBatchQueue`` with the same Python API as the pure-Python
+implementations in ``tpu_engine.core`` so the two are interchangeable (and
+are tested against the same suite, see ``tests/impl_params.py``).
+
+The shared library is built from ``tpu_engine/native`` (CMake or
+``build.sh``). If it is absent, ``available()`` triggers a one-shot quiet
+build attempt with g++; failing that, callers fall back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Any, List, Optional
+
+from tpu_engine.core.circuit_breaker import CircuitState
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_CANDIDATES = [
+    os.path.join(_NATIVE_DIR, "libtpucore.so"),
+    os.path.join(os.path.dirname(_NATIVE_DIR), "..", "build", "native", "libtpucore.so"),
+]
+
+_lib = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_size = ctypes.c_size_t
+    P = ctypes.c_void_p
+    lib.tpu_free.argtypes = [ctypes.c_void_p]
+    lib.tpu_lru_create.restype = P
+    lib.tpu_lru_create.argtypes = [c_size]
+    lib.tpu_lru_destroy.argtypes = [P]
+    lib.tpu_lru_get.restype = ctypes.c_int
+    lib.tpu_lru_get.argtypes = [P, ctypes.c_char_p, c_size,
+                                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(c_size)]
+    lib.tpu_lru_put.argtypes = [P, ctypes.c_char_p, c_size, ctypes.c_char_p, c_size]
+    lib.tpu_lru_clear.argtypes = [P]
+    lib.tpu_lru_size.restype = c_size
+    lib.tpu_lru_size.argtypes = [P]
+    lib.tpu_lru_capacity.restype = c_size
+    lib.tpu_lru_capacity.argtypes = [P]
+    lib.tpu_lru_hits.restype = ctypes.c_uint64
+    lib.tpu_lru_hits.argtypes = [P]
+    lib.tpu_lru_misses.restype = ctypes.c_uint64
+    lib.tpu_lru_misses.argtypes = [P]
+
+    lib.tpu_ring_create.restype = P
+    lib.tpu_ring_create.argtypes = [ctypes.c_int]
+    lib.tpu_ring_destroy.argtypes = [P]
+    lib.tpu_ring_add.argtypes = [P, ctypes.c_char_p]
+    lib.tpu_ring_remove.argtypes = [P, ctypes.c_char_p]
+    lib.tpu_ring_get.restype = ctypes.c_int
+    lib.tpu_ring_get.argtypes = [P, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(c_size)]
+    lib.tpu_ring_all_nodes.restype = ctypes.c_int
+    lib.tpu_ring_all_nodes.argtypes = [P, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(c_size)]
+    lib.tpu_ring_num_nodes.restype = c_size
+    lib.tpu_ring_num_nodes.argtypes = [P]
+    lib.tpu_fnv1a.restype = ctypes.c_uint32
+    lib.tpu_fnv1a.argtypes = [ctypes.c_char_p, c_size]
+
+    lib.tpu_breaker_create.restype = P
+    lib.tpu_breaker_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    lib.tpu_breaker_destroy.argtypes = [P]
+    for fn in ("tpu_breaker_allow", "tpu_breaker_state",
+               "tpu_breaker_failures", "tpu_breaker_successes"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [P]
+    lib.tpu_breaker_success.argtypes = [P]
+    lib.tpu_breaker_failure.argtypes = [P]
+
+    lib.tpu_bq_create.restype = P
+    lib.tpu_bq_create.argtypes = [c_size, ctypes.c_double]
+    lib.tpu_bq_destroy.argtypes = [P]
+    lib.tpu_bq_push.restype = ctypes.c_longlong
+    lib.tpu_bq_push.argtypes = [P, ctypes.c_char_p, c_size]
+    lib.tpu_bq_pop_batch.restype = ctypes.c_int
+    lib.tpu_bq_pop_batch.argtypes = [P, ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(c_size), ctypes.POINTER(ctypes.c_longlong),
+                                     ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.tpu_bq_close.argtypes = [P]
+    lib.tpu_bq_size.restype = c_size
+    lib.tpu_bq_size.argtypes = [P]
+    return lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        if _load_attempted:
+            return None
+        _load_attempted = True
+        path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+        if path is None and os.environ.get("TPU_ENGINE_NO_NATIVE_BUILD") != "1":
+            try:
+                subprocess.run(
+                    ["bash", os.path.join(_NATIVE_DIR, "build.sh")],
+                    check=True, capture_output=True, timeout=120,
+                )
+                path = _LIB_CANDIDATES[0]
+            except Exception:
+                return None
+        if path is None or not os.path.exists(path):
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(path))
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _try_load() is not None
+
+
+def _take_bytes(lib, ptr: ctypes.c_void_p, length: int) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.tpu_free(ptr)
+
+
+class NativeLRUCache:
+    """Byte-blob LRU; arbitrary Python values round-trip via pickle.
+
+    Keys must be ``bytes`` — the serving path keys by the serialized input
+    tensor. (The pure-Python LRUCache accepts any hashable; restricting the
+    native contract to bytes avoids pickle-canonicalization mismatches like
+    ``1`` vs ``1.0``, which hash-equal as dict keys but differ as pickles.)
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._lib = _try_load()
+        if self._lib is None:
+            raise RuntimeError("libtpucore.so is not available")
+        self._h = self._lib.tpu_lru_create(capacity)
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.tpu_lru_destroy(h)
+            self._h = None
+
+    @staticmethod
+    def _key_bytes(key) -> bytes:
+        if not isinstance(key, bytes):
+            raise TypeError(f"NativeLRUCache keys must be bytes, got {type(key).__name__}")
+        return key
+
+    def get(self, key) -> Optional[Any]:
+        out = ctypes.c_void_p()
+        n = ctypes.c_size_t()
+        k = self._key_bytes(key)
+        if not self._lib.tpu_lru_get(self._h, k, len(k), ctypes.byref(out), ctypes.byref(n)):
+            return None
+        return pickle.loads(_take_bytes(self._lib, out, n.value))
+
+    def put(self, key, value: Any) -> None:
+        k = self._key_bytes(key)
+        v = pickle.dumps(value)
+        self._lib.tpu_lru_put(self._h, k, len(k), v, len(v))
+
+    def clear(self) -> None:
+        self._lib.tpu_lru_clear(self._h)
+
+    def size(self) -> int:
+        return self._lib.tpu_lru_size(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.tpu_lru_capacity(self._h)
+
+    @property
+    def hits(self) -> int:
+        return self._lib.tpu_lru_hits(self._h)
+
+    @property
+    def misses(self) -> int:
+        return self._lib.tpu_lru_misses(self._h)
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return (self.hits / total) if total else 0.0
+
+
+class NativeConsistentHash:
+    def __init__(self, virtual_nodes: int = 150):
+        self._lib = _try_load()
+        if self._lib is None:
+            raise RuntimeError("libtpucore.so is not available")
+        self._h = self._lib.tpu_ring_create(virtual_nodes)
+        self._virtual_nodes = virtual_nodes
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.tpu_ring_destroy(h)
+            self._h = None
+
+    @property
+    def virtual_nodes(self) -> int:
+        return self._virtual_nodes
+
+    def add_node(self, node: str) -> None:
+        self._lib.tpu_ring_add(self._h, node.encode())
+
+    def remove_node(self, node: str) -> None:
+        self._lib.tpu_ring_remove(self._h, node.encode())
+
+    def get_node(self, key: str) -> str:
+        out = ctypes.c_void_p()
+        n = ctypes.c_size_t()
+        if not self._lib.tpu_ring_get(self._h, key.encode(), ctypes.byref(out), ctypes.byref(n)):
+            raise RuntimeError("hash ring is empty")
+        return _take_bytes(self._lib, out, n.value).decode()
+
+    def get_all_nodes(self) -> List[str]:
+        out = ctypes.c_void_p()
+        n = ctypes.c_size_t()
+        self._lib.tpu_ring_all_nodes(self._h, ctypes.byref(out), ctypes.byref(n))
+        buf = _take_bytes(self._lib, out, n.value)
+        # Repeated <uint32 LE length><bytes> records (see tpu_ring_all_nodes).
+        nodes, pos = [], 0
+        while pos < len(buf):
+            ln = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+            nodes.append(buf[pos:pos + ln].decode())
+            pos += ln
+        return nodes
+
+    def size(self) -> int:
+        return self._lib.tpu_ring_num_nodes(self._h)
+
+    def get_distribution(self, keys) -> dict:
+        counts: dict = {}
+        for k in keys:
+            n = self.get_node(k)
+            counts[n] = counts.get(n, 0) + 1
+        return counts
+
+
+class NativeCircuitBreaker:
+    _STATES = {0: CircuitState.CLOSED, 1: CircuitState.OPEN, 2: CircuitState.HALF_OPEN}
+
+    def __init__(self, failure_threshold: int = 5, success_threshold: int = 2,
+                 timeout_seconds: float = 30.0):
+        self._lib = _try_load()
+        if self._lib is None:
+            raise RuntimeError("libtpucore.so is not available")
+        self._h = self._lib.tpu_breaker_create(failure_threshold, success_threshold,
+                                               float(timeout_seconds))
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.tpu_breaker_destroy(h)
+            self._h = None
+
+    def allow_request(self) -> bool:
+        return bool(self._lib.tpu_breaker_allow(self._h))
+
+    def record_success(self) -> None:
+        self._lib.tpu_breaker_success(self._h)
+
+    def record_failure(self) -> None:
+        self._lib.tpu_breaker_failure(self._h)
+
+    @property
+    def state(self) -> CircuitState:
+        return self._STATES[self._lib.tpu_breaker_state(self._h)]
+
+    @property
+    def failure_count(self) -> int:
+        return self._lib.tpu_breaker_failures(self._h)
+
+    @property
+    def success_count(self) -> int:
+        return self._lib.tpu_breaker_successes(self._h)
+
+    def state_name(self) -> str:
+        return self.state.value
+
+
+class NativeBatchQueue:
+    """Native MPMC batch queue; the timed PopBatch wait releases the GIL."""
+
+    def __init__(self, max_batch: int, timeout_s: float):
+        self._lib = _try_load()
+        if self._lib is None:
+            raise RuntimeError("libtpucore.so is not available")
+        self._max = int(max_batch)
+        self._h = self._lib.tpu_bq_create(self._max, float(timeout_s))
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.tpu_bq_destroy(h)
+            self._h = None
+
+    def push(self, payload: bytes) -> int:
+        """Returns the ticket id, or -1 if the queue is closed."""
+        return self._lib.tpu_bq_push(self._h, payload, len(payload))
+
+    def pop_batch(self):
+        """Returns (items, timed_out) where items is a list of
+        (ticket, payload) — or (None, timed_out) when closed and drained."""
+        bufs = (ctypes.c_void_p * self._max)()
+        lens = (ctypes.c_size_t * self._max)()
+        tickets = (ctypes.c_longlong * self._max)()
+        timed_out = ctypes.c_int()
+        n = self._lib.tpu_bq_pop_batch(self._h, bufs, lens, tickets, self._max,
+                                       ctypes.byref(timed_out))
+        if n < 0:
+            return None, bool(timed_out.value)
+        items = [
+            (tickets[i], _take_bytes(self._lib, ctypes.c_void_p(bufs[i]), lens[i]))
+            for i in range(n)
+        ]
+        return items, bool(timed_out.value)
+
+    def close(self) -> None:
+        self._lib.tpu_bq_close(self._h)
+
+    def size(self) -> int:
+        return self._lib.tpu_bq_size(self._h)
+
+
+def native_fnv1a_32(key: str) -> int:
+    lib = _try_load()
+    if lib is None:
+        raise RuntimeError("libtpucore.so is not available")
+    b = key.encode()
+    return lib.tpu_fnv1a(b, len(b))

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Minimal no-cmake build of libtpucore.so (used as the fallback by
+# tpu_engine.core.native when the library has not been built yet).
+set -euo pipefail
+cd "$(dirname "$0")"
+out="${1:-libtpucore.so}"
+g++ -std=c++17 -O3 -Wall -Wextra -fPIC -shared -pthread core_api.cc -o "$out"
+echo "built $out"

@@ -1,0 +1,167 @@
+// Flat C API over the tpucore classes, consumed from Python via ctypes
+// (tpu_engine/core/native.py). Ownership rules: every handle returned by a
+// *_create is released by the matching *_destroy; byte buffers returned via
+// tpu_alloc-ed pointers are released with tpu_free.
+
+#include <cstdlib>
+#include <cstring>
+
+#include "core.h"
+
+using tpucore::BatchQueue;
+using tpucore::Breaker;
+using tpucore::HashRing;
+using tpucore::LruCache;
+
+extern "C" {
+
+// ----- shared ---------------------------------------------------------------
+
+void tpu_free(void* p) { std::free(p); }
+
+static char* AllocCopy(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+  if (out && !s.empty()) std::memcpy(out, s.data(), s.size());
+  return out;
+}
+
+// ----- LRU cache ------------------------------------------------------------
+
+void* tpu_lru_create(std::size_t capacity) { return new LruCache(capacity); }
+void tpu_lru_destroy(void* h) { delete static_cast<LruCache*>(h); }
+
+// Returns 1 on hit (caller frees *val_out with tpu_free), 0 on miss.
+int tpu_lru_get(void* h, const char* key, std::size_t klen, char** val_out,
+                std::size_t* vlen_out) {
+  std::string value;
+  if (!static_cast<LruCache*>(h)->Get(std::string(key, klen), &value)) {
+    return 0;
+  }
+  *val_out = AllocCopy(value);
+  *vlen_out = value.size();
+  return 1;
+}
+
+void tpu_lru_put(void* h, const char* key, std::size_t klen, const char* val,
+                 std::size_t vlen) {
+  static_cast<LruCache*>(h)->Put(std::string(key, klen),
+                                 std::string(val, vlen));
+}
+
+void tpu_lru_clear(void* h) { static_cast<LruCache*>(h)->Clear(); }
+std::size_t tpu_lru_size(void* h) { return static_cast<LruCache*>(h)->Size(); }
+std::size_t tpu_lru_capacity(void* h) {
+  return static_cast<LruCache*>(h)->capacity();
+}
+std::uint64_t tpu_lru_hits(void* h) { return static_cast<LruCache*>(h)->hits(); }
+std::uint64_t tpu_lru_misses(void* h) {
+  return static_cast<LruCache*>(h)->misses();
+}
+
+// ----- consistent-hash ring -------------------------------------------------
+
+void* tpu_ring_create(int virtual_nodes) { return new HashRing(virtual_nodes); }
+void tpu_ring_destroy(void* h) { delete static_cast<HashRing*>(h); }
+void tpu_ring_add(void* h, const char* node) {
+  static_cast<HashRing*>(h)->AddNode(node);
+}
+void tpu_ring_remove(void* h, const char* node) {
+  static_cast<HashRing*>(h)->RemoveNode(node);
+}
+
+// Returns 1 and allocates *node_out on success, 0 if the ring is empty.
+int tpu_ring_get(void* h, const char* key, char** node_out,
+                 std::size_t* nlen_out) {
+  std::string node;
+  if (!static_cast<HashRing*>(h)->GetNode(key, &node)) return 0;
+  *node_out = AllocCopy(node);
+  *nlen_out = node.size();
+  return 1;
+}
+
+// Distinct nodes in ring order, framed as repeated
+// <uint32 little-endian length><bytes> records so arbitrary node names
+// (including '\n') round-trip exactly. Caller frees with tpu_free.
+int tpu_ring_all_nodes(void* h, char** out, std::size_t* len_out) {
+  std::string joined;
+  for (const auto& n : static_cast<HashRing*>(h)->AllNodes()) {
+    std::uint32_t len = static_cast<std::uint32_t>(n.size());
+    joined.append(reinterpret_cast<const char*>(&len), sizeof(len));
+    joined += n;
+  }
+  *out = AllocCopy(joined);
+  *len_out = joined.size();
+  return 1;
+}
+
+std::size_t tpu_ring_num_nodes(void* h) {
+  return static_cast<HashRing*>(h)->NumNodes();
+}
+
+std::uint32_t tpu_fnv1a(const char* key, std::size_t klen) {
+  return HashRing::Fnv1a(std::string(key, klen));
+}
+
+// ----- circuit breaker ------------------------------------------------------
+
+void* tpu_breaker_create(int failure_threshold, int success_threshold,
+                         double timeout_s) {
+  return new Breaker(failure_threshold, success_threshold, timeout_s);
+}
+void tpu_breaker_destroy(void* h) { delete static_cast<Breaker*>(h); }
+int tpu_breaker_allow(void* h) {
+  return static_cast<Breaker*>(h)->AllowRequest() ? 1 : 0;
+}
+void tpu_breaker_success(void* h) { static_cast<Breaker*>(h)->RecordSuccess(); }
+void tpu_breaker_failure(void* h) { static_cast<Breaker*>(h)->RecordFailure(); }
+int tpu_breaker_state(void* h) { return static_cast<Breaker*>(h)->state(); }
+int tpu_breaker_failures(void* h) {
+  return static_cast<Breaker*>(h)->failure_count();
+}
+int tpu_breaker_successes(void* h) {
+  return static_cast<Breaker*>(h)->success_count();
+}
+
+// ----- batch queue ----------------------------------------------------------
+
+void* tpu_bq_create(std::size_t max_batch, double timeout_s) {
+  return new BatchQueue(max_batch, timeout_s);
+}
+void tpu_bq_destroy(void* h) { delete static_cast<BatchQueue*>(h); }
+
+long long tpu_bq_push(void* h, const char* data, std::size_t len) {
+  return static_cast<BatchQueue*>(h)->Push(std::string(data, len));
+}
+
+// Pops up to min(max, queue max_batch) items. Fills parallel arrays of
+// malloc'd payload pointers (caller frees each with tpu_free), lengths and
+// tickets. Returns the item count (0 = timeout with empty queue), or -1
+// when closed+drained.
+int tpu_bq_pop_batch(void* h, char** bufs, std::size_t* lens,
+                     long long* tickets, int max, int* timed_out) {
+  std::vector<BatchQueue::Item> items;
+  bool to = false;
+  if (max <= 0) {
+    *timed_out = 0;
+    return 0;
+  }
+  if (!static_cast<BatchQueue*>(h)->PopBatch(
+          &items, &to, static_cast<std::size_t>(max))) {
+    *timed_out = to ? 1 : 0;
+    return -1;
+  }
+  *timed_out = to ? 1 : 0;
+  int n = 0;
+  for (auto& item : items) {
+    bufs[n] = AllocCopy(item.payload);
+    lens[n] = item.payload.size();
+    tickets[n] = item.ticket;
+    ++n;
+  }
+  return n;
+}
+
+void tpu_bq_close(void* h) { static_cast<BatchQueue*>(h)->Close(); }
+std::size_t tpu_bq_size(void* h) { return static_cast<BatchQueue*>(h)->Size(); }
+
+}  // extern "C"
