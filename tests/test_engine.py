@@ -1,0 +1,108 @@
+"""InferenceEngine: shape-bucketed compile cache, pad/truncate, mesh sharding."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_engine.parallel.mesh import create_mesh
+from tpu_engine.runtime.engine import InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine("mlp", dtype="float32",
+                           model_kwargs=dict(input_dim=8, hidden_dim=32, output_dim=4),
+                           batch_buckets=(1, 2, 4, 8))
+
+
+def test_shape_introspection(engine):
+    assert engine.get_input_shape() == (-1, 8)
+    assert engine.get_output_shape() == (-1, 4)
+    assert engine.input_size == 8
+    assert engine.output_size == 4
+
+
+def test_predict_exact_size(engine):
+    out = engine.predict([1.0] * 8)
+    assert out.shape == (4,)
+    assert out.dtype == np.float32
+
+
+def test_predict_pads_short_input(engine):
+    # Reference predict resizes both directions (inference_engine.cpp:100-103);
+    # the benchmark sends 3-float vectors to a large model.
+    short = engine.predict([1.0, 2.0, 3.0])
+    padded = engine.predict([1.0, 2.0, 3.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+    np.testing.assert_allclose(short, padded, rtol=1e-5)
+
+
+def test_predict_truncates_long_input(engine):
+    long = engine.predict(list(range(20)))
+    exact = engine.predict(list(range(8)))
+    np.testing.assert_allclose(long, exact, rtol=1e-5)
+
+
+def test_batch_matches_single(engine):
+    # Padding rows to the bucket must not perturb real samples — and the
+    # reference batchPredict misalignment bug (oversized sample shifts later
+    # samples, inference_engine.cpp:151-160) must not exist here.
+    inputs = [[float(i)] * 8 for i in range(3)]
+    batch_out = engine.batch_predict(inputs)
+    for vec, b in zip(inputs, batch_out):
+        np.testing.assert_allclose(engine.predict(vec), b, rtol=1e-5)
+
+
+def test_oversized_sample_does_not_shift_neighbors(engine):
+    inputs = [list(range(30)), [1.0] * 8]  # first sample oversized
+    out = engine.batch_predict(inputs)
+    np.testing.assert_allclose(out[1], engine.predict([1.0] * 8), rtol=1e-5)
+
+
+def test_bucket_selection_and_compile_cache(engine):
+    engine.batch_predict([[0.0]] * 3)  # needs bucket 4
+    s = engine.stats()
+    assert 4 in s["compiled_buckets"]
+    before = len(s["compiled_buckets"])
+    engine.batch_predict([[0.0]] * 3)  # same bucket: no new compile
+    assert len(engine.stats()["compiled_buckets"]) == before
+
+
+def test_batch_larger_than_max_bucket_chunks(engine):
+    inputs = [[float(i)] * 8 for i in range(11)]  # max bucket is 8
+    out = engine.batch_predict(inputs)
+    assert len(out) == 11
+    np.testing.assert_allclose(out[10], engine.predict(inputs[10]), rtol=1e-5)
+
+
+def test_empty_batch(engine):
+    assert engine.batch_predict([]) == []
+
+
+def test_warmup_precompiles(engine):
+    engine.warmup()
+    assert engine.stats()["compiled_buckets"] == [1, 2, 4, 8]
+
+
+def test_mesh_sharded_engine_matches_single_device():
+    mesh = create_mesh(shape=(8,), axis_names=("data",))
+    e_mesh = InferenceEngine("mlp", dtype="float32",
+                             model_kwargs=dict(input_dim=8, hidden_dim=32, output_dim=4),
+                             batch_buckets=(8, 16), mesh=mesh)
+    e_single = InferenceEngine("mlp", dtype="float32", rng_seed=0,
+                               model_kwargs=dict(input_dim=8, hidden_dim=32, output_dim=4),
+                               batch_buckets=(8, 16))
+    inputs = [[float(i)] * 8 for i in range(10)]
+    np.testing.assert_allclose(
+        np.stack(e_mesh.batch_predict(inputs)),
+        np.stack(e_single.batch_predict(inputs)),
+        rtol=1e-5,
+    )
+    assert e_mesh.stats()["mesh"]["n_devices"] == 8
+
+
+def test_mesh_buckets_rounded_to_data_axis():
+    mesh = create_mesh(shape=(8,), axis_names=("data",))
+    e = InferenceEngine("mlp", dtype="float32",
+                        model_kwargs=dict(input_dim=8, output_dim=4),
+                        batch_buckets=(1, 2, 32), mesh=mesh)
+    assert all(b % 8 == 0 for b in e.buckets)

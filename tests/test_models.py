@@ -1,0 +1,80 @@
+"""Model zoo: registration, init, forward shapes, jit-compilability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    available_models,
+    create_model,
+    _ensure_builtin_models_imported,
+)
+from tpu_engine.ops import nn
+
+_ensure_builtin_models_imported()
+
+
+def test_registry_has_flagship_models():
+    models = available_models()
+    assert "resnet50" in models
+    assert "mlp" in models
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError, match="unknown model"):
+        create_model("does-not-exist")
+
+
+def test_mlp_forward_shape_and_dtype():
+    spec = create_model("mlp", input_dim=8, hidden_dim=32, output_dim=4)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.ones((5, 8), jnp.float32)
+    y = jax.jit(lambda p, x: spec.apply(p, x))(params, x)
+    assert y.shape == (5, 4)
+    assert y.dtype == jnp.float32  # f32 out even with bf16 compute
+
+
+def test_mlp_deterministic():
+    spec = create_model("mlp", input_dim=8, output_dim=4)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 8))
+    y1 = spec.apply(params, x)
+    y2 = spec.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_resnet50_small_forward():
+    # Small image keeps CPU compile/runtime reasonable; architecture (depth,
+    # strides, expansion) is identical to 224.
+    spec = create_model("resnet50", image_size=32, num_classes=10)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    y = jax.jit(lambda p, x: spec.apply(p, x, dtype=jnp.float32))(params, x)
+    assert y.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_resnet50_param_count_matches_architecture():
+    # ~25.5M params at 224/1000 — the standard ResNet-50 budget. Confirms the
+    # stage/width/expansion wiring rather than trusting the forward pass.
+    spec = create_model("resnet50")
+    params = spec.init(jax.random.PRNGKey(0))
+    n = nn.count_params(params)
+    assert 23_000_000 < n < 28_000_000
+    assert spec.input_size == 224 * 224 * 3
+    assert spec.output_size == 1000
+
+
+def test_batchnorm_identity_at_init():
+    p = nn.batchnorm_init(4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 3, 4))
+    np.testing.assert_allclose(np.asarray(nn.batchnorm(p, x)), np.asarray(x), atol=1e-4)
+
+
+def test_layernorm_normalizes():
+    p = nn.layernorm_init(16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 10 + 3
+    y = np.asarray(nn.layernorm(p, x))
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-2)

@@ -108,8 +108,13 @@ class ConsistentHash:
     def get_distribution(self, keys: Sequence[str]) -> Dict[str, int]:
         """Per-node assignment counts over ``keys`` — the test/debug probe the
         reference shipped but never called (``consistent_hash.cpp:61-70``)."""
-        counts: Dict[str, int] = {}
-        for k in keys:
-            n = self.get_node(k)
-            counts[n] = counts.get(n, 0) + 1
-        return counts
+        return compute_distribution(self, keys)
+
+
+def compute_distribution(ring, keys: Sequence[str]) -> Dict[str, int]:
+    """Shared by the Python and native rings (derived logic, not ring state)."""
+    counts: Dict[str, int] = {}
+    for k in keys:
+        n = ring.get_node(k)
+        counts[n] = counts.get(n, 0) + 1
+    return counts

@@ -86,5 +86,10 @@ class LRUCache:
 
         Matches ``LRUCache::getHitRate`` (reference ``lru_cache.h:66-71``).
         """
-        total = self._hits + self._misses
-        return (self._hits / total) if total else 0.0
+        return compute_hit_rate(self._hits, self._misses)
+
+
+def compute_hit_rate(hits: int, misses: int) -> float:
+    """Shared by the Python and native caches."""
+    total = hits + misses
+    return (hits / total) if total else 0.0

@@ -103,13 +103,22 @@ def _try_load() -> Optional[ctypes.CDLL]:
         _load_attempted = True
         path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
         if path is None and os.environ.get("TPU_ENGINE_NO_NATIVE_BUILD") != "1":
+            # Build to a pid-suffixed temp name, then atomically rename: two
+            # processes cold-starting together must not interleave g++ output
+            # into the same file (a corrupt .so would poison all future runs).
+            tmp_name = f"libtpucore.so.tmp.{os.getpid()}"
             try:
                 subprocess.run(
-                    ["bash", os.path.join(_NATIVE_DIR, "build.sh")],
+                    ["bash", os.path.join(_NATIVE_DIR, "build.sh"), tmp_name],
                     check=True, capture_output=True, timeout=120,
                 )
+                os.replace(os.path.join(_NATIVE_DIR, tmp_name), _LIB_CANDIDATES[0])
                 path = _LIB_CANDIDATES[0]
             except Exception:
+                try:
+                    os.unlink(os.path.join(_NATIVE_DIR, tmp_name))
+                except OSError:
+                    pass
                 return None
         if path is None or not os.path.exists(path):
             return None
@@ -192,8 +201,9 @@ class NativeLRUCache:
         return self._lib.tpu_lru_misses(self._h)
 
     def hit_rate(self) -> float:
-        total = self.hits + self.misses
-        return (self.hits / total) if total else 0.0
+        from tpu_engine.core.lru_cache import compute_hit_rate
+
+        return compute_hit_rate(self.hits, self.misses)
 
 
 class NativeConsistentHash:
@@ -245,11 +255,9 @@ class NativeConsistentHash:
         return self._lib.tpu_ring_num_nodes(self._h)
 
     def get_distribution(self, keys) -> dict:
-        counts: dict = {}
-        for k in keys:
-            n = self.get_node(k)
-            counts[n] = counts.get(n, 0) + 1
-        return counts
+        from tpu_engine.core.consistent_hash import compute_distribution
+
+        return compute_distribution(self, keys)
 
 
 class NativeCircuitBreaker:
@@ -298,6 +306,8 @@ class NativeBatchQueue:
     """Native MPMC batch queue; the timed PopBatch wait releases the GIL."""
 
     def __init__(self, max_batch: int, timeout_s: float):
+        if max_batch <= 0:
+            raise ValueError("max_batch must be positive")
         self._lib = _try_load()
         if self._lib is None:
             raise RuntimeError("libtpucore.so is not available")

@@ -1,0 +1,43 @@
+"""Tiny MLP — the fast-path model for tests and latency benchmarks.
+
+Serves the reference benchmark workload (3-float input vectors,
+``/root/reference/benchmark.py:23``) without convolution cost; also the
+default CI model because it compiles in milliseconds on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import ModelSpec, register
+from tpu_engine.ops import nn
+
+
+@register("mlp")
+def make_mlp(input_dim: int = 16, hidden_dim: int = 128, output_dim: int = 16,
+             num_layers: int = 2) -> ModelSpec:
+    dims = [input_dim] + [hidden_dim] * (num_layers - 1) + [output_dim]
+
+    def init(rng):
+        keys = jax.random.split(rng, len(dims) - 1)
+        return {
+            f"layer_{i}": nn.dense_init(keys[i], dims[i], dims[i + 1])
+            for i in range(len(dims) - 1)
+        }
+
+    def apply(params, x, dtype=jnp.bfloat16):
+        h = x
+        for i in range(len(dims) - 1):
+            h = nn.dense(params[f"layer_{i}"], h, dtype=dtype)
+            if i < len(dims) - 2:
+                h = nn.relu(h)
+        return h.astype(jnp.float32)
+
+    return ModelSpec(
+        name="mlp",
+        apply=apply,
+        init=init,
+        input_shape=(input_dim,),
+        output_shape=(output_dim,),
+    )

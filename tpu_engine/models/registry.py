@@ -1,0 +1,69 @@
+"""Model registry: name → factory for the serving zoo.
+
+The reference serves one opaque ONNX graph per worker
+(``/root/reference/src/inference_engine.cpp:31``); here models are JAX
+programs registered by name, selected per worker via config
+(``WorkerConfig.model``). Each factory returns a ``ModelSpec`` — everything
+the engine needs to stage the model to XLA: an ``apply`` function, parameter
+init, and the flat input/output contract that keeps the reference's
+wire format (flat float vectors, pad/truncate) intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    name: str
+    apply: Callable          # (params, batch_input) -> batch_output
+    init: Callable           # (rng) -> params
+    input_shape: Tuple[int, ...]   # per-sample shape the model consumes
+    output_shape: Tuple[int, ...]  # per-sample output shape
+    flatten_io: bool = True  # serve as flat float vectors (wire parity)
+
+    @property
+    def input_size(self) -> int:
+        n = 1
+        for d in self.input_shape:
+            n *= d
+        return n
+
+    @property
+    def output_size(self) -> int:
+        n = 1
+        for d in self.output_shape:
+            n *= d
+        return n
+
+
+_REGISTRY: Dict[str, Callable[..., ModelSpec]] = {}
+
+
+def register(name: str):
+    def deco(factory: Callable[..., ModelSpec]):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def create_model(name: str, **kwargs) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def available_models():
+    return sorted(_REGISTRY)
+
+
+def _ensure_builtin_models_imported():
+    # Import side-effect registration; kept lazy so `tpu_engine.core` users
+    # never pay the JAX import.
+    from tpu_engine.models import mlp, resnet  # noqa: F401
+    try:
+        from tpu_engine.models import bert, gpt2, yolo  # noqa: F401
+    except ImportError:
+        pass

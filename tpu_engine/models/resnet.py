@@ -1,0 +1,95 @@
+"""ResNet-50 v2 (pre-activation) — the flagship serving model.
+
+The reference benchmarks ResNet-50 v2-7 ONNX through ONNX Runtime
+(``/root/reference/CMakeLists.txt``, model asset ``models/resnet50-v2-7.onnx``
+— stripped from the snapshot). Here the same architecture is a JAX program:
+NHWC activations, HWIO kernels, bf16 matmuls/convs with f32 accumulation on
+the MXU, inference-mode batch norm that XLA folds into the convolutions.
+
+Architecture (He et al., "Identity Mappings in Deep Residual Networks"):
+stem 7x7/2 conv + 3x3/2 maxpool, stages of pre-activation bottleneck blocks
+[3, 4, 6, 3] with widths 64/128/256/512 (4x expansion), final BN+ReLU,
+global average pool, dense to 1000 classes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import ModelSpec, register
+from tpu_engine.ops import nn
+
+_STAGES = (3, 4, 6, 3)
+_WIDTHS = (64, 128, 256, 512)
+_EXPANSION = 4
+
+
+def _block_init(key, in_ch: int, mid_ch: int, stride: int):
+    out_ch = mid_ch * _EXPANSION
+    k = jax.random.split(key, 4)
+    params = {
+        "bn1": nn.batchnorm_init(in_ch),
+        "conv1": nn.conv_init(k[0], 1, 1, in_ch, mid_ch),
+        "bn2": nn.batchnorm_init(mid_ch),
+        "conv2": nn.conv_init(k[1], 3, 3, mid_ch, mid_ch),
+        "bn3": nn.batchnorm_init(mid_ch),
+        "conv3": nn.conv_init(k[2], 1, 1, mid_ch, out_ch),
+    }
+    if stride != 1 or in_ch != out_ch:
+        params["proj"] = nn.conv_init(k[3], 1, 1, in_ch, out_ch)
+    return params
+
+
+def _block_apply(params, x, stride: int, dtype):
+    # Pre-activation: BN+ReLU precede each conv; the first pre-activation
+    # also feeds the projection shortcut.
+    pre = nn.relu(nn.batchnorm(params["bn1"], x))
+    shortcut = x
+    if "proj" in params:
+        shortcut = nn.conv2d(params["proj"], pre, stride=stride, dtype=dtype)
+    h = nn.conv2d(params["conv1"], pre, stride=1, dtype=dtype)
+    h = nn.relu(nn.batchnorm(params["bn2"], h))
+    h = nn.conv2d(params["conv2"], h, stride=stride, dtype=dtype)
+    h = nn.relu(nn.batchnorm(params["bn3"], h))
+    h = nn.conv2d(params["conv3"], h, stride=1, dtype=dtype)
+    return h + shortcut
+
+
+@register("resnet50")
+def make_resnet50(image_size: int = 224, num_classes: int = 1000) -> ModelSpec:
+    def init(rng):
+        keys = jax.random.split(rng, 2 + sum(_STAGES))
+        params = {"stem": nn.conv_init(keys[0], 7, 7, 3, 64)}
+        in_ch = 64
+        ki = 1
+        for s, (n_blocks, width) in enumerate(zip(_STAGES, _WIDTHS)):
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                params[f"stage{s}_block{b}"] = _block_init(keys[ki], in_ch, width, stride)
+                in_ch = width * _EXPANSION
+                ki += 1
+        params["final_bn"] = nn.batchnorm_init(in_ch)
+        params["head"] = nn.dense_init(keys[ki], in_ch, num_classes)
+        return params
+
+    def apply(params, x, dtype=jnp.bfloat16):
+        # x: (B, H, W, 3) float32 in [0, 1]-ish range; dtype is the MXU
+        # compute dtype (bf16 by default, f32 accumulation inside the convs).
+        h = nn.conv2d(params["stem"], x, stride=2, dtype=dtype)
+        h = nn.max_pool(h, 3, 2)
+        for s, (n_blocks, _) in enumerate(zip(_STAGES, _WIDTHS)):
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                h = _block_apply(params[f"stage{s}_block{b}"], h, stride, dtype)
+        h = nn.relu(nn.batchnorm(params["final_bn"], h))
+        h = nn.global_avg_pool(h)
+        return nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+
+    return ModelSpec(
+        name="resnet50",
+        apply=apply,
+        init=init,
+        input_shape=(image_size, image_size, 3),
+        output_shape=(num_classes,),
+    )

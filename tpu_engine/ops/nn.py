@@ -1,0 +1,132 @@
+"""Functional NN building blocks (pure JAX, param-pytree style).
+
+The model zoo (``tpu_engine.models``) is built on these instead of a heavy
+framework layer: every op is a pure function over explicit parameter dicts,
+which keeps pytrees transparent for ``jax.sharding`` annotation (tensor
+parallelism shards these dicts directly) and lets XLA fuse elementwise work
+into the surrounding matmuls/convs.
+
+Conventions: NHWC activations, HWIO conv kernels (TPU-native layouts),
+bfloat16-friendly — params are stored float32 and cast at apply time so the
+MXU runs bf16 while accumulation stays f32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def he_normal(key, shape, fan_in, dtype=jnp.float32):
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * std
+
+
+# -- dense ------------------------------------------------------------------
+
+def dense_init(key, in_dim: int, out_dim: int):
+    kw, _ = jax.random.split(key)
+    return {
+        "kernel": he_normal(kw, (in_dim, out_dim), in_dim),
+        "bias": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def dense(params, x, dtype=None):
+    kernel = params["kernel"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+    # f32 accumulation on the MXU regardless of input dtype.
+    y = jax.lax.dot_general(
+        x, kernel, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y + params["bias"]
+
+
+# -- conv -------------------------------------------------------------------
+
+def conv_init(key, kh: int, kw: int, in_ch: int, out_ch: int):
+    fan_in = kh * kw * in_ch
+    return {"kernel": he_normal(key, (kh, kw, in_ch, out_ch), fan_in)}
+
+
+def conv2d(params, x, stride: int = 1, padding="SAME", dtype=None):
+    kernel = params["kernel"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# -- norm -------------------------------------------------------------------
+
+def batchnorm_init(ch: int):
+    return {
+        "scale": jnp.ones((ch,), jnp.float32),
+        "bias": jnp.zeros((ch,), jnp.float32),
+        "mean": jnp.zeros((ch,), jnp.float32),
+        "var": jnp.ones((ch,), jnp.float32),
+    }
+
+
+def batchnorm(params, x, eps: float = 1e-5):
+    """Inference-mode batch norm using stored statistics. XLA folds the
+    per-channel affine into the adjacent conv."""
+    inv = jax.lax.rsqrt(params["var"] + eps) * params["scale"]
+    return x * inv + (params["bias"] - params["mean"] * inv)
+
+
+def layernorm_init(dim: int):
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layernorm(params, x, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * params["scale"] + params["bias"]
+
+
+# -- pooling ----------------------------------------------------------------
+
+def max_pool(x, window: int, stride: int, padding="SAME"):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, window, window, 1), (1, stride, stride, 1), padding,
+    )
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+# -- activations / misc -----------------------------------------------------
+
+relu = jax.nn.relu
+gelu = jax.nn.gelu
+silu = jax.nn.silu
+
+
+def embedding_init(key, vocab: int, dim: int):
+    return {"table": jax.random.normal(key, (vocab, dim), jnp.float32) * 0.02}
+
+
+def embedding(params, ids):
+    return params["table"][ids]
+
+
+def count_params(params) -> int:
+    return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))

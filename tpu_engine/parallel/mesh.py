@@ -1,0 +1,55 @@
+"""Device-mesh construction helpers.
+
+The reference scales by launching N replica worker processes and fanning
+HTTP requests across them (``/root/reference/README.md:101-122``). The
+TPU-native equivalent is a single process owning all local chips through a
+``jax.sharding.Mesh``; "workers" are dispatch lanes over mesh slices and the
+scatter/gather rides ICI via XLA collectives (SURVEY.md §2 checklist).
+
+Axis conventions used across the framework:
+  - ``data``  — batch/data parallelism (also the serving scatter axis)
+  - ``model`` — tensor parallelism (shards weight matrices)
+  - ``seq``   — sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all local devices).
+
+    ``shape`` defaults to all devices on the first axis. Axis sizes must
+    multiply to the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, axis: str = "data", ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def single_device_mesh() -> Mesh:
+    """One-device mesh — lets every code path be mesh-driven even on 1 chip."""
+    return create_mesh(shape=(1,), devices=jax.devices()[:1])
