@@ -106,3 +106,16 @@ def test_mesh_buckets_rounded_to_data_axis():
                         model_kwargs=dict(input_dim=8, output_dim=4),
                         batch_buckets=(1, 2, 32), mesh=mesh)
     assert all(b % 8 == 0 for b in e.buckets)
+
+
+def test_device_pinned_engine_runs_on_that_device():
+    import jax
+
+    dev = jax.devices()[3]
+    e = InferenceEngine("mlp", dtype="float32", device=dev,
+                        model_kwargs=dict(input_dim=8, hidden_dim=32, output_dim=4),
+                        batch_buckets=(1, 2))
+    out = e.predict([1.0] * 8)
+    assert out.shape == (4,)
+    assert all(d == dev for d in jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda p: next(iter(p.devices())), e.params)))

@@ -1,0 +1,274 @@
+"""Serving layer: worker wire schemas, gateway routing/failover, HTTP e2e.
+
+The HTTP tests drive the exact wire format the reference's benchmark.py and
+diagnostics.sh use (README.md:134-202), on the CPU backend.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_engine.serving.clients import WorkerError, parse_worker_url
+from tpu_engine.serving.gateway import Gateway, GatewayError
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+
+def make_worker(node_id="worker_1", **kw):
+    cfg = WorkerConfig(node_id=node_id, model="mlp", dtype="float32",
+                       batch_buckets=(1, 2, 4, 8), **kw)
+    return WorkerNode(cfg)
+
+
+@pytest.fixture(scope="module")
+def worker():
+    w = make_worker()
+    yield w
+    w.stop()
+
+
+# -- worker ------------------------------------------------------------------
+
+def test_infer_response_schema(worker):
+    resp = worker.handle_infer({"request_id": "req_1", "input_data": [1.0, 2.0, 3.0]})
+    assert set(resp) == {"request_id", "output_data", "node_id", "cached",
+                        "inference_time_us"}
+    assert resp["request_id"] == "req_1"
+    assert resp["node_id"] == "worker_1"
+    assert resp["cached"] is False
+    assert isinstance(resp["output_data"], list)
+    assert all(isinstance(v, float) for v in resp["output_data"])
+    assert resp["inference_time_us"] > 0
+
+
+def test_cache_hit_second_request(worker):
+    payload = {"request_id": "req_a", "input_data": [9.0, 9.0]}
+    first = worker.handle_infer(payload)
+    second = worker.handle_infer({"request_id": "req_b", "input_data": [9.0, 9.0]})
+    assert first["cached"] is False
+    assert second["cached"] is True
+    assert second["inference_time_us"] == 50  # reference worker_node.cpp:65
+    assert second["output_data"] == first["output_data"]
+
+
+def test_health_schema(worker):
+    worker.handle_infer({"request_id": "h", "input_data": [5.0]})
+    h = worker.get_health()
+    assert set(h) == {"healthy", "node_id", "total_requests", "cache_hits",
+                      "cache_size", "cache_hit_rate", "batch_processor"}
+    assert set(h["batch_processor"]) == {"total_batches", "avg_batch_size",
+                                         "timeout_batches", "full_batches"}
+    assert h["healthy"] is True
+    assert h["total_requests"] >= 1
+
+
+def test_missing_fields_raise(worker):
+    with pytest.raises(KeyError):
+        worker.handle_infer({"input_data": [1.0]})
+    with pytest.raises(KeyError):
+        worker.handle_infer({"request_id": "x"})
+
+
+# -- url parsing --------------------------------------------------------------
+
+def test_parse_worker_url_variants():
+    assert parse_worker_url("localhost:8001") == ("localhost", 8001)
+    assert parse_worker_url("http://h:99/path") == ("h", 99)
+    # Reference parseUrl default port is 8080 (gateway.cpp:139,147).
+    assert parse_worker_url("justhost") == ("justhost", 8080)
+
+
+# -- gateway (local lanes) -----------------------------------------------------
+
+class FlakyWorker:
+    """Worker stub whose failures are script-controlled."""
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.fail = False
+        self.calls = 0
+
+    def handle_infer(self, payload):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("device exploded")
+        return {"request_id": payload["request_id"], "output_data": [1.0],
+                "node_id": self.node_id, "cached": False, "inference_time_us": 10}
+
+    def get_health(self):
+        return {"healthy": True, "node_id": self.node_id}
+
+
+def make_flaky_gateway(n=3, breaker_timeout=0.3):
+    cfg = GatewayConfig(failure_threshold=3, success_threshold=1,
+                        breaker_timeout_s=breaker_timeout)
+    workers = [FlakyWorker(f"w{i}") for i in range(1, n + 1)]
+    return Gateway(workers, cfg), workers
+
+
+def test_gateway_routes_deterministically():
+    gw, _ = make_flaky_gateway()
+    n1 = gw.route_request({"request_id": "req_5", "input_data": [1.0]})["node_id"]
+    for _ in range(5):
+        assert gw.route_request({"request_id": "req_5", "input_data": [1.0]})["node_id"] == n1
+
+
+def test_gateway_failover_and_breaker_stats():
+    gw, workers = make_flaky_gateway()
+    target = gw.route_request({"request_id": "req_9", "input_data": [1.0]})["node_id"]
+    victim = next(w for w in workers if w.node_id == target)
+    victim.fail = True
+    resp = gw.route_request({"request_id": "req_9", "input_data": [1.0]})
+    assert resp["node_id"] != target  # failed over in ring order
+    stats = gw.get_stats()
+    assert stats["total_workers"] == 3
+    entry = next(e for e in stats["circuit_breakers"] if e["node"] == target)
+    assert entry["failures"] >= 1
+    assert set(entry) == {"node", "state", "failures", "successes"}
+
+
+def test_gateway_all_workers_down():
+    gw, workers = make_flaky_gateway()
+    for w in workers:
+        w.fail = True
+    with pytest.raises(GatewayError):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+
+
+def test_gateway_breaker_opens_and_recovers():
+    gw, workers = make_flaky_gateway()
+    target = gw.route_request({"request_id": "req_2", "input_data": [1.0]})["node_id"]
+    victim = next(w for w in workers if w.node_id == target)
+    victim.fail = True
+    for _ in range(4):
+        gw.route_request({"request_id": "req_2", "input_data": [1.0]})
+    entry = next(e for e in gw.get_stats()["circuit_breakers"] if e["node"] == target)
+    assert entry["state"] == "OPEN"
+    calls_while_open = victim.calls
+    gw.route_request({"request_id": "req_2", "input_data": [1.0]})
+    assert victim.calls == calls_while_open  # breaker short-circuits the dead lane
+    victim.fail = False
+    time.sleep(0.35)
+    gw.route_request({"request_id": "req_2", "input_data": [1.0]})  # HALF_OPEN probe
+    entry = next(e for e in gw.get_stats()["circuit_breakers"] if e["node"] == target)
+    assert entry["state"] == "CLOSED"
+
+
+def test_gateway_elastic_membership():
+    gw, workers = make_flaky_gateway()
+    gw.remove_worker("w2")
+    assert "w2" not in gw.worker_names()
+    for i in range(20):
+        assert gw.route_request({"request_id": f"k{i}", "input_data": [1.0]})["node_id"] != "w2"
+
+
+# -- HTTP end-to-end -----------------------------------------------------------
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def http_stack():
+    """Two HTTP workers + HTTP gateway — the reference's process topology."""
+    from tpu_engine.serving.app import serve_gateway, serve_worker
+
+    w1, s1 = serve_worker(WorkerConfig(port=0, node_id="worker_1", model="mlp",
+                                       dtype="float32", batch_buckets=(1, 2, 4, 8)))
+    w2, s2 = serve_worker(WorkerConfig(port=0, node_id="worker_2", model="mlp",
+                                       dtype="float32", batch_buckets=(1, 2, 4, 8)))
+    gw, gs = serve_gateway([f"localhost:{s1.port}", f"localhost:{s2.port}"],
+                           GatewayConfig(port=0))
+    yield {"workers": [(w1, s1), (w2, s2)], "gateway": (gw, gs)}
+    gs.stop()
+    for w, s in [(w1, s1), (w2, s2)]:
+        s.stop()
+        w.stop()
+
+
+def test_http_end_to_end_infer(http_stack):
+    gs = http_stack["gateway"][1]
+    status, resp = _post(f"http://localhost:{gs.port}/infer",
+                         {"request_id": "req_42", "input_data": [4.0, 2.0, 0.0]})
+    assert status == 200
+    assert resp["request_id"] == "req_42"
+    assert resp["node_id"] in ("worker_1", "worker_2")
+    assert len(resp["output_data"]) == 16  # mlp default output_dim
+
+
+def test_http_worker_direct_and_health(http_stack):
+    (w1, s1) = http_stack["workers"][0]
+    status, resp = _post(f"http://localhost:{s1.port}/infer",
+                         {"request_id": "d", "input_data": [1.0]})
+    assert status == 200 and resp["node_id"] == "worker_1"
+    status, h = _get(f"http://localhost:{s1.port}/health")
+    assert status == 200 and h["healthy"] is True
+
+
+def test_http_gateway_stats(http_stack):
+    gs = http_stack["gateway"][1]
+    status, stats = _get(f"http://localhost:{gs.port}/stats")
+    assert status == 200
+    assert stats["total_workers"] == 2
+    assert all(e["state"] == "CLOSED" for e in stats["circuit_breakers"])
+
+
+def test_http_malformed_request_returns_500(http_stack):
+    gs = http_stack["gateway"][1]
+    try:
+        status, resp = _post(f"http://localhost:{gs.port}/infer", {"bogus": True})
+        raise AssertionError(f"expected 500, got {status} {resp}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert "error" in json.loads(e.read())
+
+
+def test_http_unknown_route_404(http_stack):
+    gs = http_stack["gateway"][1]
+    try:
+        _get(f"http://localhost:{gs.port}/nope")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_http_worker_kill_failover(http_stack):
+    """Kill one worker's HTTP server; the gateway must fail over."""
+    gs = http_stack["gateway"][1]
+    (w2, s2) = http_stack["workers"][1]
+    s2.stop()
+    try:
+        served_by_w1 = 0
+        for i in range(10):
+            # On the 1-core CI box a loaded worker can exceed the 5 s client
+            # timeout once; tolerate a transient 500 and retry.
+            try:
+                status, resp = _post(f"http://localhost:{gs.port}/infer",
+                                     {"request_id": f"kill_{i}", "input_data": [1.0]})
+            except urllib.error.HTTPError:
+                time.sleep(0.2)
+                continue
+            assert status == 200
+            assert resp["node_id"] == "worker_1"
+            served_by_w1 += 1
+        assert served_by_w1 >= 5  # failover actually happened
+    finally:
+        # Restart worker_2's server on the same port for later tests.
+        from tpu_engine.serving.http import JsonHttpServer
+
+        new_s = JsonHttpServer(s2.port)
+        new_s.route("POST", "/infer", lambda body: (200, w2.handle_infer(body)))
+        new_s.route("GET", "/health", lambda _b: (200, w2.get_health()))
+        new_s.start()
+        http_stack["workers"][1] = (w2, new_s)

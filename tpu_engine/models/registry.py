@@ -22,7 +22,6 @@ class ModelSpec:
     init: Callable           # (rng) -> params
     input_shape: Tuple[int, ...]   # per-sample shape the model consumes
     output_shape: Tuple[int, ...]  # per-sample output shape
-    flatten_io: bool = True  # serve as flat float vectors (wire parity)
 
     @property
     def input_size(self) -> int:
@@ -61,9 +60,14 @@ def available_models():
 
 def _ensure_builtin_models_imported():
     # Import side-effect registration; kept lazy so `tpu_engine.core` users
-    # never pay the JAX import.
+    # never pay the JAX import. Optional families import only when their
+    # module file exists — a present-but-broken module must raise, not be
+    # silently dropped from the registry.
+    import importlib
+    import importlib.util
+
     from tpu_engine.models import mlp, resnet  # noqa: F401
-    try:
-        from tpu_engine.models import bert, gpt2, yolo  # noqa: F401
-    except ImportError:
-        pass
+
+    for optional in ("bert", "gpt2", "yolo"):
+        if importlib.util.find_spec(f"tpu_engine.models.{optional}") is not None:
+            importlib.import_module(f"tpu_engine.models.{optional}")

@@ -49,6 +49,7 @@ class InferenceEngine:
         batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
         mesh=None,
         data_axis: str = "data",
+        device=None,
         model_kwargs: Optional[dict] = None,
     ):
         if isinstance(model, str):
@@ -62,12 +63,18 @@ class InferenceEngine:
         if mesh is not None:
             self._mesh_data_size = mesh.shape[data_axis]
         self._buckets = self._normalize_buckets(batch_buckets)
+        self._device = device  # pin to one chip (serving lane); exclusive with mesh
+        if mesh is not None and device is not None:
+            raise ValueError("pass either mesh or device, not both")
         self.params = params if params is not None else model.init(jax.random.PRNGKey(rng_seed))
         if mesh is not None:
             self.params = jax.device_put(self.params, replicated(mesh))
+        elif device is not None:
+            self.params = jax.device_put(self.params, device)
         self._executables: Dict[int, jax.stages.Compiled] = {}
         self._compile_lock = threading.Lock()
         self._compile_times: Dict[int, float] = {}
+        self._stats_lock = threading.Lock()
         self._execute_count = 0
 
     # -- shape contract (reference inference_engine.cpp:211-217) -------------
@@ -130,6 +137,10 @@ class InferenceEngine:
             x0 = jnp.zeros(shape, jnp.float32)
             if self._mesh is not None:
                 x0 = jax.device_put(x0, data_sharding(self._mesh, self._data_axis, len(shape)))
+            elif self._device is not None:
+                # Lower against the pinned chip so the AOT executable's
+                # placement matches what _stage_batch will feed it.
+                x0 = jax.device_put(x0, self._device)
             exe = jitted.lower(self.params, x0).compile()
             self._executables[bucket] = exe
             self._compile_times[bucket] = time.monotonic() - start
@@ -161,6 +172,8 @@ class InferenceEngine:
         x = buf.reshape((bucket,) + tuple(self.spec.input_shape))
         if self._mesh is not None:
             return jax.device_put(x, data_sharding(self._mesh, self._data_axis, x.ndim))
+        if self._device is not None:
+            return jax.device_put(x, self._device)
         return jnp.asarray(x)
 
     # -- inference -------------------------------------------------------------
@@ -191,7 +204,8 @@ class InferenceEngine:
             exe = self._compiled(bucket)
             x = self._stage_batch(chunk, bucket)
             pending.append((len(chunk), exe(self.params, x)))
-            self._execute_count += 1
+            with self._stats_lock:
+                self._execute_count += 1
         out: List[np.ndarray] = []
         for n_real, y in pending:
             y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)

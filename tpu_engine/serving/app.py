@@ -1,0 +1,126 @@
+"""Process composition: wire workers/gateway to HTTP servers.
+
+Three launchable shapes:
+
+- ``serve_worker`` — one worker lane behind HTTP (reference
+  ``worker_node <port> <node_id> [model]``, ``worker_node.cpp:145-204``);
+- ``serve_gateway`` — routing gateway over remote HTTP workers (reference
+  ``gateway <worker:port> ...``, ``gateway.cpp:161-200``);
+- ``serve_combined`` — the TPU-native shape: one process, one HTTP front
+  door, N in-process lanes pinned round-robin onto the local chips
+  (SURVEY.md §7 design stance). No per-request HTTP between gateway and
+  lanes; the hash ring selects a lane directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tpu_engine.serving.gateway import Gateway
+from tpu_engine.serving.http import JsonHttpServer
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+
+def model_from_path(path_or_name: str) -> str:
+    """Map a reference-style model path (e.g. models/resnet50-v2-7.onnx) to a
+    registry name so reference launch lines work unchanged."""
+    from tpu_engine.models.registry import available_models, _ensure_builtin_models_imported
+
+    _ensure_builtin_models_imported()
+    names = available_models()
+    if path_or_name in names:
+        return path_or_name
+    base = path_or_name.rsplit("/", 1)[-1].lower()
+    for name in names:
+        if name in base.replace("-", "").replace("_", ""):
+            return name
+    for name in names:  # resnet50-v2-7.onnx → resnet50
+        if base.startswith(name[: max(4, len(name) - 2)]):
+            return name
+    raise ValueError(f"cannot map '{path_or_name}' to a registered model {names}")
+
+
+def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerNode, JsonHttpServer]:
+    worker = WorkerNode(config)
+    server = JsonHttpServer(config.port)
+    server.route("POST", "/infer", lambda body: (200, worker.handle_infer(body)))
+    server.route("GET", "/health", lambda _body: (200, worker.get_health()))
+    _print_worker_banner(worker, config)
+    server.start(background=background)
+    return worker, server
+
+
+def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None,
+                  background: bool = True) -> Tuple[Gateway, JsonHttpServer]:
+    config = config or GatewayConfig()
+    gateway = Gateway(worker_urls, config)
+    server = JsonHttpServer(config.port)
+    server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
+    print(f"Gateway listening on port {config.port}")
+    print(f"Workers: {len(worker_urls)}")
+    print("Circuit breakers enabled")
+    print("Ready!")
+    server.start(background=background)
+    return gateway, server
+
+
+def serve_combined(
+    model: str = "resnet50",
+    lanes: int = 0,
+    port: int = 8000,
+    worker_config: Optional[WorkerConfig] = None,
+    gateway_config: Optional[GatewayConfig] = None,
+    background: bool = True,
+):
+    """One process: HTTP front door + in-process lanes over local devices.
+
+    ``lanes=0`` means one lane per local device. Lanes share nothing but the
+    host process: each has its own cache, batcher and engine pinned to a chip
+    (round-robin when lanes > devices).
+    """
+    import jax
+
+    devices = jax.devices()
+    n_lanes = lanes or len(devices)
+    gateway_config = gateway_config or GatewayConfig(port=port)
+    workers = []
+    for i in range(n_lanes):
+        cfg = worker_config or WorkerConfig()
+        lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": f"worker_{i+1}", "model": model})
+        from tpu_engine.runtime.engine import InferenceEngine
+
+        engine = InferenceEngine(
+            lane_cfg.model,
+            dtype=lane_cfg.dtype,
+            batch_buckets=lane_cfg.batch_buckets,
+            device=devices[i % len(devices)],
+        )
+        workers.append(WorkerNode(lane_cfg, engine=engine))
+    gateway = Gateway(workers, gateway_config)
+    server = JsonHttpServer(port)
+    server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
+    # Lane health is addressable through the gateway process in combined mode.
+    for w in workers:
+        server.route("GET", f"/health/{w.node_id}", lambda _b, w=w: (200, w.get_health()))
+    server.route("GET", "/health", lambda _b: (200, workers[0].get_health()))
+    print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} device(s), port {port}")
+    server.start(background=background)
+    return gateway, workers, server
+
+
+def _print_worker_banner(worker: WorkerNode, config: WorkerConfig) -> None:
+    # Startup banner parity (reference worker_node.cpp:192-201).
+    bar = "━" * 44
+    print(bar)
+    print(f"Worker Node: {config.node_id}")
+    print(bar)
+    print(f"   Port:              {config.port}")
+    print(f"   Model:             {worker.engine.spec.name}")
+    print(f"   Cache Capacity:    {config.cache_capacity} entries")
+    print(f"   Batch Size:        {config.max_batch_size} requests")
+    print(f"   Batch Timeout:     {int(config.batch_timeout_ms)}ms")
+    print(bar)
+    print("Ready to accept requests!")

@@ -1,0 +1,95 @@
+"""CLI entry points, argv-compatible with the reference binaries.
+
+Reference launch lines work verbatim with ``python -m tpu_engine.serving.cli``
+(or the ``bin/worker_node`` / ``bin/gateway`` wrappers):
+
+  worker_node <port> <node_id> [model_path]     (worker_node.cpp:145-168;
+                                                 $MODEL_PATH honored)
+  gateway <worker1:port> [worker2:port] ...     (gateway.cpp:161-171)
+
+Plus the TPU-native combined mode the reference doesn't have:
+
+  serve [--model resnet50] [--lanes N] [--port 8000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def _run_forever():
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+
+    # TPU_ENGINE_PLATFORM=cpu runs serving on the host backend (e.g. several
+    # worker processes on one machine, reference-style, when the TPU chip is
+    # single-tenant). The axon plugin ignores JAX_PLATFORMS, hence the knob.
+    platform = os.environ.get("TPU_ENGINE_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    if cmd in ("worker", "worker_node"):
+        from tpu_engine.serving.app import model_from_path, serve_worker
+        from tpu_engine.utils.config import WorkerConfig
+
+        if not rest:
+            print("Usage: worker_node <port> <node_id> [model_path]")
+            return 1
+        port = int(rest[0])
+        node_id = rest[1] if len(rest) > 1 else f"worker_{port}"
+        model_arg = rest[2] if len(rest) > 2 else os.environ.get("MODEL_PATH", "resnet50")
+        cfg = WorkerConfig(port=port, node_id=node_id, model=model_from_path(model_arg))
+        serve_worker(cfg, background=True)
+        _run_forever()
+        return 0
+
+    if cmd == "gateway":
+        from tpu_engine.serving.app import serve_gateway
+        from tpu_engine.utils.config import GatewayConfig
+
+        if not rest:
+            print("Usage: gateway <worker1_host:port> [worker2_host:port] ...")
+            return 1
+        parser = argparse.ArgumentParser(prog="gateway")
+        parser.add_argument("workers", nargs="+")
+        parser.add_argument("--port", type=int, default=8000)
+        args = parser.parse_args(rest)
+        serve_gateway(args.workers, GatewayConfig(port=args.port), background=True)
+        _run_forever()
+        return 0
+
+    if cmd == "serve":
+        from tpu_engine.serving.app import serve_combined
+
+        parser = argparse.ArgumentParser(prog="serve")
+        parser.add_argument("--model", default="resnet50")
+        parser.add_argument("--lanes", type=int, default=0)
+        parser.add_argument("--port", type=int, default=8000)
+        args = parser.parse_args(rest)
+        serve_combined(model=args.model, lanes=args.lanes, port=args.port)
+        _run_forever()
+        return 0
+
+    print(f"unknown command '{cmd}' (expected worker_node | gateway | serve)")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Worker clients: how the gateway reaches a serving lane.
+
+The reference gateway holds one persistent ``httplib::Client`` per worker
+(``/root/reference/src/gateway.cpp:29-33``). Here the dispatch target is
+pluggable:
+
+- ``LocalWorkerClient`` — the TPU-native shape: the lane lives in the same
+  process (one process owns all chips; "routing" selects a lane, no HTTP
+  hop, no JSON re-encode).
+- ``HttpWorkerClient`` — the reference deployment shape: POST /infer over
+  a persistent connection pool with the reference's 5 s timeouts, enabling
+  multi-host (DCN) topologies and wire-compat testing.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import socket
+import threading
+from typing import Optional, Tuple
+
+
+class WorkerError(Exception):
+    """Dispatch failure: connection error, timeout, non-200, device error."""
+
+
+class LocalWorkerClient:
+    def __init__(self, worker):
+        self.worker = worker
+
+    def infer(self, payload: dict) -> dict:
+        try:
+            return self.worker.handle_infer(payload)
+        except (KeyError, TypeError, ValueError):
+            # Malformed request — the worker would answer 500 over HTTP
+            # (reference worker_node.cpp:180-186); treat equally here.
+            raise
+        except Exception as exc:  # device/runtime failure → breaker signal
+            raise WorkerError(str(exc)) from exc
+
+    def health(self) -> dict:
+        return self.worker.get_health()
+
+
+def parse_worker_url(url: str, default_port: int = 8080) -> Tuple[str, int]:
+    """'host', 'host:port', or 'http://host:port' → (host, port). Default
+    port 8080 mirrors the reference's parseUrl (``gateway.cpp:139,147``)."""
+    u = url.strip()
+    if "://" in u:
+        u = u.split("://", 1)[1]
+    u = u.split("/", 1)[0]
+    if ":" in u:
+        host, port_s = u.rsplit(":", 1)
+        return host, int(port_s)
+    return u, default_port
+
+
+class HttpWorkerClient:
+    """Thread-safe persistent-connection pool to one worker."""
+
+    def __init__(self, url: str, timeout_s: float = 5.0, default_port: int = 8080,
+                 pool_size: int = 64):
+        self.host, self.port = parse_worker_url(url, default_port)
+        self.url = f"{self.host}:{self.port}"
+        self._timeout = timeout_s
+        self._pool: "queue.LifoQueue[Optional[http.client.HTTPConnection]]" = queue.LifoQueue()
+        for _ in range(pool_size):
+            self._pool.put(None)  # lazily created
+
+    def _acquire(self) -> http.client.HTTPConnection:
+        try:
+            conn = self._pool.get(timeout=self._timeout)
+        except queue.Empty:
+            raise WorkerError(f"connection pool to {self.url} exhausted")
+        if conn is None:
+            try:
+                conn = http.client.HTTPConnection(self.host, self.port, timeout=self._timeout)
+                conn.connect()
+                conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except Exception as exc:
+                # Return the slot before surfacing the failure, else the pool
+                # leaks one slot per dead-worker connect attempt.
+                self._pool.put(None)
+                raise WorkerError(f"worker {self.url}: {exc}") from exc
+        return conn
+
+    def _release(self, conn: Optional[http.client.HTTPConnection]) -> None:
+        self._pool.put(conn)
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        conn = self._acquire()
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise WorkerError(f"worker {self.url} returned {resp.status}")
+            out = json.loads(data)
+            self._release(conn)
+            return out
+        except WorkerError:
+            conn.close()
+            self._release(None)
+            raise
+        except Exception as exc:
+            conn.close()
+            self._release(None)
+            raise WorkerError(f"worker {self.url}: {exc}") from exc
+
+    def infer(self, payload: dict) -> dict:
+        return self._request("POST", "/infer", payload)
+
+    def health(self) -> dict:
+        return self._request("GET", "/health")

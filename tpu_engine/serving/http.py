@@ -1,0 +1,106 @@
+"""Minimal threaded JSON-over-HTTP server for the serving endpoints.
+
+Plays the role of cpp-httplib in the reference (vendored at
+``/root/reference/external/cpp-httplib``): POST/GET JSON routes with
+keep-alive. Python stdlib only — ``ThreadingHTTPServer`` with HTTP/1.1
+persistent connections; handlers return ``(status, dict)`` and errors map
+to 500 ``{"error": ...}`` exactly like the reference handlers
+(``worker_node.cpp:174-186``, ``gateway.cpp:176-188``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+Handler = Callable[[Optional[dict]], Tuple[int, dict]]
+
+
+class JsonHttpServer:
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._routes: Dict[Tuple[str, str], Handler] = {}
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, path: str, handler: Handler) -> None:
+        self._routes[(method.upper(), path)] = handler
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _make_handler(self):
+        routes = self._routes
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # On the handler (StreamRequestHandler), not the server: without
+            # TCP_NODELAY the two-write response (headers, body) stalls ~40 ms
+            # behind Nagle + the peer's delayed ACK on keep-alive connections.
+            disable_nagle_algorithm = True
+
+            def log_message(self, *args):  # silence per-request stderr noise
+                pass
+
+            def _respond(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, method: str) -> None:
+                handler = routes.get((method, self.path.split("?", 1)[0]))
+                if handler is None:
+                    self._respond(404, {"error": f"no route {method} {self.path}"})
+                    return
+                try:
+                    body = None
+                    if method == "POST":
+                        length = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(length) if length else b"{}"
+                        body = json.loads(raw)
+                    status, payload = handler(body)
+                    self._respond(status, payload)
+                except Exception as exc:  # reference: any handler error → 500
+                    try:
+                        self._respond(500, {"error": str(exc)})
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+        return _Handler
+
+    def start(self, background: bool = True) -> None:
+        # socketserver's default listen backlog is 5; benchmark clients open a
+        # fresh connection per request at 50+ threads, so SYNs get dropped and
+        # retransmitted (1 s tail spikes) without a real backlog.
+        ThreadingHTTPServer.request_queue_size = 1024
+        self._server = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self._server.daemon_threads = True
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, name=f"http-{self.port}", daemon=True
+            )
+            self._thread.start()
+        else:
+            self._server.serve_forever()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,150 @@
+"""WorkerNode: a serving lane — LRU result cache + dynamic batcher + engine.
+
+Capability parity with the reference worker
+(``/root/reference/src/worker_node.cpp``): ``handle_infer`` is cache-first
+(``:50-83``), misses go through the dynamic batcher into batched execution,
+and ``get_health`` exposes the exact JSON schema the reference documents
+(``README.md:157-202``) and its tooling parses (``benchmark.py:148-178``,
+``diagnostics.sh:39-56``).
+
+TPU-native differences:
+- the engine executes on a TPU chip (or mesh slice) through the
+  shape-bucketed XLA executable cache instead of ONNX Runtime;
+- per-request inference time is batch_duration / batch_size like the
+  reference (``worker_node.cpp:123``), measured around the XLA dispatch;
+- the result cache can be the native C++ LRU (byte-blob keys) when
+  libtpucore.so is available.
+
+A worker lane is addressable either over HTTP (reference deployment shape)
+or in-process by the gateway (TPU-native shape: one process, lanes = chips).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tpu_engine.core.lru_cache import LRUCache
+from tpu_engine.runtime.batch_processor import BatchProcessor
+from tpu_engine.utils.config import WorkerConfig
+
+
+@dataclass
+class _BatchItem:
+    request_id: str
+    input_data: Sequence[float]
+
+
+@dataclass
+class _BatchResult:
+    output_data: np.ndarray
+    inference_time_us: int
+
+
+def _make_cache(capacity: int):
+    try:
+        from tpu_engine.core import native
+
+        if native.available():
+            return native.NativeLRUCache(capacity)
+    except Exception:
+        pass
+    return LRUCache(capacity)
+
+
+class WorkerNode:
+    def __init__(self, config: Optional[WorkerConfig] = None, engine=None, **overrides):
+        self.config = config or WorkerConfig.from_env(**overrides)
+        self.node_id = self.config.node_id
+        if engine is None:
+            from tpu_engine.runtime.engine import InferenceEngine
+
+            engine = InferenceEngine(
+                self.config.model,
+                dtype=self.config.dtype,
+                batch_buckets=self.config.batch_buckets,
+            )
+        self.engine = engine
+        self.cache = _make_cache(self.config.cache_capacity)
+        self.batch_processor: BatchProcessor[_BatchItem, _BatchResult] = BatchProcessor(
+            self.config.max_batch_size,
+            self.config.batch_timeout_ms,
+            self._process_batch,
+            linger_ms=self.config.batch_linger_ms,
+            name=f"{self.node_id}-batcher",
+        )
+        self.batch_processor.start()
+        # Worker-level counters, distinct from the LRU's own accounting
+        # (reference worker_node.cpp:141-142).
+        self._total_requests = 0
+        self._cache_hits = 0
+        self._counter_lock = threading.Lock()
+
+    # -- request path ---------------------------------------------------------
+
+    @staticmethod
+    def _cache_key(input_data) -> bytes:
+        return np.asarray(input_data, dtype=np.float32).tobytes()
+
+    def handle_infer(self, request: dict) -> dict:
+        """Serve one /infer payload; wire schema identical to the reference
+        (``worker_node.cpp:50-83``)."""
+        with self._counter_lock:
+            self._total_requests += 1
+        request_id = request["request_id"]
+        input_data = request["input_data"]
+
+        key = self._cache_key(input_data)
+        cached = self.cache.get(key)
+        if cached is not None:
+            with self._counter_lock:
+                self._cache_hits += 1
+            return {
+                "request_id": request_id,
+                "output_data": cached.tolist(),
+                "node_id": self.node_id,
+                "cached": True,
+                # Reference reports a fixed fake latency on hits (:65).
+                "inference_time_us": self.config.fake_cached_latency_us,
+            }
+
+        result = self.batch_processor.process(_BatchItem(request_id, input_data))
+        self.cache.put(key, result.output_data)
+        return {
+            "request_id": request_id,
+            "output_data": result.output_data.tolist(),
+            "node_id": self.node_id,
+            "cached": False,
+            "inference_time_us": result.inference_time_us,
+        }
+
+    def _process_batch(self, items: List[_BatchItem]) -> List[_BatchResult]:
+        start = time.perf_counter()
+        outputs = self.engine.batch_predict([it.input_data for it in items])
+        elapsed_us = (time.perf_counter() - start) * 1e6
+        per_request_us = int(elapsed_us / max(1, len(items)))  # worker_node.cpp:123
+        return [_BatchResult(out, per_request_us) for out in outputs]
+
+    # -- observability --------------------------------------------------------
+
+    def get_health(self) -> dict:
+        """Exact /health schema (``worker_node.cpp:85-103``)."""
+        m = self.batch_processor.get_metrics()
+        with self._counter_lock:
+            total, hits = self._total_requests, self._cache_hits
+        return {
+            "healthy": True,
+            "node_id": self.node_id,
+            "total_requests": total,
+            "cache_hits": hits,
+            "cache_size": self.cache.size(),
+            "cache_hit_rate": self.cache.hit_rate(),
+            "batch_processor": m.as_dict(),
+        }
+
+    def stop(self) -> None:
+        self.batch_processor.stop()
