@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""End-to-end serving benchmark — the reference's headline harness, reproduced.
+
+Mirrors /root/reference/benchmark.py: a closed-loop multithreaded client
+POSTs `{request_id, input_data}` JSON to the gateway `/infer` endpoint
+(10,000 requests, 50 threads, 10 distinct input vectors — the reference's
+published 522.64 req/s run, README.md:274-300). The serving stack under
+test is the TPU-native combined process: HTTP front door → hash-ring lane
+selection → LRU cache → dynamic batcher → shape-bucketed XLA executables.
+
+The server runs in a SEPARATE process (its own GIL) so the client load
+generator doesn't share an interpreter with the serving path.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+All progress/diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+BASELINE_REQ_S = 522.64  # reference README.md:283 (BASELINE.md)
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_ready(port: int, timeout_s: float = 600.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", "/stats")
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            if resp.status == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"server on port {port} not ready after {timeout_s}s")
+
+
+class LoadGen:
+    """Closed-loop load: T threads, each a persistent keep-alive connection,
+    issuing its share of N requests back-to-back (reference benchmark.py:49-76)."""
+
+    def __init__(self, port: int, n_requests: int, n_threads: int,
+                 distinct_inputs: int = 10):
+        self.port = port
+        self.n_requests = n_requests
+        self.n_threads = n_threads
+        # Reference workload: input cycles through 10 distinct small vectors
+        # (benchmark.py:23) — the ~99.7% cache hit rate is a workload property.
+        self.payloads = [
+            json.dumps({
+                "request_id": "req_{}",  # filled per request
+                "input_data": [float(i), float(i + 1), float(i + 2)],
+            })
+            for i in range(distinct_inputs)
+        ]
+        self.latencies_ms: list[list[float]] = [[] for _ in range(n_threads)]
+        self.failures = [0] * n_threads
+
+    def _worker(self, tid: int, start_idx: int, count: int) -> None:
+        lat = self.latencies_ms[tid]
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+        headers = {"Content-Type": "application/json"}
+        for k in range(count):
+            i = start_idx + k
+            body = self.payloads[i % len(self.payloads)].replace(
+                '"req_{}"', f'"req_{i}"')
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/infer", body=body, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+            except (OSError, http.client.HTTPException):
+                ok = False
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if ok:
+                lat.append(dt_ms)
+            else:
+                self.failures[tid] += 1
+        conn.close()
+
+    def run(self) -> dict:
+        per = self.n_requests // self.n_threads
+        extra = self.n_requests % self.n_threads
+        threads = []
+        idx = 0
+        t_start = time.perf_counter()
+        for tid in range(self.n_threads):
+            count = per + (1 if tid < extra else 0)
+            th = threading.Thread(target=self._worker, args=(tid, idx, count))
+            idx += count
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        wall_s = time.perf_counter() - t_start
+        lats = sorted(x for chunk in self.latencies_ms for x in chunk)
+        n_ok = len(lats)
+        n_fail = sum(self.failures)
+
+        def pct(p: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(len(lats) - 1, int(p / 100.0 * len(lats)))]
+
+        return {
+            "requests": self.n_requests,
+            "success": n_ok,
+            "failed": n_fail,
+            "success_rate": n_ok / max(1, self.n_requests),
+            "wall_s": round(wall_s, 3),
+            "throughput_req_s": round(n_ok / wall_s, 2) if wall_s > 0 else 0.0,
+            "latency_ms": {
+                "mean": round(statistics.fmean(lats), 3) if lats else 0.0,
+                "p50": round(pct(50), 3),
+                "p90": round(pct(90), 3),
+                "p95": round(pct(95), 3),
+                "p99": round(pct(99), 3),
+                "max": round(lats[-1], 3) if lats else 0.0,
+            },
+        }
+
+
+def scrape_stats(port: int) -> dict:
+    out = {}
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        conn.close()
+        out["cache_hit_rate"] = health.get("cache_hit_rate")
+        bp = health.get("batch_processor", {})
+        out["avg_batch_size"] = bp.get("avg_batch_size")
+    except Exception as exc:  # stats are best-effort
+        log(f"stats scrape failed: {exc}")
+    return out
+
+
+def launch_server(model: str, port: int, lanes: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+           "--model", model, "--port", str(port), "--lanes", str(lanes),
+           "--warmup"]
+    log(f"launching server: {' '.join(cmd)}")
+    return subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=sys.stderr, stderr=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=10_000)
+    ap.add_argument("--threads", type=int, default=50)
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--lanes", type=int, default=0,
+                    help="serving lanes (0 = one per device)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="use an already-running server on this port")
+    ap.add_argument("--quick", action="store_true",
+                    help="1000 requests / 20 threads smoke run")
+    args = ap.parse_args()
+    if args.quick:
+        args.requests, args.threads = 1000, 20
+
+    proc = None
+    port = args.port
+    try:
+        if port == 0:
+            port = free_port()
+            proc = launch_server(args.model, port, args.lanes)
+        log(f"waiting for server on :{port} ...")
+        wait_ready(port)
+        log("server ready; warmup pass (misses populate the cache) ...")
+        warm = LoadGen(port, 20, 4)
+        warm.run()
+
+        log(f"benchmark: {args.requests} requests, {args.threads} threads")
+        gen = LoadGen(port, args.requests, args.threads)
+        result = gen.run()
+        result.update(scrape_stats(port))
+        log(json.dumps(result, indent=2))
+
+        line = {
+            "metric": "serving_throughput",
+            "value": result["throughput_req_s"],
+            "unit": "req/s",
+            "vs_baseline": round(result["throughput_req_s"] / BASELINE_REQ_S, 3),
+            "model": args.model,
+            "requests": args.requests,
+            "threads": args.threads,
+            "success_rate": round(result["success_rate"], 4),
+            "p50_ms": result["latency_ms"]["p50"],
+            "p99_ms": result["latency_ms"]["p99"],
+            "cache_hit_rate": result.get("cache_hit_rate"),
+            "avg_batch_size": result.get("avg_batch_size"),
+        }
+        print(json.dumps(line), flush=True)
+        return 0 if result["success_rate"] > 0.99 else 1
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

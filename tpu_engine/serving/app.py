@@ -73,6 +73,7 @@ def serve_combined(
     worker_config: Optional[WorkerConfig] = None,
     gateway_config: Optional[GatewayConfig] = None,
     background: bool = True,
+    warmup: bool = False,
 ):
     """One process: HTTP front door + in-process lanes over local devices.
 
@@ -98,6 +99,13 @@ def serve_combined(
             device=devices[i % len(devices)],
         )
         workers.append(WorkerNode(lane_cfg, engine=engine))
+    if warmup:
+        # Pre-compile every batch bucket before accepting traffic — the
+        # reference pays its graph compile at session load the same way
+        # (inference_engine.cpp:31). Lanes pinned to the same device share
+        # XLA's compile cache, so this is ~one compile per bucket.
+        for w in workers:
+            w.engine.warmup()
     gateway = Gateway(workers, gateway_config)
     server = JsonHttpServer(port)
     server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import signal
 import sys
 import time
 
@@ -82,8 +81,11 @@ def main(argv=None) -> int:
         parser.add_argument("--model", default="resnet50")
         parser.add_argument("--lanes", type=int, default=0)
         parser.add_argument("--port", type=int, default=8000)
+        parser.add_argument("--warmup", action="store_true",
+                            help="pre-compile all batch buckets before listening")
         args = parser.parse_args(rest)
-        serve_combined(model=args.model, lanes=args.lanes, port=args.port)
+        serve_combined(model=args.model, lanes=args.lanes, port=args.port,
+                       warmup=args.warmup)
         _run_forever()
         return 0
 

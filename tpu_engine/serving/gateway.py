@@ -97,12 +97,12 @@ class Gateway:
         result = self._try_node(primary, payload)
         if result is not None:
             return result
+        with self._lock:
+            self._failovers += 1
         # Ring-order failover across every other lane (gateway.cpp:51-59).
         for node in self._ring.get_all_nodes():
             if node == primary:
                 continue
-            with self._lock:
-                self._failovers += 1
             result = self._try_node(node, payload)
             if result is not None:
                 return result
@@ -132,8 +132,13 @@ class Gateway:
         """Exact /stats schema (``gateway.cpp:63-77``)."""
         with self._lock:
             items = list(self._breakers.items())
+            total, failovers = self._total_requests, self._failovers
         return {
             "total_workers": len(items),
+            # Additive fields (reference /stats has only total_workers +
+            # circuit_breakers; extra keys don't break its parsers).
+            "total_requests": total,
+            "failovers": failovers,
             "circuit_breakers": [
                 {
                     "node": node,

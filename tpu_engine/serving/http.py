@@ -83,8 +83,10 @@ class JsonHttpServer:
         # socketserver's default listen backlog is 5; benchmark clients open a
         # fresh connection per request at 50+ threads, so SYNs get dropped and
         # retransmitted (1 s tail spikes) without a real backlog.
-        ThreadingHTTPServer.request_queue_size = 1024
-        self._server = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 1024
+
+        self._server = _Server((self.host, self.port), self._make_handler())
         self._server.daemon_threads = True
         if self.port == 0:
             self.port = self._server.server_address[1]

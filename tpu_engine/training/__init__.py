@@ -1,0 +1,1 @@
+from tpu_engine.training.train import TrainState, make_train_step  # noqa: F401

@@ -1,0 +1,101 @@
+"""Sharded training step: pjit over a named mesh.
+
+The reference is inference-only (SURVEY.md §5 — no checkpoint/resume, no
+training); the TPU-native framework adds a first-class training path because
+the same sharded-apply functions drive both serving and fine-tuning. The
+step is a single jitted function — forward, loss, backward, optimizer — with
+`jax.sharding.NamedSharding` annotations so XLA inserts the collectives
+(psum for gradient reduction over `data`, all-gather/reduce-scatter for
+tensor-parallel matmuls over `model`) on ICI.
+
+Mesh axis conventions (tpu_engine.parallel.mesh):
+  data  — batch sharding (gradients psum over this axis)
+  model — tensor parallelism (kernels sharded on the output feature dim)
+  seq   — sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean token-level cross entropy; labels < 0 are masked (padding)."""
+    mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def mse_loss(outputs, targets):
+    return jnp.mean((outputs.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2)
+
+
+def make_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable = mse_loss,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    dtype=jnp.bfloat16,
+):
+    """Build (init_state, train_step). `apply_fn(params, x, dtype=...)` is a
+    model apply; `loss_fn(outputs, targets)` a scalar loss."""
+    optimizer = optimizer or optax.adamw(1e-3)
+
+    def init_state(params) -> TrainState:
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    def train_step(state: TrainState, x, targets):
+        def scalar_loss(params):
+            out = apply_fn(params, x, dtype=dtype)
+            return loss_fn(out, targets)
+
+        loss, grads = jax.value_and_grad(scalar_loss)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_state, train_step
+
+
+# -- sharding rules -----------------------------------------------------------
+
+def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
+    """Tensor-parallel placement heuristic for param pytrees built from
+    `tpu_engine.ops.nn`: 2-D dense kernels shard their output-feature dim
+    over `axis`; matching biases shard too; everything else replicates.
+
+    XLA then runs each dense as a local matmul producing the local shard of
+    the features — the all-gather (or reduce-scatter in the backward pass)
+    is inserted automatically where a replicated tensor is needed.
+    """
+    msize = mesh.shape[axis]
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 2 and shape[-1] % msize == 0:
+            return P(*([None] * (len(shape) - 1)), axis)
+        if len(shape) == 1 and shape[0] % msize == 0 and shape[0] > 1:
+            return P(axis)
+        return P()
+
+    return jax.tree.map(lambda l: NamedSharding(mesh, spec_for(l)), params)
+
+
+def replicated_tree(params, mesh: Mesh):
+    return jax.tree.map(lambda _l: NamedSharding(mesh, P()), params)
