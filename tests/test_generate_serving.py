@@ -1,0 +1,76 @@
+"""/generate serving path: worker batcher, gateway routing, HTTP wire."""
+
+import json
+import http.client
+
+import pytest
+
+from tpu_engine.serving.app import serve_worker
+from tpu_engine.serving.gateway import Gateway
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import WorkerConfig
+
+
+@pytest.fixture(scope="module")
+def worker():
+    w = WorkerNode(WorkerConfig(node_id="gen_worker", model="gpt2-small-test",
+                                dtype="float32"))
+    yield w
+    w.stop()
+
+
+def test_worker_handle_generate(worker):
+    resp = worker.handle_generate({
+        "request_id": "g1",
+        "prompt_tokens": [5, 9, 3],
+        "max_new_tokens": 6,
+    })
+    assert resp["request_id"] == "g1"
+    assert resp["node_id"] == "gen_worker"
+    assert len(resp["tokens"]) == 6
+    assert all(isinstance(t, int) for t in resp["tokens"])
+    assert resp["generate_time_us"] > 0
+
+
+def test_generate_deterministic_across_batching(worker):
+    a = worker.handle_generate({"request_id": "d1", "prompt_tokens": [7, 2],
+                                "max_new_tokens": 5})
+    b = worker.handle_generate({"request_id": "d2", "prompt_tokens": [7, 2],
+                                "max_new_tokens": 5})
+    assert a["tokens"] == b["tokens"]
+
+
+def test_gateway_routes_generate(worker):
+    gw = Gateway([worker])
+    resp = gw.route_generate({"request_id": "g2", "prompt_tokens": [1, 2, 3],
+                              "max_new_tokens": 4})
+    assert len(resp["tokens"]) == 4
+
+
+def test_generate_over_http():
+    cfg = WorkerConfig(port=0, node_id="http_gen", model="gpt2-small-test",
+                       dtype="float32")
+    w, server = serve_worker(cfg, background=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        body = json.dumps({"request_id": "h1", "prompt_tokens": [4, 8],
+                           "max_new_tokens": 3})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        assert resp.status == 200
+        assert len(data["tokens"]) == 3
+        conn.close()
+    finally:
+        server.stop()
+        w.stop()
+
+
+def test_non_transformer_model_rejects_generate():
+    w = WorkerNode(WorkerConfig(node_id="mlp_worker", model="mlp"))
+    try:
+        with pytest.raises(ValueError):
+            w.handle_generate({"request_id": "x", "prompt_tokens": [1]})
+    finally:
+        w.stop()

@@ -1,0 +1,93 @@
+"""Generation runtime: greedy decode equivalence, batching, EOS handling.
+
+Pins the invariant that the bucketed/left-padded/chunked decode pipeline
+produces exactly the tokens a naive full-forward argmax loop would — i.e.
+all the TPU-shaped machinery (static KV caches, scan chunks, left padding)
+is semantically invisible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import create_model
+from tpu_engine.models.transformer import transformer_apply
+from tpu_engine.runtime.generator import Generator
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return Generator(
+        "gpt2-small-test",
+        dtype="float32",
+        batch_buckets=(1, 2, 4),
+        prompt_buckets=(8, 16),
+        step_chunk=4,
+        max_seq=64,
+    )
+
+
+def naive_greedy(gen, prompt, n_tokens):
+    """Reference decode: full forward over the growing sequence each step."""
+    cfg = gen.cfg
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        x = jnp.asarray([toks], jnp.int32)
+        logits = transformer_apply(gen.params, x, cfg, dtype=jnp.float32)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def test_greedy_matches_naive_loop(gen):
+    prompt = [5, 9, 3, 7, 2]
+    got = gen.generate([prompt], max_new_tokens=10)[0]
+    want = naive_greedy(gen, prompt, 10)
+    assert got == want
+
+
+def test_batch_equals_single(gen):
+    """Mixed-length batched generation == each prompt generated alone."""
+    prompts = [[5, 9, 3], [11, 2, 8, 4, 1, 6], [7]]
+    batched = gen.generate(prompts, max_new_tokens=8)
+    for p, got in zip(prompts, batched):
+        alone = gen.generate([p], max_new_tokens=8)[0]
+        assert got == alone, f"prompt {p}: batched {got} != alone {alone}"
+
+
+def test_eos_truncation(gen):
+    prompt = [5, 9, 3, 7, 2]
+    full = gen.generate([prompt], max_new_tokens=12)[0]
+    eos = full[3]  # pretend the 4th generated token is EOS
+    got = gen.generate([prompt], max_new_tokens=12, eos_id=eos)[0]
+    assert got == full[:full.index(eos)]
+
+
+def test_sampled_generation_valid(gen):
+    toks = gen.generate([[5, 9]], max_new_tokens=6, temperature=0.8, seed=7)[0]
+    assert len(toks) == 6
+    assert all(0 <= t < gen.cfg.vocab for t in toks)
+    # Different seeds should (overwhelmingly) differ somewhere.
+    other = gen.generate([[5, 9]], max_new_tokens=6, temperature=0.8, seed=8)[0]
+    assert toks != other or True  # non-flaky: just exercise the path
+
+
+def test_long_prompt_truncates(gen):
+    prompt = list(range(1, 40))  # longer than the largest prompt bucket (16)
+    got = gen.generate([prompt], max_new_tokens=4)[0]
+    want = naive_greedy(gen, prompt[-16:], 4)
+    assert got == want
+
+
+def test_compile_cache_reuse(gen):
+    gen.generate([[1, 2, 3]], max_new_tokens=4)
+    stats = gen.stats()
+    n_prefill = len(stats["compiled_prefill"])
+    n_decode = len(stats["compiled_decode"])
+    gen.generate([[4, 5, 6]], max_new_tokens=4)
+    stats = gen.stats()
+    assert len(stats["compiled_prefill"]) == n_prefill
+    assert len(stats["compiled_decode"]) == n_decode

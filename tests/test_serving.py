@@ -224,14 +224,19 @@ def test_http_gateway_stats(http_stack):
     assert all(e["state"] == "CLOSED" for e in stats["circuit_breakers"])
 
 
-def test_http_malformed_request_returns_500(http_stack):
+def test_http_malformed_request_returns_400(http_stack):
+    """Malformed payloads are client errors (400) and must NOT feed the
+    breakers (the reference 500s everything, letting bad clients trip
+    breakers fleet-wide — deliberate improvement)."""
     gs = http_stack["gateway"][1]
     try:
         status, resp = _post(f"http://localhost:{gs.port}/infer", {"bogus": True})
-        raise AssertionError(f"expected 500, got {status} {resp}")
+        raise AssertionError(f"expected 400, got {status} {resp}")
     except urllib.error.HTTPError as e:
-        assert e.code == 500
+        assert e.code == 400
         assert "error" in json.loads(e.read())
+    stats = _get(f"http://localhost:{gs.port}/stats")[1]
+    assert all(e["failures"] == 0 for e in stats["circuit_breakers"])
 
 
 def test_http_unknown_route_404(http_stack):

@@ -1,0 +1,147 @@
+"""Transformer core: full forward vs prefill/decode consistency, masking.
+
+The decode path is the subsystem the reference could not express at all
+(one-shot ONNX Session::Run, no KV cache — SURVEY.md §5 long-context):
+these tests pin the invariant that incremental decode with a static-shape
+KV cache reproduces the full-sequence forward exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    init_caches,
+    transformer_apply,
+    transformer_decode_step,
+    transformer_init,
+    transformer_prefill,
+)
+
+_ensure_builtin_models_imported()
+
+CFG = TransformerConfig(vocab=128, n_layers=2, d_model=32, n_heads=2,
+                        d_ff=64, max_seq=32, causal=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer_init(jax.random.PRNGKey(0), CFG)
+
+
+def test_full_forward_shapes(params):
+    tokens = jnp.array([[1, 5, 9, 2], [3, 4, 4, 4]], jnp.int32)
+    logits = transformer_apply(params, tokens, CFG, dtype=jnp.float32)
+    assert logits.shape == (2, 4, CFG.vocab)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_causal_masking(params):
+    """Changing a later token must not change earlier logits."""
+    a = jnp.array([[1, 5, 9, 2]], jnp.int32)
+    b = a.at[0, 3].set(77)
+    la = transformer_apply(params, a, CFG, dtype=jnp.float32)
+    lb = transformer_apply(params, b, CFG, dtype=jnp.float32)
+    np.testing.assert_allclose(la[0, :3], lb[0, :3], atol=1e-5)
+    assert not np.allclose(la[0, 3], lb[0, 3])
+
+
+def test_prefill_matches_full_forward(params):
+    tokens = jnp.array([[1, 5, 9, 2, 8]], jnp.int32)
+    full = transformer_apply(params, tokens, CFG, dtype=jnp.float32)
+    caches = init_caches(CFG, batch=1, max_seq=16, dtype=jnp.float32)
+    last, caches = transformer_prefill(params, tokens, caches, CFG,
+                                       dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(last[0]), np.asarray(full[0, -1]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_steps_match_full_forward(params):
+    """Prefill(4 tokens) + 3 decode steps == full forward over 7 tokens."""
+    tokens = jnp.array([[1, 5, 9, 2, 8, 3, 7]], jnp.int32)
+    full = transformer_apply(params, tokens, CFG, dtype=jnp.float32)
+
+    caches = init_caches(CFG, batch=1, max_seq=16, dtype=jnp.float32)
+    _, caches = transformer_prefill(params, tokens[:, :4], caches, CFG,
+                                    dtype=jnp.float32)
+    step = jax.jit(
+        lambda p, t, c, pos: transformer_decode_step(p, t, c, pos, CFG,
+                                                     dtype=jnp.float32))
+    for i in range(4, 7):
+        logits, caches = step(params, tokens[:, i], caches, i)
+        np.testing.assert_allclose(np.asarray(logits[0]),
+                                   np.asarray(full[0, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_decode_step_compiles_once(params):
+    caches = init_caches(CFG, batch=2, max_seq=16, dtype=jnp.float32)
+    step = jax.jit(
+        lambda p, t, c, pos: transformer_decode_step(p, t, c, pos, CFG,
+                                                     dtype=jnp.float32))
+    tok = jnp.array([3, 4], jnp.int32)
+    _, caches = step(params, tok, caches, 0)
+    n0 = step._cache_size()
+    for pos in range(1, 5):
+        _, caches = step(params, tok, caches, pos)
+    assert step._cache_size() == n0  # pos is traced, not static
+
+
+def test_gpt2_registry_spec():
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.array([[5.0, 9.0, 3.0] + [0.0] * 13], jnp.float32)
+    out = spec.apply(params, x, dtype=jnp.float32)
+    assert out.shape == (1, spec.output_shape[0])
+    # Last real position is index 2; padding beyond must not matter for the
+    # causal model's position-2 logits.
+    x2 = jnp.array([[5.0, 9.0, 3.0] + [0.0] * 13], jnp.float32)
+    out2 = spec.apply(params, x2, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_bert_mask_ignores_padding():
+    spec = create_model("bert-small-test", seq_len=16, max_seq=32)
+    params = spec.init(jax.random.PRNGKey(1))
+    base = [4.0, 7.0, 2.0, 9.0]
+    x_short = jnp.array([base + [0.0] * 12], jnp.float32)
+    logits = spec.apply(params, x_short, dtype=jnp.float32)
+    assert logits.shape == (1, 16, 2)
+    # Changing a PAD position's id to another PAD-equivalent doesn't change
+    # real-position logits; changing a real token does.
+    x_tok = jnp.array([[4.0, 7.0, 5.0, 9.0] + [0.0] * 12], jnp.float32)
+    l2 = spec.apply(params, x_tok, dtype=jnp.float32)
+    assert not np.allclose(np.asarray(logits[0, :4]), np.asarray(l2[0, :4]))
+
+
+def test_bert_padded_equals_unpadded():
+    """Same content at two padded lengths → same real-position outputs
+    (the invariant that makes seq-bucketing sound)."""
+    spec16 = create_model("bert-small-test", seq_len=16, max_seq=32)
+    spec8 = create_model("bert-small-test", seq_len=8, max_seq=32)
+    params = spec16.init(jax.random.PRNGKey(2))
+    content = [4.0, 7.0, 2.0]
+    x16 = jnp.array([content + [0.0] * 13], jnp.float32)
+    x8 = jnp.array([content + [0.0] * 5], jnp.float32)
+    l16 = spec16.apply(params, x16, dtype=jnp.float32)
+    l8 = spec8.apply(params, x8, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(l16[0, :3]), np.asarray(l8[0, :3]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_dtype_stable_carry(params):
+    """Regression: nn.dense accumulates f32 — block output must cast back so
+    the layer-scan carry dtype is stable in bf16 (caught by live /generate)."""
+    tokens = jnp.array([[1, 5, 9, 2]], jnp.int32)
+    logits = transformer_apply(params, tokens, CFG, dtype=jnp.bfloat16)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    caches = init_caches(CFG, batch=1, max_seq=16, dtype=jnp.bfloat16)
+    last, caches = transformer_prefill(params, tokens, caches, CFG,
+                                       dtype=jnp.bfloat16)
+    out, _ = transformer_decode_step(params, jnp.array([3], jnp.int32),
+                                     caches, 4, CFG, dtype=jnp.bfloat16)
+    assert bool(jnp.all(jnp.isfinite(out)))

@@ -1,0 +1,70 @@
+"""BERT family — bidirectional encoder for extractive QA (SQuAD head).
+
+Reference counterpart: BASELINE.json config 3 ("BERT-base-squad ONNX,
+variable seq-len batching + LRU cache-test"). The reference zero-pads every
+request to one static graph shape (`inference_engine.cpp:154-160`); here
+variable-length inputs ride the engine's seq-bucketing (pad to the nearest
+compiled sequence bucket) and attention masks out the padding.
+
+Serving contract: input = token ids as floats, shape (seq,), pad id 0;
+output = flat start/end logits, shape (seq, 2) flattened on the wire.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import ModelSpec, register
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    transformer_apply,
+    transformer_init,
+)
+from tpu_engine.ops import nn
+
+import jax
+
+
+def _make_bert(name: str, cfg: TransformerConfig, seq_len: int,
+               n_outputs: int = 2) -> ModelSpec:
+    def init(rng):
+        k1, k2 = jax.random.split(rng)
+        params = transformer_init(k1, cfg)
+        # Replace the LM head with the QA span head (start/end logits).
+        params["head"] = nn.dense_init(k2, cfg.d_model, n_outputs)
+        return params
+
+    def apply(params, x, dtype=jnp.bfloat16):
+        tokens = jnp.clip(x.astype(jnp.int32), 0, cfg.vocab - 1)
+        mask = (tokens > 0).astype(jnp.int32)  # pad id 0, bidirectional mask
+        logits = transformer_apply(params, tokens, cfg, mask=mask, dtype=dtype)
+        return logits  # (B, seq, 2) → engine flattens per-sample
+
+    return ModelSpec(
+        name=name,
+        apply=apply,
+        init=init,
+        input_shape=(seq_len,),
+        output_shape=(seq_len, n_outputs),
+        config=cfg,
+    )
+
+
+@register("bert")
+def make_bert(seq_len: int = 384, vocab: int = 30522, n_layers: int = 12,
+              d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,
+              max_seq: int = 512) -> ModelSpec:
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=False)
+    return _make_bert("bert", cfg, seq_len)
+
+
+@register("bert-small-test")
+def make_bert_small(seq_len: int = 32, vocab: int = 512, n_layers: int = 2,
+                    d_model: int = 64, n_heads: int = 4, d_ff: int = 128,
+                    max_seq: int = 64) -> ModelSpec:
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=False)
+    return _make_bert("bert-small-test", cfg, seq_len)

@@ -1,0 +1,73 @@
+"""GPT-2 family — autoregressive decoder for the generation service.
+
+Reference counterpart: BASELINE.json config 5 ("GPT-2 / distil-Llama ONNX
+autoregressive decode"); the reference could only run such a graph one-shot
+through ONNX Runtime (`/root/reference/src/inference_engine.cpp:31`) with no
+KV cache or decode loop. Here GPT-2 is a JAX program with static-shape
+prefill/decode executables (models.transformer) driven by
+`tpu_engine.runtime.generator`.
+
+Serving-engine contract (flat float vectors on the wire,
+`worker_node.cpp:17`): input = token ids as floats, shape (seq,); output =
+next-token logits, shape (vocab,). The generation HTTP surface
+(`/generate`) uses the decode loop instead of this one-shot path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import ModelSpec, register
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    transformer_apply,
+    transformer_init,
+)
+
+
+def _spec_from_config(name: str, cfg: TransformerConfig, seq_len: int) -> ModelSpec:
+    def init(rng):
+        return transformer_init(rng, cfg)
+
+    def apply(params, x, dtype=jnp.bfloat16):
+        # x: (B, seq) float token ids (wire format) → (B, vocab) logits of
+        # the last real (non-pad) position. Pad id 0 after the first token
+        # is treated as padding, matching the engine's zero-padding.
+        tokens = jnp.clip(x.astype(jnp.int32), 0, cfg.vocab - 1)
+        positions = jnp.arange(seq_len)[None, :]
+        nonpad = jnp.where(tokens > 0, positions, 0)
+        last = jnp.max(nonpad, axis=1)  # 0 if prompt is a single token
+        logits = transformer_apply(params, tokens, cfg, dtype=dtype)
+        return jnp.take_along_axis(
+            logits, last[:, None, None], axis=1)[:, 0]
+
+    return ModelSpec(
+        name=name,
+        apply=apply,
+        init=init,
+        input_shape=(seq_len,),
+        output_shape=(cfg.vocab,),
+        config=cfg,  # generation service needs the architecture
+    )
+
+
+@register("gpt2")
+def make_gpt2(seq_len: int = 128, vocab: int = 50257, n_layers: int = 12,
+              d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,
+              max_seq: int = 1024) -> ModelSpec:
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True)
+    return _spec_from_config("gpt2", cfg, seq_len)
+
+
+@register("gpt2-small-test")
+def make_gpt2_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
+                    d_model: int = 64, n_heads: int = 4, d_ff: int = 128,
+                    max_seq: int = 64) -> ModelSpec:
+    """Tiny config for tests/CI — same code path, millisecond compiles."""
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True)
+    return _spec_from_config("gpt2-small-test", cfg, seq_len)

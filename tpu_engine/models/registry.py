@@ -22,6 +22,7 @@ class ModelSpec:
     init: Callable           # (rng) -> params
     input_shape: Tuple[int, ...]   # per-sample shape the model consumes
     output_shape: Tuple[int, ...]  # per-sample output shape
+    config: Optional[object] = None  # architecture config (e.g. TransformerConfig)
 
     @property
     def input_size(self) -> int:

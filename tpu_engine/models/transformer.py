@@ -1,0 +1,215 @@
+"""Transformer core: stacked-layer params + `lax.scan` over layers.
+
+The reference serves transformers only as opaque ONNX graphs (BASELINE.json
+configs 3 and 5: BERT-base-squad, GPT-2); here they are JAX programs built
+TPU-first:
+
+- **Stacked layer params**: every block's params are stacked on a leading
+  layer axis and the forward is one `lax.scan` — the block is traced/compiled
+  once regardless of depth (fast XLA compiles), and the layer axis is the
+  natural pipeline-parallel shard axis.
+- **Static shapes everywhere**: decode uses a preallocated KV cache
+  (ops.attention.KVCache) with position masking, so prefill and per-token
+  decode are each a single compiled executable.
+- **bf16 matmuls, f32 softmax/layernorm** — MXU-native compute with stable
+  numerics.
+
+Tensor-parallel sharding (training.shard_params_tp / parallel rules): attn
+wq/wk/wv and mlp fc shard the hidden/head output dim over `model`; wo and
+mlp proj shard their input dim; embeddings replicate or shard on vocab.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.ops import nn
+from tpu_engine.ops.attention import (
+    KVCache,
+    dot_product_attention,
+    mha_init,
+    _split_heads,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 50257
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 1024
+    causal: bool = True  # decoder (GPT) vs encoder (BERT)
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _block_init(key, cfg: TransformerConfig):
+    k_attn, k_fc, k_proj = jax.random.split(key, 3)
+    return {
+        "ln1": nn.layernorm_init(cfg.d_model),
+        "attn": mha_init(k_attn, cfg.d_model, cfg.n_heads),
+        "ln2": nn.layernorm_init(cfg.d_model),
+        "mlp": {
+            "fc": nn.dense_init(k_fc, cfg.d_model, cfg.d_ff),
+            "proj": nn.dense_init(k_proj, cfg.d_ff, cfg.d_model),
+        },
+    }
+
+
+def transformer_init(key, cfg: TransformerConfig):
+    k_tok, k_pos, k_blocks, k_head = jax.random.split(key, 4)
+    block_keys = jax.random.split(k_blocks, cfg.n_layers)
+    # Stack per-layer params on a leading axis: tree of (L, ...) arrays.
+    blocks = jax.vmap(lambda k: _block_init(k, cfg))(block_keys)
+    return {
+        "tok_embed": nn.embedding_init(k_tok, cfg.vocab, cfg.d_model),
+        "pos_embed": nn.embedding_init(k_pos, cfg.max_seq, cfg.d_model),
+        "blocks": blocks,
+        "ln_f": nn.layernorm_init(cfg.d_model),
+        # LM head tied to tok_embed would save params; kept separate so the
+        # vocab dim can shard over `model` independently.
+        "head": nn.dense_init(k_head, cfg.d_model, cfg.vocab),
+    }
+
+
+def _mlp(params, h, dtype):
+    h = nn.dense(params["fc"], h, dtype=dtype)
+    h = jax.nn.gelu(h)
+    return nn.dense(params["proj"], h, dtype=dtype)
+
+
+def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype):
+    x = nn.layernorm(bp["ln1"], h)
+    q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
+    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
+    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
+    a = dot_product_attention(q, k, v, causal=cfg.causal, mask=mask)
+    b, s = a.shape[:2]
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype)
+    # nn.dense accumulates in f32; keep the residual-stream carry in the
+    # compute dtype so the layer scan's carry type is stable.
+    return h.astype(dtype)
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig, *,
+                      mask=None, dtype=jnp.bfloat16):
+    """Full-sequence forward. tokens: (B, S) int32 → logits (B, S, vocab)."""
+    b, s = tokens.shape
+    h = nn.embedding(params["tok_embed"], tokens)
+    h = h + params["pos_embed"]["table"][None, :s]
+    h = h.astype(dtype)
+
+    def body(carry, bp):
+        return _block_apply(bp, carry, cfg, mask=mask, dtype=dtype), None
+
+    h, _ = jax.lax.scan(body, h, params["blocks"])
+    h = nn.layernorm(params["ln_f"], h)
+    return nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+
+
+# -- autoregressive decode ----------------------------------------------------
+
+def init_caches(cfg: TransformerConfig, batch: int, max_seq: Optional[int] = None,
+                dtype=jnp.bfloat16) -> KVCache:
+    """Stacked (L-leading) KV cache matching the scanned blocks."""
+    max_seq = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.d_head)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
+                  pos, cfg: TransformerConfig, *, dtype, prefill: bool,
+                  attn_mask=None, start=None):
+    ck, cv = cache_kv
+    x = nn.layernorm(bp["ln1"], h)
+    q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
+    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
+    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
+    write_at = 0 if prefill else pos
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, write_at, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, write_at, 0, 0))
+    if prefill:
+        a = dot_product_attention(q, k, v, causal=True, mask=attn_mask)
+    else:
+        max_seq = ck.shape[1]
+        kpos = jnp.arange(max_seq)[None, :]
+        valid = (kpos <= pos) * jnp.ones((h.shape[0], 1), jnp.int32)
+        if start is not None:
+            # Left-padded batch: positions before each sample's first real
+            # token are dead cache slots.
+            valid = valid * (kpos >= start[:, None])
+        a = dot_product_attention(q, ck, cv, mask=valid)
+    b, s = a.shape[:2]
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype)
+    return h.astype(dtype), (ck, cv)
+
+
+def transformer_prefill(params, tokens, caches: KVCache, cfg: TransformerConfig,
+                        *, dtype=jnp.bfloat16, attn_mask=None, pos_ids=None):
+    """Causal forward over the prompt, writing all KV entries. Returns
+    (last-position logits (B, vocab), caches).
+
+    Mixed-length batches are LEFT-padded: `attn_mask` (B, S) zeroes the pad
+    columns, `pos_ids` (B, S) gives each sample positions starting at 0 on
+    its first real token — every sample then ends at column S-1, so decode
+    continues with one scalar position for the whole batch.
+    """
+    b, s = tokens.shape
+    h = nn.embedding(params["tok_embed"], tokens)
+    if pos_ids is None:
+        h = h + params["pos_embed"]["table"][None, :s]
+    else:
+        h = h + params["pos_embed"]["table"][pos_ids]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_decode(bp, carry, (ck, cv), 0, cfg,
+                                    dtype=dtype, prefill=True,
+                                    attn_mask=attn_mask)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
+    h = nn.layernorm(params["ln_f"], h[:, -1:])
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    return logits[:, 0], KVCache(k_new, v_new)
+
+
+def transformer_decode_step(params, token_t, caches: KVCache, pos,
+                            cfg: TransformerConfig, *, dtype=jnp.bfloat16,
+                            start=None, pos_ids=None):
+    """One decode step. token_t: (B,) int32; pos: traced scalar write offset.
+    Returns (logits (B, vocab), caches). Compiles once; shapes are static.
+
+    `start` (B,) marks each sample's first valid cache column (left-padded
+    batches); `pos_ids` (B,) overrides the position-embedding index per
+    sample (defaults to `pos` for all)."""
+    h = nn.embedding(params["tok_embed"], token_t[:, None])
+    table = params["pos_embed"]["table"]
+    if pos_ids is None:
+        pos_vec = jax.lax.dynamic_slice(table, (pos, 0), (1, table.shape[1]))
+        h = h + pos_vec[None]
+    else:
+        h = h + table[pos_ids][:, None, :]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_decode(bp, carry, (ck, cv), pos, cfg,
+                                    dtype=dtype, prefill=False, start=start)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
+    h = nn.layernorm(params["ln_f"], h)
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    return logits[:, 0], KVCache(k_new, v_new)

@@ -6,11 +6,12 @@ its BASELINE configs (BERT variable-seq, GPT-2 autoregressive decode) rely
 on whatever the ONNX graph baked in. Here attention is a first-class op
 family because the TPU-native framework runs transformers as JAX programs:
 
-- `mha_apply` — full-sequence attention (prefill / encoder). QKV and the
-  output projection are single fused matmuls onto the MXU; softmax in f32.
-- `mha_decode_step` — one autoregressive step against a preallocated
-  static-shape KV cache (`lax.dynamic_update_slice`), so the decode loop
-  is compiled once and never re-traced as the sequence grows.
+- `dot_product_attention` — the attention core (softmax in f32, matmuls in
+  the MXU dtype) with causal/padding masks and decode position offsets;
+  consumed by models.transformer's full/prefill/decode block paths.
+- `KVCache` — the static-shape per-layer KV cache pytree the decode path
+  threads through `lax.scan` (written with `lax.dynamic_update_slice`, so
+  the decode step compiles once and never re-traces as the sequence grows).
 - `ring_attention` (tpu_engine.parallel.ring) — blockwise attention over a
   `seq` mesh axis with `ppermute` rotation of KV shards (ICI neighbor
   exchange), for sequences too long for one chip's HBM.
@@ -24,7 +25,7 @@ needs replication.
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -76,64 +77,8 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
     return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
 
 
-def mha_apply(params, x, *, n_heads: int, causal: bool = False, mask=None,
-              dtype=jnp.bfloat16):
-    """Full-sequence multi-head attention. x: (B, S, d_model)."""
-    q = _split_heads(nn.dense(params["wq"], x, dtype=dtype), n_heads)
-    k = _split_heads(nn.dense(params["wk"], x, dtype=dtype), n_heads)
-    v = _split_heads(nn.dense(params["wv"], x, dtype=dtype), n_heads)
-    out = dot_product_attention(q, k, v, causal=causal, mask=mask)
-    b, s = out.shape[:2]
-    return nn.dense(params["wo"], out.reshape(b, s, -1), dtype=dtype)
-
-
-# -- KV-cache decode ----------------------------------------------------------
-
 class KVCache(NamedTuple):
-    """Static-shape per-layer KV cache: (B, max_seq, H, D) device-resident."""
+    """Static-shape KV cache pytree: arrays are (B, max_seq, H, D) per layer
+    (stacked with a leading layer axis by models.transformer.init_caches)."""
     k: jnp.ndarray
     v: jnp.ndarray
-
-    @classmethod
-    def create(cls, batch: int, max_seq: int, n_heads: int, d_head: int,
-               dtype=jnp.bfloat16) -> "KVCache":
-        shape = (batch, max_seq, n_heads, d_head)
-        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-
-    def update(self, pos, k_new, v_new) -> "KVCache":
-        """Write S_new entries at sequence offset `pos` (traced scalar ok)."""
-        k = jax.lax.dynamic_update_slice(self.k, k_new.astype(self.k.dtype),
-                                         (0, pos, 0, 0))
-        v = jax.lax.dynamic_update_slice(self.v, v_new.astype(self.v.dtype),
-                                         (0, pos, 0, 0))
-        return KVCache(k, v)
-
-
-def mha_prefill(params, x, cache: KVCache, *, n_heads: int,
-                dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, KVCache]:
-    """Prefill: causal attention over the prompt, cache written at offset 0."""
-    q = _split_heads(nn.dense(params["wq"], x, dtype=dtype), n_heads)
-    k = _split_heads(nn.dense(params["wk"], x, dtype=dtype), n_heads)
-    v = _split_heads(nn.dense(params["wv"], x, dtype=dtype), n_heads)
-    cache = cache.update(0, k, v)
-    out = dot_product_attention(q, k, v, causal=True)
-    b, s = out.shape[:2]
-    return nn.dense(params["wo"], out.reshape(b, s, -1), dtype=dtype), cache
-
-
-def mha_decode_step(params, x_t, cache: KVCache, pos, *, n_heads: int,
-                    dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, KVCache]:
-    """One decode step. x_t: (B, 1, d_model); `pos` is the write offset
-    (traced). Attends over cache[0:pos+1] via position masking — shapes stay
-    static so the step compiles once."""
-    q = _split_heads(nn.dense(params["wq"], x_t, dtype=dtype), n_heads)
-    k = _split_heads(nn.dense(params["wk"], x_t, dtype=dtype), n_heads)
-    v = _split_heads(nn.dense(params["wv"], x_t, dtype=dtype), n_heads)
-    cache = cache.update(pos, k, v)
-    max_seq = cache.k.shape[1]
-    kpos = jnp.arange(max_seq)[None, :]
-    valid = (kpos <= pos).astype(jnp.int32) * jnp.ones(
-        (x_t.shape[0], 1), jnp.int32)
-    out = dot_product_attention(q, cache.k, cache.v, mask=valid)
-    b = out.shape[0]
-    return nn.dense(params["wo"], out.reshape(b, 1, -1), dtype=dtype), cache

@@ -1,0 +1,253 @@
+"""Autoregressive generation runtime: bucketed prefill + chunked scan decode.
+
+The decode-loop scheduler the reference cannot express (SURVEY.md §6 hard
+part (c): "decode loops don't fit the one-shot batchPredict contract").
+TPU-first structure:
+
+- **Prefill** compiles once per (batch bucket, prompt bucket): mixed-length
+  prompts are LEFT-padded to the bucket so every sample's last token lands
+  in the same column and decode advances with one scalar position.
+- **Decode** is a jitted `lax.scan` over a fixed step chunk — one
+  executable regardless of requested token counts; the host loops chunks
+  and early-stops between them when every row has hit EOS (one cheap sync
+  per chunk, never per token).
+- **KV caches** are static-shape device-resident arrays (L, B, max_seq, H, D)
+  allocated per batch bucket; no per-token retracing, no host round-trips
+  inside a chunk.
+
+Sampling: greedy (temperature 0) or categorical with threaded PRNG keys —
+both inside the compiled chunk.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_engine.models.registry import ModelSpec, create_model, _ensure_builtin_models_imported
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    init_caches,
+    transformer_decode_step,
+    transformer_prefill,
+)
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+           "float16": jnp.float16}
+
+
+class Generator:
+    def __init__(
+        self,
+        model: Union[str, ModelSpec],
+        params=None,
+        rng_seed: int = 0,
+        dtype: str = "bfloat16",
+        batch_buckets: Sequence[int] = (1, 2, 4, 8),
+        prompt_buckets: Sequence[int] = (16, 32, 64, 128),
+        step_chunk: int = 16,
+        max_seq: Optional[int] = None,
+        device=None,
+        model_kwargs: Optional[dict] = None,
+    ):
+        if isinstance(model, str):
+            _ensure_builtin_models_imported()
+            model = create_model(model, **(model_kwargs or {}))
+        if not isinstance(model.config, TransformerConfig):
+            raise ValueError(f"model '{model.name}' is not a transformer "
+                             "(no TransformerConfig); generation unsupported")
+        if not model.config.causal:
+            raise ValueError(f"model '{model.name}' is an encoder "
+                             "(causal=False); autoregressive generation "
+                             "requires a decoder LM")
+        if tuple(model.output_shape) != (model.config.vocab,):
+            raise ValueError(f"model '{model.name}' head is not an LM head "
+                             f"over the vocab (output_shape={model.output_shape})")
+        self.spec = model
+        self.cfg: TransformerConfig = model.config
+        self._dtype = _DTYPES[dtype]
+        self.max_seq = min(max_seq or self.cfg.max_seq, self.cfg.max_seq)
+        self._batch_buckets = tuple(sorted({max(1, int(b)) for b in batch_buckets}))
+        self._prompt_buckets = tuple(sorted(
+            {min(int(p), self.max_seq) for p in prompt_buckets}))
+        self._step_chunk = step_chunk
+        self._device = device
+        self.params = params if params is not None else model.init(
+            jax.random.PRNGKey(rng_seed))
+        if device is not None:
+            self.params = jax.device_put(self.params, device)
+        self._prefill_exe: Dict[Tuple[int, int], object] = {}
+        self._decode_exe: Dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    # -- bucketing -------------------------------------------------------------
+
+    def _bucket(self, buckets: Tuple[int, ...], n: int) -> int:
+        for b in buckets:
+            if b >= n:
+                return b
+        return buckets[-1]
+
+    # -- compiled stages -------------------------------------------------------
+
+    def _prefill(self, bb: int, pb: int):
+        key = (bb, pb)
+        exe = self._prefill_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._lock:
+            exe = self._prefill_exe.get(key)
+            if exe is not None:
+                return exe
+            cfg, dtype = self.cfg, self._dtype
+
+            def prefill(params, tokens, attn_mask, pos_ids, caches):
+                return transformer_prefill(params, tokens, caches, cfg,
+                                           dtype=dtype, attn_mask=attn_mask,
+                                           pos_ids=pos_ids)
+
+            self._prefill_exe[key] = jax.jit(prefill, donate_argnums=(4,))
+            return self._prefill_exe[key]
+
+    def _decode(self, bb: int):
+        exe = self._decode_exe.get(bb)
+        if exe is not None:
+            return exe
+        with self._lock:
+            exe = self._decode_exe.get(bb)
+            if exe is not None:
+                return exe
+            cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
+
+            def decode_chunk(params, caches, tok, pos0, start, done, rng,
+                             temperature, eos_id):
+                """Scan `chunk` decode steps. tok: (B,) last emitted token."""
+                def body(carry, i):
+                    caches, tok, done, rng = carry
+                    logits, caches = transformer_decode_step(
+                        params, tok, caches, pos0 + i, cfg, dtype=dtype,
+                        start=start, pos_ids=pos0 + i - start)
+                    rng, sub = jax.random.split(rng)
+                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    sampled = jax.random.categorical(
+                        sub, logits / jnp.maximum(temperature, 1e-6), axis=-1
+                    ).astype(jnp.int32)
+                    nxt = jnp.where(temperature > 0, sampled, greedy)
+                    nxt = jnp.where(done, eos_id, nxt)
+                    done = done | (nxt == eos_id)
+                    return (caches, nxt, done, rng), nxt
+
+                (caches, tok, done, rng), toks = jax.lax.scan(
+                    body, (caches, tok, done, rng), jnp.arange(chunk))
+                return caches, tok, done, rng, toks.T  # (B, chunk)
+
+            self._decode_exe[bb] = jax.jit(decode_chunk, donate_argnums=(1,))
+            return self._decode_exe[bb]
+
+    # -- generation ------------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int = 32,
+        eos_id: int = -1,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Batched generation. Returns per-prompt generated token lists
+        (EOS-truncated, EOS not included). `eos_id=-1` disables early stop."""
+        if not prompts:
+            return []
+        out: List[List[int]] = []
+        max_bb = self._batch_buckets[-1]
+        for i in range(0, len(prompts), max_bb):
+            out.extend(self._generate_batch(
+                [list(p) for p in prompts[i:i + max_bb]],
+                max_new_tokens, eos_id, temperature, seed + i))
+        return out
+
+    def _generate_batch(self, prompts: List[List[int]], max_new: int,
+                        eos_id: int, temperature: float, seed: int) -> List[List[int]]:
+        n = len(prompts)
+        bb = self._bucket(self._batch_buckets, n)
+        longest = max(1, max(len(p) for p in prompts))
+        pb = self._bucket(self._prompt_buckets, min(longest, self.max_seq))
+        max_new = max(1, min(max_new, self.max_seq - pb))
+
+        # Left-pad into the (bb, pb) buckets.
+        tokens = np.zeros((bb, pb), np.int32)
+        attn_mask = np.zeros((bb, pb), np.int32)
+        pos_ids = np.zeros((bb, pb), np.int32)
+        start = np.full((bb,), pb, np.int32)
+        for r, p in enumerate(prompts):
+            p = p[-pb:]  # truncate over-long prompts from the left
+            L = len(p)
+            tokens[r, pb - L:] = np.asarray(p, np.int32)
+            attn_mask[r, pb - L:] = 1
+            pos_ids[r, pb - L:] = np.arange(L)
+            start[r] = pb - L
+        dev = self._device
+
+        def put(x):
+            return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+
+        caches = init_caches(self.cfg, bb, self.max_seq, self._dtype)
+        if dev is not None:
+            caches = jax.device_put(caches, dev)
+        logits, caches = self._prefill(bb, pb)(
+            self.params, put(tokens), put(attn_mask), put(pos_ids), caches)
+
+        # First generated token comes from the prefill logits.
+        rng = jax.random.PRNGKey(seed)
+        rng, sub = jax.random.split(rng)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if temperature > 0:
+            first = jax.random.categorical(
+                sub, logits / temperature, axis=-1).astype(jnp.int32)
+        else:
+            first = greedy
+        done = (first == eos_id)
+
+        pieces = [np.asarray(first)[:, None]]
+        tok, pos = first, pb
+        decode = self._decode(bb)
+        t_dev = put(jnp.float32(temperature))
+        eos_dev = put(jnp.int32(eos_id))
+        remaining = max_new - 1
+        start_dev = put(start)
+        # max_new is clamped to max_seq - pb, so every *needed* step writes
+        # in-bounds; a final partial chunk may run steps past max_seq whose
+        # outputs are discarded by the truncation below.
+        while remaining > 0 and pos < self.max_seq:
+            caches, tok, done, rng, toks = decode(
+                self.params, caches, tok, pos, start_dev, done, rng,
+                t_dev, eos_dev)
+            pieces.append(np.asarray(toks))
+            pos += self._step_chunk
+            remaining -= self._step_chunk
+            if eos_id >= 0 and bool(np.all(np.asarray(done))):
+                break
+
+        gen = np.concatenate(pieces, axis=1)[:n, :max_new]
+        results = []
+        for r in range(n):
+            row = gen[r].tolist()
+            if eos_id >= 0 and eos_id in row:
+                row = row[:row.index(eos_id)]
+            results.append(row)
+        return results
+
+    def stats(self) -> dict:
+        return {
+            "model": self.spec.name,
+            "max_seq": self.max_seq,
+            "batch_buckets": list(self._batch_buckets),
+            "prompt_buckets": list(self._prompt_buckets),
+            "step_chunk": self._step_chunk,
+            "compiled_prefill": sorted(self._prefill_exe),
+            "compiled_decode": sorted(self._decode_exe),
+        }

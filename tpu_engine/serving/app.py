@@ -45,6 +45,7 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     worker = WorkerNode(config)
     server = JsonHttpServer(config.port)
     server.route("POST", "/infer", lambda body: (200, worker.handle_infer(body)))
+    server.route("POST", "/generate", lambda body: (200, worker.handle_generate(body)))
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
     _print_worker_banner(worker, config)
     server.start(background=background)
@@ -57,6 +58,7 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     gateway = Gateway(worker_urls, config)
     server = JsonHttpServer(config.port)
     server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
@@ -109,6 +111,7 @@ def serve_combined(
     gateway = Gateway(workers, gateway_config)
     server = JsonHttpServer(port)
     server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     # Lane health is addressable through the gateway process in combined mode.
     for w in workers:

@@ -40,6 +40,14 @@ class LocalWorkerClient:
         except Exception as exc:  # device/runtime failure → breaker signal
             raise WorkerError(str(exc)) from exc
 
+    def generate(self, payload: dict) -> dict:
+        try:
+            return self.worker.handle_generate(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def health(self) -> dict:
         return self.worker.get_health()
 
@@ -97,22 +105,40 @@ class HttpWorkerClient:
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
-            if resp.status != 200:
-                raise WorkerError(f"worker {self.url} returned {resp.status}")
-            out = json.loads(data)
-            self._release(conn)
-            return out
-        except WorkerError:
-            conn.close()
-            self._release(None)
-            raise
         except Exception as exc:
             conn.close()
             self._release(None)
             raise WorkerError(f"worker {self.url}: {exc}") from exc
+        if 400 <= resp.status < 500:
+            # Client error (bad payload, unsupported op): the request is at
+            # fault, not the worker — don't feed the breaker. Connection is
+            # still good (response fully read).
+            detail = ""
+            try:
+                detail = json.loads(data).get("error", "")
+            except Exception:
+                pass
+            self._release(conn)
+            raise ValueError(
+                f"worker {self.url} rejected request ({resp.status}): {detail}")
+        if resp.status != 200:
+            conn.close()
+            self._release(None)
+            raise WorkerError(f"worker {self.url} returned {resp.status}")
+        try:
+            out = json.loads(data)
+        except Exception as exc:
+            conn.close()
+            self._release(None)
+            raise WorkerError(f"worker {self.url}: bad response body: {exc}") from exc
+        self._release(conn)
+        return out
 
     def infer(self, payload: dict) -> dict:
         return self._request("POST", "/infer", payload)
+
+    def generate(self, payload: dict) -> dict:
+        return self._request("POST", "/generate", payload)
 
     def health(self) -> dict:
         return self._request("GET", "/health")

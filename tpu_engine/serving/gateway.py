@@ -89,12 +89,20 @@ class Gateway:
     # -- request path ---------------------------------------------------------
 
     def route_request(self, payload: dict) -> dict:
+        return self._route(payload, op="infer")
+
+    def route_generate(self, payload: dict) -> dict:
+        """Route a /generate request the same way as /infer: ring primary,
+        breaker-gated, ring-order failover."""
+        return self._route(payload, op="generate")
+
+    def _route(self, payload: dict, op: str) -> dict:
         with self._lock:
             self._total_requests += 1
         request_id = str(payload.get("request_id", id(payload)))
         primary = self._ring.get_node(request_id)
 
-        result = self._try_node(primary, payload)
+        result = self._try_node(primary, payload, op=op)
         if result is not None:
             return result
         with self._lock:
@@ -103,12 +111,12 @@ class Gateway:
         for node in self._ring.get_all_nodes():
             if node == primary:
                 continue
-            result = self._try_node(node, payload)
+            result = self._try_node(node, payload, op=op)
             if result is not None:
                 return result
         raise GatewayError("All workers failed or unavailable")
 
-    def _try_node(self, node: str, payload: dict) -> Optional[dict]:
+    def _try_node(self, node: str, payload: dict, op: str = "infer") -> Optional[dict]:
         """Breaker-gated dispatch (reference tryNode, gateway.cpp:80-128).
         Returns None on failure so the caller can fail over."""
         with self._lock:
@@ -119,7 +127,7 @@ class Gateway:
         if not breaker.allow_request():
             return None
         try:
-            response = client.infer(payload)
+            response = getattr(client, op)(payload)
             breaker.record_success()
             return response
         except WorkerError:

@@ -11,11 +11,51 @@ to 500 ``{"error": ...}`` exactly like the reference handlers
 from __future__ import annotations
 
 import json
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 Handler = Callable[[Optional[dict]], Tuple[int, dict]]
+
+
+class _TrackingServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can sever live keep-alive connections.
+
+    `shutdown()` only stops the accept loop; handler threads blocked on the
+    next keep-alive request would keep serving pooled client connections
+    after "stop". Tracking the sockets lets stop() half-close them so those
+    threads see EOF and exit.
+    """
+
+    # socketserver's default listen backlog is 5; benchmark clients open a
+    # fresh connection per request at 50+ threads, so SYNs get dropped and
+    # retransmitted (1 s tail spikes) without a real backlog.
+    request_queue_size = 1024
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_open_connections(self):
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class JsonHttpServer:
@@ -65,7 +105,16 @@ class JsonHttpServer:
                         body = json.loads(raw)
                     status, payload = handler(body)
                     self._respond(status, payload)
-                except Exception as exc:  # reference: any handler error → 500
+                except (KeyError, ValueError, TypeError) as exc:
+                    # Malformed/unsupported request → 400 so gateways can
+                    # tell client errors from worker failures (the reference
+                    # returns 500 for everything, worker_node.cpp:180-186,
+                    # which lets bad clients trip breakers fleet-wide).
+                    try:
+                        self._respond(400, {"error": str(exc)})
+                    except Exception:
+                        pass
+                except Exception as exc:  # runtime/device failure → 500
                     try:
                         self._respond(500, {"error": str(exc)})
                     except Exception:
@@ -80,13 +129,7 @@ class JsonHttpServer:
         return _Handler
 
     def start(self, background: bool = True) -> None:
-        # socketserver's default listen backlog is 5; benchmark clients open a
-        # fresh connection per request at 50+ threads, so SYNs get dropped and
-        # retransmitted (1 s tail spikes) without a real backlog.
-        class _Server(ThreadingHTTPServer):
-            request_queue_size = 1024
-
-        self._server = _Server((self.host, self.port), self._make_handler())
+        self._server = _TrackingServer((self.host, self.port), self._make_handler())
         self._server.daemon_threads = True
         if self.port == 0:
             self.port = self._server.server_address[1]
@@ -101,6 +144,7 @@ class JsonHttpServer:
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            self._server.close_open_connections()
             self._server.server_close()
             self._server = None
         if self._thread is not None:

@@ -45,6 +45,22 @@ class _BatchResult:
     inference_time_us: int
 
 
+@dataclass
+class _GenItem:
+    request_id: str
+    prompt: list
+    max_new_tokens: int
+    eos_id: int
+    temperature: float
+    seed: int
+
+
+@dataclass
+class _GenResult:
+    tokens: list
+    generate_time_us: int
+
+
 def _make_cache(capacity: int):
     try:
         from tpu_engine.core import native
@@ -78,6 +94,26 @@ class WorkerNode:
             name=f"{self.node_id}-batcher",
         )
         self.batch_processor.start()
+        # Autoregressive generation lane (transformer models only): its own
+        # batcher so decode loops never block one-shot /infer traffic.
+        self.generator = None
+        self._gen_processor: Optional[BatchProcessor[_GenItem, _GenResult]] = None
+        if getattr(self.engine.spec, "config", None) is not None:
+            from tpu_engine.runtime.generator import Generator
+
+            try:
+                self.generator = Generator(
+                    self.engine.spec, params=self.engine.params,
+                    dtype=self.config.dtype, device=getattr(engine, "_device", None))
+                self._gen_processor = BatchProcessor(
+                    self.config.gen_max_batch_size,
+                    self.config.batch_timeout_ms,
+                    self._process_gen_batch,
+                    name=f"{self.node_id}-gen-batcher",
+                )
+                self._gen_processor.start()
+            except ValueError:
+                self.generator = None
         # Worker-level counters, distinct from the LRU's own accounting
         # (reference worker_node.cpp:141-142).
         self._total_requests = 0
@@ -129,6 +165,55 @@ class WorkerNode:
         per_request_us = int(elapsed_us / max(1, len(items)))  # worker_node.cpp:123
         return [_BatchResult(out, per_request_us) for out in outputs]
 
+    # -- generation path -------------------------------------------------------
+
+    def handle_generate(self, request: dict) -> dict:
+        """Serve one /generate payload: autoregressive decode with batching.
+
+        Wire: {request_id, prompt_tokens, max_new_tokens?, eos_id?,
+        temperature?, seed?} → {request_id, tokens, node_id,
+        generate_time_us}. No reference counterpart (the reference can only
+        run one-shot graphs); field style matches /infer.
+        """
+        if self.generator is None:
+            raise ValueError(f"model '{self.config.model}' does not support generation")
+        with self._counter_lock:
+            self._total_requests += 1
+        item = _GenItem(
+            request_id=request["request_id"],
+            prompt=[int(t) for t in request["prompt_tokens"]],
+            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            eos_id=int(request.get("eos_id", -1)),
+            temperature=float(request.get("temperature", 0.0)),
+            seed=int(request.get("seed", 0)),
+        )
+        result = self._gen_processor.process(item)
+        return {
+            "request_id": item.request_id,
+            "tokens": result.tokens,
+            "node_id": self.node_id,
+            "generate_time_us": result.generate_time_us,
+        }
+
+    def _process_gen_batch(self, items: List[_GenItem]) -> List[_GenResult]:
+        """Group by sampling params (one compiled batch per group), decode,
+        split results. Within a group the batch runs to the group's max
+        max_new_tokens; per-request counts are truncated after."""
+        start = time.perf_counter()
+        results: List[Optional[_GenResult]] = [None] * len(items)
+        groups = {}
+        for idx, it in enumerate(items):
+            groups.setdefault((it.eos_id, it.temperature, it.seed), []).append(idx)
+        for (eos_id, temperature, seed), idxs in groups.items():
+            max_new = max(items[i].max_new_tokens for i in idxs)
+            toks = self.generator.generate(
+                [items[i].prompt for i in idxs], max_new_tokens=max_new,
+                eos_id=eos_id, temperature=temperature, seed=seed)
+            elapsed_us = int((time.perf_counter() - start) * 1e6 / max(1, len(items)))
+            for i, row in zip(idxs, toks):
+                results[i] = _GenResult(row[: items[i].max_new_tokens], elapsed_us)
+        return results
+
     # -- observability --------------------------------------------------------
 
     def get_health(self) -> dict:
@@ -148,3 +233,5 @@ class WorkerNode:
 
     def stop(self) -> None:
         self.batch_processor.stop()
+        if self._gen_processor is not None:
+            self._gen_processor.stop()

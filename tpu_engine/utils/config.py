@@ -28,6 +28,7 @@ class WorkerConfig:
     dtype: str = "bfloat16"             # MXU-native compute dtype
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32)
     fake_cached_latency_us: int = 50    # reference worker_node.cpp:65
+    gen_max_batch_size: int = 8         # decode-lane batcher (transformers)
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
