@@ -86,12 +86,13 @@ def _mlp(params, h, dtype):
     return nn.dense(params["proj"], h, dtype=dtype)
 
 
-def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype):
+def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
+    attn_fn = attn_fn or dot_product_attention
     x = nn.layernorm(bp["ln1"], h)
     q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
     k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
     v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
-    a = dot_product_attention(q, k, v, causal=cfg.causal, mask=mask)
+    a = attn_fn(q, k, v, causal=cfg.causal, mask=mask)
     b, s = a.shape[:2]
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
     h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype)
@@ -101,15 +102,20 @@ def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype):
 
 
 def transformer_apply(params, tokens, cfg: TransformerConfig, *,
-                      mask=None, dtype=jnp.bfloat16):
-    """Full-sequence forward. tokens: (B, S) int32 → logits (B, S, vocab)."""
+                      mask=None, dtype=jnp.bfloat16, attn_fn=None):
+    """Full-sequence forward. tokens: (B, S) int32 → logits (B, S, vocab).
+
+    `attn_fn` swaps the attention implementation — e.g. a partial of
+    parallel.ring.ring_attention for sequence-parallel long-context runs,
+    or ops.flash.flash_attention for the fused Pallas kernel."""
     b, s = tokens.shape
     h = nn.embedding(params["tok_embed"], tokens)
     h = h + params["pos_embed"]["table"][None, :s]
     h = h.astype(dtype)
 
     def body(carry, bp):
-        return _block_apply(bp, carry, cfg, mask=mask, dtype=dtype), None
+        return _block_apply(bp, carry, cfg, mask=mask, dtype=dtype,
+                            attn_fn=attn_fn), None
 
     h, _ = jax.lax.scan(body, h, params["blocks"])
     h = nn.layernorm(params["ln_f"], h)

@@ -15,8 +15,11 @@ TPU-first structure:
   allocated per batch bucket; no per-token retracing, no host round-trips
   inside a chunk.
 
-Sampling: greedy (temperature 0) or categorical with threaded PRNG keys —
-both inside the compiled chunk.
+Sampling: greedy (temperature 0) or categorical, per-row inside the compiled
+chunk. Each row's PRNG key is `fold_in(PRNGKey(row_seed), logical_position)`
+— a function of the request's seed and its own token position only — so a
+seeded request samples identical tokens regardless of which other requests
+the dynamic batcher co-batched it with, or which bucket it landed in.
 """
 
 from __future__ import annotations
@@ -40,6 +43,22 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def _sample(logits, seeds, positions, temperature):
+    """Per-row sampling: logits (B, V); seeds/positions/temperature (B,).
+
+    Greedy where temperature == 0, else categorical with key
+    fold_in(PRNGKey(seed_r), position_r) — deterministic per (seed, position)
+    so co-batching and bucketing never change a request's tokens."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def row(key_seed, pos, lg, t):
+        key = jax.random.fold_in(jax.random.PRNGKey(key_seed), pos)
+        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(row)(seeds, positions, logits, temperature).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 class Generator:
     def __init__(
         self,
@@ -48,7 +67,7 @@ class Generator:
         rng_seed: int = 0,
         dtype: str = "bfloat16",
         batch_buckets: Sequence[int] = (1, 2, 4, 8),
-        prompt_buckets: Sequence[int] = (16, 32, 64, 128),
+        prompt_buckets: Optional[Sequence[int]] = None,
         step_chunk: int = 16,
         max_seq: Optional[int] = None,
         device=None,
@@ -72,6 +91,14 @@ class Generator:
         self._dtype = _DTYPES[dtype]
         self.max_seq = min(max_seq or self.cfg.max_seq, self.cfg.max_seq)
         self._batch_buckets = tuple(sorted({max(1, int(b)) for b in batch_buckets}))
+        if prompt_buckets is None:
+            # Powers of two up to the model's full context — long prompts must
+            # never be silently truncated below what the model can serve.
+            b, prompt_buckets = 16, []
+            while b < self.max_seq:
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(self.max_seq)
         self._prompt_buckets = tuple(sorted(
             {min(int(p), self.max_seq) for p in prompt_buckets}))
         self._step_chunk = step_chunk
@@ -123,27 +150,27 @@ class Generator:
                 return exe
             cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
-            def decode_chunk(params, caches, tok, pos0, start, done, rng,
+            def decode_chunk(params, caches, tok, pos0, start, done, seeds,
                              temperature, eos_id):
-                """Scan `chunk` decode steps. tok: (B,) last emitted token."""
+                """Scan `chunk` decode steps. tok: (B,) last emitted token;
+                seeds/temperature: per-row (B,) sampling params."""
                 def body(carry, i):
-                    caches, tok, done, rng = carry
+                    caches, tok, done = carry
                     logits, caches = transformer_decode_step(
                         params, tok, caches, pos0 + i, cfg, dtype=dtype,
                         start=start, pos_ids=pos0 + i - start)
-                    rng, sub = jax.random.split(rng)
-                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    sampled = jax.random.categorical(
-                        sub, logits / jnp.maximum(temperature, 1e-6), axis=-1
-                    ).astype(jnp.int32)
-                    nxt = jnp.where(temperature > 0, sampled, greedy)
+                    # The token sampled here sits at logical position
+                    # pos0+i+1-start in its own sequence — fold that in so
+                    # the stream is batch- and bucket-independent.
+                    nxt = _sample(logits, seeds, pos0 + i + 1 - start,
+                                  temperature)
                     nxt = jnp.where(done, eos_id, nxt)
                     done = done | (nxt == eos_id)
-                    return (caches, nxt, done, rng), nxt
+                    return (caches, nxt, done), nxt
 
-                (caches, tok, done, rng), toks = jax.lax.scan(
-                    body, (caches, tok, done, rng), jnp.arange(chunk))
-                return caches, tok, done, rng, toks.T  # (B, chunk)
+                (caches, tok, done), toks = jax.lax.scan(
+                    body, (caches, tok, done), jnp.arange(chunk))
+                return caches, tok, done, toks.T  # (B, chunk)
 
             self._decode_exe[bb] = jax.jit(decode_chunk, donate_argnums=(1,))
             return self._decode_exe[bb]
@@ -155,23 +182,37 @@ class Generator:
         prompts: Sequence[Sequence[int]],
         max_new_tokens: int = 32,
         eos_id: int = -1,
-        temperature: float = 0.0,
-        seed: int = 0,
+        temperature: Union[float, Sequence[float]] = 0.0,
+        seed: Union[int, Sequence[int]] = 0,
     ) -> List[List[int]]:
         """Batched generation. Returns per-prompt generated token lists
-        (EOS-truncated, EOS not included). `eos_id=-1` disables early stop."""
+        (EOS-truncated, EOS not included). `eos_id=-1` disables early stop.
+
+        `temperature` and `seed` may be per-prompt sequences. A request with
+        an explicit per-prompt seed samples the same tokens no matter how
+        requests are batched. A scalar seed expands to seed+row so rows of
+        one call still sample independently."""
         if not prompts:
             return []
+        n = len(prompts)
+        temps = ([float(temperature)] * n if np.isscalar(temperature)
+                 else [float(t) for t in temperature])
+        seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
+                 else [int(s) for s in seed])
+        if len(temps) != n or len(seeds) != n:
+            raise ValueError("temperature/seed sequence length != n prompts")
         out: List[List[int]] = []
         max_bb = self._batch_buckets[-1]
-        for i in range(0, len(prompts), max_bb):
+        for i in range(0, n, max_bb):
             out.extend(self._generate_batch(
                 [list(p) for p in prompts[i:i + max_bb]],
-                max_new_tokens, eos_id, temperature, seed + i))
+                max_new_tokens, eos_id, temps[i:i + max_bb],
+                seeds[i:i + max_bb]))
         return out
 
     def _generate_batch(self, prompts: List[List[int]], max_new: int,
-                        eos_id: int, temperature: float, seed: int) -> List[List[int]]:
+                        eos_id: int, temps: List[float],
+                        seeds: List[int]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -201,31 +242,32 @@ class Generator:
         logits, caches = self._prefill(bb, pb)(
             self.params, put(tokens), put(attn_mask), put(pos_ids), caches)
 
-        # First generated token comes from the prefill logits.
-        rng = jax.random.PRNGKey(seed)
-        rng, sub = jax.random.split(rng)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if temperature > 0:
-            first = jax.random.categorical(
-                sub, logits / temperature, axis=-1).astype(jnp.int32)
-        else:
-            first = greedy
+        # Per-row sampling params, padded to the batch bucket.
+        temps_arr = np.zeros((bb,), np.float32)
+        seeds_arr = np.zeros((bb,), np.int32)
+        temps_arr[:n] = temps
+        seeds_arr[:n] = np.asarray(seeds, np.int64).astype(np.int32)
+        temps_dev, seeds_dev = put(temps_arr), put(seeds_arr)
+        start_dev = put(start)
+
+        # First generated token comes from the prefill logits; its logical
+        # position in each row is the prompt length pb - start.
+        first = _sample(logits, seeds_dev, pb - jnp.asarray(start_dev),
+                        jnp.asarray(temps_dev))
         done = (first == eos_id)
 
         pieces = [np.asarray(first)[:, None]]
         tok, pos = first, pb
         decode = self._decode(bb)
-        t_dev = put(jnp.float32(temperature))
         eos_dev = put(jnp.int32(eos_id))
         remaining = max_new - 1
-        start_dev = put(start)
         # max_new is clamped to max_seq - pb, so every *needed* step writes
         # in-bounds; a final partial chunk may run steps past max_seq whose
         # outputs are discarded by the truncation below.
         while remaining > 0 and pos < self.max_seq:
-            caches, tok, done, rng, toks = decode(
-                self.params, caches, tok, pos, start_dev, done, rng,
-                t_dev, eos_dev)
+            caches, tok, done, toks = decode(
+                self.params, caches, tok, pos, start_dev, done, seeds_dev,
+                temps_dev, eos_dev)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk
             remaining -= self._step_chunk

@@ -69,10 +69,14 @@ class HttpWorkerClient:
     """Thread-safe persistent-connection pool to one worker."""
 
     def __init__(self, url: str, timeout_s: float = 5.0, default_port: int = 8080,
-                 pool_size: int = 64):
+                 pool_size: int = 64, gen_timeout_s: float = 120.0):
         self.host, self.port = parse_worker_url(url, default_port)
         self.url = f"{self.host}:{self.port}"
         self._timeout = timeout_s
+        # /generate holds the socket for a whole decode loop (+ first-call
+        # XLA compile) — the reference's 5 s /infer timeout would misread
+        # every realistic generation as a worker failure and trip breakers.
+        self._gen_timeout = max(gen_timeout_s, timeout_s)
         self._pool: "queue.LifoQueue[Optional[http.client.HTTPConnection]]" = queue.LifoQueue()
         for _ in range(pool_size):
             self._pool.put(None)  # lazily created
@@ -97,9 +101,14 @@ class HttpWorkerClient:
     def _release(self, conn: Optional[http.client.HTTPConnection]) -> None:
         self._pool.put(conn)
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 timeout_s: Optional[float] = None) -> dict:
         conn = self._acquire()
         try:
+            t = timeout_s if timeout_s is not None else self._timeout
+            conn.timeout = t
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
             conn.request(method, path, body=payload, headers=headers)
@@ -138,7 +147,8 @@ class HttpWorkerClient:
         return self._request("POST", "/infer", payload)
 
     def generate(self, payload: dict) -> dict:
-        return self._request("POST", "/generate", payload)
+        return self._request("POST", "/generate", payload,
+                             timeout_s=self._gen_timeout)
 
     def health(self) -> dict:
         return self._request("GET", "/health")

@@ -62,6 +62,7 @@ class Gateway:
                 worker,
                 timeout_s=self.config.worker_timeout_s,
                 default_port=self.config.default_worker_port,
+                gen_timeout_s=self.config.gen_timeout_s,
             )
             name = client.url
         else:

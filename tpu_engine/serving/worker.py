@@ -196,20 +196,25 @@ class WorkerNode:
         }
 
     def _process_gen_batch(self, items: List[_GenItem]) -> List[_GenResult]:
-        """Group by sampling params (one compiled batch per group), decode,
-        split results. Within a group the batch runs to the group's max
+        """Group by eos_id (a compile-time scalar of the decode executable);
+        temperature and seed are per-row vectors, so mixed sampling params
+        share one compiled batch. The batch runs to the group's max
         max_new_tokens; per-request counts are truncated after."""
-        start = time.perf_counter()
         results: List[Optional[_GenResult]] = [None] * len(items)
         groups = {}
         for idx, it in enumerate(items):
-            groups.setdefault((it.eos_id, it.temperature, it.seed), []).append(idx)
-        for (eos_id, temperature, seed), idxs in groups.items():
+            groups.setdefault(it.eos_id, []).append(idx)
+        for eos_id, idxs in groups.items():
+            t0 = time.perf_counter()
             max_new = max(items[i].max_new_tokens for i in idxs)
             toks = self.generator.generate(
                 [items[i].prompt for i in idxs], max_new_tokens=max_new,
-                eos_id=eos_id, temperature=temperature, seed=seed)
-            elapsed_us = int((time.perf_counter() - start) * 1e6 / max(1, len(items)))
+                eos_id=eos_id,
+                temperature=[items[i].temperature for i in idxs],
+                seed=[items[i].seed for i in idxs])
+            # Reference semantic: per-request time = batch_duration /
+            # batch_size, per group (worker_node.cpp:123).
+            elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))
             for i, row in zip(idxs, toks):
                 results[i] = _GenResult(row[: items[i].max_new_tokens], elapsed_us)
         return results

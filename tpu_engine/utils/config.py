@@ -48,4 +48,5 @@ class GatewayConfig:
     success_threshold: int = 2          # reference gateway.cpp:21
     breaker_timeout_s: float = 30.0     # reference gateway.cpp:22
     worker_timeout_s: float = 5.0       # reference gateway.cpp:32-33
+    gen_timeout_s: float = 120.0        # /generate: decode loop + compile
     default_worker_port: int = 8080     # reference parseUrl gateway.cpp:139,147
