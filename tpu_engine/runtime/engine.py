@@ -47,6 +47,7 @@ class InferenceEngine:
         rng_seed: int = 0,
         dtype: str = "bfloat16",
         batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+        shape_buckets: Optional[Sequence[Tuple[int, ...]]] = None,
         mesh=None,
         data_axis: str = "data",
         device=None,
@@ -63,6 +64,17 @@ class InferenceEngine:
         if mesh is not None:
             self._mesh_data_size = mesh.shape[data_axis]
         self._buckets = self._normalize_buckets(batch_buckets)
+        # Mixed-shape serving (BASELINE config 4): a small set of static
+        # per-sample input shapes; requests carry their true shape and run
+        # on the smallest bucket that fits (spatial zero-pad), one compiled
+        # executable per (shape bucket, batch bucket). The model's apply
+        # must be shape-polymorphic (fully-convolutional zoo entries are).
+        self._shape_buckets: Optional[Tuple[Tuple[int, ...], ...]] = None
+        if shape_buckets is not None:
+            normalized = {tuple(int(d) for d in s) for s in shape_buckets}
+            normalized.add(tuple(model.input_shape))
+            self._shape_buckets = tuple(sorted(
+                normalized, key=lambda s: (int(np.prod(s)), s)))
         self._device = device  # pin to one chip (serving lane); exclusive with mesh
         if mesh is not None and device is not None:
             raise ValueError("pass either mesh or device, not both")
@@ -113,16 +125,17 @@ class InferenceEngine:
                 return b
         return self._buckets[-1]
 
-    def _compiled(self, bucket: int):
-        exe = self._executables.get(bucket)
+    def _compiled(self, bucket: int, sample_shape: Optional[Tuple[int, ...]] = None):
+        key = bucket if sample_shape is None else (sample_shape, bucket)
+        exe = self._executables.get(key)
         if exe is not None:
             return exe
         with self._compile_lock:
-            exe = self._executables.get(bucket)
+            exe = self._executables.get(key)
             if exe is not None:
                 return exe
             start = time.monotonic()
-            shape = (bucket,) + tuple(self.spec.input_shape)
+            shape = (bucket,) + tuple(sample_shape or self.spec.input_shape)
             fn = lambda params, x: self.spec.apply(params, x, dtype=self._dtype)  # noqa: E731
             if self._mesh is not None:
                 jitted = jax.jit(
@@ -142,15 +155,24 @@ class InferenceEngine:
                 # placement matches what _stage_batch will feed it.
                 x0 = jax.device_put(x0, self._device)
             exe = jitted.lower(self.params, x0).compile()
-            self._executables[bucket] = exe
-            self._compile_times[bucket] = time.monotonic() - start
+            self._executables[key] = exe
+            self._compile_times[key] = time.monotonic() - start
             return exe
 
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               shapes: Optional[Sequence[Tuple[int, ...]]] = None) -> None:
         """Pre-compile executables (the reference pays graph compile at
-        session load, ``inference_engine.cpp:31``; we pay per bucket here)."""
+        session load, ``inference_engine.cpp:31``; we pay per bucket here).
+        `shapes=None` warms every shape bucket at the largest batch bucket
+        (what a loaded batcher produces); pass () to skip shape warmup."""
         for b in buckets or self._buckets:
             self._compiled(self._bucket_for(b))
+        if shapes is None:
+            shapes = self._shape_buckets or ()
+        default = tuple(self.spec.input_shape)
+        for s in shapes:
+            if tuple(s) != default:
+                self._compiled(self._buckets[-1], tuple(s))
 
     # -- input staging ---------------------------------------------------------
 
@@ -176,22 +198,52 @@ class InferenceEngine:
             return jax.device_put(x, self._device)
         return jnp.asarray(x)
 
+    def _shape_bucket_for(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Smallest bucket that fits every dim; else the largest (cropped)."""
+        for b in self._shape_buckets:
+            if len(b) == len(shape) and all(bd >= sd for bd, sd in zip(b, shape)):
+                return b
+        return self._shape_buckets[-1]
+
+    def _coerce_shaped(self, vec, shape: Tuple[int, ...],
+                       bucket: Tuple[int, ...]) -> np.ndarray:
+        """Place a sample of `shape` into a zero canvas of `bucket` (crop
+        dims that exceed — reference predict truncates oversize too)."""
+        arr = np.asarray(vec, dtype=np.float32).ravel()
+        n = int(np.prod(shape))
+        if arr.size < n:
+            arr = np.pad(arr, (0, n - arr.size))
+        arr = arr[:n].reshape(shape)
+        canvas = np.zeros(bucket, np.float32)
+        region = tuple(slice(0, min(bd, sd)) for bd, sd in zip(bucket, shape))
+        canvas[region] = arr[region]
+        return canvas
+
     # -- inference -------------------------------------------------------------
 
-    def predict(self, input_vector) -> np.ndarray:
+    def predict(self, input_vector, shape: Optional[Tuple[int, ...]] = None) -> np.ndarray:
         """Single-sample inference; returns the flat float32 output vector."""
-        return self.batch_predict([input_vector])[0]
+        return self.batch_predict([input_vector],
+                                  shapes=None if shape is None else [shape])[0]
 
-    def batch_predict(self, inputs: Sequence) -> List[np.ndarray]:
+    def batch_predict(self, inputs: Sequence,
+                      shapes: Optional[Sequence] = None) -> List[np.ndarray]:
         """Batched inference over a dynamic-size list of flat vectors.
 
         Replaces the reference's flatten+pad into one ORT tensor
         (``:151-173``): samples are coerced to the static per-sample shape,
         the batch is padded up to a compiled bucket, executed, and the
         outputs are split per request (``:195-206``).
+
+        `shapes` (mixed-shape serving): optional per-sample true shapes;
+        samples group by shape bucket and each group runs its own compiled
+        executable. Entries may be None (use the model's default shape).
         """
         if not inputs:
             return []
+        if self._shape_buckets is not None and shapes is not None and any(
+                s is not None for s in shapes):
+            return self._batch_predict_shaped(inputs, shapes)
         samples = [self._coerce_sample(v) for v in inputs]
         max_bucket = self._buckets[-1]
         # Two phases: dispatch every chunk first (JAX dispatch is async, so
@@ -212,6 +264,46 @@ class InferenceEngine:
             out.extend(y_host[i] for i in range(n_real))
         return out
 
+    def _batch_predict_shaped(self, inputs: Sequence,
+                              shapes: Sequence) -> List[np.ndarray]:
+        """Mixed-shape path: group by shape bucket, dispatch every group's
+        chunks (async), then materialize in request order."""
+        default = tuple(self.spec.input_shape)
+        groups: Dict[Tuple[int, ...], List[int]] = {}
+        canvases: List[np.ndarray] = [None] * len(inputs)  # type: ignore
+        for i, (vec, shape) in enumerate(zip(inputs, shapes)):
+            shape = default if shape is None else tuple(int(d) for d in shape)
+            bucket = self._shape_bucket_for(shape)
+            canvases[i] = self._coerce_shaped(vec, shape, bucket)
+            groups.setdefault(bucket, []).append(i)
+
+        max_bucket = self._buckets[-1]
+        pending: List[Tuple[List[int], object]] = []
+        for shape_bucket, idxs in groups.items():
+            for c0 in range(0, len(idxs), max_bucket):
+                chunk = idxs[c0:c0 + max_bucket]
+                bb = self._bucket_for(len(chunk))
+                exe = self._compiled(bb, shape_bucket)
+                buf = np.zeros((bb,) + shape_bucket, np.float32)
+                for row, i in enumerate(chunk):
+                    buf[row] = canvases[i]
+                if self._mesh is not None:
+                    x = jax.device_put(buf, data_sharding(
+                        self._mesh, self._data_axis, buf.ndim))
+                elif self._device is not None:
+                    x = jax.device_put(buf, self._device)
+                else:
+                    x = jnp.asarray(buf)
+                pending.append((chunk, exe(self.params, x)))
+                with self._stats_lock:
+                    self._execute_count += 1
+        out: List[np.ndarray] = [None] * len(inputs)  # type: ignore
+        for chunk, y in pending:
+            y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
+            for row, i in enumerate(chunk):
+                out[i] = y_host[row]
+        return out
+
     # -- observability ---------------------------------------------------------
 
     def stats(self) -> dict:
@@ -219,7 +311,9 @@ class InferenceEngine:
             "model": self.spec.name,
             "dtype": str(self._dtype.__name__ if hasattr(self._dtype, "__name__") else self._dtype),
             "buckets": list(self._buckets),
-            "compiled_buckets": sorted(self._executables),
+            "shape_buckets": (None if self._shape_buckets is None
+                              else [list(s) for s in self._shape_buckets]),
+            "compiled_buckets": sorted(self._executables, key=str),
             "compile_times_s": {str(k): round(v, 4) for k, v in self._compile_times.items()},
             "execute_count": self._execute_count,
             "mesh": None if self._mesh is None else {

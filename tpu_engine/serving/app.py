@@ -98,6 +98,7 @@ def serve_combined(
             lane_cfg.model,
             dtype=lane_cfg.dtype,
             batch_buckets=lane_cfg.batch_buckets,
+            shape_buckets=lane_cfg.shape_buckets,
             device=devices[i % len(devices)],
         )
         workers.append(WorkerNode(lane_cfg, engine=engine))

@@ -83,9 +83,20 @@ def main(argv=None) -> int:
         parser.add_argument("--port", type=int, default=8000)
         parser.add_argument("--warmup", action="store_true",
                             help="pre-compile all batch buckets before listening")
+        parser.add_argument("--shape-buckets", default=None,
+                            help="mixed-shape serving: comma-separated HxWxC "
+                                 "list, e.g. 320x320x3,640x640x3")
         args = parser.parse_args(rest)
+        worker_config = None
+        if args.shape_buckets:
+            from tpu_engine.utils.config import WorkerConfig
+
+            buckets = tuple(
+                tuple(int(d) for d in s.split("x"))
+                for s in args.shape_buckets.split(","))
+            worker_config = WorkerConfig(shape_buckets=buckets)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
-                       warmup=args.warmup)
+                       warmup=args.warmup, worker_config=worker_config)
         _run_forever()
         return 0
 

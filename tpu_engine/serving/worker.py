@@ -37,6 +37,7 @@ from tpu_engine.utils.config import WorkerConfig
 class _BatchItem:
     request_id: str
     input_data: Sequence[float]
+    shape: Optional[tuple] = None  # mixed-shape serving (BASELINE config 4)
 
 
 @dataclass
@@ -83,6 +84,7 @@ class WorkerNode:
                 self.config.model,
                 dtype=self.config.dtype,
                 batch_buckets=self.config.batch_buckets,
+                shape_buckets=self.config.shape_buckets,
             )
         self.engine = engine
         self.cache = _make_cache(self.config.cache_capacity)
@@ -123,18 +125,25 @@ class WorkerNode:
     # -- request path ---------------------------------------------------------
 
     @staticmethod
-    def _cache_key(input_data) -> bytes:
-        return np.asarray(input_data, dtype=np.float32).tobytes()
+    def _cache_key(input_data, shape=None) -> bytes:
+        blob = np.asarray(input_data, dtype=np.float32).tobytes()
+        if shape is not None:
+            blob = np.asarray(shape, np.int64).tobytes() + b"|" + blob
+        return blob
 
     def handle_infer(self, request: dict) -> dict:
         """Serve one /infer payload; wire schema identical to the reference
-        (``worker_node.cpp:50-83``)."""
+        (``worker_node.cpp:50-83``). Additive field: optional "shape"
+        [h, w, c] for mixed-shape models (engine shape buckets)."""
         with self._counter_lock:
             self._total_requests += 1
         request_id = request["request_id"]
         input_data = request["input_data"]
+        shape = request.get("shape")
+        if shape is not None:
+            shape = tuple(int(d) for d in shape)
 
-        key = self._cache_key(input_data)
+        key = self._cache_key(input_data, shape)
         cached = self.cache.get(key)
         if cached is not None:
             with self._counter_lock:
@@ -148,7 +157,8 @@ class WorkerNode:
                 "inference_time_us": self.config.fake_cached_latency_us,
             }
 
-        result = self.batch_processor.process(_BatchItem(request_id, input_data))
+        result = self.batch_processor.process(
+            _BatchItem(request_id, input_data, shape))
         self.cache.put(key, result.output_data)
         return {
             "request_id": request_id,
@@ -160,7 +170,10 @@ class WorkerNode:
 
     def _process_batch(self, items: List[_BatchItem]) -> List[_BatchResult]:
         start = time.perf_counter()
-        outputs = self.engine.batch_predict([it.input_data for it in items])
+        shapes = ([it.shape for it in items]
+                  if any(it.shape is not None for it in items) else None)
+        outputs = self.engine.batch_predict(
+            [it.input_data for it in items], shapes=shapes)
         elapsed_us = (time.perf_counter() - start) * 1e6
         per_request_us = int(elapsed_us / max(1, len(items)))  # worker_node.cpp:123
         return [_BatchResult(out, per_request_us) for out in outputs]

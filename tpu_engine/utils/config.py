@@ -27,6 +27,9 @@ class WorkerConfig:
     batch_linger_ms: float = 0.0        # TPU extension: accumulation window
     dtype: str = "bfloat16"             # MXU-native compute dtype
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32)
+    # Mixed-shape serving (BASELINE config 4): per-sample input shapes the
+    # engine compiles executables for; requests carry "shape": [h, w, c].
+    shape_buckets: Optional[Tuple[Tuple[int, ...], ...]] = None
     fake_cached_latency_us: int = 50    # reference worker_node.cpp:65
     gen_max_batch_size: int = 8         # decode-lane batcher (transformers)
 
