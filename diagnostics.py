@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""6-step live-system smoke test — ops parity with the reference's
+diagnostics.sh (/root/reference/diagnostics.sh): process check (:9-24),
+port check (:27-36), worker /health (:39-56), gateway /stats (:59-68),
+direct worker /infer (:71-89), end-to-end gateway /infer (:92-109) — each
+with a ✓/✗ verdict and a non-zero exit code when any step fails.
+
+Usage:
+  python3 diagnostics.py [--gateway http://localhost:8000]
+                         [--workers localhost:8001 localhost:8002 ...]
+In combined single-process mode (`serve`), pass only --gateway: worker
+health is proxied at /health and there are no separate worker ports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import socket
+import subprocess
+import sys
+
+OK, FAIL = "✓", "✗"
+_results = []
+
+
+def step(n: int, title: str, ok: bool, detail: str = "") -> None:
+    mark = OK if ok else FAIL
+    print(f"[{n}/6] {title}: {mark} {detail}".rstrip())
+    _results.append(ok)
+
+
+def _get(hostport: str, path: str, timeout=5.0):
+    host, port = hostport.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _post(hostport: str, path: str, body: dict, timeout=30.0):
+    host, port = hostport.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _strip(url: str) -> str:
+    u = url.split("://", 1)[-1].split("/", 1)[0]
+    return u if ":" in u else u + ":8000"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gateway", default="http://localhost:8000")
+    ap.add_argument("--workers", nargs="*", default=[])
+    args = ap.parse_args()
+    gw = _strip(args.gateway)
+    workers = [w if ":" in w else w + ":8080" for w in args.workers]
+    combined = not workers
+
+    # 1. process check (reference :9-24)
+    try:
+        out = subprocess.run(
+            ["pgrep", "-af", "serving.cli|worker_node|gateway"],
+            capture_output=True, text=True).stdout.strip()
+        n_proc = len([ln for ln in out.splitlines() if "pgrep" not in ln])
+        step(1, "serving processes", n_proc > 0, f"({n_proc} found)")
+    except FileNotFoundError:
+        step(1, "serving processes", True, "(pgrep unavailable, skipped)")
+
+    # 2. port check (reference :27-36)
+    ports_ok = True
+    for hp in [gw] + workers:
+        host, port = hp.rsplit(":", 1)
+        s = socket.socket()
+        s.settimeout(2)
+        try:
+            s.connect((host, int(port)))
+        except OSError:
+            ports_ok = False
+        finally:
+            s.close()
+    step(2, "ports listening", ports_ok, f"({gw}{' + ' + str(len(workers)) + ' workers' if workers else ''})")
+
+    # 3. worker /health (reference :39-56)
+    ok, details = True, []
+    targets = workers or [gw]
+    for hp in targets:
+        try:
+            status, body = _get(hp, "/health")
+            healthy = status == 200 and body.get("healthy") is True
+            ok = ok and healthy
+            details.append(f"{body.get('node_id', hp)}:{'up' if healthy else 'DOWN'}")
+        except OSError as exc:
+            ok = False
+            details.append(f"{hp}:{exc}")
+    step(3, "worker health", ok, "(" + ", ".join(details) + ")")
+
+    # 4. gateway /stats (reference :59-68)
+    try:
+        status, body = _get(gw, "/stats")
+        n = body.get("total_workers", 0)
+        step(4, "gateway stats", status == 200 and n > 0, f"({n} workers)")
+    except OSError as exc:
+        step(4, "gateway stats", False, f"({exc})")
+
+    # 5. direct worker inference, bypassing the gateway (reference :71-89)
+    payload = {"request_id": "diag_direct", "input_data": [1.0, 2.0, 3.0]}
+    if combined:
+        step(5, "direct worker infer", True, "(combined mode: no direct port, skipped)")
+    else:
+        try:
+            status, body = _post(workers[0], "/infer", payload)
+            step(5, "direct worker infer", status == 200 and "output_data" in body,
+                 f"({len(body.get('output_data', []))} outputs from {body.get('node_id')})")
+        except OSError as exc:
+            step(5, "direct worker infer", False, f"({exc})")
+
+    # 6. end-to-end through the gateway (reference :92-109)
+    try:
+        status, body = _post(gw, "/infer",
+                             {"request_id": "diag_e2e", "input_data": [4.0, 5.0, 6.0]})
+        step(6, "gateway end-to-end infer", status == 200 and "output_data" in body,
+             f"(node {body.get('node_id')}, {body.get('inference_time_us')} us)")
+    except OSError as exc:
+        step(6, "gateway end-to-end infer", False, f"({exc})")
+
+    n_ok = sum(_results)
+    print(f"\n{n_ok}/{len(_results)} checks passed")
+    return 0 if n_ok == len(_results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Fault-injection scenario (BASELINE config 5) — in-process version of
+tools/fault_injection.py: inject a lane fault, watch the breaker
+OPEN -> HALF_OPEN probe -> CLOSED while failover keeps traffic at 100%.
+"""
+
+import time
+
+import pytest
+
+from tpu_engine.serving.gateway import Gateway
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+
+@pytest.fixture
+def stack():
+    workers = [
+        WorkerNode(WorkerConfig(node_id=f"worker_{i+1}", model="mlp",
+                                batch_timeout_ms=2.0))
+        for i in range(3)
+    ]
+    gw = Gateway(workers, GatewayConfig(failure_threshold=3,
+                                        success_threshold=2,
+                                        breaker_timeout_s=0.3))
+    yield gw, workers
+    for w in workers:
+        w.stop()
+
+
+def _route_map(gw, n=60):
+    pools = {}
+    for i in range(n):
+        rid = f"probe_{i}"
+        out = gw.route_request({"request_id": rid, "input_data": [float(i)] * 3})
+        pools.setdefault(out["node_id"], []).append(rid)
+    return pools
+
+
+def _state(gw, node):
+    for br in gw.get_stats()["circuit_breakers"]:
+        if br["node"] == node:
+            return br["state"]
+    return None
+
+
+def test_fault_injection_full_cycle(stack):
+    gw, workers = stack
+    pools = _route_map(gw)
+    victim = max(pools, key=lambda k: len(pools[k]))
+    victim_ids = pools[victim]
+    assert len(victim_ids) >= 3
+    w_victim = next(w for w in workers if w.node_id == victim)
+
+    # Fault: victim-primary traffic fails over; breaker opens.
+    w_victim.inject_fault("test")
+    for rid in victim_ids:
+        out = gw.route_request({"request_id": rid, "input_data": [1.0, 2.0, 3.0]})
+        assert out["node_id"] != victim
+    assert _state(gw, victim) == "OPEN"
+    assert gw.get_stats()["failovers"] >= len(victim_ids[:3])
+    assert not w_victim.get_health()["healthy"]
+
+    # While OPEN (pre-timeout), victim is skipped without being called.
+    before = w_victim.get_health()["total_requests"]
+    gw.route_request({"request_id": victim_ids[0], "input_data": [1.0, 2.0, 3.0]})
+    assert w_victim.get_health()["total_requests"] == before
+
+    # Heal + wait out the timeout: HALF_OPEN probe succeeds, breaker closes.
+    w_victim.heal()
+    time.sleep(0.35)
+    for rid in victim_ids[:2]:
+        out = gw.route_request({"request_id": rid, "input_data": [1.0, 2.0, 3.0]})
+        assert out["node_id"] == victim
+    assert _state(gw, victim) == "CLOSED"
+    assert w_victim.get_health()["healthy"]
+
+
+def test_fault_on_generate_path():
+    """/generate failures feed the same breakers."""
+    w = WorkerNode(WorkerConfig(node_id="g1", model="gpt2-small-test",
+                                batch_timeout_ms=2.0))
+    try:
+        gw = Gateway([w], GatewayConfig(failure_threshold=2,
+                                        breaker_timeout_s=30.0))
+        ok = gw.route_generate({"request_id": "a", "prompt_tokens": [5, 9],
+                                "max_new_tokens": 2})
+        assert ok["tokens"]
+        w.inject_fault()
+        from tpu_engine.serving.gateway import GatewayError
+
+        for _ in range(2):
+            with pytest.raises(GatewayError):
+                gw.route_generate({"request_id": "a", "prompt_tokens": [5, 9],
+                                   "max_new_tokens": 2})
+        assert _state(gw, "g1") == "OPEN"
+    finally:
+        w.stop()

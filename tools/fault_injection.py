@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Fault-injection harness — BASELINE config 5's breaker scenario, scripted.
+
+The reference "tests" fault tolerance by hand: kill a worker, eyeball the
+gateway stats (/root/reference/README.md:322-349). This harness runs the
+scenario end-to-end against a live combined server and asserts the breaker
+state machine (5 consecutive failures -> OPEN; after timeout one probe ->
+HALF_OPEN; 2 successes -> CLOSED, gateway.cpp:19-23 semantics):
+
+  phase 1  baseline load, all lanes healthy        -> 100% success
+  phase 2  inject fault into one lane, keep load   -> failovers, breaker OPEN
+  phase 3  heal the lane, wait breaker timeout     -> probe, breaker CLOSED
+  phase 4  final load                              -> 100% success again
+
+Usage:
+  python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
+      [--requests-per-phase 60] [--breaker-timeout 2.0]
+Start the server first, with a short breaker timeout so phase 3 is quick:
+  python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
+      --port 8000 --breaker-timeout 2
+Prints a JSON report; exit 0 iff every phase met its assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import time
+
+
+def _call(port: int, method: str, path: str, body=None, timeout=30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers={"Content-Type": "application/json"} if payload else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def load(port: int, ids, tag: str):
+    ok = fail = 0
+    nodes = {}
+    for i, rid in enumerate(ids):
+        try:
+            status, body = _call(port, "POST", "/infer", {
+                "request_id": rid,
+                "input_data": [float(i % 10), float(i % 10 + 1), float(i % 10 + 2)],
+            })
+            if status == 200:
+                ok += 1
+                nodes[body["node_id"]] = nodes.get(body["node_id"], 0) + 1
+            else:
+                fail += 1
+        except OSError:
+            fail += 1
+    return ok, fail, nodes
+
+
+def route_map(port: int, n: int):
+    """Pre-pass: learn which request ids route to which lane. The ring is
+    reference-faithful 32-bit FNV-1a and therefore skewed (the reference's
+    own published load split is 46.8/24.7/38.5, README.md:297-300) — fault
+    phases must use ids KNOWN to route to the victim, not hash luck."""
+    pools = {}
+    for i in range(n):
+        rid = f"probe_{i}"
+        status, body = _call(port, "POST", "/infer", {
+            "request_id": rid, "input_data": [float(i % 10)] * 3})
+        if status == 200:
+            pools.setdefault(body["node_id"], []).append(rid)
+    return pools
+
+
+def breaker_state(port: int, victim: str):
+    _, stats = _call(port, "GET", "/stats")
+    for br in stats.get("circuit_breakers", []):
+        if br["node"] == victim:
+            return br["state"], stats.get("failovers", 0)
+    return None, stats.get("failovers", 0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--victim", default="worker_1")
+    ap.add_argument("--requests-per-phase", type=int, default=60)
+    ap.add_argument("--breaker-timeout", type=float, default=30.0,
+                    help="server's breaker_timeout_s (phase 3 waits this long)")
+    args = ap.parse_args()
+    port, n = args.port, args.requests_per_phase
+    checks = []
+
+    # Phase 0: routing pre-pass — collect ids per lane, pick the victim.
+    pools = route_map(port, max(4 * n, 100))
+    victim = (args.victim
+              if len(pools.get(args.victim, [])) >= 5
+              else max(pools, key=lambda k: len(pools[k])))
+    victim_ids = pools[victim]
+    all_ids = [rid for p in pools.values() for rid in p]
+    report = {"victim": victim,
+              "routing": {k: len(v) for k, v in pools.items()},
+              "phases": {}}
+    checks.append(("victim owns enough keys to trip the breaker",
+                   len(victim_ids) >= 5))
+
+    # Phase 1: healthy baseline over every lane's keys. The pre-pass
+    # populated the LRU caches; reuse of the same ids exercises hits too.
+    ok, fail, nodes = load(port, all_ids[:n], "base")
+    state, _ = breaker_state(port, victim)
+    report["phases"]["baseline"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                    "breaker": state}
+    checks.append(("baseline 100% success", fail == 0))
+
+    # Phase 2: inject fault; drive ids that route PRIMARY to the victim so
+    # its breaker sees consecutive failures while failover answers them.
+    _call(port, "POST", "/admin/fault", {"node": victim, "action": "fail"})
+    ok, fail, nodes = load(port, victim_ids[:n], "fault")
+    state, failovers = breaker_state(port, victim)
+    report["phases"]["faulted"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                   "breaker": state, "failovers": failovers}
+    checks.append(("failover keeps success at 100%", fail == 0))
+    checks.append(("victim took no faulted traffic", victim not in nodes))
+    checks.append(("breaker OPEN after consecutive failures", state == "OPEN"))
+    checks.append(("failovers counted", failovers > 0))
+
+    # Phase 3: heal, wait out the breaker timeout, probe traffic re-closes it.
+    _call(port, "POST", "/admin/fault", {"node": victim, "action": "heal"})
+    time.sleep(args.breaker_timeout + 0.5)
+    ok, fail, nodes = load(port, victim_ids[:n], "heal")
+    state, _ = breaker_state(port, victim)
+    report["phases"]["healed"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                  "breaker": state}
+    checks.append(("breaker CLOSED after recovery", state == "CLOSED"))
+    checks.append(("victim serving again", nodes.get(victim, 0) > 0))
+
+    # Phase 4: steady state across all lanes.
+    ok, fail, nodes = load(port, all_ids[:n], "final")
+    report["phases"]["final"] = {"ok": ok, "fail": fail, "nodes": nodes}
+    checks.append(("final 100% success", fail == 0))
+
+    report["checks"] = {name: passed for name, passed in checks}
+    report["passed"] = all(p for _, p in checks)
+    print(json.dumps(report, indent=2))
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,23 @@ def serve_combined(
     for w in workers:
         server.route("GET", f"/health/{w.node_id}", lambda _b, w=w: (200, w.get_health()))
     server.route("GET", "/health", lambda _b: (200, workers[0].get_health()))
+
+    # Fault injection (BASELINE config 5). The reference injects faults by
+    # killing worker processes (README.md:322-349); in-process lanes expose
+    # an explicit admin hook instead: {"node": "worker_1", "action":
+    # "fail"|"heal"}.
+    def _admin_fault(body):
+        node = body.get("node")
+        action = body.get("action", "fail")
+        targets = [w for w in workers if w.node_id == node or node in (None, "*")]
+        if not targets:
+            return 404, {"error": f"unknown node '{node}'"}
+        for w in targets:
+            w.inject_fault() if action == "fail" else w.heal()
+        return 200, {"ok": True, "nodes": [w.node_id for w in targets],
+                     "action": action}
+
+    server.route("POST", "/admin/fault", _admin_fault)
     print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} device(s), port {port}")
     server.start(background=background)
     return gateway, workers, server

@@ -86,7 +86,16 @@ def main(argv=None) -> int:
         parser.add_argument("--shape-buckets", default=None,
                             help="mixed-shape serving: comma-separated HxWxC "
                                  "list, e.g. 320x320x3,640x640x3")
+        parser.add_argument("--breaker-timeout", type=float, default=None,
+                            help="circuit-breaker OPEN->HALF_OPEN timeout "
+                                 "seconds (default 30, reference gateway.cpp:22)")
         args = parser.parse_args(rest)
+        gateway_config = None
+        if args.breaker_timeout is not None:
+            from tpu_engine.utils.config import GatewayConfig
+
+            gateway_config = GatewayConfig(port=args.port,
+                                           breaker_timeout_s=args.breaker_timeout)
         worker_config = None
         if args.shape_buckets:
             from tpu_engine.utils.config import WorkerConfig
@@ -96,7 +105,8 @@ def main(argv=None) -> int:
                 for s in args.shape_buckets.split(","))
             worker_config = WorkerConfig(shape_buckets=buckets)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
-                       warmup=args.warmup, worker_config=worker_config)
+                       warmup=args.warmup, worker_config=worker_config,
+                       gateway_config=gateway_config)
         _run_forever()
         return 0
 

@@ -121,6 +121,19 @@ class WorkerNode:
         self._total_requests = 0
         self._cache_hits = 0
         self._counter_lock = threading.Lock()
+        # Fault injection (BASELINE config 5): the reference injects faults
+        # by killing worker processes (README.md:322-349); in-process lanes
+        # need an explicit hook. While set, every request raises — the
+        # gateway's breaker sees it exactly like a dead worker.
+        self._injected_fault: Optional[str] = None
+
+    # -- fault injection -------------------------------------------------------
+
+    def inject_fault(self, reason: str = "injected") -> None:
+        self._injected_fault = reason
+
+    def heal(self) -> None:
+        self._injected_fault = None
 
     # -- request path ---------------------------------------------------------
 
@@ -135,6 +148,8 @@ class WorkerNode:
         """Serve one /infer payload; wire schema identical to the reference
         (``worker_node.cpp:50-83``). Additive field: optional "shape"
         [h, w, c] for mixed-shape models (engine shape buckets)."""
+        if self._injected_fault is not None:
+            raise RuntimeError(f"fault injected: {self._injected_fault}")
         with self._counter_lock:
             self._total_requests += 1
         request_id = request["request_id"]
@@ -190,6 +205,8 @@ class WorkerNode:
         """
         if self.generator is None:
             raise ValueError(f"model '{self.config.model}' does not support generation")
+        if self._injected_fault is not None:
+            raise RuntimeError(f"fault injected: {self._injected_fault}")
         with self._counter_lock:
             self._total_requests += 1
         item = _GenItem(
@@ -240,7 +257,7 @@ class WorkerNode:
         with self._counter_lock:
             total, hits = self._total_requests, self._cache_hits
         return {
-            "healthy": True,
+            "healthy": self._injected_fault is None,
             "node_id": self.node_id,
             "total_requests": total,
             "cache_hits": hits,
