@@ -135,6 +135,25 @@ def serve_combined(
                      "action": action}
 
     server.route("POST", "/admin/fault", _admin_fault)
+
+    # Tracing (SURVEY.md §5: the reference has only per-request wall clocks).
+    def _trace(_body):
+        return 200, {
+            "summary": {w.node_id: w.tracer.summary() for w in workers},
+            "recent": [s for w in workers for s in w.tracer.recent(20)],
+        }
+
+    def _admin_profile(body):
+        from tpu_engine.utils import tracing
+
+        if body.get("action") == "start":
+            return 200, tracing.profiler_start(body.get("log_dir", "/tmp/tpu_engine_profile"))
+        if body.get("action") == "stop":
+            return 200, tracing.profiler_stop()
+        return 400, {"error": "action must be start|stop"}
+
+    server.route("GET", "/trace", _trace)
+    server.route("POST", "/admin/profile", _admin_profile)
     print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} device(s), port {port}")
     server.start(background=background)
     return gateway, workers, server

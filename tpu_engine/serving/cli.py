@@ -110,7 +110,28 @@ def main(argv=None) -> int:
         _run_forever()
         return 0
 
-    print(f"unknown command '{cmd}' (expected worker_node | gateway | serve)")
+    if cmd == "save-checkpoint":
+        # Initialize a model's params and persist them — gives model_path
+        # launch lines (reference worker_node.cpp:154-168) a real artifact.
+        parser = argparse.ArgumentParser(prog="save-checkpoint")
+        parser.add_argument("--model", required=True)
+        parser.add_argument("--out", required=True)
+        parser.add_argument("--seed", type=int, default=0)
+        args = parser.parse_args(rest)
+        import jax
+
+        from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
+        from tpu_engine.utils.checkpoint import save_params
+
+        _ensure_builtin_models_imported()
+        spec = create_model(args.model)
+        params = spec.init(jax.random.PRNGKey(args.seed))
+        path = save_params(args.out, params)
+        print(f"saved {args.model} params -> {path}")
+        return 0
+
+    print(f"unknown command '{cmd}' "
+          "(expected worker_node | gateway | serve | save-checkpoint)")
     return 2
 
 

@@ -21,6 +21,7 @@ or in-process by the gateway (TPU-native shape: one process, lanes = chips).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -31,6 +32,7 @@ import numpy as np
 from tpu_engine.core.lru_cache import LRUCache
 from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.utils.config import WorkerConfig
+from tpu_engine.utils.tracing import SpanRecorder
 
 
 @dataclass
@@ -80,8 +82,17 @@ class WorkerNode:
         if engine is None:
             from tpu_engine.runtime.engine import InferenceEngine
 
+            params = None
+            if self.config.model_path and os.path.isdir(self.config.model_path):
+                # model_path (reference positional arg / $MODEL_PATH,
+                # worker_node.cpp:154-168) points at an orbax checkpoint
+                # directory — real weights instead of random init.
+                from tpu_engine.utils.checkpoint import load_params
+
+                params = load_params(self.config.model_path)
             engine = InferenceEngine(
                 self.config.model,
+                params=params,
                 dtype=self.config.dtype,
                 batch_buckets=self.config.batch_buckets,
                 shape_buckets=self.config.shape_buckets,
@@ -126,6 +137,7 @@ class WorkerNode:
         # need an explicit hook. While set, every request raises — the
         # gateway's breaker sees it exactly like a dead worker.
         self._injected_fault: Optional[str] = None
+        self.tracer = SpanRecorder()
 
     # -- fault injection -------------------------------------------------------
 
@@ -163,6 +175,8 @@ class WorkerNode:
         if cached is not None:
             with self._counter_lock:
                 self._cache_hits += 1
+            self.tracer.record(request_id, "infer", self.node_id,
+                               self.config.fake_cached_latency_us, cached=True)
             return {
                 "request_id": request_id,
                 "output_data": cached.tolist(),
@@ -175,6 +189,8 @@ class WorkerNode:
         result = self.batch_processor.process(
             _BatchItem(request_id, input_data, shape))
         self.cache.put(key, result.output_data)
+        self.tracer.record(request_id, "infer", self.node_id,
+                           result.inference_time_us)
         return {
             "request_id": request_id,
             "output_data": result.output_data.tolist(),
@@ -218,6 +234,8 @@ class WorkerNode:
             seed=int(request.get("seed", 0)),
         )
         result = self._gen_processor.process(item)
+        self.tracer.record(item.request_id, "generate", self.node_id,
+                           result.generate_time_us)
         return {
             "request_id": item.request_id,
             "tokens": result.tokens,

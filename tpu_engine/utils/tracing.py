@@ -1,0 +1,93 @@
+"""Tracing / profiling — replaces the reference's ad-hoc wall-clock timing.
+
+The reference's only tracing is a per-batch stopwatch divided by batch size
+(``/root/reference/src/worker_node.cpp:108-123``) surfaced as
+``inference_time_us``; no spans, no trace ids, no profiler (SURVEY.md §5).
+Here:
+
+- `SpanRecorder` — a lock-guarded ring buffer of recent request spans
+  (request_id, op, node, duration, cached, batch size). Zero-allocation
+  steady state, O(capacity) memory, exposed at ``GET /trace`` so the
+  `inference_time_us` wire field finally has a server-side counterpart.
+- `profiler_start` / `profiler_stop` — ``jax.profiler`` session wrappers
+  (XLA device traces viewable in TensorBoard / Perfetto), driven by
+  ``POST /admin/profile`` on the combined server.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class SpanRecorder:
+    def __init__(self, capacity: int = 512):
+        self._spans = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, request_id: str, op: str, node: str, duration_us: int,
+               *, cached: bool = False, batch_size: int = 1) -> None:
+        span = {
+            "request_id": request_id,
+            "op": op,
+            "node": node,
+            "duration_us": int(duration_us),
+            "cached": cached,
+            "batch_size": batch_size,
+            "ts": time.time(),
+        }
+        with self._lock:
+            self._spans.append(span)
+
+    def recent(self, n: int = 100):
+        with self._lock:
+            items = list(self._spans)
+        return items[-n:]
+
+    def summary(self) -> dict:
+        with self._lock:
+            items = list(self._spans)
+        if not items:
+            return {"spans": 0}
+        durs = sorted(s["duration_us"] for s in items)
+
+        def pct(p):
+            return durs[min(len(durs) - 1, int(p / 100 * len(durs)))]
+
+        return {
+            "spans": len(items),
+            "cached": sum(1 for s in items if s["cached"]),
+            "duration_us": {"p50": pct(50), "p90": pct(90), "p99": pct(99),
+                            "max": durs[-1]},
+        }
+
+
+_profile_lock = threading.Lock()
+_profile_dir: Optional[str] = None
+
+
+def profiler_start(log_dir: str) -> dict:
+    """Begin a jax.profiler trace (device + host) into `log_dir`."""
+    global _profile_dir
+    import jax
+
+    with _profile_lock:
+        if _profile_dir is not None:
+            return {"error": f"profiler already running -> {_profile_dir}"}
+        jax.profiler.start_trace(log_dir)
+        _profile_dir = log_dir
+    return {"ok": True, "log_dir": log_dir}
+
+
+def profiler_stop() -> dict:
+    global _profile_dir
+    import jax
+
+    with _profile_lock:
+        if _profile_dir is None:
+            return {"error": "profiler not running"}
+        jax.profiler.stop_trace()
+        out, _profile_dir = _profile_dir, None
+    return {"ok": True, "log_dir": out}
