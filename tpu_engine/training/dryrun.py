@@ -2,9 +2,15 @@
 
 Run by the driver with N virtual CPU devices to validate that the
 framework's multi-chip shardings compile and execute without real chips
-(same mechanism as tests/conftest.py). The mesh factors n_devices into
-(data, model) axes — data parallelism plus tensor parallelism — and runs
-one optimizer step on tiny shapes.
+(same mechanism as tests/conftest.py). Exercises every parallelism
+strategy the framework ships:
+
+  dp + tp — full training step on a (data, model) mesh (NamedShardings;
+            XLA inserts grad psum over `data`, TP collectives over `model`)
+  sp      — seq-parallel transformer forward with ring attention
+            (ppermute KV rotation) on a ("seq",) mesh
+  pp      — GPipe microbatch pipeline of stacked layers on a ("stage",) mesh
+  ep      — expert-parallel MoE forward, experts sharded on ("expert",)
 """
 
 from __future__ import annotations
@@ -59,5 +65,82 @@ def run_dryrun(n_devices: int, verbose: bool = True) -> float:
     loss = float(jax.block_until_ready(loss))
     assert loss == loss, "NaN loss in dryrun"  # noqa: PLR0124
     if verbose:
-        print(f"dryrun train step OK: loss={loss:.6f}")
+        print(f"dryrun dp{dp}xtp{tp} train step OK: loss={loss:.6f}")
+
+    _dryrun_seq_parallel(devices, verbose)
+    _dryrun_pipeline(devices, verbose)
+    _dryrun_expert_parallel(devices, verbose)
     return loss
+
+
+def _dryrun_seq_parallel(devices, verbose):
+    """sp: ring attention inside a jitted GPT forward, tokens sharded."""
+    import functools
+
+    from jax.sharding import NamedSharding
+
+    from tpu_engine.models.transformer import (
+        TransformerConfig, transformer_apply, transformer_init)
+    from tpu_engine.parallel.ring import ring_attention
+
+    n = len(devices)
+    mesh = create_mesh((n,), ("seq",), devices=devices)
+    cfg = TransformerConfig(vocab=64, n_layers=2, d_model=16, n_heads=4,
+                            d_ff=32, max_seq=8 * n, causal=True)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.device_put(
+        jnp.zeros((1, 4 * n), jnp.int32),
+        NamedSharding(mesh, P(None, "seq")))
+    ring = functools.partial(ring_attention, mesh=mesh, axis_name="seq")
+
+    @jax.jit
+    def fwd(params, tokens):
+        return transformer_apply(
+            params, tokens, cfg, dtype=jnp.float32,
+            attn_fn=lambda q, k, v, causal, mask: ring(
+                q, k, v, causal=causal, kv_mask=mask))
+
+    logits = jax.block_until_ready(fwd(params, tokens))
+    assert bool(jnp.isfinite(logits).all()), "NaN in seq-parallel dryrun"
+    if verbose:
+        print(f"dryrun sp (ring attention over seq={n}) OK")
+
+
+def _dryrun_pipeline(devices, verbose):
+    """pp: stacked layers as a GPipe microbatch pipeline."""
+    from tpu_engine.parallel.pipeline import pipeline_apply
+
+    n = len(devices)
+    mesh = create_mesh((n,), ("stage",), devices=devices)
+    d = 8
+    keys = jax.random.split(jax.random.PRNGKey(1), 2 * n)
+    params = {"w": jnp.stack([jax.random.normal(k, (d, d)) / jnp.sqrt(d)
+                              for k in keys])}
+    x = jnp.ones((2 * n, d))
+    out = pipeline_apply(lambda lp, h: jnp.tanh(h @ lp["w"]), params, x, mesh)
+    assert bool(jnp.isfinite(jax.block_until_ready(out)).all())
+    if verbose:
+        print(f"dryrun pp ({n} stages x 2 layers) OK")
+
+
+def _dryrun_expert_parallel(devices, verbose):
+    """ep: MoE forward with experts sharded over the mesh."""
+    from jax.sharding import NamedSharding
+
+    from tpu_engine.ops.moe import MoEConfig, moe_apply, moe_init, shard_moe_params
+
+    n = len(devices)
+    mesh = create_mesh((n,), ("expert",), devices=devices)
+    cfg = MoEConfig(d_model=8, d_ff=16, n_experts=n, top_k=2)
+    params = moe_init(jax.random.PRNGKey(2), cfg)
+    params = jax.device_put(params, shard_moe_params(params, mesh))
+    x = jax.device_put(jnp.ones((2, 8, 8)), NamedSharding(mesh, P()))
+
+    @jax.jit
+    def fwd(p, x):
+        return moe_apply(p, x, cfg, dtype=jnp.float32)
+
+    out = jax.block_until_ready(fwd(params, x))
+    assert bool(jnp.isfinite(out).all()), "NaN in expert-parallel dryrun"
+    if verbose:
+        print(f"dryrun ep ({n} experts sharded) OK")
