@@ -44,7 +44,7 @@ def model_from_path(path_or_name: str) -> str:
 def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerNode, JsonHttpServer]:
     worker = WorkerNode(config)
     server = JsonHttpServer(config.port)
-    server.route("POST", "/infer", lambda body: (200, worker.handle_infer(body)))
+    server.route("POST", "/infer", lambda body: (200, worker.handle_infer_raw(body)))
     server.route("POST", "/generate", lambda body: (200, worker.handle_generate(body)))
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
     _print_worker_banner(worker, config)
@@ -57,7 +57,7 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     config = config or GatewayConfig()
     gateway = Gateway(worker_urls, config)
     server = JsonHttpServer(config.port)
-    server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("POST", "/infer", lambda body: (200, gateway.route_request_raw(body)))
     server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     print(f"Gateway listening on port {config.port}")
@@ -111,7 +111,7 @@ def serve_combined(
             w.engine.warmup()
     gateway = Gateway(workers, gateway_config)
     server = JsonHttpServer(port)
-    server.route("POST", "/infer", lambda body: (200, gateway.route_request(body)))
+    server.route("POST", "/infer", lambda body: (200, gateway.route_request_raw(body)))
     server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     # Lane health is addressable through the gateway process in combined mode.

@@ -40,6 +40,16 @@ class LocalWorkerClient:
         except Exception as exc:  # device/runtime failure → breaker signal
             raise WorkerError(str(exc)) from exc
 
+    def infer_raw(self, payload: dict) -> bytes:
+        """Pre-serialized response bytes (worker splices its cached output
+        fragment) — the combined server's hot path."""
+        try:
+            return self.worker.handle_infer_raw(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def generate(self, payload: dict) -> dict:
         try:
             return self.worker.handle_generate(payload)
@@ -103,6 +113,14 @@ class HttpWorkerClient:
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  timeout_s: Optional[float] = None) -> dict:
+        out = self._request_raw(method, path, body, timeout_s)
+        try:
+            return json.loads(out)
+        except Exception as exc:
+            raise WorkerError(f"worker {self.url}: bad response body: {exc}") from exc
+
+    def _request_raw(self, method: str, path: str, body: Optional[dict] = None,
+                     timeout_s: Optional[float] = None) -> bytes:
         conn = self._acquire()
         try:
             t = timeout_s if timeout_s is not None else self._timeout
@@ -134,17 +152,16 @@ class HttpWorkerClient:
             conn.close()
             self._release(None)
             raise WorkerError(f"worker {self.url} returned {resp.status}")
-        try:
-            out = json.loads(data)
-        except Exception as exc:
-            conn.close()
-            self._release(None)
-            raise WorkerError(f"worker {self.url}: bad response body: {exc}") from exc
         self._release(conn)
-        return out
+        return data
 
     def infer(self, payload: dict) -> dict:
         return self._request("POST", "/infer", payload)
+
+    def infer_raw(self, payload: dict) -> bytes:
+        """Raw response bytes, not parsed: the gateway proxies them verbatim
+        (the reference pays a parse + re-encode per hop, gateway.cpp:99-103)."""
+        return self._request_raw("POST", "/infer", payload)
 
     def generate(self, payload: dict) -> dict:
         return self._request("POST", "/generate", payload,

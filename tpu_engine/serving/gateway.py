@@ -92,6 +92,11 @@ class Gateway:
     def route_request(self, payload: dict) -> dict:
         return self._route(payload, op="infer")
 
+    def route_request_raw(self, payload: dict) -> bytes:
+        """Hot path: response stays pre-serialized bytes end-to-end (the
+        reference re-parses and re-encodes the float array at every hop)."""
+        return self._route(payload, op="infer_raw")
+
     def route_generate(self, payload: dict) -> dict:
         """Route a /generate request the same way as /infer: ring primary,
         breaker-gated, ring-order failover."""

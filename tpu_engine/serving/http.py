@@ -84,8 +84,11 @@ class JsonHttpServer:
             def log_message(self, *args):  # silence per-request stderr noise
                 pass
 
-            def _respond(self, status: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
+            def _respond(self, status: int, payload) -> None:
+                # Handlers may return pre-serialized bytes (hot /infer path)
+                # or a dict.
+                body = (payload if isinstance(payload, (bytes, bytearray))
+                        else json.dumps(payload).encode())
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

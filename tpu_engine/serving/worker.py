@@ -21,11 +21,12 @@ or in-process by the gateway (TPU-native shape: one process, lanes = chips).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -156,10 +157,14 @@ class WorkerNode:
             blob = np.asarray(shape, np.int64).tobytes() + b"|" + blob
         return blob
 
-    def handle_infer(self, request: dict) -> dict:
-        """Serve one /infer payload; wire schema identical to the reference
-        (``worker_node.cpp:50-83``). Additive field: optional "shape"
-        [h, w, c] for mixed-shape models (engine shape buckets)."""
+    def _infer_core(self, request: dict) -> Tuple[str, np.ndarray, bytes, bool, int]:
+        """Shared /infer flow → (request_id, output array, pre-encoded JSON
+        fragment of output_data, cached?, inference_time_us).
+
+        The fragment is cached alongside the array: serializing ~1000
+        floats costs ~670 µs in json.dumps but 1 µs to splice pre-encoded —
+        on a ~99% hit-rate workload (the reference's own benchmark) that
+        serialization dominated the whole request path."""
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         with self._counter_lock:
@@ -171,33 +176,47 @@ class WorkerNode:
             shape = tuple(int(d) for d in shape)
 
         key = self._cache_key(input_data, shape)
-        cached = self.cache.get(key)
-        if cached is not None:
+        hit = self.cache.get(key)
+        if hit is not None:
+            arr, frag = hit
             with self._counter_lock:
                 self._cache_hits += 1
             self.tracer.record(request_id, "infer", self.node_id,
                                self.config.fake_cached_latency_us, cached=True)
-            return {
-                "request_id": request_id,
-                "output_data": cached.tolist(),
-                "node_id": self.node_id,
-                "cached": True,
-                # Reference reports a fixed fake latency on hits (:65).
-                "inference_time_us": self.config.fake_cached_latency_us,
-            }
+            # Reference reports a fixed fake latency on hits (:65).
+            return request_id, arr, frag, True, self.config.fake_cached_latency_us
 
         result = self.batch_processor.process(
             _BatchItem(request_id, input_data, shape))
-        self.cache.put(key, result.output_data)
+        frag = json.dumps(result.output_data.tolist()).encode()
+        self.cache.put(key, (result.output_data, frag))
         self.tracer.record(request_id, "infer", self.node_id,
                            result.inference_time_us)
+        return (request_id, result.output_data, frag, False,
+                result.inference_time_us)
+
+    def handle_infer(self, request: dict) -> dict:
+        """Serve one /infer payload; wire schema identical to the reference
+        (``worker_node.cpp:50-83``). Additive field: optional "shape"
+        [h, w, c] for mixed-shape models (engine shape buckets)."""
+        request_id, arr, _frag, cached, time_us = self._infer_core(request)
         return {
             "request_id": request_id,
-            "output_data": result.output_data.tolist(),
+            "output_data": arr.tolist(),
             "node_id": self.node_id,
-            "cached": False,
-            "inference_time_us": result.inference_time_us,
+            "cached": cached,
+            "inference_time_us": time_us,
         }
+
+    def handle_infer_raw(self, request: dict) -> bytes:
+        """handle_infer, already serialized: the full response JSON built by
+        splicing the cached output fragment — no float re-encoding."""
+        request_id, _arr, frag, cached, time_us = self._infer_core(request)
+        return (b'{"request_id": ' + json.dumps(request_id).encode()
+                + b', "output_data": ' + frag
+                + b', "node_id": "' + self.node_id.encode() + b'"'
+                + b', "cached": ' + (b"true" if cached else b"false")
+                + b', "inference_time_us": ' + str(time_us).encode() + b"}")
 
     def _process_batch(self, items: List[_BatchItem]) -> List[_BatchResult]:
         start = time.perf_counter()
