@@ -90,7 +90,29 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tpu_bq_close.argtypes = [P]
     lib.tpu_bq_size.restype = c_size
     lib.tpu_bq_size.argtypes = [P]
+
+    lib.tpu_front_create.restype = P
+    lib.tpu_front_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.tpu_front_destroy.argtypes = [P]
+    lib.tpu_front_add_lane.argtypes = [P, ctypes.c_char_p, P, P]
+    lib.tpu_front_set_lane_enabled.argtypes = [P, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_front_set_handler.argtypes = [P, HANDLER_FN]
+    lib.tpu_front_start.restype = ctypes.c_int
+    lib.tpu_front_start.argtypes = [P]
+    lib.tpu_front_stop.argtypes = [P]
+    lib.tpu_front_lane_total.restype = ctypes.c_uint64
+    lib.tpu_front_lane_total.argtypes = [P, ctypes.c_char_p]
+    lib.tpu_front_lane_hits.restype = ctypes.c_uint64
+    lib.tpu_front_lane_hits.argtypes = [P, ctypes.c_char_p]
+    lib.tpu_front_reply.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_char_p, c_size]
     return lib
+
+
+# void handler(reply_ctx, method, path, body, body_len)
+HANDLER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_size_t)
 
 
 def _try_load() -> Optional[ctypes.CDLL]:
@@ -149,13 +171,21 @@ class NativeLRUCache:
     ``1`` vs ``1.0``, which hash-equal as dict keys but differ as pickles.)
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, raw: bool = False):
+        """``raw=True`` stores values as verbatim bytes (no pickle) — the
+        contract that lets the native HTTP front read entries directly."""
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self._lib = _try_load()
         if self._lib is None:
             raise RuntimeError("libtpucore.so is not available")
+        self._raw = raw
         self._h = self._lib.tpu_lru_create(capacity)
+
+    @property
+    def handle(self):
+        """The underlying C handle (for tpu_front_add_lane)."""
+        return self._h
 
     def __del__(self):
         lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
@@ -175,11 +205,14 @@ class NativeLRUCache:
         k = self._key_bytes(key)
         if not self._lib.tpu_lru_get(self._h, k, len(k), ctypes.byref(out), ctypes.byref(n)):
             return None
-        return pickle.loads(_take_bytes(self._lib, out, n.value))
+        blob = _take_bytes(self._lib, out, n.value)
+        return blob if self._raw else pickle.loads(blob)
 
     def put(self, key, value: Any) -> None:
         k = self._key_bytes(key)
-        v = pickle.dumps(value)
+        v = value if self._raw else pickle.dumps(value)
+        if not isinstance(v, bytes):
+            raise TypeError("raw NativeLRUCache values must be bytes")
         self._lib.tpu_lru_put(self._h, k, len(k), v, len(v))
 
     def clear(self) -> None:
@@ -354,3 +387,79 @@ def native_fnv1a_32(key: str) -> int:
         raise RuntimeError("libtpucore.so is not available")
     b = key.encode()
     return lib.tpu_fnv1a(b, len(b))
+
+
+class NativeHttpFront:
+    """The C++ HTTP front door (tpu_engine/native/http_front.h).
+
+    Serves /infer cache hits entirely in C++ (ring lookup + raw-mode LRU
+    fetch + response splice, no GIL); everything else — cache misses,
+    /generate, health/stats/admin — calls the Python ``fallback`` handler:
+    ``fallback(method: str, path: str, body: bytes) -> (status, bytes)``.
+    """
+
+    def __init__(self, port: int, fallback, virtual_nodes: int = 150,
+                 fake_cached_latency_us: int = 50):
+        self._lib = _try_load()
+        if self._lib is None:
+            raise RuntimeError("libtpucore.so is not available")
+        self._h = self._lib.tpu_front_create(port, virtual_nodes,
+                                             fake_cached_latency_us)
+        self.port = port
+        self._lanes: List[str] = []
+        lib = self._lib
+
+        def _handler(reply_ctx, method, path, body, body_len):
+            try:
+                status, payload = fallback(
+                    method.decode(), path.decode(), body or b"")
+            except Exception as exc:  # never let an exception cross ctypes
+                status, payload = 500, (
+                    b'{"error": ' + _json_str(str(exc)) + b"}")
+            lib.tpu_front_reply(reply_ctx, status, payload, len(payload))
+
+        # Keep a reference: the C side stores the raw function pointer.
+        self._handler_ref = HANDLER_FN(_handler)
+        self._lib.tpu_front_set_handler(self._h, self._handler_ref)
+
+    def add_lane(self, name: str, cache: "NativeLRUCache",
+                 breaker: "Optional[NativeCircuitBreaker]" = None) -> None:
+        if not getattr(cache, "_raw", False):
+            raise ValueError("front lanes need raw-mode NativeLRUCache")
+        self._lanes.append(name)
+        self._lib.tpu_front_add_lane(
+            self._h, name.encode(), cache.handle,
+            breaker._h if breaker is not None else None)
+
+    def set_lane_enabled(self, name: str, enabled: bool) -> None:
+        self._lib.tpu_front_set_lane_enabled(self._h, name.encode(),
+                                             1 if enabled else 0)
+
+    def start(self) -> int:
+        port = self._lib.tpu_front_start(self._h)
+        if port < 0:
+            raise OSError(f"native front failed to bind port {self.port}")
+        self.port = port
+        return port
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.tpu_front_stop(self._h)
+
+    def lane_counters(self, name: str):
+        n = name.encode()
+        return (int(self._lib.tpu_front_lane_total(self._h, n)),
+                int(self._lib.tpu_front_lane_hits(self._h, n)))
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.tpu_front_stop(h)
+            lib.tpu_front_destroy(h)
+            self._h = None
+
+
+def _json_str(s: str) -> bytes:
+    import json
+
+    return json.dumps(s).encode()

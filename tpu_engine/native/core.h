@@ -41,11 +41,15 @@ class LruCache {
   explicit LruCache(std::size_t capacity) : capacity_(capacity) {}
 
   // Returns true and copies the value on hit; promotes the entry to MRU.
-  bool Get(const std::string& key, std::string* value_out) {
+  // count_miss=false is for probe callers (the native HTTP front) whose
+  // misses fall through to a second, counted Get on the Python path —
+  // counting both would double every miss in the hit-rate stats.
+  bool Get(const std::string& key, std::string* value_out,
+           bool count_miss = true) {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = index_.find(key);
     if (it == index_.end()) {
-      ++misses_;
+      if (count_miss) ++misses_;
       return false;
     }
     order_.splice(order_.begin(), order_, it->second);

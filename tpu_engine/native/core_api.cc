@@ -7,11 +7,14 @@
 #include <cstring>
 
 #include "core.h"
+#include "http_front.h"
 
 using tpucore::BatchQueue;
 using tpucore::Breaker;
 using tpucore::HashRing;
+using tpucore::HttpFront;
 using tpucore::LruCache;
+using tpucore::ReplySlot;
 
 extern "C" {
 
@@ -163,5 +166,45 @@ int tpu_bq_pop_batch(void* h, char** bufs, std::size_t* lens,
 
 void tpu_bq_close(void* h) { static_cast<BatchQueue*>(h)->Close(); }
 std::size_t tpu_bq_size(void* h) { return static_cast<BatchQueue*>(h)->Size(); }
+
+// ----- native HTTP front ----------------------------------------------------
+
+void* tpu_front_create(int port, int virtual_nodes, int fake_cached_us) {
+  return new HttpFront(port, virtual_nodes, fake_cached_us);
+}
+void tpu_front_destroy(void* h) { delete static_cast<HttpFront*>(h); }
+
+// lru_handle must be a tpu_lru_create handle; breaker_handle a
+// tpu_breaker_create handle or NULL. The front borrows both (the Python
+// WorkerNode/Gateway keep ownership and share the same objects).
+void tpu_front_add_lane(void* h, const char* name, void* lru_handle,
+                        void* breaker_handle) {
+  static_cast<HttpFront*>(h)->AddLane(name,
+                                      static_cast<LruCache*>(lru_handle),
+                                      static_cast<Breaker*>(breaker_handle));
+}
+void tpu_front_set_lane_enabled(void* h, const char* name, int enabled) {
+  static_cast<HttpFront*>(h)->SetLaneEnabled(name, enabled != 0);
+}
+void tpu_front_set_handler(void* h, tpucore::PyHandler handler) {
+  static_cast<HttpFront*>(h)->SetHandler(handler);
+}
+int tpu_front_start(void* h) { return static_cast<HttpFront*>(h)->Start(); }
+void tpu_front_stop(void* h) { static_cast<HttpFront*>(h)->Stop(); }
+std::uint64_t tpu_front_lane_total(void* h, const char* name) {
+  return static_cast<HttpFront*>(h)->LaneTotal(name);
+}
+std::uint64_t tpu_front_lane_hits(void* h, const char* name) {
+  return static_cast<HttpFront*>(h)->LaneHits(name);
+}
+
+// Called by the Python fallback handler (inside the handler callback) to
+// deliver its response; the front copies the bytes before returning.
+void tpu_front_reply(void* reply_ctx, int status, const char* data,
+                     std::size_t len) {
+  auto* slot = static_cast<ReplySlot*>(reply_ctx);
+  slot->status = status;
+  slot->body.assign(data, len);
+}
 
 }  // extern "C"

@@ -76,6 +76,7 @@ def serve_combined(
     gateway_config: Optional[GatewayConfig] = None,
     background: bool = True,
     warmup: bool = False,
+    native_front: Optional[bool] = None,
 ):
     """One process: HTTP front door + in-process lanes over local devices.
 
@@ -110,14 +111,14 @@ def serve_combined(
         for w in workers:
             w.engine.warmup()
     gateway = Gateway(workers, gateway_config)
-    server = JsonHttpServer(port)
-    server.route("POST", "/infer", lambda body: (200, gateway.route_request_raw(body)))
-    server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
-    server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
+    routes = {}
+    routes[("POST", "/infer")] = lambda body: (200, gateway.route_request_raw(body))
+    routes[("POST", "/generate")] = lambda body: (200, gateway.route_generate(body))
+    routes[("GET", "/stats")] = lambda _body: (200, gateway.get_stats())
     # Lane health is addressable through the gateway process in combined mode.
     for w in workers:
-        server.route("GET", f"/health/{w.node_id}", lambda _b, w=w: (200, w.get_health()))
-    server.route("GET", "/health", lambda _b: (200, workers[0].get_health()))
+        routes[("GET", f"/health/{w.node_id}")] = lambda _b, w=w: (200, w.get_health())
+    routes[("GET", "/health")] = lambda _b: (200, workers[0].get_health())
 
     # Fault injection (BASELINE config 5). The reference injects faults by
     # killing worker processes (README.md:322-349); in-process lanes expose
@@ -134,7 +135,7 @@ def serve_combined(
         return 200, {"ok": True, "nodes": [w.node_id for w in targets],
                      "action": action}
 
-    server.route("POST", "/admin/fault", _admin_fault)
+    routes[("POST", "/admin/fault")] = _admin_fault
 
     # Tracing (SURVEY.md §5: the reference has only per-request wall clocks).
     def _trace(_body):
@@ -152,11 +153,78 @@ def serve_combined(
             return 200, tracing.profiler_stop()
         return 400, {"error": "action must be start|stop"}
 
-    server.route("GET", "/trace", _trace)
-    server.route("POST", "/admin/profile", _admin_profile)
-    print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} device(s), port {port}")
-    server.start(background=background)
+    routes[("GET", "/trace")] = _trace
+    routes[("POST", "/admin/profile")] = _admin_profile
+
+    server = _make_front_server(port, routes, workers, gateway, native_front)
+    kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"
+    print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} "
+          f"device(s), port {port} ({kind})")
+    if isinstance(server, JsonHttpServer):
+        server.start(background=background)
+    elif not background:
+        import time as _time
+
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
     return gateway, workers, server
+
+
+def _make_front_server(port: int, routes: dict, workers, gateway,
+                       native_front: Optional[bool]):
+    """Choose the serving edge: the C++ HttpFront (cache hits answered
+    without the GIL; misses + misc routes fall back to Python) when the
+    native lib and raw-mode lane caches are available, else the Python
+    ThreadingHTTPServer. native_front: None=auto, True=require, False=off."""
+    use_native = False
+    if native_front is not False:
+        try:
+            from tpu_engine.core import native
+
+            use_native = native.available() and all(
+                isinstance(w.cache, native.NativeLRUCache)
+                and getattr(w.cache, "_raw", False) for w in workers)
+        except Exception:
+            use_native = False
+        if native_front is True and not use_native:
+            raise RuntimeError("native front requested but libtpucore.so or "
+                               "raw-mode lane caches are unavailable")
+    if not use_native:
+        server = JsonHttpServer(port)
+        for (method, path), handler in routes.items():
+            server.route(method, path, handler)
+        return server
+
+    import json as _json
+
+    from tpu_engine.core.native import NativeHttpFront
+
+    def fallback(method: str, path: str, body: bytes):
+        handler = routes.get((method, path))
+        if handler is None:
+            return 404, _json.dumps({"error": f"no route {method} {path}"}).encode()
+        try:
+            parsed = _json.loads(body) if method == "POST" else None
+            status, payload = handler(parsed)
+        except (KeyError, ValueError, TypeError) as exc:
+            return 400, _json.dumps({"error": str(exc)}).encode()
+        except Exception as exc:
+            return 500, _json.dumps({"error": str(exc)}).encode()
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = _json.dumps(payload).encode()
+        return status, payload
+
+    front = NativeHttpFront(port, fallback)
+    for w in workers:
+        front.add_lane(w.node_id, w.cache, gateway.breaker_for(w.node_id))
+        w.external_counters = (lambda name=w.node_id: front.lane_counters(name))
+        w.on_fault_change(lambda healthy, name=w.node_id:
+                          front.set_lane_enabled(name, healthy))
+    front.start()
+    return front
 
 
 def _print_worker_banner(worker: WorkerNode, config: WorkerConfig) -> None:

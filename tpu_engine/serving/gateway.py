@@ -70,13 +70,33 @@ class Gateway:
             name = worker.node_id
         with self._lock:
             self._clients[name] = client
-            self._breakers[name] = CircuitBreaker(
-                self.config.failure_threshold,
-                self.config.success_threshold,
-                self.config.breaker_timeout_s,
-            )
+            self._breakers[name] = self._make_breaker()
         self._ring.add_node(name)
         return name
+
+    def _make_breaker(self):
+        """Native breaker when the C++ core is loaded — the native HTTP
+        front shares the same breaker object for its hit-path gate."""
+        try:
+            from tpu_engine.core import native
+
+            if native.available():
+                return native.NativeCircuitBreaker(
+                    self.config.failure_threshold,
+                    self.config.success_threshold,
+                    self.config.breaker_timeout_s,
+                )
+        except Exception:
+            pass
+        return CircuitBreaker(
+            self.config.failure_threshold,
+            self.config.success_threshold,
+            self.config.breaker_timeout_s,
+        )
+
+    def breaker_for(self, name: str):
+        with self._lock:
+            return self._breakers.get(name)
 
     def remove_worker(self, name: str) -> None:
         self._ring.remove_node(name)

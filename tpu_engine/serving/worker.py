@@ -66,11 +66,13 @@ class _GenResult:
 
 
 def _make_cache(capacity: int):
+    # Values are the pre-encoded output_data JSON fragments (bytes) — raw
+    # mode lets the native HTTP front read entries without unpickling.
     try:
         from tpu_engine.core import native
 
         if native.available():
-            return native.NativeLRUCache(capacity)
+            return native.NativeLRUCache(capacity, raw=True)
     except Exception:
         pass
     return LRUCache(capacity)
@@ -138,15 +140,28 @@ class WorkerNode:
         # need an explicit hook. While set, every request raises — the
         # gateway's breaker sees it exactly like a dead worker.
         self._injected_fault: Optional[str] = None
+        self._fault_listeners: list = []
+        # (total, hits) served on this lane's behalf outside this process's
+        # Python path — the native HTTP front reports through here.
+        self.external_counters = None
         self.tracer = SpanRecorder()
 
     # -- fault injection -------------------------------------------------------
 
     def inject_fault(self, reason: str = "injected") -> None:
         self._injected_fault = reason
+        for listener in self._fault_listeners:
+            listener(False)
 
     def heal(self) -> None:
         self._injected_fault = None
+        for listener in self._fault_listeners:
+            listener(True)
+
+    def on_fault_change(self, listener) -> None:
+        """Register listener(healthy: bool) — the native HTTP front uses
+        this to stop serving a faulted lane's cache hits in C++."""
+        self._fault_listeners.append(listener)
 
     # -- request path ---------------------------------------------------------
 
@@ -157,9 +172,9 @@ class WorkerNode:
             blob = np.asarray(shape, np.int64).tobytes() + b"|" + blob
         return blob
 
-    def _infer_core(self, request: dict) -> Tuple[str, np.ndarray, bytes, bool, int]:
-        """Shared /infer flow → (request_id, output array, pre-encoded JSON
-        fragment of output_data, cached?, inference_time_us).
+    def _infer_core(self, request: dict) -> Tuple[str, bytes, bool, int]:
+        """Shared /infer flow → (request_id, pre-encoded JSON fragment of
+        output_data, cached?, inference_time_us).
 
         The fragment is cached alongside the array: serializing ~1000
         floats costs ~670 µs in json.dumps but 1 µs to splice pre-encoded —
@@ -176,33 +191,31 @@ class WorkerNode:
             shape = tuple(int(d) for d in shape)
 
         key = self._cache_key(input_data, shape)
-        hit = self.cache.get(key)
-        if hit is not None:
-            arr, frag = hit
+        frag = self.cache.get(key)
+        if frag is not None:
             with self._counter_lock:
                 self._cache_hits += 1
             self.tracer.record(request_id, "infer", self.node_id,
                                self.config.fake_cached_latency_us, cached=True)
             # Reference reports a fixed fake latency on hits (:65).
-            return request_id, arr, frag, True, self.config.fake_cached_latency_us
+            return request_id, frag, True, self.config.fake_cached_latency_us
 
         result = self.batch_processor.process(
             _BatchItem(request_id, input_data, shape))
         frag = json.dumps(result.output_data.tolist()).encode()
-        self.cache.put(key, (result.output_data, frag))
+        self.cache.put(key, frag)
         self.tracer.record(request_id, "infer", self.node_id,
                            result.inference_time_us)
-        return (request_id, result.output_data, frag, False,
-                result.inference_time_us)
+        return request_id, frag, False, result.inference_time_us
 
     def handle_infer(self, request: dict) -> dict:
         """Serve one /infer payload; wire schema identical to the reference
         (``worker_node.cpp:50-83``). Additive field: optional "shape"
         [h, w, c] for mixed-shape models (engine shape buckets)."""
-        request_id, arr, _frag, cached, time_us = self._infer_core(request)
+        request_id, frag, cached, time_us = self._infer_core(request)
         return {
             "request_id": request_id,
-            "output_data": arr.tolist(),
+            "output_data": json.loads(frag),
             "node_id": self.node_id,
             "cached": cached,
             "inference_time_us": time_us,
@@ -211,7 +224,7 @@ class WorkerNode:
     def handle_infer_raw(self, request: dict) -> bytes:
         """handle_infer, already serialized: the full response JSON built by
         splicing the cached output fragment — no float re-encoding."""
-        request_id, _arr, frag, cached, time_us = self._infer_core(request)
+        request_id, frag, cached, time_us = self._infer_core(request)
         return (b'{"request_id": ' + json.dumps(request_id).encode()
                 + b', "output_data": ' + frag
                 + b', "node_id": "' + self.node_id.encode() + b'"'
@@ -293,6 +306,10 @@ class WorkerNode:
         m = self.batch_processor.get_metrics()
         with self._counter_lock:
             total, hits = self._total_requests, self._cache_hits
+        if self.external_counters is not None:
+            ext_total, ext_hits = self.external_counters()
+            total += ext_total
+            hits += ext_hits
         return {
             "healthy": self._injected_fault is None,
             "node_id": self.node_id,
