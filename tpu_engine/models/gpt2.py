@@ -71,3 +71,31 @@ def make_gpt2_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
                             n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
                             causal=True)
     return _spec_from_config("gpt2-small-test", cfg, seq_len)
+
+
+@register("gpt2-moe")
+def make_gpt2_moe(seq_len: int = 128, vocab: int = 50257, n_layers: int = 12,
+                  d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,
+                  max_seq: int = 1024, n_experts: int = 8, top_k: int = 2,
+                  capacity_factor: float = 1.25) -> ModelSpec:
+    """GPT-2 with a Mixture-of-Experts FFN in every block — the
+    expert-parallel serving family (experts shard over the `expert` mesh
+    axis, ops.moe). Same /infer and /generate contracts as gpt2."""
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True, n_experts=n_experts,
+                            moe_top_k=top_k,
+                            moe_capacity_factor=capacity_factor)
+    return _spec_from_config("gpt2-moe", cfg, seq_len)
+
+
+@register("gpt2-moe-test")
+def make_gpt2_moe_test(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
+                       d_model: int = 64, n_heads: int = 4, d_ff: int = 128,
+                       max_seq: int = 64, n_experts: int = 4) -> ModelSpec:
+    """Tiny MoE config; generous capacity so tests are drop-free."""
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True, n_experts=n_experts,
+                            moe_capacity_factor=4.0)
+    return _spec_from_config("gpt2-moe-test", cfg, seq_len)

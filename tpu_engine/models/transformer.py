@@ -45,23 +45,42 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq: int = 1024
     causal: bool = True  # decoder (GPT) vs encoder (BERT)
+    # Mixture-of-Experts FFN (0 = dense). Experts shard over the `expert`
+    # mesh axis (ops.moe); top-k routing, static capacity slots.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def d_head(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def moe(self):
+        from tpu_engine.ops.moe import MoEConfig
+
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         n_experts=self.n_experts, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor)
+
 
 def _block_init(key, cfg: TransformerConfig):
     k_attn, k_fc, k_proj = jax.random.split(key, 3)
-    return {
+    out = {
         "ln1": nn.layernorm_init(cfg.d_model),
         "attn": mha_init(k_attn, cfg.d_model, cfg.n_heads),
         "ln2": nn.layernorm_init(cfg.d_model),
-        "mlp": {
+    }
+    if cfg.n_experts > 0:
+        from tpu_engine.ops.moe import moe_init
+
+        out["mlp"] = moe_init(k_fc, cfg.moe)
+    else:
+        out["mlp"] = {
             "fc": nn.dense_init(k_fc, cfg.d_model, cfg.d_ff),
             "proj": nn.dense_init(k_proj, cfg.d_ff, cfg.d_model),
-        },
-    }
+        }
+    return out
 
 
 def transformer_init(key, cfg: TransformerConfig):
@@ -80,7 +99,11 @@ def transformer_init(key, cfg: TransformerConfig):
     }
 
 
-def _mlp(params, h, dtype):
+def _mlp(params, h, dtype, cfg: TransformerConfig = None):
+    if cfg is not None and cfg.n_experts > 0:
+        from tpu_engine.ops.moe import moe_apply
+
+        return moe_apply(params, h, cfg.moe, dtype=dtype)
     h = nn.dense(params["fc"], h, dtype=dtype)
     h = jax.nn.gelu(h)
     return nn.dense(params["proj"], h, dtype=dtype)
@@ -95,7 +118,7 @@ def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
     a = attn_fn(q, k, v, causal=cfg.causal, mask=mask)
     b, s = a.shape[:2]
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
     # nn.dense accumulates in f32; keep the residual-stream carry in the
     # compute dtype so the layer scan's carry type is stable.
     return h.astype(dtype)
@@ -156,7 +179,7 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
         a = dot_product_attention(q, ck, cv, mask=valid)
     b, s = a.shape[:2]
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
     return h.astype(dtype), (ck, cv)
 
 
