@@ -1,0 +1,49 @@
+"""Multi-host backend helpers — single-process degenerate forms (the same
+launch code must run unchanged from 1 host to N hosts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_engine.parallel.distributed import (
+    dcn_axis_recommendation,
+    hybrid_mesh,
+    initialize,
+)
+
+
+def test_initialize_single_process():
+    info = initialize()
+    assert info["num_processes"] == 1
+    assert info["process_id"] == 0
+    assert info["local_devices"] == 8
+
+
+def test_hybrid_mesh_single_host():
+    """dcn_shape defaults to all-ones on one host: plain ICI mesh."""
+    mesh = hybrid_mesh(ici_shape=(2, 4), axis_names=("data", "model"))
+    assert dict(mesh.shape) == {"data": 2, "model": 4}
+
+    # Train-step-shaped usage: batch over data, kernel over model.
+    x = jax.device_put(jnp.ones((4, 8)), NamedSharding(mesh, P("data", None)))
+    w = jax.device_put(jnp.ones((8, 8)), NamedSharding(mesh, P(None, "model")))
+
+    @jax.jit
+    def f(x, w):
+        return x @ w
+
+    out = f(x, w)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+
+def test_hybrid_mesh_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="must align"):
+        hybrid_mesh(ici_shape=(8,), axis_names=("data", "model"))
+    with pytest.raises(ValueError, match="needs"):
+        hybrid_mesh(ici_shape=(4,), axis_names=("data",))
+
+
+def test_dcn_recommendation():
+    assert "data" in dcn_axis_recommendation()

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Race detection for the native core — the sanitizer pass the reference
+# never had (its release flags are plain -O3 -march=native; SURVEY.md §5
+# "race detection: absent"). Builds the concurrency stress test twice:
+#   1. ThreadSanitizer   — data races, lock-order inversions
+#   2. AddressSanitizer  — heap errors in the buffer-passing C API
+# Any sanitizer report fails the script.
+set -euo pipefail
+cd "$(dirname "$0")/../tpu_engine/native"
+
+echo "== TSan =="
+g++ -std=c++17 -O1 -g -fsanitize=thread -pthread stress_test.cc -o /tmp/tpu_stress_tsan
+TSAN_OPTIONS="halt_on_error=1" /tmp/tpu_stress_tsan
+
+echo "== ASan =="
+g++ -std=c++17 -O1 -g -fsanitize=address,undefined -pthread stress_test.cc -o /tmp/tpu_stress_asan
+/tmp/tpu_stress_asan
+
+echo "race check: clean under TSan + ASan/UBSan"

@@ -1,0 +1,153 @@
+// Concurrency stress test for the native core — built with -fsanitize=thread
+// by tools/race_check.sh (race detection: the reference has no sanitizer
+// story at all, SURVEY.md §5 — its CMake flags are plain -O3).
+//
+// Hammers every shared structure from many threads simultaneously:
+//   LruCache   get/put/clear under contention (eviction + splice races)
+//   HashRing   lookups during add/remove (elastic membership)
+//   Breaker    allow/success/failure interleavings (state transitions)
+//   BatchQueue producers racing a consumer's timed batch pops
+//   HttpFront  hit-path counters vs lane enable/disable flips
+// Exit 0 = no crashes; TSan reports go to stderr and fail the run.
+
+#include <atomic>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "core.h"
+#include "http_front.h"
+
+using namespace tpucore;
+
+static constexpr int kThreads = 8;
+static constexpr int kIters = 3000;
+
+static void StressLru() {
+  LruCache cache(64);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&cache, t] {
+      std::string val;
+      for (int i = 0; i < kIters; ++i) {
+        std::string key = "k" + std::to_string((t * 7 + i) % 128);
+        if (i % 3 == 0) {
+          cache.Put(key, "v" + std::to_string(i));
+        } else if (i % 97 == 0) {
+          cache.Clear();
+        } else {
+          cache.Get(key, &val);
+        }
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  std::printf("lru ok (size=%zu hits=%llu misses=%llu)\n", cache.Size(),
+              (unsigned long long)cache.hits(),
+              (unsigned long long)cache.misses());
+}
+
+static void StressRing() {
+  HashRing ring(50);
+  ring.AddNode("a");
+  ring.AddNode("b");
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&ring, t] {
+      std::string node;
+      for (int i = 0; i < kIters; ++i) {
+        if (t == 0 && i % 200 == 0) {
+          ring.RemoveNode("c");
+          ring.AddNode("c");
+        } else {
+          ring.GetNode("key" + std::to_string(i), &node);
+          if (i % 50 == 0) ring.AllNodes();
+        }
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  std::printf("ring ok (%zu nodes)\n", ring.NumNodes());
+}
+
+static void StressBreaker() {
+  Breaker b(5, 2, 0.001);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&b, t] {
+      for (int i = 0; i < kIters; ++i) {
+        if (b.AllowRequest()) {
+          if ((t + i) % 3 == 0) {
+            b.RecordFailure();
+          } else {
+            b.RecordSuccess();
+          }
+        }
+        b.state();
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  std::printf("breaker ok (state=%d)\n", b.state());
+}
+
+static void StressBatchQueue() {
+  BatchQueue q(16, 0.001);
+  std::atomic<long long> popped{0};
+  std::thread consumer([&q, &popped] {
+    std::vector<BatchQueue::Item> items;
+    bool timed_out = false;
+    while (q.PopBatch(&items, &timed_out)) {
+      popped += (long long)items.size();
+    }
+  });
+  std::vector<std::thread> producers;
+  for (int t = 0; t < kThreads; ++t) {
+    producers.emplace_back([&q] {
+      for (int i = 0; i < kIters; ++i) q.Push("p" + std::to_string(i));
+    });
+  }
+  for (auto& t : producers) t.join();
+  q.Close();
+  consumer.join();
+  std::printf("batch queue ok (popped=%lld of %d)\n", popped.load(),
+              kThreads * kIters);
+  if (popped.load() != (long long)kThreads * kIters) std::abort();
+}
+
+static void StressFrontCounters() {
+  // Exercises Lane atomics + shared cache + breaker the way the HTTP hit
+  // path does, without sockets.
+  LruCache cache(128);
+  Breaker breaker(5, 2, 0.001);
+  HttpFront front(0, 50, 50);
+  front.AddLane("lane", &cache, &breaker);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&, t] {
+      std::string val;
+      for (int i = 0; i < kIters; ++i) {
+        std::string key = "k" + std::to_string(i % 64);
+        if (i % 2 == 0) cache.Put(key, "[1.0]");
+        cache.Get(key, &val, i % 3 == 0);
+        if (t == 0 && i % 100 == 0) {
+          front.SetLaneEnabled("lane", i % 200 == 0);
+        }
+        front.LaneTotal("lane");
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  std::printf("front counters ok\n");
+}
+
+int main() {
+  StressLru();
+  StressRing();
+  StressBreaker();
+  StressBatchQueue();
+  StressFrontCounters();
+  std::printf("ALL STRESS PASSED\n");
+  return 0;
+}

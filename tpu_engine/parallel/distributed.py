@@ -1,0 +1,104 @@
+"""Multi-host distributed backend: ICI within a host, DCN across hosts.
+
+The reference's only "communication backend" is HTTP/1.1 + JSON over TCP
+between gateway and worker processes (SURVEY.md §2: no NCCL/MPI/Gloo, no
+collectives). The TPU-native backend is XLA collectives compiled by the
+runtime: within a host/pod-slice they ride ICI; across hosts they ride DCN.
+This module is the process-group bootstrap + topology-aware mesh layout:
+
+- `initialize(...)` wraps `jax.distributed.initialize` (JAX's coordinator
+  protocol — one process per host, rendezvous at a coordinator address;
+  env-var driven exactly like the standard JAX multi-process launch).
+- `hybrid_mesh(...)` lays mesh axes out so the LEADING axes cross hosts
+  (DCN) and the trailing axes stay inside a host (ICI). The framework's
+  convention: `data` (gradient psum is one small all-reduce per step →
+  tolerant of DCN latency) spans hosts; `model`/`seq`/`expert` (per-layer
+  all-gather/ppermute/all-to-all traffic → needs ICI bandwidth) stay
+  host-local. This is the standard scaling recipe: pick a mesh, put
+  bandwidth-hungry axes on ICI, let XLA insert the collectives.
+- Serving across hosts keeps the reference deployment shape: each host
+  runs a combined server over its local chips and a gateway spreads
+  requests over hosts with HttpWorkerClient (DCN at the request level,
+  ICI inside each host's mesh).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> dict:
+    """Join the JAX process group (no-op for single-process runs).
+
+    Args default from the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) or cloud auto-detection. Returns a
+    summary dict {process_id, num_processes, local_devices, global_devices}.
+    """
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if explicit and jax.process_count() == 1 and num_processes != 1:
+        jax.distributed.initialize(
+            coordinator_address=explicit,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def hybrid_mesh(ici_shape: Sequence[int], axis_names: Sequence[str],
+                dcn_shape: Optional[Sequence[int]] = None,
+                devices=None) -> Mesh:
+    """Mesh whose axes factor into (DCN across hosts) x (ICI within host).
+
+    ici_shape: per-host axis sizes (prod == local device count).
+    dcn_shape: per-axis host counts (prod == process count); default puts
+    every host on the FIRST axis — e.g. 4 hosts x 8 chips with
+    ici_shape=(1, 8), axis_names=("data", "model") gives a (4, 8) mesh
+    where `data` crosses DCN and `model` stays on ICI.
+
+    Single-process runs degenerate to a plain mesh over local devices, so
+    the same launch code runs everywhere.
+    """
+    n_proc = jax.process_count()
+    if dcn_shape is None:
+        dcn_shape = (n_proc,) + (1,) * (len(ici_shape) - 1)
+    if len(dcn_shape) != len(ici_shape) or len(ici_shape) != len(axis_names):
+        raise ValueError("ici_shape, dcn_shape, axis_names must align")
+    if int(np.prod(dcn_shape)) != n_proc:
+        raise ValueError(f"dcn_shape {dcn_shape} must multiply to "
+                         f"process_count {n_proc}")
+
+    if n_proc == 1:
+        devices = list(devices if devices is not None else jax.devices())
+        shape = tuple(int(d * i) for d, i in zip(dcn_shape, ici_shape))
+        if int(np.prod(shape)) != len(devices):
+            raise ValueError(f"mesh {shape} needs {int(np.prod(shape))} "
+                             f"devices, have {len(devices)}")
+        return Mesh(np.array(devices).reshape(shape), tuple(axis_names))
+
+    from jax.experimental import mesh_utils
+
+    arr = mesh_utils.create_hybrid_device_mesh(
+        tuple(int(i) for i in ici_shape),
+        tuple(int(d) for d in dcn_shape),
+        devices=devices,
+    )
+    return Mesh(arr, tuple(axis_names))
+
+
+def dcn_axis_recommendation() -> Tuple[str, ...]:
+    """Which framework axes tolerate DCN: data (one gradient psum per
+    step). model/seq/expert exchange per-layer activations — keep on ICI."""
+    return ("data",)
