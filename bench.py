@@ -178,6 +178,94 @@ def launch_server(model: str, port: int, lanes: int) -> subprocess.Popen:
                             stdout=sys.stderr, stderr=sys.stderr)
 
 
+def run_cache_test(port: int, n: int = 100) -> dict:
+    """Reference benchmark.py's cache-effectiveness A/B (its :180-220):
+    n distinct inputs (miss phase), then the same n again (hit phase)."""
+    import random
+
+    rnd = random.Random(1234)
+    inputs = [[rnd.uniform(0, 100) for _ in range(3)] for _ in range(n)]
+
+    def phase(tag):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        lats = []
+        for i, vec in enumerate(inputs):
+            body = json.dumps({"request_id": f"cache_{tag}_{i}",
+                               "input_data": vec})
+            t0 = time.perf_counter()
+            conn.request("POST", "/infer", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+            lats.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
+        return statistics.fmean(lats)
+
+    # Same request_id per input across phases so both route to one lane.
+    miss_ms = phase("x")
+    hit_ms = phase("x")
+    return {
+        "miss_avg_ms": round(miss_ms, 3),
+        "hit_avg_ms": round(hit_ms, 3),
+        "speedup": round(miss_ms / max(hit_ms, 1e-9), 2),
+    }
+
+
+def run_generate_bench(port: int, n_requests: int = 16, max_new: int = 32,
+                       n_threads: int = 8) -> dict:
+    """Autoregressive decode throughput: concurrent /generate requests,
+    reports generated tokens/s (BASELINE config 5 workload)."""
+    import random
+
+    rnd = random.Random(7)
+    prompts = [[rnd.randrange(1, 200) for _ in range(rnd.randrange(4, 24))]
+               for _ in range(n_requests)]
+    tokens_out = [0] * n_threads
+    fails = [0] * n_threads
+
+    def worker(tid):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        for i in range(tid, n_requests, n_threads):
+            body = json.dumps({"request_id": f"gen_{i}",
+                               "prompt_tokens": prompts[i],
+                               "max_new_tokens": max_new})
+            try:
+                conn.request("POST", "/generate", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = json.loads(resp.read())
+                if resp.status == 200:
+                    tokens_out[tid] += len(data["tokens"])
+                else:
+                    fails[tid] += 1
+            except (OSError, http.client.HTTPException):
+                fails[tid] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        conn.close()
+
+    # Warm the compiled prefill/decode executables before timing.
+    warm = threading.Thread(target=worker, args=(0,))
+    warm.start()
+    warm.join()
+    tokens_out[0] = 0
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(tid,))
+               for tid in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = sum(tokens_out)
+    return {
+        "tokens": total,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total / wall, 2) if wall > 0 else 0.0,
+        "failed": sum(fails),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=10_000)
@@ -189,9 +277,15 @@ def main() -> int:
                     help="use an already-running server on this port")
     ap.add_argument("--quick", action="store_true",
                     help="1000 requests / 20 threads smoke run")
+    ap.add_argument("--cache-test", action="store_true",
+                    help="reference cache-effectiveness A/B instead of load")
+    ap.add_argument("--scenario", choices=["infer", "generate"],
+                    default="infer")
     args = ap.parse_args()
     if args.quick:
         args.requests, args.threads = 1000, 20
+    if args.scenario == "generate" and args.model == "resnet50":
+        args.model = "gpt2"
 
     proc = None
     port = args.port
@@ -201,6 +295,27 @@ def main() -> int:
             proc = launch_server(args.model, port, args.lanes)
         log(f"waiting for server on :{port} ...")
         wait_ready(port)
+
+        if args.cache_test:
+            result = run_cache_test(port)
+            log(json.dumps(result, indent=2))
+            print(json.dumps({
+                "metric": "cache_speedup", "value": result["speedup"],
+                "unit": "x", "vs_baseline": None, "model": args.model,
+                **result,
+            }), flush=True)
+            return 0
+
+        if args.scenario == "generate":
+            result = run_generate_bench(port)
+            log(json.dumps(result, indent=2))
+            print(json.dumps({
+                "metric": "decode_throughput", "value": result["tokens_per_s"],
+                "unit": "tokens/s", "vs_baseline": None, "model": args.model,
+                **result,
+            }), flush=True)
+            return 0 if result["failed"] == 0 else 1
+
         log("server ready; warmup pass (misses populate the cache) ...")
         warm = LoadGen(port, 20, 4)
         warm.run()
