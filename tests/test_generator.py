@@ -113,3 +113,28 @@ def test_scalar_seed_rows_differ(gen):
     outs = gen.generate([[5, 9], [5, 9]], max_new_tokens=12,
                         temperature=1.2, seed=0)
     assert outs[0] != outs[1]
+
+
+def test_top_p_filters_tail(gen):
+    """Tiny top_p restricts sampling to the argmax token: nucleus sampling
+    at p->0 must equal greedy; p=1.0 with temp must remain valid."""
+    prompt = [5, 9, 3]
+    greedy = gen.generate([prompt], max_new_tokens=8)[0]
+    nucleus = gen.generate([prompt], max_new_tokens=8, temperature=1.5,
+                           seed=[3], top_p=1e-6)[0]
+    assert nucleus == greedy
+    full = gen.generate([prompt], max_new_tokens=8, temperature=1.5,
+                        seed=[3], top_p=1.0)[0]
+    assert all(0 <= t < gen.cfg.vocab for t in full)
+
+
+def test_top_p_batch_invariant(gen):
+    """top_p rides the same per-row fold_in streams: co-batching doesn't
+    change a seeded nucleus-sampled request."""
+    prompt = [5, 9, 3]
+    alone = gen.generate([prompt], max_new_tokens=6, temperature=0.9,
+                         seed=[11], top_p=[0.8])[0]
+    batched = gen.generate([[2, 8], prompt], max_new_tokens=6,
+                           temperature=[0.7, 0.9], seed=[4, 11],
+                           top_p=[0.5, 0.8])[1]
+    assert alone == batched

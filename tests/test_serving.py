@@ -277,3 +277,43 @@ def test_http_worker_kill_failover(http_stack):
         new_s.route("GET", "/health", lambda _b: (200, w2.get_health()))
         new_s.start()
         http_stack["workers"][1] = (w2, new_s)
+
+
+def test_inflight_coalescing():
+    """Concurrent identical misses share one execution (the reference runs
+    them all, SURVEY.md §3.2); distinct inputs still execute separately."""
+    import threading as th
+
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    w = WorkerNode(WorkerConfig(node_id="co1", model="mlp", dtype="float32",
+                                batch_timeout_ms=30.0))
+    try:
+        results = []
+        errs = []
+
+        def fire(i):
+            try:
+                results.append(w.handle_infer(
+                    {"request_id": f"r{i}", "input_data": [1.0, 2.0, 3.0]}))
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [th.Thread(target=fire, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert len(results) == 6
+        outs = {tuple(r["output_data"]) for r in results}
+        assert len(outs) == 1  # identical answers
+        # One shared execution: the engine compiled-and-ran exactly once
+        # for this input (batcher saw a single item).
+        assert w.engine.stats()["execute_count"] == 1
+        # Next identical request is a plain cache hit.
+        assert w.handle_infer({"request_id": "r9",
+                               "input_data": [1.0, 2.0, 3.0]})["cached"]
+    finally:
+        w.stop()

@@ -43,19 +43,32 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
-def _sample(logits, seeds, positions, temperature):
-    """Per-row sampling: logits (B, V); seeds/positions/temperature (B,).
+def _sample(logits, seeds, positions, temperature, top_p=None):
+    """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p (B,).
 
-    Greedy where temperature == 0, else categorical with key
-    fold_in(PRNGKey(seed_r), position_r) — deterministic per (seed, position)
-    so co-batching and bucketing never change a request's tokens."""
+    Greedy where temperature == 0, else categorical (optionally
+    nucleus-filtered to the smallest token set with cumulative probability
+    >= top_p) with key fold_in(PRNGKey(seed_r), position_r) — deterministic
+    per (seed, position) so co-batching and bucketing never change a
+    request's tokens."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_p is None:
+        top_p = jnp.ones(logits.shape[:1], jnp.float32)
 
-    def row(key_seed, pos, lg, t):
+    def row(key_seed, pos, lg, t, p):
         key = jax.random.fold_in(jax.random.PRNGKey(key_seed), pos)
-        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+        lg = lg / jnp.maximum(t, 1e-6)
+        # Nucleus filter: keep the top tokens whose cumulative softmax mass
+        # reaches p (always at least one). p >= 1 keeps everything.
+        sorted_lg = jnp.sort(lg)[::-1]
+        cum = jnp.cumsum(jax.nn.softmax(sorted_lg))
+        k = jnp.minimum(jnp.sum(cum < p) + 1, lg.shape[-1])
+        thresh = sorted_lg[k - 1]
+        lg = jnp.where(lg >= thresh, lg, -jnp.inf)
+        return jax.random.categorical(key, lg)
 
-    sampled = jax.vmap(row)(seeds, positions, logits, temperature).astype(jnp.int32)
+    sampled = jax.vmap(row)(seeds, positions, logits, temperature,
+                            top_p).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
@@ -151,9 +164,9 @@ class Generator:
             cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
             def decode_chunk(params, caches, tok, pos0, start, done, seeds,
-                             temperature, eos_id):
+                             temperature, top_p, eos_id):
                 """Scan `chunk` decode steps. tok: (B,) last emitted token;
-                seeds/temperature: per-row (B,) sampling params."""
+                seeds/temperature/top_p: per-row (B,) sampling params."""
                 def body(carry, i):
                     caches, tok, done = carry
                     logits, caches = transformer_decode_step(
@@ -163,7 +176,7 @@ class Generator:
                     # pos0+i+1-start in its own sequence — fold that in so
                     # the stream is batch- and bucket-independent.
                     nxt = _sample(logits, seeds, pos0 + i + 1 - start,
-                                  temperature)
+                                  temperature, top_p)
                     nxt = jnp.where(done, eos_id, nxt)
                     done = done | (nxt == eos_id)
                     return (caches, nxt, done), nxt
@@ -184,14 +197,16 @@ class Generator:
         eos_id: int = -1,
         temperature: Union[float, Sequence[float]] = 0.0,
         seed: Union[int, Sequence[int]] = 0,
+        top_p: Union[float, Sequence[float]] = 1.0,
     ) -> List[List[int]]:
         """Batched generation. Returns per-prompt generated token lists
         (EOS-truncated, EOS not included). `eos_id=-1` disables early stop.
 
-        `temperature` and `seed` may be per-prompt sequences. A request with
-        an explicit per-prompt seed samples the same tokens no matter how
-        requests are batched. A scalar seed expands to seed+row so rows of
-        one call still sample independently."""
+        `temperature`, `seed` and `top_p` may be per-prompt sequences. A
+        request with an explicit per-prompt seed samples the same tokens no
+        matter how requests are batched. A scalar seed expands to seed+row
+        so rows of one call still sample independently. `top_p < 1` applies
+        nucleus filtering before the categorical draw."""
         if not prompts:
             return []
         n = len(prompts)
@@ -199,20 +214,23 @@ class Generator:
                  else [float(t) for t in temperature])
         seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
                  else [int(s) for s in seed])
-        if len(temps) != n or len(seeds) != n:
-            raise ValueError("temperature/seed sequence length != n prompts")
+        top_ps = ([float(top_p)] * n if np.isscalar(top_p)
+                  else [float(p) for p in top_p])
+        if len(temps) != n or len(seeds) != n or len(top_ps) != n:
+            raise ValueError("temperature/seed/top_p sequence length != n prompts")
         out: List[List[int]] = []
         max_bb = self._batch_buckets[-1]
         for i in range(0, n, max_bb):
             out.extend(self._generate_batch(
                 [list(p) for p in prompts[i:i + max_bb]],
                 max_new_tokens, eos_id, temps[i:i + max_bb],
-                seeds[i:i + max_bb]))
+                seeds[i:i + max_bb], top_ps[i:i + max_bb]))
         return out
 
     def _generate_batch(self, prompts: List[List[int]], max_new: int,
                         eos_id: int, temps: List[float],
-                        seeds: List[int]) -> List[List[int]]:
+                        seeds: List[int],
+                        top_ps: List[float]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -245,15 +263,17 @@ class Generator:
         # Per-row sampling params, padded to the batch bucket.
         temps_arr = np.zeros((bb,), np.float32)
         seeds_arr = np.zeros((bb,), np.int32)
+        topp_arr = np.ones((bb,), np.float32)
         temps_arr[:n] = temps
         seeds_arr[:n] = np.asarray(seeds, np.int64).astype(np.int32)
-        temps_dev, seeds_dev = put(temps_arr), put(seeds_arr)
+        topp_arr[:n] = top_ps
+        temps_dev, seeds_dev, topp_dev = put(temps_arr), put(seeds_arr), put(topp_arr)
         start_dev = put(start)
 
         # First generated token comes from the prefill logits; its logical
         # position in each row is the prompt length pb - start.
         first = _sample(logits, seeds_dev, pb - jnp.asarray(start_dev),
-                        jnp.asarray(temps_dev))
+                        jnp.asarray(temps_dev), jnp.asarray(topp_dev))
         done = (first == eos_id)
 
         pieces = [np.asarray(first)[:, None]]
@@ -267,7 +287,7 @@ class Generator:
         while remaining > 0 and pos < self.max_seq:
             caches, tok, done, toks = decode(
                 self.params, caches, tok, pos, start_dev, done, seeds_dev,
-                temps_dev, eos_dev)
+                temps_dev, topp_dev, eos_dev)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk
             remaining -= self._step_chunk

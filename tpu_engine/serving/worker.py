@@ -49,6 +49,18 @@ class _BatchResult:
     inference_time_us: int
 
 
+class _Inflight:
+    """One in-flight computation shared by concurrent identical requests."""
+
+    __slots__ = ("event", "frag", "time_us", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.frag: Optional[bytes] = None
+        self.time_us = 0
+        self.error: Optional[BaseException] = None
+
+
 @dataclass
 class _GenItem:
     request_id: str
@@ -57,6 +69,7 @@ class _GenItem:
     eos_id: int
     temperature: float
     seed: int
+    top_p: float = 1.0
 
 
 @dataclass
@@ -141,6 +154,14 @@ class WorkerNode:
         # gateway's breaker sees it exactly like a dead worker.
         self._injected_fault: Optional[str] = None
         self._fault_listeners: list = []
+        # In-flight coalescing: concurrent identical misses share ONE
+        # execution. The reference deliberately lacks this — simultaneous
+        # identical requests all enter the batch because the cache is only
+        # written after the batch returns (worker_node.cpp:70-73;
+        # SURVEY.md §3.2 flags it as a decision point). Followers wait on
+        # the leader's event and reuse its encoded result.
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
         # (total, hits) served on this lane's behalf outside this process's
         # Python path — the native HTTP front reports through here.
         self.external_counters = None
@@ -200,10 +221,35 @@ class WorkerNode:
             # Reference reports a fixed fake latency on hits (:65).
             return request_id, frag, True, self.config.fake_cached_latency_us
 
-        result = self.batch_processor.process(
-            _BatchItem(request_id, input_data, shape))
-        frag = json.dumps(result.output_data.tolist()).encode()
-        self.cache.put(key, frag)
+        with self._inflight_lock:
+            entry = self._inflight.get(key)
+            leader = entry is None
+            if leader:
+                entry = _Inflight()
+                self._inflight[key] = entry
+        if not leader:
+            if not entry.event.wait(timeout=120.0):
+                raise RuntimeError("coalesced request timed out")
+            if entry.error is not None:
+                raise RuntimeError(str(entry.error))
+            self.tracer.record(request_id, "infer", self.node_id,
+                               entry.time_us, batch_size=0)  # coalesced
+            return request_id, entry.frag, False, entry.time_us
+
+        try:
+            result = self.batch_processor.process(
+                _BatchItem(request_id, input_data, shape))
+            frag = json.dumps(result.output_data.tolist()).encode()
+            self.cache.put(key, frag)
+            entry.frag = frag
+            entry.time_us = result.inference_time_us
+        except BaseException as exc:
+            entry.error = exc
+            raise
+        finally:
+            entry.event.set()
+            with self._inflight_lock:
+                self._inflight.pop(key, None)
         self.tracer.record(request_id, "infer", self.node_id,
                            result.inference_time_us)
         return request_id, frag, False, result.inference_time_us
@@ -264,6 +310,7 @@ class WorkerNode:
             eos_id=int(request.get("eos_id", -1)),
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)),
+            top_p=float(request.get("top_p", 1.0)),
         )
         result = self._gen_processor.process(item)
         self.tracer.record(item.request_id, "generate", self.node_id,
@@ -291,7 +338,8 @@ class WorkerNode:
                 [items[i].prompt for i in idxs], max_new_tokens=max_new,
                 eos_id=eos_id,
                 temperature=[items[i].temperature for i in idxs],
-                seed=[items[i].seed for i in idxs])
+                seed=[items[i].seed for i in idxs],
+                top_p=[items[i].top_p for i in idxs])
             # Reference semantic: per-request time = batch_duration /
             # batch_size, per group (worker_node.cpp:123).
             elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))
