@@ -214,6 +214,58 @@ def transformer_prefill(params, tokens, caches: KVCache, cfg: TransformerConfig,
     return logits[:, 0], KVCache(k_new, v_new)
 
 
+def _block_decode_rows(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
+                       dtype, start_vec):
+    """One decode step with PER-ROW cache positions — the continuous-
+    batching primitive (rows admitted at different times sit at different
+    depths). pos_vec/start_vec: (B,) int32."""
+    ck, cv = cache_kv
+    b = h.shape[0]
+    x = nn.layernorm(bp["ln1"], h)
+    q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
+    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
+    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
+    rows = jnp.arange(b)
+    ck = ck.at[rows, pos_vec].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[rows, pos_vec].set(v[:, 0].astype(cv.dtype))
+    kpos = jnp.arange(ck.shape[1])[None, :]
+    valid = ((kpos <= pos_vec[:, None]) & (kpos >= start_vec[:, None])
+             ).astype(jnp.int32)
+    a = dot_product_attention(q, ck, cv, mask=valid)
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
+    return h.astype(dtype), (ck, cv)
+
+
+def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
+                            cfg: TransformerConfig, *, dtype=jnp.bfloat16,
+                            start_vec=None):
+    """One decode step where every row has its own cache position.
+
+    token_t: (B,); pos_vec: (B,) write offsets; start_vec: (B,) first valid
+    cache column per row. Returns (logits (B, vocab), caches). The
+    continuous scheduler (runtime.scheduler) drives this so rows admitted
+    mid-flight decode alongside older rows."""
+    if start_vec is None:
+        start_vec = jnp.zeros_like(pos_vec)
+    h = nn.embedding(params["tok_embed"], token_t[:, None])
+    logical = jnp.clip(pos_vec - start_vec, 0,
+                       params["pos_embed"]["table"].shape[0] - 1)
+    h = h + params["pos_embed"]["table"][logical][:, None, :]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_decode_rows(bp, carry, (ck, cv), pos_vec, cfg,
+                                         dtype=dtype, start_vec=start_vec)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
+    h = nn.layernorm(params["ln_f"], h)
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    return logits[:, 0], KVCache(k_new, v_new)
+
+
 def transformer_decode_step(params, token_t, caches: KVCache, pos,
                             cfg: TransformerConfig, *, dtype=jnp.bfloat16,
                             start=None, pos_ids=None):

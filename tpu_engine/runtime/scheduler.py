@@ -1,0 +1,331 @@
+"""Continuous-batching decode scheduler (vLLM-style iteration-level
+scheduling, static shapes).
+
+SURVEY.md §7 hard part (c): "decode loops don't fit the one-shot
+batchPredict contract; needs a decode-step scheduler". runtime.generator
+solved it batch-at-a-time: a batch runs to completion before the next
+starts, so one long request convoys everything behind it. This scheduler
+closes the gap: a FIXED-shape decode batch runs forever, and requests join
+and leave between chunks —
+
+- The batch is `n_slots` rows over one preallocated KV cache
+  (L, n_slots, max_seq, H, D). All shapes static: the decode chunk and the
+  per-bucket prefill/insert executables each compile exactly once.
+- **Admission**: a new request prefills alone on a (1, prompt-bucket)
+  executable, then its KV slice is written into a free row
+  (`dynamic_update_slice` on the row axis) with per-row `pos`/`start`.
+- **Decode** runs `transformer_decode_rows` — every row carries its own
+  cache position, so rows admitted at different times decode side by side.
+  Finished rows (EOS or budget) free their slot between chunks; idle rows
+  burn lanes of an already-launched batch, not wall-clock.
+- Sampling is the generator's per-row fold_in(seed, position) scheme, so a
+  seeded request emits identical tokens whether it was admitted into an
+  empty, full, or draining batch (tested).
+
+`submit()` returns a Future; a daemon thread runs the admit→decode→emit
+loop. `generate()` is a blocking convenience with the same signature as
+Generator.generate.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_engine.models.registry import ModelSpec, create_model, _ensure_builtin_models_imported
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    init_caches,
+    transformer_decode_rows,
+    transformer_prefill,
+)
+from tpu_engine.runtime.generator import _DTYPES, _sample
+
+
+@dataclass
+class _Request:
+    prompt: List[int]
+    max_new: int
+    eos_id: int
+    temperature: float
+    seed: int
+    top_p: float
+    future: Future = field(default_factory=Future)
+
+
+class ContinuousGenerator:
+    def __init__(
+        self,
+        model: Union[str, ModelSpec],
+        params=None,
+        rng_seed: int = 0,
+        dtype: str = "bfloat16",
+        n_slots: int = 8,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        step_chunk: int = 8,
+        max_seq: Optional[int] = None,
+        device=None,
+    ):
+        if isinstance(model, str):
+            _ensure_builtin_models_imported()
+            model = create_model(model)
+        if not isinstance(model.config, TransformerConfig) or not model.config.causal:
+            raise ValueError(f"model '{model.name}' is not a decoder transformer")
+        self.spec = model
+        self.cfg: TransformerConfig = model.config
+        self._dtype = _DTYPES[dtype]
+        self.max_seq = min(max_seq or self.cfg.max_seq, self.cfg.max_seq)
+        self.n_slots = int(n_slots)
+        self._step_chunk = int(step_chunk)
+        if prompt_buckets is None:
+            b, prompt_buckets = 16, []
+            while b < self.max_seq:
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(self.max_seq)
+        self._prompt_buckets = tuple(sorted(
+            {min(int(p), self.max_seq) for p in prompt_buckets}))
+        self._device = device
+        self.params = params if params is not None else model.init(
+            jax.random.PRNGKey(rng_seed))
+        if device is not None:
+            self.params = jax.device_put(self.params, device)
+
+        # Device state: one persistent KV cache + per-row vectors.
+        self._caches = init_caches(self.cfg, self.n_slots, self.max_seq,
+                                   self._dtype)
+        if device is not None:
+            self._caches = jax.device_put(self._caches, device)
+        self._pos = np.zeros((self.n_slots,), np.int32)      # next write col
+        self._start = np.zeros((self.n_slots,), np.int32)    # first valid col
+        self._tok = np.zeros((self.n_slots,), np.int32)      # last emitted
+        self._seeds = np.zeros((self.n_slots,), np.int32)
+        self._temps = np.zeros((self.n_slots,), np.float32)
+        self._topps = np.ones((self.n_slots,), np.float32)
+        self._done = np.ones((self.n_slots,), bool)          # sampling mask
+        self._row_req: List[Optional[_Request]] = [None] * self.n_slots
+        self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
+
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._exe_lock = threading.Lock()
+        self._prefill_exe: Dict[int, object] = {}
+        self._decode_exe = None
+        self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="continuous-decode", daemon=True)
+        self._thread.start()
+
+    # -- compiled stages -------------------------------------------------------
+
+    def _prefill(self, pb: int):
+        exe = self._prefill_exe.get(pb)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._prefill_exe.get(pb)
+            if exe is None:
+                cfg, dtype = self.cfg, self._dtype
+
+                def prefill_insert(params, tokens, attn_mask, pos_ids,
+                                   caches, row):
+                    """Prefill one prompt alone, then write its KV rows into
+                    slot `row` of the shared batch cache."""
+                    row_caches = init_caches(cfg, 1, caches.k.shape[2], dtype)
+                    logits, row_caches = transformer_prefill(
+                        params, tokens, row_caches, cfg, dtype=dtype,
+                        attn_mask=attn_mask, pos_ids=pos_ids)
+                    k = jax.lax.dynamic_update_slice(
+                        caches.k, row_caches.k, (0, row, 0, 0, 0))
+                    v = jax.lax.dynamic_update_slice(
+                        caches.v, row_caches.v, (0, row, 0, 0, 0))
+                    return logits[0], type(caches)(k, v)
+
+                self._prefill_exe[pb] = jax.jit(prefill_insert,
+                                                donate_argnums=(4,))
+            return self._prefill_exe[pb]
+
+    def _decode(self):
+        if self._decode_exe is not None:
+            return self._decode_exe
+        with self._exe_lock:
+            if self._decode_exe is None:
+                cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
+
+                def decode_chunk(params, caches, tok, pos, start, done,
+                                 seeds, temps, topps, eos_vec):
+                    def body(carry, _):
+                        caches, tok, pos, done = carry
+                        logits, caches = transformer_decode_rows(
+                            params, tok, caches, pos, cfg, dtype=dtype,
+                            start_vec=start)
+                        nxt = _sample(logits, seeds, pos + 1 - start, temps,
+                                      topps)
+                        nxt = jnp.where(done, eos_vec, nxt)
+                        done = done | (nxt == eos_vec)
+                        # Only live rows advance their write position (and
+                        # never past the last cache column).
+                        pos = jnp.where(done, pos,
+                                        jnp.minimum(pos + 1,
+                                                    caches.k.shape[2] - 1))
+                        return (caches, nxt, pos, done), nxt
+
+                    (caches, tok, pos, done), toks = jax.lax.scan(
+                        body, (caches, tok, pos, done), None, length=chunk)
+                    return caches, tok, pos, done, toks.T  # (B, chunk)
+
+                self._decode_exe = jax.jit(decode_chunk, donate_argnums=(1,))
+            return self._decode_exe
+
+    # -- public API ------------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
+               top_p: float = 1.0) -> Future:
+        """Enqueue one request; resolves to its generated token list."""
+        if not self._running:
+            raise RuntimeError("scheduler stopped")
+        req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
+                       float(temperature), int(seed), float(top_p))
+        self._queue.put(req)
+        return req.future
+
+    def generate(self, prompts, max_new_tokens: int = 32, eos_id: int = -1,
+                 temperature=0.0, seed=0, top_p=1.0) -> List[List[int]]:
+        """Blocking convenience over submit() (Generator-compatible)."""
+        n = len(prompts)
+        temps = [temperature] * n if np.isscalar(temperature) else temperature
+        seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
+                 else seed)
+        topps = [top_p] * n if np.isscalar(top_p) else top_p
+        futs = [self.submit(p, max_new_tokens, eos_id, temps[i], seeds[i],
+                            topps[i]) for i, p in enumerate(prompts)]
+        return [f.result(timeout=600) for f in futs]
+
+    def stats(self) -> dict:
+        return dict(self._stats, n_slots=self.n_slots,
+                    active=int(sum(r is not None for r in self._row_req)))
+
+    def stop(self) -> None:
+        self._running = False
+        self._queue.put(None)
+        self._thread.join(timeout=10)
+
+    # -- scheduler loop --------------------------------------------------------
+
+    def _free_rows(self) -> List[int]:
+        return [r for r in range(self.n_slots) if self._row_req[r] is None]
+
+    def _admit(self, req: _Request, row: int) -> None:
+        pb = next((b for b in self._prompt_buckets if b >= len(req.prompt)),
+                  self._prompt_buckets[-1])
+        prompt = req.prompt[-pb:]
+        L = len(prompt)
+        tokens = np.zeros((1, pb), np.int32)
+        attn = np.zeros((1, pb), np.int32)
+        pos_ids = np.zeros((1, pb), np.int32)
+        tokens[0, pb - L:] = prompt
+        attn[0, pb - L:] = 1
+        pos_ids[0, pb - L:] = np.arange(L)
+
+        logits, self._caches = self._prefill(pb)(
+            self.params, jnp.asarray(tokens), jnp.asarray(attn),
+            jnp.asarray(pos_ids), self._caches, row)
+
+        self._start[row] = pb - L
+        self._pos[row] = pb
+        self._seeds[row] = np.int64(req.seed) & 0x7FFFFFFF
+        self._temps[row] = req.temperature
+        self._topps[row] = req.top_p
+        # First token from the prefill logits at logical position L.
+        first = _sample(jnp.asarray(logits)[None, :],
+                        jnp.asarray(self._seeds[row:row + 1]),
+                        jnp.asarray([L], jnp.int32),
+                        jnp.asarray(self._temps[row:row + 1]),
+                        jnp.asarray(self._topps[row:row + 1]))
+        first_tok = int(first[0])
+        self._tok[row] = first_tok
+        self._row_req[row] = req
+        self._row_emitted[row] = [first_tok]
+        self._done[row] = (req.eos_id >= 0 and first_tok == req.eos_id)
+        self._stats["admitted"] += 1
+        self._maybe_complete(row)
+
+    def _maybe_complete(self, row: int) -> None:
+        req = self._row_req[row]
+        if req is None:
+            return
+        emitted = self._row_emitted[row]
+        hit_eos = req.eos_id >= 0 and req.eos_id in emitted
+        budget = len(emitted) >= req.max_new
+        out_of_cache = int(self._pos[row]) >= self.max_seq - 1
+        if hit_eos or budget or out_of_cache or self._done[row]:
+            toks = emitted[:req.max_new]
+            if req.eos_id >= 0 and req.eos_id in toks:
+                toks = toks[:toks.index(req.eos_id)]
+            req.future.set_result(toks)
+            self._row_req[row] = None
+            self._row_emitted[row] = []
+            self._done[row] = True
+            self._stats["completed"] += 1
+
+    def _loop(self) -> None:
+        while self._running:
+            # Admit as many queued requests as there are free rows; block
+            # briefly when completely idle.
+            free = self._free_rows()
+            admitted_any = False
+            while free:
+                try:
+                    req = self._queue.get(
+                        timeout=0.02 if not admitted_any and len(free) == self.n_slots
+                        else 0.0)
+                except queue.Empty:
+                    break
+                if req is None:
+                    return
+                try:
+                    self._admit(req, free.pop(0))
+                    admitted_any = True
+                except Exception as exc:
+                    req.future.set_exception(exc)
+            if all(r is None for r in self._row_req):
+                continue
+
+            # One decode chunk over the fixed batch. -1 marks rows with EOS
+            # disabled (and free rows): sampled tokens are in [0, vocab) so
+            # `nxt == -1` never fires; done rows emit -1 (discarded), and
+            # the embedding lookup of -1 clips harmlessly under jit.
+            eos_vec = np.full((self.n_slots,), -1, np.int32)
+            for r, req in enumerate(self._row_req):
+                if req is not None and req.eos_id >= 0:
+                    eos_vec[r] = req.eos_id
+            self._caches, tok, pos, done, toks = self._decode()(
+                self.params, self._caches, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._start),
+                jnp.asarray(self._done), jnp.asarray(self._seeds),
+                jnp.asarray(self._temps), jnp.asarray(self._topps),
+                jnp.asarray(eos_vec))
+            # np.array (copy): np.asarray of a jax.Array is read-only and
+            # the admit path mutates these vectors in place.
+            self._tok = np.array(tok)
+            self._pos = np.array(pos)
+            self._done = np.array(done)
+            toks_host = np.asarray(toks)
+            self._stats["chunks"] += 1
+
+            for r, req in enumerate(self._row_req):
+                if req is None:
+                    continue
+                need = req.max_new - len(self._row_emitted[r])
+                if need > 0:
+                    self._row_emitted[r].extend(
+                        int(t) for t in toks_host[r, :need])
+                self._maybe_complete(r)

@@ -89,6 +89,10 @@ def main(argv=None) -> int:
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
+        parser.add_argument("--gen-scheduler", choices=["batch", "continuous"],
+                            default="batch",
+                            help="decode scheduling: batch-to-completion or "
+                                 "continuous (iteration-level admission)")
         args = parser.parse_args(rest)
         gateway_config = None
         if args.breaker_timeout is not None:
@@ -97,13 +101,16 @@ def main(argv=None) -> int:
             gateway_config = GatewayConfig(port=args.port,
                                            breaker_timeout_s=args.breaker_timeout)
         worker_config = None
-        if args.shape_buckets:
+        if args.shape_buckets or args.gen_scheduler != "batch":
             from tpu_engine.utils.config import WorkerConfig
 
-            buckets = tuple(
-                tuple(int(d) for d in s.split("x"))
-                for s in args.shape_buckets.split(","))
-            worker_config = WorkerConfig(shape_buckets=buckets)
+            buckets = None
+            if args.shape_buckets:
+                buckets = tuple(
+                    tuple(int(d) for d in s.split("x"))
+                    for s in args.shape_buckets.split(","))
+            worker_config = WorkerConfig(shape_buckets=buckets,
+                                         gen_scheduler=args.gen_scheduler)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
                        warmup=args.warmup, worker_config=worker_config,
                        gateway_config=gateway_config)

@@ -127,20 +127,34 @@ class WorkerNode:
         # batcher so decode loops never block one-shot /infer traffic.
         self.generator = None
         self._gen_processor: Optional[BatchProcessor[_GenItem, _GenResult]] = None
+        self._continuous = self.config.gen_scheduler == "continuous"
         if getattr(self.engine.spec, "config", None) is not None:
-            from tpu_engine.runtime.generator import Generator
-
             try:
-                self.generator = Generator(
-                    self.engine.spec, params=self.engine.params,
-                    dtype=self.config.dtype, device=getattr(engine, "_device", None))
-                self._gen_processor = BatchProcessor(
-                    self.config.gen_max_batch_size,
-                    self.config.batch_timeout_ms,
-                    self._process_gen_batch,
-                    name=f"{self.node_id}-gen-batcher",
-                )
-                self._gen_processor.start()
+                if self._continuous:
+                    # Iteration-level scheduling: the scheduler IS the
+                    # batcher — HTTP handler threads submit directly and
+                    # requests join the running decode batch between chunks.
+                    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+                    self.generator = ContinuousGenerator(
+                        self.engine.spec, params=self.engine.params,
+                        dtype=self.config.dtype,
+                        n_slots=self.config.gen_max_batch_size,
+                        device=getattr(engine, "_device", None))
+                else:
+                    from tpu_engine.runtime.generator import Generator
+
+                    self.generator = Generator(
+                        self.engine.spec, params=self.engine.params,
+                        dtype=self.config.dtype,
+                        device=getattr(engine, "_device", None))
+                    self._gen_processor = BatchProcessor(
+                        self.config.gen_max_batch_size,
+                        self.config.batch_timeout_ms,
+                        self._process_gen_batch,
+                        name=f"{self.node_id}-gen-batcher",
+                    )
+                    self._gen_processor.start()
             except ValueError:
                 self.generator = None
         # Worker-level counters, distinct from the LRU's own accounting
@@ -312,7 +326,17 @@ class WorkerNode:
             seed=int(request.get("seed", 0)),
             top_p=float(request.get("top_p", 1.0)),
         )
-        result = self._gen_processor.process(item)
+        if self._continuous:
+            t0 = time.perf_counter()
+            fut = self.generator.submit(
+                item.prompt, max_new_tokens=item.max_new_tokens,
+                eos_id=item.eos_id, temperature=item.temperature,
+                seed=item.seed, top_p=item.top_p)
+            tokens = fut.result(timeout=600)
+            elapsed_us = int((time.perf_counter() - t0) * 1e6)
+            result = _GenResult(tokens, elapsed_us)
+        else:
+            result = self._gen_processor.process(item)
         self.tracer.record(item.request_id, "generate", self.node_id,
                            result.generate_time_us)
         return {
@@ -372,3 +396,5 @@ class WorkerNode:
         self.batch_processor.stop()
         if self._gen_processor is not None:
             self._gen_processor.stop()
+        if self._continuous and self.generator is not None:
+            self.generator.stop()

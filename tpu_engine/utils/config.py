@@ -32,6 +32,10 @@ class WorkerConfig:
     shape_buckets: Optional[Tuple[Tuple[int, ...], ...]] = None
     fake_cached_latency_us: int = 50    # reference worker_node.cpp:65
     gen_max_batch_size: int = 8         # decode-lane batcher (transformers)
+    # "batch": collect a batch, decode it to completion (generator.py).
+    # "continuous": iteration-level scheduling — requests join/leave the
+    # running decode batch between chunks (scheduler.py).
+    gen_scheduler: str = "batch"
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
