@@ -1,0 +1,301 @@
+"""Golden-output parity: imported HF/torch weights, JAX forward vs torch.
+
+The round-1 VERDICT's top gap: "correctness of the entire model zoo is
+currently 'shapes are right and numbers are finite'". These tests close it:
+a torch/transformers reference model (random-init — this environment has no
+network, but the key layout and math are identical to real pretrained
+checkpoints) is imported through models.import_weights and the JAX forward
+must reproduce the torch forward to float32 tolerance. That proves both the
+importer mapping AND that our model graphs compute what GPT-2 / BERT /
+ResNet-50 compute.
+"""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from tpu_engine.models.import_weights import (  # noqa: E402
+    import_bert,
+    import_gpt2,
+    import_resnet50_v1,
+    importer_for,
+    load_onnx_initializers,
+    load_state_dict,
+)
+from tpu_engine.models.transformer import TransformerConfig, transformer_apply  # noqa: E402
+
+
+def _sd(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+# -- GPT-2 ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hf_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=64, n_embd=64, n_layer=3, n_head=4,
+        n_inner=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(cfg).eval()
+    return model, cfg
+
+
+def test_gpt2_golden_parity(hf_gpt2):
+    model, hcfg = hf_gpt2
+    cfg = TransformerConfig(vocab=97, n_layers=3, d_model=64, n_heads=4,
+                            d_ff=128, max_seq=64, causal=True)
+    params = import_gpt2(_sd(model), cfg)
+
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 97, size=(2, 17))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(transformer_apply(
+        params, jnp.asarray(tokens, jnp.int32), cfg, dtype=jnp.float32))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_decode_matches_hf_generate(hf_gpt2):
+    """Greedy decode through the KV-cache path reproduces HF generate."""
+    from tpu_engine.runtime.generator import Generator
+    from tpu_engine.models.registry import ModelSpec
+
+    model, _ = hf_gpt2
+    cfg = TransformerConfig(vocab=97, n_layers=3, d_model=64, n_heads=4,
+                            d_ff=128, max_seq=64, causal=True)
+    params = import_gpt2(_sd(model), cfg)
+    spec = ModelSpec(name="hf-gpt2-test", apply=None, init=None,
+                     input_shape=(16,), output_shape=(97,), config=cfg)
+
+    prompt = [11, 42, 7, 3]
+    with torch.no_grad():
+        ref = model.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()[0, len(prompt):].tolist()
+    gen = Generator(spec, params=params, dtype="float32", batch_buckets=(1,))
+    got = gen.generate([prompt], max_new_tokens=8)[0]
+    assert got == ref
+
+
+# -- BERT ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=99, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    return transformers.BertForQuestionAnswering(cfg).eval(), cfg
+
+
+def test_bert_golden_parity(hf_bert):
+    model, _ = hf_bert
+    cfg = TransformerConfig(vocab=99, n_layers=3, d_model=64, n_heads=4,
+                            d_ff=128, max_seq=64, causal=False,
+                            post_ln=True, embed_ln=True, type_vocab=2,
+                            gelu_tanh=False, ln_eps=1e-12)
+    params = import_bert(_sd(model), cfg)
+
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(1, 99, size=(2, 21))
+    mask = np.ones_like(tokens)
+    mask[1, 15:] = 0  # ragged batch: second row padded
+    tokens = tokens * mask
+    types = np.zeros_like(tokens)
+    types[:, 10:] = 1  # question/context segmentation
+    types = types * mask
+
+    with torch.no_grad():
+        out = model(torch.tensor(tokens), attention_mask=torch.tensor(mask),
+                    token_type_ids=torch.tensor(types))
+        ref = np.stack([out.start_logits.numpy(), out.end_logits.numpy()], -1)
+    got = np.asarray(transformer_apply(
+        params, jnp.asarray(tokens, jnp.int32), cfg,
+        mask=jnp.asarray(mask, jnp.int32), dtype=jnp.float32,
+        token_type_ids=jnp.asarray(types, jnp.int32)))
+    # Compare valid (unpadded) positions.
+    np.testing.assert_allclose(got[0], ref[0], atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(got[1, :15], ref[1, :15], atol=2e-4, rtol=2e-4)
+
+
+def test_bert_spec_apply_uses_padding_mask(hf_bert):
+    """The registry model's wire-format apply (float tokens, pad id 0)
+    agrees with the HF forward under the same padding."""
+    from tpu_engine.models.bert import _bert_cfg, _make_bert
+
+    model, _ = hf_bert
+    cfg = _bert_cfg(vocab=99, n_layers=3, d_model=64, n_heads=4, d_ff=128,
+                    max_seq=64)
+    spec = _make_bert("bert-golden", cfg, seq_len=24)
+    params = import_bert(_sd(model), cfg)
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(1, 99, size=(1, 24))
+    tokens[0, 19:] = 0  # wire pad
+    with torch.no_grad():
+        out = model(torch.tensor(tokens),
+                    attention_mask=torch.tensor((tokens > 0).astype(np.int64)))
+        ref = np.stack([out.start_logits.numpy(), out.end_logits.numpy()], -1)
+    got = np.asarray(spec.apply(params, jnp.asarray(tokens, jnp.float32),
+                                dtype=jnp.float32))
+    np.testing.assert_allclose(got[0, :19], ref[0, :19], atol=2e-4, rtol=2e-4)
+
+
+# -- ResNet-50 v1.5 ------------------------------------------------------------
+
+def test_resnet50_v1_golden_parity():
+    cfg = transformers.ResNetConfig(
+        embedding_size=64, hidden_sizes=[256, 512, 1024, 2048],
+        depths=[3, 4, 6, 3], layer_type="bottleneck", num_labels=1000)
+    torch.manual_seed(0)
+    model = transformers.ResNetForImageClassification(cfg).eval()
+    params = import_resnet50_v1(_sd(model))
+
+    from tpu_engine.models.registry import create_model, \
+        _ensure_builtin_models_imported
+
+    _ensure_builtin_models_imported()
+    spec = create_model("resnet50-v1")
+
+    rng = np.random.default_rng(4)
+    img = rng.standard_normal((2, 224, 224, 3), dtype=np.float32)
+    with torch.no_grad():
+        ref = model(torch.tensor(img.transpose(0, 3, 1, 2))).logits.numpy()
+    got = np.asarray(spec.apply(params, jnp.asarray(img), dtype=jnp.float32))
+    np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)
+
+
+# -- containers & dispatch -----------------------------------------------------
+
+def test_load_state_dict_roundtrip(tmp_path, hf_gpt2):
+    model, _ = hf_gpt2
+    p = tmp_path / "ckpt.bin"
+    torch.save(model.state_dict(), p)
+    sd = load_state_dict(str(p))
+    ref = _sd(model)
+    assert set(k for k in ref if "attn.bias" not in k or "c_" in k) <= set(sd)
+    np.testing.assert_array_equal(sd["transformer.wte.weight"],
+                                  ref["transformer.wte.weight"])
+
+
+def test_load_state_dict_safetensors(tmp_path, hf_bert):
+    from safetensors.torch import save_file
+
+    model, _ = hf_bert
+    p = tmp_path / "model.safetensors"
+    save_file({k: v.contiguous() for k, v in model.state_dict().items()},
+              str(p))
+    sd = load_state_dict(str(tmp_path))  # dir resolution
+    assert "bert.embeddings.word_embeddings.weight" in sd
+
+
+def test_importer_dispatch():
+    assert importer_for("gpt2") is not None
+    assert importer_for("gpt2-small-test") is not None
+    assert importer_for("bert") is not None
+    assert importer_for("resnet50-v1") is not None
+    assert importer_for("gpt2-moe") is None  # dense ckpt can't fill experts
+    assert importer_for("mlp") is None
+
+
+def test_worker_serves_imported_checkpoint(tmp_path, hf_gpt2):
+    """End-to-end VERDICT item 1: `worker_node <port> <id> <ckpt>` serves
+    the real checkpoint's logits (golden vs torch) instead of random init."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    hcfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        n_inner=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(7)
+    model = transformers.GPT2LMHeadModel(hcfg).eval()
+    p = tmp_path / "gpt2-small.bin"
+    torch.save(model.state_dict(), p)
+
+    w = WorkerNode(WorkerConfig(model="gpt2-small-test", model_path=str(p),
+                                dtype="float32", batch_buckets=(1, 2)))
+    try:
+        prompt = [5, 9, 3]
+        resp = w.handle_infer({"request_id": "r1",
+                               "input_data": [float(t) for t in prompt]})
+        got = np.asarray(resp["output_data"], np.float32)
+        padded = prompt + [0] * (16 - len(prompt))
+        with torch.no_grad():
+            ref = model(torch.tensor([padded])).logits.numpy()[0, len(prompt) - 1]
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+    finally:
+        w.stop()
+
+
+# -- ONNX reader ---------------------------------------------------------------
+
+def _pb_tag(field, wire):
+    return _pb_varint((field << 3) | wire)
+
+
+def _pb_varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _pb_len(field, payload):
+    return _pb_tag(field, 2) + _pb_varint(len(payload)) + payload
+
+
+def _tensor_proto(name, arr):
+    body = b""
+    for d in arr.shape:
+        body += _pb_tag(1, 0) + _pb_varint(d)
+    dtype_code = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    body += _pb_tag(2, 0) + _pb_varint(dtype_code)
+    body += _pb_len(8, name.encode())
+    body += _pb_len(9, arr.tobytes())
+    return body
+
+
+def test_onnx_initializer_reader(tmp_path):
+    """Hand-encoded ModelProto → load_onnx_initializers recovers tensors.
+    (The reference's resnet50-v2-7.onnx asset is stripped from its snapshot
+    and this environment has no network, so the reader is validated on a
+    synthetic file with the same wire layout.)"""
+    w = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    b = np.asarray([7, -1], np.int64)
+    graph = _pb_len(5, _tensor_proto("conv.weight", w)) + \
+        _pb_len(5, _tensor_proto("shape", b)) + \
+        _pb_len(1, b"")  # an (empty) node, skipped by the reader
+    model = _pb_tag(1, 0) + _pb_varint(8) + _pb_len(7, graph)  # ir_version + graph
+    p = tmp_path / "tiny.onnx"
+    p.write_bytes(model)
+
+    out = load_onnx_initializers(str(p))
+    assert set(out) == {"conv.weight", "shape"}
+    np.testing.assert_array_equal(out["conv.weight"], w)
+    np.testing.assert_array_equal(out["shape"], b)
+
+
+def test_onnx_float_data_variant(tmp_path):
+    """float_data (packed field 4) variant, no raw_data."""
+    vals = np.asarray([1.5, -2.25, 3.0], np.float32)
+    body = _pb_tag(1, 0) + _pb_varint(3)
+    body += _pb_tag(2, 0) + _pb_varint(1)
+    body += _pb_len(8, b"w")
+    body += _pb_len(4, struct.pack("<3f", *vals))
+    model = _pb_len(7, _pb_len(5, body))
+    p = tmp_path / "t.onnx"
+    p.write_bytes(model)
+    out = load_onnx_initializers(str(p))
+    np.testing.assert_array_equal(out["w"], vals)

@@ -25,6 +25,16 @@ from tpu_engine.ops import nn
 import jax
 
 
+def _bert_cfg(**kw) -> TransformerConfig:
+    """HF-BERT-exact dialect: post-LN blocks, LayerNorm'd embeddings with
+    segment (token-type) table, erf GELU, eps 1e-12 — the knobs that make
+    `models.import_weights.import_bert` produce bit-compatible forwards
+    against `transformers.BertForQuestionAnswering` (golden-tested)."""
+    return TransformerConfig(causal=False, post_ln=True, embed_ln=True,
+                             type_vocab=2, gelu_tanh=False, ln_eps=1e-12,
+                             **kw)
+
+
 def _make_bert(name: str, cfg: TransformerConfig, seq_len: int,
                n_outputs: int = 2) -> ModelSpec:
     def init(rng):
@@ -54,9 +64,8 @@ def _make_bert(name: str, cfg: TransformerConfig, seq_len: int,
 def make_bert(seq_len: int = 384, vocab: int = 30522, n_layers: int = 12,
               d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,
               max_seq: int = 512) -> ModelSpec:
-    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
-                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
-                            causal=False)
+    cfg = _bert_cfg(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                    n_heads=n_heads, d_ff=d_ff, max_seq=max_seq)
     return _make_bert("bert", cfg, seq_len)
 
 
@@ -64,7 +73,6 @@ def make_bert(seq_len: int = 384, vocab: int = 30522, n_layers: int = 12,
 def make_bert_small(seq_len: int = 32, vocab: int = 512, n_layers: int = 2,
                     d_model: int = 64, n_heads: int = 4, d_ff: int = 128,
                     max_seq: int = 64) -> ModelSpec:
-    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
-                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
-                            causal=False)
+    cfg = _bert_cfg(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                    n_heads=n_heads, d_ff=d_ff, max_seq=max_seq)
     return _make_bert("bert-small-test", cfg, seq_len)

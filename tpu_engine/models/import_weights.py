@@ -1,0 +1,447 @@
+"""Pretrained-weight importers: HF/torch checkpoints → tpu_engine pytrees.
+
+The reference's whole value proposition is serving *real trained weights*
+(ResNet-50 v2-7 ONNX, ``/root/reference/src/inference_engine.cpp:31``); this
+module is the TPU-native equivalent of its model-loading path. It maps
+checkpoint tensors from the ecosystem's dominant formats onto this
+framework's parameter pytrees:
+
+- ``import_gpt2``       — HF ``GPT2LMHeadModel``/``GPT2Model`` state dicts
+- ``import_bert``       — HF ``BertForQuestionAnswering``/``BertModel``
+- ``import_resnet50_v1``— HF ``microsoft/resnet-50`` (torchvision-equivalent
+  v1.5 bottleneck layout) onto the ``resnet50-v1`` model
+- ``load_onnx_initializers`` — generic ONNX weight extraction via a minimal
+  protobuf wire-format reader (no ``onnx`` package needed; the reference's
+  model asset is ONNX, so a migrating user can at least read it here)
+
+Every importer is golden-tested (tests/test_import_weights.py): a randomly
+initialized torch/transformers reference model is imported and the JAX
+forward must match the torch forward to float32 tolerance. The mappings are
+name-driven and size-agnostic, so the same code imports tiny test configs
+and full pretrained checkpoints (when a checkpoint directory is available —
+this environment has no network, so tests use random-init HF models, which
+exercise the identical key layout a real download has).
+
+Checkpoint containers supported by ``load_state_dict``: a ``.safetensors``
+file, a torch ``.bin``/``.pt`` pickle, or an HF checkpoint directory
+(including sharded ``*.index.json`` layouts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "load_state_dict",
+    "import_gpt2",
+    "import_bert",
+    "import_resnet50_v1",
+    "load_onnx_initializers",
+    "load_pretrained",
+]
+
+
+# -- checkpoint containers -----------------------------------------------------
+
+def _load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    try:
+        return dict(load_file(path))
+    except Exception:
+        # bf16 tensors can't round-trip through numpy directly; go via torch.
+        from safetensors.torch import load_file as load_torch
+
+        return {k: v.float().numpy() for k, v in load_torch(path).items()}
+
+
+def _load_torch_bin(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "state_dict" in sd and not any(
+            hasattr(v, "numpy") for v in sd.values()):
+        sd = sd["state_dict"]
+    return {k: v.float().numpy() if v.dtype.is_floating_point else v.numpy()
+            for k, v in sd.items() if hasattr(v, "numpy")}
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a checkpoint from a file or HF checkpoint directory into a flat
+    ``{name: float32 ndarray}`` dict."""
+    if os.path.isdir(path):
+        for index in ("model.safetensors.index.json",
+                      "pytorch_model.bin.index.json"):
+            ipath = os.path.join(path, index)
+            if os.path.exists(ipath):
+                with open(ipath) as f:
+                    shards = sorted(set(json.load(f)["weight_map"].values()))
+                out: Dict[str, np.ndarray] = {}
+                for shard in shards:
+                    out.update(load_state_dict(os.path.join(path, shard)))
+                return out
+        for name in ("model.safetensors", "pytorch_model.bin"):
+            fpath = os.path.join(path, name)
+            if os.path.exists(fpath):
+                return load_state_dict(fpath)
+        raise FileNotFoundError(
+            f"no model.safetensors / pytorch_model.bin under {path}")
+    if path.endswith(".safetensors"):
+        return _load_safetensors(path)
+    return _load_torch_bin(path)
+
+
+def _strip(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    if any(k.startswith(prefix) for k in sd):
+        return {k[len(prefix):] if k.startswith(prefix) else k: v
+                for k, v in sd.items()}
+    return sd
+
+
+def _f32(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x), dtype=np.float32)
+
+
+def _stack(per_layer):
+    """List of per-layer pytrees (same structure) → one pytree of stacked
+    (L, ...) arrays, matching transformer_init's scanned-block layout."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *per_layer)
+
+
+# -- GPT-2 ---------------------------------------------------------------------
+
+def import_gpt2(sd: Dict[str, np.ndarray], cfg=None) -> dict:
+    """HF GPT-2 state dict → transformer pytree.
+
+    HF's ``Conv1D`` stores weights (in, out) — our dense layout exactly, no
+    transpose. ``c_attn`` is fused (D, 3D) and splits into wq/wk/wv. The LM
+    head is tied to ``wte`` (``lm_head.weight`` is a view of it), so
+    ``head.kernel = wte.T`` with a zero bias.
+    """
+    sd = _strip(sd, "transformer.")
+    d = sd["wte.weight"].shape[1]
+    n_layers = 1 + max(int(k.split(".")[1]) for k in sd if k.startswith("h."))
+    if cfg is not None:
+        assert cfg.n_layers == n_layers, (cfg.n_layers, n_layers)
+        assert cfg.d_model == d, (cfg.d_model, d)
+
+    blocks = []
+    for i in range(n_layers):
+        p = f"h.{i}."
+        ca_w, ca_b = _f32(sd[p + "attn.c_attn.weight"]), _f32(sd[p + "attn.c_attn.bias"])
+        wq, wk, wv = np.split(ca_w, 3, axis=1)
+        bq, bk, bv = np.split(ca_b, 3)
+        blocks.append({
+            "ln1": {"scale": _f32(sd[p + "ln_1.weight"]),
+                    "bias": _f32(sd[p + "ln_1.bias"])},
+            "attn": {
+                "wq": {"kernel": wq, "bias": bq},
+                "wk": {"kernel": wk, "bias": bk},
+                "wv": {"kernel": wv, "bias": bv},
+                "wo": {"kernel": _f32(sd[p + "attn.c_proj.weight"]),
+                       "bias": _f32(sd[p + "attn.c_proj.bias"])},
+            },
+            "ln2": {"scale": _f32(sd[p + "ln_2.weight"]),
+                    "bias": _f32(sd[p + "ln_2.bias"])},
+            "mlp": {
+                "fc": {"kernel": _f32(sd[p + "mlp.c_fc.weight"]),
+                       "bias": _f32(sd[p + "mlp.c_fc.bias"])},
+                "proj": {"kernel": _f32(sd[p + "mlp.c_proj.weight"]),
+                         "bias": _f32(sd[p + "mlp.c_proj.bias"])},
+            },
+        })
+
+    wte = _f32(sd["wte.weight"])
+    head_w = _f32(sd["lm_head.weight"]) if "lm_head.weight" in sd else wte
+    return {
+        "tok_embed": {"table": wte},
+        "pos_embed": {"table": _f32(sd["wpe.weight"])},
+        "blocks": _stack(blocks),
+        "ln_f": {"scale": _f32(sd["ln_f.weight"]),
+                 "bias": _f32(sd["ln_f.bias"])},
+        "head": {"kernel": np.ascontiguousarray(head_w.T),
+                 "bias": np.zeros((head_w.shape[0],), np.float32)},
+    }
+
+
+# -- BERT ----------------------------------------------------------------------
+
+def _linear(sd, key):
+    """torch nn.Linear (out, in) → dense {kernel (in, out), bias}."""
+    return {"kernel": np.ascontiguousarray(_f32(sd[key + ".weight"]).T),
+            "bias": _f32(sd[key + ".bias"])}
+
+
+def _ln(sd, key):
+    return {"scale": _f32(sd[key + ".weight"]), "bias": _f32(sd[key + ".bias"])}
+
+
+def import_bert(sd: Dict[str, np.ndarray], cfg=None,
+                n_outputs: int = 2) -> dict:
+    """HF BERT (QA-head) state dict → transformer pytree (post-LN dialect).
+
+    Mapping: ``attention.output.LayerNorm`` → ln1 (applied after the
+    attention residual), ``output.LayerNorm`` → ln2 (after the FFN
+    residual), per the post-LN block in models.transformer._block_apply.
+    The pooler is unused by the QA task and skipped. Without a
+    ``qa_outputs`` head (plain BertModel) the head is zero-initialized.
+    """
+    sd = _strip(sd, "bert.")
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("encoder.layer."))
+    d = sd["embeddings.word_embeddings.weight"].shape[1]
+    if cfg is not None:
+        assert cfg.n_layers == n_layers and cfg.d_model == d
+
+    blocks = []
+    for i in range(n_layers):
+        p = f"encoder.layer.{i}."
+        blocks.append({
+            "ln1": _ln(sd, p + "attention.output.LayerNorm"),
+            "attn": {
+                "wq": _linear(sd, p + "attention.self.query"),
+                "wk": _linear(sd, p + "attention.self.key"),
+                "wv": _linear(sd, p + "attention.self.value"),
+                "wo": _linear(sd, p + "attention.output.dense"),
+            },
+            "ln2": _ln(sd, p + "output.LayerNorm"),
+            "mlp": {
+                "fc": _linear(sd, p + "intermediate.dense"),
+                "proj": _linear(sd, p + "output.dense"),
+            },
+        })
+
+    if "qa_outputs.weight" in sd:
+        head = _linear(sd, "qa_outputs")
+    else:
+        head = {"kernel": np.zeros((d, n_outputs), np.float32),
+                "bias": np.zeros((n_outputs,), np.float32)}
+    return {
+        "tok_embed": {"table": _f32(sd["embeddings.word_embeddings.weight"])},
+        "pos_embed": {"table": _f32(sd["embeddings.position_embeddings.weight"])},
+        "type_embed": {"table": _f32(sd["embeddings.token_type_embeddings.weight"])},
+        "embed_ln": _ln(sd, "embeddings.LayerNorm"),
+        "blocks": _stack(blocks),
+        "head": head,
+    }
+
+
+# -- ResNet-50 v1.5 ------------------------------------------------------------
+
+def _conv(sd, key):
+    """torch Conv2d OIHW → conv {kernel HWIO}."""
+    return {"kernel": np.ascontiguousarray(
+        _f32(sd[key + ".weight"]).transpose(2, 3, 1, 0))}
+
+
+def _bn(sd, key):
+    return {"scale": _f32(sd[key + ".weight"]),
+            "bias": _f32(sd[key + ".bias"]),
+            "mean": _f32(sd[key + ".running_mean"]),
+            "var": _f32(sd[key + ".running_var"])}
+
+
+def import_resnet50_v1(sd: Dict[str, np.ndarray]) -> dict:
+    """HF ``ResNetForImageClassification`` (microsoft/resnet-50 layout)
+    state dict → ``resnet50-v1`` pytree. Depths [3, 4, 6, 3]; block j convs
+    ``layer.{0,1,2}`` → conv1/2/3, ``shortcut`` → proj/proj_bn."""
+    sd = _strip(sd, "resnet.")
+    params = {
+        "stem": _conv(sd, "embedder.embedder.convolution"),
+        "stem_bn": _bn(sd, "embedder.embedder.normalization"),
+    }
+    depths = (3, 4, 6, 3)
+    for s, depth in enumerate(depths):
+        for b in range(depth):
+            p = f"encoder.stages.{s}.layers.{b}."
+            block = {}
+            for j in range(3):
+                block[f"conv{j+1}"] = _conv(sd, p + f"layer.{j}.convolution")
+                block[f"bn{j+1}"] = _bn(sd, p + f"layer.{j}.normalization")
+            if p + "shortcut.convolution.weight" in sd:
+                block["proj"] = _conv(sd, p + "shortcut.convolution")
+                block["proj_bn"] = _bn(sd, p + "shortcut.normalization")
+            params[f"stage{s}_block{b}"] = block
+    if "classifier.1.weight" in sd:
+        params["head"] = _linear(sd, "classifier.1")
+    else:  # plain ResNetModel: no classifier
+        width = params["stage3_block0"]["conv3"]["kernel"].shape[-1]
+        params["head"] = {"kernel": np.zeros((width, 1000), np.float32),
+                          "bias": np.zeros((1000,), np.float32)}
+    return params
+
+
+# -- ONNX ----------------------------------------------------------------------
+
+# Minimal protobuf wire-format reader — enough to pull initializers
+# (TensorProto) out of an ONNX ModelProto without the `onnx` package.
+# Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+
+def _read_varint(buf: bytes, i: int):
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 1:
+            val, i = buf[i:i + 8], i + 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val, i = buf[i:i + ln], i + ln
+        elif wire == 5:
+            val, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, val
+
+
+_ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+                7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def _parse_tensor(buf: bytes):
+    dims, dtype, name = [], 1, ""
+    raw = None
+    floats, int64s, int32s, doubles = [], [], [], []
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            if wire == 0:
+                dims.append(val)
+            else:  # packed
+                i = 0
+                while i < len(val):
+                    v, i = _read_varint(val, i)
+                    dims.append(v)
+        elif field == 2:
+            dtype = val
+        elif field == 4:
+            if wire == 5:
+                floats.append(struct.unpack("<f", val)[0])
+            else:
+                floats.extend(struct.unpack(f"<{len(val)//4}f", val))
+        elif field == 5:
+            if wire == 0:
+                int32s.append(val)
+            else:
+                i = 0
+                while i < len(val):
+                    v, i = _read_varint(val, i)
+                    int32s.append(v)
+        elif field == 7:
+            if wire == 0:
+                int64s.append(val)
+            else:
+                i = 0
+                while i < len(val):
+                    v, i = _read_varint(val, i)
+                    int64s.append(v)
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    np_dtype = _ONNX_DTYPES.get(dtype, np.float32)
+    if raw is not None:
+        arr = np.frombuffer(raw, dtype=np_dtype)
+    elif floats:
+        arr = np.asarray(floats, np.float32)
+    elif int64s:
+        arr = np.asarray(int64s, np.int64)
+    elif int32s:
+        arr = np.asarray(int32s, np.int32)
+    else:
+        arr = np.zeros((0,), np_dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def load_onnx_initializers(path: str) -> Dict[str, np.ndarray]:
+    """Extract every initializer (weight tensor) from an ONNX model file.
+
+    This reads the protobuf wire format directly (ModelProto field 7 →
+    GraphProto field 5 → TensorProto), so the reference's
+    ``models/resnet50-v2-7.onnx`` asset is readable without onnx/ORT.
+    """
+    with open(path, "rb") as f:
+        buf = f.read()
+    out: Dict[str, np.ndarray] = {}
+    for field, _wire, val in _iter_fields(buf):
+        if field == 7:  # ModelProto.graph
+            for gfield, _gwire, gval in _iter_fields(val):
+                if gfield == 5:  # GraphProto.initializer
+                    name, arr = _parse_tensor(gval)
+                    out[name] = arr
+    return out
+
+
+# -- dispatch ------------------------------------------------------------------
+
+_IMPORTERS = {
+    "gpt2": lambda sd, spec: import_gpt2(sd, getattr(spec, "config", None)),
+    "bert": lambda sd, spec: import_bert(sd, getattr(spec, "config", None)),
+    "resnet50-v1": lambda sd, spec: import_resnet50_v1(sd),
+}
+
+
+def importer_for(model_name: str):
+    """Longest-prefix importer lookup: 'gpt2', 'bert', 'resnet50-v1' (and
+    size variants like 'bert-small-test') resolve to their family."""
+    best = None
+    for family in _IMPORTERS:
+        if (model_name == family or model_name.startswith(family)) and (
+                best is None or len(family) > len(best)):
+            best = family
+    # gpt2-moe has extra (router/expert) params a dense checkpoint can't fill
+    if best and model_name.startswith("gpt2-moe"):
+        return None
+    return _IMPORTERS.get(best) if best else None
+
+
+# HF config.json model_type → registry family with an importer. ResNet maps
+# to the v1.5 model (HF/torchvision layout) — the v2 flagship has a
+# different (pre-activation) graph that HF checkpoints cannot fill.
+_HF_MODEL_TYPES = {"gpt2": "gpt2", "bert": "bert", "resnet": "resnet50-v1"}
+
+
+def model_name_from_hf(path: str) -> Optional[str]:
+    """Read an HF checkpoint dir's config.json and return the registry model
+    name its weights import into (None when unrecognized / not an HF dir)."""
+    cpath = os.path.join(path, "config.json") if os.path.isdir(path) else None
+    if not cpath or not os.path.exists(cpath):
+        return None
+    with open(cpath) as f:
+        cfg = json.load(f)
+    return _HF_MODEL_TYPES.get(cfg.get("model_type", ""))
+
+
+def load_pretrained(model_name: str, path: str, spec=None):
+    """Checkpoint file/dir → parameter pytree for registry model
+    ``model_name``. Raises ValueError when the family has no importer."""
+    imp = importer_for(model_name)
+    if imp is None:
+        raise ValueError(f"no pretrained-weight importer for '{model_name}'")
+    if spec is None:
+        from tpu_engine.models.registry import create_model, \
+            _ensure_builtin_models_imported
+
+        _ensure_builtin_models_imported()
+        spec = create_model(model_name)
+    return imp(load_state_dict(path), spec)

@@ -93,3 +93,88 @@ def make_resnet50(image_size: int = 224, num_classes: int = 1000) -> ModelSpec:
         input_shape=(image_size, image_size, 3),
         output_shape=(num_classes,),
     )
+
+
+# -- ResNet-50 v1.5 (post-activation) -----------------------------------------
+#
+# The pretrained-weight serving family: bottleneck layout, conv→BN→ReLU
+# ordering, stride on the 3x3, exactly matching torchvision/HF
+# `microsoft/resnet-50` so `models.import_weights.import_resnet50_v1` maps
+# real ImageNet checkpoints onto this pytree (golden-tested against the
+# torch forward). Padding is explicit torch-style (k//2 per side): XLA
+# "SAME" pads asymmetrically at stride 2 and would shift every window.
+
+def _v1_block_init(key, in_ch: int, out_ch: int, stride: int):
+    mid = out_ch // _EXPANSION
+    k = jax.random.split(key, 4)
+    params = {
+        "conv1": nn.conv_init(k[0], 1, 1, in_ch, mid),
+        "bn1": nn.batchnorm_init(mid),
+        "conv2": nn.conv_init(k[1], 3, 3, mid, mid),
+        "bn2": nn.batchnorm_init(mid),
+        "conv3": nn.conv_init(k[2], 1, 1, mid, out_ch),
+        "bn3": nn.batchnorm_init(out_ch),
+    }
+    if stride != 1 or in_ch != out_ch:
+        params["proj"] = nn.conv_init(k[3], 1, 1, in_ch, out_ch)
+        params["proj_bn"] = nn.batchnorm_init(out_ch)
+    return params
+
+
+def _v1_block_apply(params, x, stride: int, dtype):
+    shortcut = x
+    if "proj" in params:
+        shortcut = nn.batchnorm(
+            params["proj_bn"],
+            nn.conv2d(params["proj"], x, stride=stride, padding=((0, 0), (0, 0)),
+                      dtype=dtype))
+    h = nn.relu(nn.batchnorm(params["bn1"], nn.conv2d(
+        params["conv1"], x, stride=1, padding=((0, 0), (0, 0)), dtype=dtype)))
+    h = nn.relu(nn.batchnorm(params["bn2"], nn.conv2d(
+        params["conv2"], h, stride=stride, padding=((1, 1), (1, 1)),
+        dtype=dtype)))
+    h = nn.batchnorm(params["bn3"], nn.conv2d(
+        params["conv3"], h, stride=1, padding=((0, 0), (0, 0)), dtype=dtype))
+    return nn.relu(h + shortcut)
+
+
+@register("resnet50-v1")
+def make_resnet50_v1(image_size: int = 224, num_classes: int = 1000) -> ModelSpec:
+    out_chs = tuple(w * _EXPANSION for w in _WIDTHS)
+
+    def init(rng):
+        keys = jax.random.split(rng, 2 + sum(_STAGES))
+        params = {"stem": nn.conv_init(keys[0], 7, 7, 3, 64),
+                  "stem_bn": nn.batchnorm_init(64)}
+        in_ch = 64
+        ki = 1
+        for s, (n_blocks, out_ch) in enumerate(zip(_STAGES, out_chs)):
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                params[f"stage{s}_block{b}"] = _v1_block_init(
+                    keys[ki], in_ch, out_ch, stride)
+                in_ch = out_ch
+                ki += 1
+        params["head"] = nn.dense_init(keys[ki], in_ch, num_classes)
+        return params
+
+    def apply(params, x, dtype=jnp.bfloat16):
+        h = nn.conv2d(params["stem"], x, stride=2, padding=((3, 3), (3, 3)),
+                      dtype=dtype)
+        h = nn.relu(nn.batchnorm(params["stem_bn"], h))
+        h = nn.max_pool(h, 3, 2, padding=((0, 0), (1, 1), (1, 1), (0, 0)))
+        for s, (n_blocks, _) in enumerate(zip(_STAGES, out_chs)):
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                h = _v1_block_apply(params[f"stage{s}_block{b}"], h, stride,
+                                    dtype)
+        h = nn.global_avg_pool(h)
+        return nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+
+    return ModelSpec(
+        name="resnet50-v1",
+        apply=apply,
+        init=init,
+        input_shape=(image_size, image_size, 3),
+        output_shape=(num_classes,),
+    )

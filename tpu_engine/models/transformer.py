@@ -45,6 +45,15 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq: int = 1024
     causal: bool = True  # decoder (GPT) vs encoder (BERT)
+    # Architecture dialect knobs — defaults are GPT-2-exact; BERT flips all
+    # four (post-LN blocks, LayerNorm'd embeddings, segment embeddings,
+    # erf GELU, eps 1e-12). Faithful dialects are what let the HF weight
+    # importer (models.import_weights) produce bit-compatible forwards.
+    post_ln: bool = False       # BERT: x = LN(x + sub(x));  GPT: x = x + sub(LN(x))
+    embed_ln: bool = False      # LayerNorm after (tok + pos + type) embeddings
+    type_vocab: int = 0         # token-type (segment) embedding table size
+    gelu_tanh: bool = True      # tanh-approx GELU (GPT-2) vs erf GELU (BERT)
+    ln_eps: float = 1e-5
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the `expert`
     # mesh axis (ops.moe); top-k routing, static capacity slots.
     n_experts: int = 0
@@ -84,19 +93,28 @@ def _block_init(key, cfg: TransformerConfig):
 
 
 def transformer_init(key, cfg: TransformerConfig):
-    k_tok, k_pos, k_blocks, k_head = jax.random.split(key, 4)
+    k_tok, k_pos, k_blocks, k_head, k_type = jax.random.split(key, 5)
     block_keys = jax.random.split(k_blocks, cfg.n_layers)
     # Stack per-layer params on a leading axis: tree of (L, ...) arrays.
     blocks = jax.vmap(lambda k: _block_init(k, cfg))(block_keys)
-    return {
+    params = {
         "tok_embed": nn.embedding_init(k_tok, cfg.vocab, cfg.d_model),
         "pos_embed": nn.embedding_init(k_pos, cfg.max_seq, cfg.d_model),
         "blocks": blocks,
-        "ln_f": nn.layernorm_init(cfg.d_model),
         # LM head tied to tok_embed would save params; kept separate so the
         # vocab dim can shard over `model` independently.
         "head": nn.dense_init(k_head, cfg.d_model, cfg.vocab),
     }
+    if not cfg.post_ln:
+        # Post-LN dialects (BERT) normalize inside every block and have no
+        # final LayerNorm.
+        params["ln_f"] = nn.layernorm_init(cfg.d_model)
+    if cfg.embed_ln:
+        params["embed_ln"] = nn.layernorm_init(cfg.d_model)
+    if cfg.type_vocab > 0:
+        params["type_embed"] = nn.embedding_init(k_type, cfg.type_vocab,
+                                                 cfg.d_model)
+    return params
 
 
 def _mlp(params, h, dtype, cfg: TransformerConfig = None):
@@ -105,35 +123,60 @@ def _mlp(params, h, dtype, cfg: TransformerConfig = None):
 
         return moe_apply(params, h, cfg.moe, dtype=dtype)
     h = nn.dense(params["fc"], h, dtype=dtype)
-    h = jax.nn.gelu(h)
+    h = jax.nn.gelu(h, approximate=cfg.gelu_tanh if cfg is not None else True)
     return nn.dense(params["proj"], h, dtype=dtype)
 
 
-def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
+def _attn(bp, x, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
     attn_fn = attn_fn or dot_product_attention
-    x = nn.layernorm(bp["ln1"], h)
     q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
     k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
     v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
     a = attn_fn(q, k, v, causal=cfg.causal, mask=mask)
     b, s = a.shape[:2]
-    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
+    return nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
+
+
+def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
+    eps = cfg.ln_eps
+    if cfg.post_ln:
+        # BERT dialect: sublayer → residual add → LayerNorm.
+        h = nn.layernorm(bp["ln1"], h + _attn(bp, h, cfg, mask=mask,
+                                              dtype=dtype, attn_fn=attn_fn),
+                         eps=eps)
+        h = nn.layernorm(bp["ln2"], h + _mlp(bp["mlp"], h, dtype, cfg),
+                         eps=eps)
+    else:
+        # GPT dialect: LayerNorm → sublayer → residual add.
+        h = h + _attn(bp, nn.layernorm(bp["ln1"], h, eps=eps), cfg,
+                      mask=mask, dtype=dtype, attn_fn=attn_fn)
+        h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=eps), dtype,
+                     cfg)
     # nn.dense accumulates in f32; keep the residual-stream carry in the
     # compute dtype so the layer scan's carry type is stable.
     return h.astype(dtype)
 
 
 def transformer_apply(params, tokens, cfg: TransformerConfig, *,
-                      mask=None, dtype=jnp.bfloat16, attn_fn=None):
+                      mask=None, dtype=jnp.bfloat16, attn_fn=None,
+                      token_type_ids=None):
     """Full-sequence forward. tokens: (B, S) int32 → logits (B, S, vocab).
 
     `attn_fn` swaps the attention implementation — e.g. a partial of
     parallel.ring.ring_attention for sequence-parallel long-context runs,
-    or ops.flash.flash_attention for the fused Pallas kernel."""
+    or ops.flash.flash_attention for the fused Pallas kernel.
+    `token_type_ids` (B, S) selects segment embeddings when the config has a
+    type vocabulary (BERT); defaults to all-zeros."""
     b, s = tokens.shape
     h = nn.embedding(params["tok_embed"], tokens)
     h = h + params["pos_embed"]["table"][None, :s]
+    if cfg.type_vocab > 0:
+        if token_type_ids is None:
+            h = h + params["type_embed"]["table"][0]
+        else:
+            h = h + nn.embedding(params["type_embed"], token_type_ids)
+    if cfg.embed_ln:
+        h = nn.layernorm(params["embed_ln"], h, eps=cfg.ln_eps)
     h = h.astype(dtype)
 
     def body(carry, bp):
@@ -141,7 +184,8 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
                             attn_fn=attn_fn), None
 
     h, _ = jax.lax.scan(body, h, params["blocks"])
-    h = nn.layernorm(params["ln_f"], h)
+    if not cfg.post_ln:
+        h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
     return nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
 
 
@@ -159,7 +203,7 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
                   pos, cfg: TransformerConfig, *, dtype, prefill: bool,
                   attn_mask=None, start=None):
     ck, cv = cache_kv
-    x = nn.layernorm(bp["ln1"], h)
+    x = nn.layernorm(bp["ln1"], h, eps=cfg.ln_eps)
     q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
     k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
     v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
@@ -179,7 +223,7 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
         a = dot_product_attention(q, ck, cv, mask=valid)
     b, s = a.shape[:2]
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=cfg.ln_eps), dtype, cfg)
     return h.astype(dtype), (ck, cv)
 
 
@@ -209,7 +253,7 @@ def transformer_prefill(params, tokens, caches: KVCache, cfg: TransformerConfig,
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h[:, -1:])
+    h = nn.layernorm(params["ln_f"], h[:, -1:], eps=cfg.ln_eps)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)
 
@@ -221,7 +265,7 @@ def _block_decode_rows(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
     depths). pos_vec/start_vec: (B,) int32."""
     ck, cv = cache_kv
     b = h.shape[0]
-    x = nn.layernorm(bp["ln1"], h)
+    x = nn.layernorm(bp["ln1"], h, eps=cfg.ln_eps)
     q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
     k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
     v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
@@ -233,7 +277,7 @@ def _block_decode_rows(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
              ).astype(jnp.int32)
     a = dot_product_attention(q, ck, cv, mask=valid)
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h), dtype, cfg)
+    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=cfg.ln_eps), dtype, cfg)
     return h.astype(dtype), (ck, cv)
 
 
@@ -261,7 +305,7 @@ def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h)
+    h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)
 
@@ -291,6 +335,6 @@ def transformer_decode_step(params, token_t, caches: KVCache, pos,
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h)
+    h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)

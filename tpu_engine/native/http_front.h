@@ -34,6 +34,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "core.h"
@@ -121,10 +122,14 @@ class HttpFront {
       std::lock_guard<std::mutex> lk(conn_mu_);
       for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
     }
-    for (auto& t : conn_threads_) {
-      if (t.joinable()) t.join();
+    std::unordered_map<std::uint64_t, std::thread> rest;
+    {
+      std::lock_guard<std::mutex> lk(threads_mu_);
+      rest.swap(conn_threads_);
     }
-    conn_threads_.clear();
+    for (auto& kv : rest) {
+      if (kv.second.joinable()) kv.second.join();
+    }
   }
 
   int port() const { return port_; }
@@ -149,20 +154,61 @@ class HttpFront {
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      ReapFinished();
       {
         std::lock_guard<std::mutex> lk(conn_mu_);
         conn_fds_.insert(fd);
-        // Reap finished threads opportunistically to bound the vector.
-        if (conn_threads_.size() > 4096) {
-          for (auto& t : conn_threads_) {
-            if (t.joinable()) t.join();
-          }
-          conn_threads_.clear();
-        }
-        conn_threads_.emplace_back([this, fd] { Serve(fd); });
+      }
+      std::uint64_t tid = next_thread_id_.fetch_add(1);
+      std::thread t([this, fd, tid] {
+        Serve(fd);
+        // Self-registration on the done list is the ONLY cross-thread
+        // signal; the accept loop joins exclusively ids found here, so it
+        // never blocks on a thread still serving a live connection, and it
+        // holds neither conn_mu_ nor threads_mu_ while joining.
+        std::lock_guard<std::mutex> lk(done_mu_);
+        done_ids_.push_back(tid);
+      });
+      {
+        std::lock_guard<std::mutex> lk(threads_mu_);
+        conn_threads_.emplace(tid, std::move(t));
       }
     }
   }
+
+  // Joins only threads whose Serve() already returned. Join happens outside
+  // every mutex: a joined thread's final act is the done-list append, so the
+  // join can only wait on that last statement, never on live I/O.
+  void ReapFinished() {
+    std::vector<std::uint64_t> done;
+    {
+      std::lock_guard<std::mutex> lk(done_mu_);
+      done.swap(done_ids_);
+    }
+    for (std::uint64_t tid : done) {
+      std::thread t;
+      {
+        std::lock_guard<std::mutex> lk(threads_mu_);
+        auto it = conn_threads_.find(tid);
+        if (it == conn_threads_.end()) {
+          // Finished before the accept loop emplaced it; retry next reap.
+          std::lock_guard<std::mutex> dlk(done_mu_);
+          done_ids_.push_back(tid);
+          continue;
+        }
+        t = std::move(it->second);
+        conn_threads_.erase(it);
+      }
+      if (t.joinable()) t.join();
+    }
+  }
+
+  // Caps: a single header line (and the buffered remainder while looking for
+  // one) may not exceed kMaxHeaderBytes (431), and a declared body may not
+  // exceed kMaxBodyBytes (413); either way the connection is closed — without
+  // this, one never-terminated or huge request exhausts server memory.
+  static constexpr std::size_t kMaxHeaderBytes = 64 * 1024;
+  static constexpr std::size_t kMaxBodyBytes = 64ull * 1024 * 1024;
 
   static bool ReadLine(int fd, std::string* buf, std::string* line) {
     // Reads from fd into *buf until a "\r\n" is available; pops it.
@@ -173,6 +219,7 @@ class HttpFront {
         buf->erase(0, pos + 2);
         return true;
       }
+      if (buf->size() > kMaxHeaderBytes) return false;
       char tmp[4096];
       ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
       if (n <= 0) return false;
@@ -203,11 +250,24 @@ class HttpFront {
     return true;
   }
 
+  // Sends 431 before closing when a ReadLine failure was a header-size
+  // overflow (vs a plain EOF/reset, where the peer is already gone).
+  void MaybeReject431(int fd, const std::string& buf) {
+    if (buf.size() > kMaxHeaderBytes) {
+      std::string resp;
+      WrapHttp(431, "{\"error\": \"request header too large\"}", &resp);
+      SendAll(fd, resp.data(), resp.size());
+    }
+  }
+
   void Serve(int fd) {
     std::string buf;
     while (running_.load()) {
       std::string req_line;
-      if (!ReadLine(fd, &buf, &req_line)) break;
+      if (!ReadLine(fd, &buf, &req_line)) {
+        MaybeReject431(fd, buf);
+        break;
+      }
       if (req_line.empty()) continue;
       auto sp1 = req_line.find(' ');
       auto sp2 = req_line.find(' ', sp1 + 1);
@@ -221,7 +281,10 @@ class HttpFront {
       bool close_conn = false;
       std::string header;
       for (;;) {
-        if (!ReadLine(fd, &buf, &header)) return CloseFd(fd);
+        if (!ReadLine(fd, &buf, &header)) {
+          MaybeReject431(fd, buf);
+          return CloseFd(fd);
+        }
         if (header.empty()) break;
         std::string lower;
         lower.reserve(header.size());
@@ -232,6 +295,12 @@ class HttpFront {
                    lower.find("close") != std::string::npos) {
           close_conn = true;
         }
+      }
+      if (content_length > kMaxBodyBytes) {
+        std::string resp;
+        WrapHttp(413, "{\"error\": \"request body too large\"}", &resp);
+        SendAll(fd, resp.data(), resp.size());
+        return CloseFd(fd);
       }
       std::string body;
       if (content_length &&
@@ -373,6 +442,8 @@ class HttpFront {
     const char* reason = status == 200 ? "OK"
                          : status == 400 ? "Bad Request"
                          : status == 404 ? "Not Found"
+                         : status == 413 ? "Payload Too Large"
+                         : status == 431 ? "Request Header Fields Too Large"
                                          : "Internal Server Error";
     resp->clear();
     resp->reserve(payload.size() + 128);
@@ -398,7 +469,11 @@ class HttpFront {
   std::unordered_map<std::string, Lane*> index_;
   std::mutex conn_mu_;
   std::unordered_set<int> conn_fds_;
-  std::vector<std::thread> conn_threads_;
+  std::mutex threads_mu_;
+  std::unordered_map<std::uint64_t, std::thread> conn_threads_;
+  std::mutex done_mu_;
+  std::vector<std::uint64_t> done_ids_;
+  std::atomic<std::uint64_t> next_thread_id_{0};
 };
 
 }  // namespace tpucore

@@ -265,7 +265,10 @@ class Generator:
         seeds_arr = np.zeros((bb,), np.int32)
         topp_arr = np.ones((bb,), np.float32)
         temps_arr[:n] = temps
-        seeds_arr[:n] = np.asarray(seeds, np.int64).astype(np.int32)
+        # Same normalization as the continuous scheduler (& 0x7FFFFFFF):
+        # seeds >= 2**31 must sample identically under both gen_scheduler
+        # settings (documented seeded-reproducibility contract).
+        seeds_arr[:n] = [int(s) & 0x7FFFFFFF for s in seeds]
         topp_arr[:n] = top_ps
         temps_dev, seeds_dev, topp_dev = put(temps_arr), put(seeds_arr), put(topp_arr)
         start_dev = put(start)

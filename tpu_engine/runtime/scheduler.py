@@ -241,7 +241,7 @@ class ContinuousGenerator:
 
         self._start[row] = pb - L
         self._pos[row] = pb
-        self._seeds[row] = np.int64(req.seed) & 0x7FFFFFFF
+        self._seeds[row] = int(req.seed) & 0x7FFFFFFF
         self._temps[row] = req.temperature
         self._topps[row] = req.top_p
         # First token from the prefill logits at logical position L.
@@ -276,6 +276,30 @@ class ContinuousGenerator:
             self._done[row] = True
             self._stats["completed"] += 1
 
+    def _recover(self, exc: BaseException) -> None:
+        """Device-step failure recovery. The prefill/decode executables
+        donate ``self._caches``, so after a failed step the KV buffer may
+        already be invalidated — every in-flight row's state is lost. Fail
+        their futures with the real error, rebuild the cache, reset slot
+        state, and keep the loop serving (a transient device error must not
+        silently kill the daemon and hang all future /generate calls —
+        ADVICE round 1, scheduler.py:310)."""
+        for r, req in enumerate(self._row_req):
+            if req is not None and not req.future.done():
+                req.future.set_exception(exc)
+            self._row_req[r] = None
+            self._row_emitted[r] = []
+        self._pos[:] = 0
+        self._start[:] = 0
+        self._tok[:] = 0
+        self._done[:] = True
+        self._stats["failures"] = self._stats.get("failures", 0) + 1
+        caches = init_caches(self.cfg, self.n_slots, self.max_seq,
+                             self._dtype)
+        if self._device is not None:
+            caches = jax.device_put(caches, self._device)
+        self._caches = caches
+
     def _loop(self) -> None:
         while self._running:
             # Admit as many queued requests as there are free rows; block
@@ -295,30 +319,39 @@ class ContinuousGenerator:
                     self._admit(req, free.pop(0))
                     admitted_any = True
                 except Exception as exc:
+                    # Prefill donates the shared cache too — conservatively
+                    # treat any admit failure as a device-state loss.
                     req.future.set_exception(exc)
+                    self._recover(exc)
+                    break
             if all(r is None for r in self._row_req):
                 continue
 
-            # One decode chunk over the fixed batch. -1 marks rows with EOS
-            # disabled (and free rows): sampled tokens are in [0, vocab) so
-            # `nxt == -1` never fires; done rows emit -1 (discarded), and
-            # the embedding lookup of -1 clips harmlessly under jit.
-            eos_vec = np.full((self.n_slots,), -1, np.int32)
-            for r, req in enumerate(self._row_req):
-                if req is not None and req.eos_id >= 0:
-                    eos_vec[r] = req.eos_id
-            self._caches, tok, pos, done, toks = self._decode()(
-                self.params, self._caches, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._start),
-                jnp.asarray(self._done), jnp.asarray(self._seeds),
-                jnp.asarray(self._temps), jnp.asarray(self._topps),
-                jnp.asarray(eos_vec))
-            # np.array (copy): np.asarray of a jax.Array is read-only and
-            # the admit path mutates these vectors in place.
-            self._tok = np.array(tok)
-            self._pos = np.array(pos)
-            self._done = np.array(done)
-            toks_host = np.asarray(toks)
+            try:
+                # One decode chunk over the fixed batch. -1 marks rows with
+                # EOS disabled (and free rows): sampled tokens are in
+                # [0, vocab) so `nxt == -1` never fires; done rows emit -1
+                # (discarded), and the embedding lookup of -1 clips
+                # harmlessly under jit.
+                eos_vec = np.full((self.n_slots,), -1, np.int32)
+                for r, req in enumerate(self._row_req):
+                    if req is not None and req.eos_id >= 0:
+                        eos_vec[r] = req.eos_id
+                self._caches, tok, pos, done, toks = self._decode()(
+                    self.params, self._caches, jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), jnp.asarray(self._start),
+                    jnp.asarray(self._done), jnp.asarray(self._seeds),
+                    jnp.asarray(self._temps), jnp.asarray(self._topps),
+                    jnp.asarray(eos_vec))
+                # np.array (copy): np.asarray of a jax.Array is read-only
+                # and the admit path mutates these vectors in place.
+                self._tok = np.array(tok)
+                self._pos = np.array(pos)
+                self._done = np.array(done)
+                toks_host = np.asarray(toks)
+            except Exception as exc:
+                self._recover(exc)
+                continue
             self._stats["chunks"] += 1
 
             for r, req in enumerate(self._row_req):

@@ -89,6 +89,13 @@ def serve_combined(
     devices = jax.devices()
     n_lanes = lanes or len(devices)
     gateway_config = gateway_config or GatewayConfig(port=port)
+    # Real weights (HF/torch/orbax) are loaded once and shared by every lane
+    # (each engine device_puts its own copy onto its chip).
+    params = None
+    if worker_config is not None and worker_config.model_path:
+        from tpu_engine.serving.worker import _load_model_path
+
+        params = _load_model_path(model, worker_config.model_path)
     workers = []
     for i in range(n_lanes):
         cfg = worker_config or WorkerConfig()
@@ -97,6 +104,7 @@ def serve_combined(
 
         engine = InferenceEngine(
             lane_cfg.model,
+            params=params,
             dtype=lane_cfg.dtype,
             batch_buckets=lane_cfg.batch_buckets,
             shape_buckets=lane_cfg.shape_buckets,

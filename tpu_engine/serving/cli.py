@@ -54,7 +54,19 @@ def main(argv=None) -> int:
         port = int(rest[0])
         node_id = rest[1] if len(rest) > 1 else f"worker_{port}"
         model_arg = rest[2] if len(rest) > 2 else os.environ.get("MODEL_PATH", "resnet50")
-        cfg = WorkerConfig(port=port, node_id=node_id, model=model_from_path(model_arg))
+        # A real path loads real weights (HF/torch/orbax via the worker's
+        # _load_model_path); a bare registry name serves random init. HF
+        # checkpoint dirs resolve their registry model from config.json
+        # (e.g. model_type "resnet" → resnet50-v1, the importable family).
+        model_path = model_arg if os.path.exists(model_arg) else None
+        model = None
+        if model_path:
+            from tpu_engine.models.import_weights import model_name_from_hf
+
+            model = model_name_from_hf(model_path)
+        cfg = WorkerConfig(port=port, node_id=node_id,
+                           model=model or model_from_path(model_arg),
+                           model_path=model_path)
         serve_worker(cfg, background=True)
         _run_forever()
         return 0
@@ -79,6 +91,9 @@ def main(argv=None) -> int:
 
         parser = argparse.ArgumentParser(prog="serve")
         parser.add_argument("--model", default="resnet50")
+        parser.add_argument("--model-path", default=None,
+                            help="HF/torch/orbax checkpoint with real weights "
+                                 "(default: random init)")
         parser.add_argument("--lanes", type=int, default=0)
         parser.add_argument("--port", type=int, default=8000)
         parser.add_argument("--warmup", action="store_true",
@@ -101,7 +116,7 @@ def main(argv=None) -> int:
             gateway_config = GatewayConfig(port=args.port,
                                            breaker_timeout_s=args.breaker_timeout)
         worker_config = None
-        if args.shape_buckets or args.gen_scheduler != "batch":
+        if args.shape_buckets or args.gen_scheduler != "batch" or args.model_path:
             from tpu_engine.utils.config import WorkerConfig
 
             buckets = None
@@ -110,11 +125,31 @@ def main(argv=None) -> int:
                     tuple(int(d) for d in s.split("x"))
                     for s in args.shape_buckets.split(","))
             worker_config = WorkerConfig(shape_buckets=buckets,
-                                         gen_scheduler=args.gen_scheduler)
+                                         gen_scheduler=args.gen_scheduler,
+                                         model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
                        warmup=args.warmup, worker_config=worker_config,
                        gateway_config=gateway_config)
         _run_forever()
+        return 0
+
+    if cmd == "import-weights":
+        # HF/torch checkpoint → orbax checkpoint serving artifact:
+        #   import-weights --model gpt2 --src /path/to/hf_ckpt --out ckpt/
+        # The orbax output then serves via `worker_node <port> <id> ckpt/`.
+        parser = argparse.ArgumentParser(prog="import-weights")
+        parser.add_argument("--model", required=True,
+                            help="registry model name (gpt2, bert, resnet50-v1)")
+        parser.add_argument("--src", required=True,
+                            help="HF checkpoint dir, .safetensors, or torch .bin")
+        parser.add_argument("--out", required=True)
+        args = parser.parse_args(rest)
+        from tpu_engine.models.import_weights import load_pretrained
+        from tpu_engine.utils.checkpoint import save_params
+
+        params = load_pretrained(args.model, args.src)
+        path = save_params(args.out, params)
+        print(f"imported {args.src} as {args.model} -> {path}")
         return 0
 
     if cmd == "save-checkpoint":
@@ -138,7 +173,8 @@ def main(argv=None) -> int:
         return 0
 
     print(f"unknown command '{cmd}' "
-          "(expected worker_node | gateway | serve | save-checkpoint)")
+          "(expected worker_node | gateway | serve | save-checkpoint | "
+          "import-weights)")
     return 2
 
 

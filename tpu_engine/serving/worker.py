@@ -78,6 +78,33 @@ class _GenResult:
     generate_time_us: int
 
 
+def _load_model_path(model: str, model_path: Optional[str]):
+    """Resolve the worker's model_path into a parameter pytree (or None for
+    random init). HF checkpoint layouts (config.json / *.safetensors /
+    pytorch_model.bin, or those files directly) go through the pretrained
+    importers; other directories are treated as orbax checkpoints."""
+    if not model_path:
+        return None
+    if os.path.isfile(model_path):
+        if model_path.endswith((".safetensors", ".bin", ".pt", ".pth")):
+            from tpu_engine.models.import_weights import load_pretrained
+
+            return load_pretrained(model, model_path)
+        return None  # e.g. a reference-style .onnx path used only for naming
+    if os.path.isdir(model_path):
+        if any(os.path.exists(os.path.join(model_path, f))
+               for f in ("config.json", "model.safetensors",
+                         "pytorch_model.bin",
+                         "model.safetensors.index.json")):
+            from tpu_engine.models.import_weights import load_pretrained
+
+            return load_pretrained(model, model_path)
+        from tpu_engine.utils.checkpoint import load_params
+
+        return load_params(model_path)
+    return None
+
+
 def _make_cache(capacity: int):
     # Values are the pre-encoded output_data JSON fragments (bytes) — raw
     # mode lets the native HTTP front read entries without unpickling.
@@ -95,17 +122,17 @@ class WorkerNode:
     def __init__(self, config: Optional[WorkerConfig] = None, engine=None, **overrides):
         self.config = config or WorkerConfig.from_env(**overrides)
         self.node_id = self.config.node_id
+        # Pre-escaped for the raw-splice response path: an operator-supplied
+        # node_id containing quotes/backslashes must not corrupt the JSON.
+        self._node_id_json = json.dumps(self.node_id).encode()
         if engine is None:
             from tpu_engine.runtime.engine import InferenceEngine
 
-            params = None
-            if self.config.model_path and os.path.isdir(self.config.model_path):
-                # model_path (reference positional arg / $MODEL_PATH,
-                # worker_node.cpp:154-168) points at an orbax checkpoint
-                # directory — real weights instead of random init.
-                from tpu_engine.utils.checkpoint import load_params
-
-                params = load_params(self.config.model_path)
+            # model_path (reference positional arg / $MODEL_PATH,
+            # worker_node.cpp:154-168): real weights instead of random init.
+            # Accepts an HF checkpoint dir / .safetensors / torch .bin (via
+            # models.import_weights) or an orbax checkpoint directory.
+            params = _load_model_path(self.config.model, self.config.model_path)
             engine = InferenceEngine(
                 self.config.model,
                 params=params,
@@ -245,7 +272,11 @@ class WorkerNode:
             if not entry.event.wait(timeout=120.0):
                 raise RuntimeError("coalesced request timed out")
             if entry.error is not None:
-                raise RuntimeError(str(entry.error))
+                # Re-raise the leader's exception unchanged so client-input
+                # error types (KeyError/TypeError/ValueError) keep their
+                # no-breaker-penalty classification in LocalWorkerClient —
+                # a coalesced bad input must not count as a lane failure.
+                raise entry.error
             self.tracer.record(request_id, "infer", self.node_id,
                                entry.time_us, batch_size=0)  # coalesced
             return request_id, entry.frag, False, entry.time_us
@@ -287,7 +318,7 @@ class WorkerNode:
         request_id, frag, cached, time_us = self._infer_core(request)
         return (b'{"request_id": ' + json.dumps(request_id).encode()
                 + b', "output_data": ' + frag
-                + b', "node_id": "' + self.node_id.encode() + b'"'
+                + b', "node_id": ' + self._node_id_json
                 + b', "cached": ' + (b"true" if cached else b"false")
                 + b', "inference_time_us": ' + str(time_us).encode() + b"}")
 
