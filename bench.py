@@ -32,6 +32,27 @@ import time
 BASELINE_REQ_S = 522.64  # reference README.md:283 (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
+# MFU figures are computed against these; unknown chips report raw FLOP/s.
+PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def chip_peak_flops() -> tuple:
+    """(device_kind, peak bf16 FLOP/s or None)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    lk = kind.lower()
+    for sub, peak in PEAK_BF16_FLOPS:
+        if sub in lk:
+            return kind, peak
+    return kind, None
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -64,7 +85,13 @@ def wait_ready(port: int, timeout_s: float = 600.0) -> None:
 
 class LoadGen:
     """Closed-loop load: T threads, each a persistent keep-alive connection,
-    issuing its share of N requests back-to-back (reference benchmark.py:49-76)."""
+    issuing its share of N requests back-to-back (reference benchmark.py:49-76).
+
+    The client is raw sockets with precomputed request bytes — http.client's
+    per-request object churn was the measured bottleneck at >8k req/s (the
+    server's hit path is GIL-free C++, so client CPU directly caps the
+    recorded number). Semantics unchanged: one outstanding request per
+    thread, no pipelining."""
 
     def __init__(self, port: int, n_requests: int, n_threads: int,
                  distinct_inputs: int = 10):
@@ -73,40 +100,78 @@ class LoadGen:
         self.n_threads = n_threads
         # Reference workload: input cycles through 10 distinct small vectors
         # (benchmark.py:23) — the ~99.7% cache hit rate is a workload property.
-        self.payloads = [
-            json.dumps({
-                "request_id": "req_{}",  # filled per request
+        # Stored as (head, tail) byte fragments: request i's body is
+        # head + str(i) + tail, with Content-Length patched per request.
+        self._frags = []
+        for i in range(distinct_inputs):
+            body = json.dumps({
+                "request_id": "req_@",
                 "input_data": [float(i), float(i + 1), float(i + 2)],
             })
-            for i in range(distinct_inputs)
-        ]
+            head, tail = body.split("req_@")
+            self._frags.append((head.encode() + b"req_", tail.encode()))
         self.latencies_ms: list[list[float]] = [[] for _ in range(n_threads)]
         self.failures = [0] * n_threads
 
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(("127.0.0.1", self.port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
     def _worker(self, tid: int, start_idx: int, count: int) -> None:
         lat = self.latencies_ms[tid]
-        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
-        headers = {"Content-Type": "application/json"}
+        lat_append = lat.append
+        perf = time.perf_counter
+        frags = self._frags
+        n_frags = len(frags)
+        prefix = (b"POST /infer HTTP/1.1\r\nHost: b\r\n"
+                  b"Content-Type: application/json\r\nContent-Length: ")
+        sock = self._connect()
+        buf = b""
         for k in range(count):
             i = start_idx + k
-            body = self.payloads[i % len(self.payloads)].replace(
-                '"req_{}"', f'"req_{i}"')
-            t0 = time.perf_counter()
+            head, tail = frags[i % n_frags]
+            ib = str(i).encode()
+            body = head + ib + tail
+            req = prefix + str(len(body)).encode() + b"\r\n\r\n" + body
+            t0 = perf()
             try:
-                conn.request("POST", "/infer", body=body, headers=headers)
-                resp = conn.getresponse()
-                resp.read()
-                ok = resp.status == 200
-            except (OSError, http.client.HTTPException):
+                sock.sendall(req)
+                # Headers (server always sends Content-Length, no chunking).
+                while True:
+                    j = buf.find(b"\r\n\r\n")
+                    if j >= 0:
+                        break
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise OSError("connection closed")
+                    buf += chunk
+                cl_at = buf.find(b"Content-Length: ", 0, j)
+                cl_end = buf.find(b"\r\n", cl_at)
+                total = j + 4 + int(buf[cl_at + 16:cl_end])
+                while len(buf) < total:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise OSError("connection closed")
+                    buf += chunk
+                ok = buf.startswith(b"HTTP/1.1 200")
+                buf = buf[total:]
+            except (OSError, ValueError):
                 ok = False
-                conn.close()
-                conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
-            dt_ms = (time.perf_counter() - t0) * 1e3
+                buf = b""
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                try:
+                    sock = self._connect()
+                except OSError:
+                    pass
             if ok:
-                lat.append(dt_ms)
+                lat_append((perf() - t0) * 1e3)
             else:
                 self.failures[tid] += 1
-        conn.close()
+        sock.close()
 
     def run(self) -> dict:
         per = self.n_requests // self.n_threads
@@ -166,13 +231,16 @@ def scrape_stats(port: int) -> dict:
     return out
 
 
-def launch_server(model: str, port: int, lanes: int) -> subprocess.Popen:
+def launch_server(model: str, port: int, lanes: int,
+                  mixed: bool = False) -> subprocess.Popen:
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
            "--model", model, "--port", str(port), "--lanes", str(lanes),
            "--warmup"]
+    if mixed:
+        cmd += ["--shape-buckets", "320x320x3,480x480x3,640x640x3"]
     log(f"launching server: {' '.join(cmd)}")
     return subprocess.Popen(cmd, cwd=REPO, env=env,
                             stdout=sys.stderr, stderr=sys.stderr)
@@ -266,6 +334,240 @@ def run_generate_bench(port: int, n_requests: int = 16, max_new: int = 32,
     }
 
 
+def run_compute_bench(model: str = "resnet50", batch: int = 32,
+                      iters: int = 30, dtype: str = "bfloat16") -> dict:
+    """Device-compute benchmark (VERDICT r1 item 2): sustained MISS-path
+    throughput — every input distinct, batch-`batch` executables saturated —
+    with MFU computed from XLA's own cost analysis of the compiled
+    executable against the chip's peak bf16 FLOP/s."""
+    import numpy as np
+
+    from tpu_engine.runtime.engine import InferenceEngine
+
+    eng = InferenceEngine(model, dtype=dtype, batch_buckets=(batch,))
+    t0 = time.perf_counter()
+    eng.warmup()
+    compile_s = time.perf_counter() - t0
+
+    exe = eng._compiled(batch)
+    flops_per_exec = None
+    try:
+        ca = exe.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops_per_exec = float(ca.get("flops", 0.0)) or None
+    except Exception as exc:
+        log(f"cost_analysis unavailable: {exc}")
+
+    rng = np.random.default_rng(0)
+    n_in = eng.input_size
+
+    def batch_inputs():
+        # Distinct every time — nothing cacheable anywhere.
+        return [rng.standard_normal(n_in).astype(np.float32)
+                for _ in range(batch)]
+
+    eng.batch_predict(batch_inputs())  # one warm pass through the full path
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eng.batch_predict(batch_inputs())
+    wall = time.perf_counter() - t0
+
+    kind, peak = chip_peak_flops()
+    samples_s = batch * iters / wall
+    achieved = flops_per_exec * iters / wall if flops_per_exec else None
+    return {
+        "model": model,
+        "batch": batch,
+        "iters": iters,
+        "samples_per_s": round(samples_s, 2),
+        "step_ms": round(wall / iters * 1e3, 3),
+        "compile_s": round(compile_s, 2),
+        "flops_per_batch": flops_per_exec,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "device_kind": kind,
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+    }
+
+
+def run_decode_compute(model: str = "gpt2", batch: int = 8,
+                       max_new: int = 64, dtype: str = "bfloat16") -> dict:
+    """On-chip decode throughput: tokens/s/chip through the KV-cache decode
+    loop, with decode MFU ≈ tokens/s x 2 x params / peak (decode is
+    HBM-bandwidth-bound; low MFU is expected and honest)."""
+    import numpy as np
+
+    from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
+    from tpu_engine.ops.nn import count_params
+    from tpu_engine.runtime.generator import Generator
+
+    _ensure_builtin_models_imported()
+    spec = create_model(model)
+    gen = Generator(spec, dtype=dtype, batch_buckets=(batch,))
+    n_params = count_params(gen.params)
+
+    rng = np.random.default_rng(1)
+    prompts = [[int(t) for t in rng.integers(1, 1000, size=12)]
+               for _ in range(batch)]
+    t0 = time.perf_counter()
+    gen.generate(prompts, max_new_tokens=4)  # compile prefill+decode
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = gen.generate(prompts, max_new_tokens=max_new, temperature=0.0)
+    wall = time.perf_counter() - t0
+    tokens = sum(len(o) for o in out)
+    kind, peak = chip_peak_flops()
+    tok_s = tokens / wall
+    flops_s = tok_s * 2.0 * n_params  # matmul fwd ≈ 2*N FLOPs/token
+    return {
+        "model": model,
+        "batch": batch,
+        "max_new_tokens": max_new,
+        "tokens_per_s": round(tok_s, 2),
+        "wall_s": round(wall, 3),
+        "compile_s": round(compile_s, 2),
+        "n_params": n_params,
+        "device_kind": kind,
+        "decode_mfu": round(flops_s / peak, 4) if peak else None,
+    }
+
+
+def run_decode_ab(model: str = "gpt2", n_requests: int = 24,
+                  max_new: int = 32, mean_gap_ms: float = 40.0,
+                  dtype: str = "bfloat16") -> dict:
+    """Continuous vs batch-to-completion decode under Poisson arrivals
+    (VERDICT r1 item 7): same model/params/workload, reports tokens/s and
+    per-request latency for both schedulers."""
+    import random
+
+    import jax
+    import numpy as np
+
+    from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    _ensure_builtin_models_imported()
+    spec = create_model(model)
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(42)
+    prompts = [[rnd.randrange(1, 1000) for _ in range(rnd.randrange(4, 24))]
+               for _ in range(n_requests)]
+    gaps = [rnd.expovariate(1000.0 / mean_gap_ms) / 1000.0
+            for _ in range(n_requests)]
+
+    results = {}
+    for sched in ("batch", "continuous"):
+        cfg = WorkerConfig(model=model, node_id=f"ab-{sched}", dtype=dtype,
+                           gen_scheduler=sched, batch_buckets=(1,))
+        engine = InferenceEngine(spec, params=params, dtype=dtype,
+                                 batch_buckets=(1,))
+        w = WorkerNode(cfg, engine=engine)
+        try:
+            # Warm compiles outside the timed window.
+            w.handle_generate({"request_id": "warm", "prompt_tokens": [1, 2, 3],
+                               "max_new_tokens": 4})
+            lats = [None] * n_requests
+            threads = []
+
+            def issue(i):
+                t0 = time.perf_counter()
+                w.handle_generate({"request_id": f"ab_{i}",
+                                   "prompt_tokens": prompts[i],
+                                   "max_new_tokens": max_new})
+                lats[i] = (time.perf_counter() - t0) * 1e3
+
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                time.sleep(gaps[i])
+                th = threading.Thread(target=issue, args=(i,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            lat_sorted = sorted(lats)
+            results[sched] = {
+                "tokens_per_s": round(n_requests * max_new / wall, 2),
+                "wall_s": round(wall, 3),
+                "latency_p50_ms": round(lat_sorted[len(lats) // 2], 1),
+                "latency_p95_ms": round(lat_sorted[int(0.95 * len(lats))
+                                                   - 1], 1),
+            }
+        finally:
+            w.stop()
+    cont, bat = results["continuous"], results["batch"]
+    results["continuous_speedup"] = round(
+        cont["tokens_per_s"] / max(bat["tokens_per_s"], 1e-9), 3)
+    return results
+
+
+def run_mixed_shape_bench(port: int, n_requests: int = 2000,
+                          n_threads: int = 16) -> dict:
+    """Mixed-shape load (BASELINE config 4): yolov8n requests cycling three
+    resolutions with distinct payloads, stressing the (shape, batch)
+    executable cache under concurrent traffic."""
+    import random
+
+    rnd = random.Random(9)
+    shapes = [(320, 320, 3), (480, 480, 3), (640, 640, 3)]
+    lat = [[] for _ in range(n_threads)]
+    fails = [0] * n_threads
+
+    def worker(tid):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        for i in range(tid, n_requests, n_threads):
+            shape = shapes[i % len(shapes)]
+            # Tiny distinct payload; engine zero-pads to the true shape —
+            # wire cost stays client-bound, device cost is the real shape.
+            body = json.dumps({
+                "request_id": f"mix_{i}",
+                "input_data": [rnd.random() for _ in range(16)],
+                "shape": list(shape),
+            })
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/infer", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    lat[tid].append((time.perf_counter() - t0) * 1e3)
+                else:
+                    fails[tid] += 1
+            except (OSError, http.client.HTTPException):
+                fails[tid] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.close()
+
+    # Warm every (shape, batch) bucket before timing.
+    warm = threading.Thread(target=worker, args=(0,))
+    warm.start()
+    warm.join()
+    lat[0] = []
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats = sorted(x for chunk in lat for x in chunk)
+    return {
+        "requests": n_requests,
+        "shapes": [list(s) for s in shapes],
+        "throughput_req_s": round(len(lats) / wall, 2),
+        "p50_ms": round(lats[len(lats) // 2], 2) if lats else None,
+        "p99_ms": round(lats[int(0.99 * len(lats)) - 1], 2) if lats else None,
+        "failed": sum(fails),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=10_000)
@@ -279,22 +581,76 @@ def main() -> int:
                     help="1000 requests / 20 threads smoke run")
     ap.add_argument("--cache-test", action="store_true",
                     help="reference cache-effectiveness A/B instead of load")
-    ap.add_argument("--scenario", choices=["infer", "generate"],
+    ap.add_argument("--distinct", type=int, default=10,
+                    help="distinct input vectors in the load (10 = reference "
+                         "parity / ~99.7%% hits; large values force the miss "
+                         "path)")
+    ap.add_argument("--no-compute", action="store_true",
+                    help="skip the device-compute (MFU) addendum after the "
+                         "serving load")
+    ap.add_argument("--scenario",
+                    choices=["infer", "generate", "compute", "decode-ab",
+                             "mixed"],
                     default="infer")
     args = ap.parse_args()
+    # In-process scenarios (compute / decode-ab) honor the same platform
+    # override the serving CLI does (the axon plugin ignores JAX_PLATFORMS).
+    platform = os.environ.get("TPU_ENGINE_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if args.quick:
         args.requests, args.threads = 1000, 20
-    if args.scenario == "generate" and args.model == "resnet50":
+    if args.scenario in ("generate", "decode-ab") and args.model == "resnet50":
         args.model = "gpt2"
+    if args.scenario == "mixed" and args.model == "resnet50":
+        args.model = "yolov8n"
+
+    if args.scenario == "compute":
+        # In-process, no HTTP: pure device-compute evidence.
+        compute = run_compute_bench(model=args.model
+                                    if args.model != "gpt2" else "resnet50")
+        decode = run_decode_compute()
+        log(json.dumps({"compute": compute, "decode": decode}, indent=2))
+        print(json.dumps({
+            "metric": "device_compute", "value": compute["samples_per_s"],
+            "unit": "samples/s", "vs_baseline": None,
+            "mfu": compute["mfu"], "decode_tokens_per_s": decode["tokens_per_s"],
+            "compute": compute, "decode": decode,
+        }), flush=True)
+        return 0
+
+    if args.scenario == "decode-ab":
+        result = run_decode_ab(model=args.model)
+        log(json.dumps(result, indent=2))
+        print(json.dumps({
+            "metric": "decode_continuous_speedup",
+            "value": result["continuous_speedup"], "unit": "x",
+            "vs_baseline": None, "model": args.model, **result,
+        }), flush=True)
+        return 0
 
     proc = None
     port = args.port
     try:
         if port == 0:
             port = free_port()
-            proc = launch_server(args.model, port, args.lanes)
+            proc = launch_server(args.model, port, args.lanes,
+                                 mixed=args.scenario == "mixed")
         log(f"waiting for server on :{port} ...")
         wait_ready(port)
+
+        if args.scenario == "mixed":
+            result = run_mixed_shape_bench(port)
+            log(json.dumps(result, indent=2))
+            result.update(scrape_stats(port))
+            print(json.dumps({
+                "metric": "mixed_shape_throughput",
+                "value": result["throughput_req_s"], "unit": "req/s",
+                "vs_baseline": None, "model": args.model, **result,
+            }), flush=True)
+            return 0 if result["failed"] == 0 else 1
 
         if args.cache_test:
             result = run_cache_test(port)
@@ -320,11 +676,49 @@ def main() -> int:
         warm = LoadGen(port, 20, 4)
         warm.run()
 
-        log(f"benchmark: {args.requests} requests, {args.threads} threads")
-        gen = LoadGen(port, args.requests, args.threads)
+        log(f"benchmark: {args.requests} requests, {args.threads} threads, "
+            f"{args.distinct} distinct inputs")
+        gen = LoadGen(port, args.requests, args.threads,
+                      distinct_inputs=args.distinct)
         result = gen.run()
         result.update(scrape_stats(port))
         log(json.dumps(result, indent=2))
+
+        # Miss-heavy companion load (VERDICT r1 "bench workload hides the
+        # engine"): same wire, every input distinct — no cache, every
+        # request batches onto the device.
+        miss = None
+        if args.distinct == 10 and not args.quick:
+            n_miss = max(1000, args.requests // 5)
+            log(f"miss-path load: {n_miss} distinct requests ...")
+            miss = LoadGen(port, n_miss, args.threads,
+                           distinct_inputs=n_miss).run()
+            miss = {
+                "throughput_req_s": miss["throughput_req_s"],
+                "p50_ms": miss["latency_ms"]["p50"],
+                "p99_ms": miss["latency_ms"]["p99"],
+                "success_rate": round(miss["success_rate"], 4),
+            }
+            log(json.dumps({"miss_path": miss}, indent=2))
+
+        # Free the chip before the in-process compute addendum.
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            proc = None
+
+        compute = decode = None
+        if not args.no_compute:
+            try:
+                compute = run_compute_bench()
+                log(json.dumps({"compute": compute}, indent=2))
+                decode = run_decode_compute()
+                log(json.dumps({"decode": decode}, indent=2))
+            except Exception as exc:
+                log(f"compute addendum failed: {exc}")
 
         line = {
             "metric": "serving_throughput",
@@ -334,12 +728,22 @@ def main() -> int:
             "model": args.model,
             "requests": args.requests,
             "threads": args.threads,
+            "distinct_inputs": args.distinct,
             "success_rate": round(result["success_rate"], 4),
             "p50_ms": result["latency_ms"]["p50"],
             "p99_ms": result["latency_ms"]["p99"],
             "cache_hit_rate": result.get("cache_hit_rate"),
             "avg_batch_size": result.get("avg_batch_size"),
         }
+        if miss is not None:
+            line["miss_path"] = miss
+        if compute is not None:
+            line["compute"] = {k: compute[k] for k in
+                               ("samples_per_s", "step_ms", "mfu",
+                                "achieved_tflops", "device_kind") if k in compute}
+        if decode is not None:
+            line["decode"] = {k: decode[k] for k in
+                              ("tokens_per_s", "decode_mfu") if k in decode}
         print(json.dumps(line), flush=True)
         return 0 if result["success_rate"] > 0.99 else 1
     finally:

@@ -106,3 +106,61 @@ def test_flash_in_transformer_forward():
             q, k, v, causal=causal, mask=mask, block_q=8, block_k=8))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_default_attention_gate(monkeypatch):
+    """TPU_ENGINE_FLASH selects the serving attention: auto→XLA on CPU,
+    1→flash, 0→XLA (on TPU, auto→flash — the serving default)."""
+    from tpu_engine.models.transformer import default_attention
+    from tpu_engine.ops.attention import dot_product_attention as xla_attn
+
+    monkeypatch.delenv("TPU_ENGINE_FLASH", raising=False)
+    assert default_attention() is xla_attn  # CPU backend under tests
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "1")
+    assert default_attention() is flash_attention
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "0")
+    assert default_attention() is xla_attn
+
+
+def test_serving_forward_flash_equals_xla(monkeypatch):
+    """The DEFAULT serving forward (no explicit attn_fn) under forced flash
+    matches the XLA path — i.e. flipping the gate never changes results."""
+    from tpu_engine.models.transformer import (
+        TransformerConfig, transformer_apply, transformer_init)
+
+    cfg = TransformerConfig(vocab=128, n_layers=2, d_model=32, n_heads=4,
+                            d_ff=64, max_seq=64, causal=True)
+    params = transformer_init(jax.random.PRNGKey(5), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 24), 0, 128)
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "0")
+    ref = transformer_apply(params, tokens, cfg, dtype=jnp.float32)
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "1")
+    out = transformer_apply(params, tokens, cfg, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_flash_equals_xla(monkeypatch):
+    """Prefill (the decode path's full-sequence pass) under forced flash
+    produces the same logits and KV cache as the XLA path."""
+    from tpu_engine.models.transformer import (
+        TransformerConfig, init_caches, transformer_init, transformer_prefill)
+
+    cfg = TransformerConfig(vocab=128, n_layers=2, d_model=32, n_heads=4,
+                            d_ff=64, max_seq=64, causal=True)
+    params = transformer_init(jax.random.PRNGKey(7), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 16), 1, 128)
+    attn_mask = jnp.ones((2, 16), jnp.int32)
+
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "0")
+    ref_logits, ref_caches = transformer_prefill(
+        params, tokens, init_caches(cfg, 2, 32, jnp.float32), cfg,
+        dtype=jnp.float32, attn_mask=attn_mask)
+    monkeypatch.setenv("TPU_ENGINE_FLASH", "1")
+    logits, caches = transformer_prefill(
+        params, tokens, init_caches(cfg, 2, 32, jnp.float32), cfg,
+        dtype=jnp.float32, attn_mask=attn_mask)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(caches.k), np.asarray(ref_caches.k),
+                               rtol=2e-4, atol=2e-4)

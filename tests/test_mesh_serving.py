@@ -1,0 +1,107 @@
+"""Mesh-sharded serving: one engine spanning the device mesh behind /infer.
+
+Round-1 VERDICT item 4: the north star (BASELINE.json) replaces the
+reference's gateway→worker HTTP fan-out with in-process ICI batch
+scatter/result gather. These tests build the launchable serving mode
+(serve --mesh data=8 / model=2,data=4) on the 8-virtual-device CPU mesh.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_engine.serving.app import _mesh_engine, parse_mesh_spec, serve_combined
+from tpu_engine.utils.config import WorkerConfig
+
+
+def test_parse_mesh_spec_axes():
+    mesh = parse_mesh_spec("data=8")
+    assert dict(mesh.shape) == {"data": 8}
+    mesh = parse_mesh_spec("model=2,data=4")
+    assert dict(mesh.shape) == {"model": 2, "data": 4}
+    # missing data axis is added (engine's scatter axis must exist)
+    mesh = parse_mesh_spec("model=8")
+    assert dict(mesh.shape) == {"model": 8, "data": 1}
+
+
+def test_mesh_engine_data_sharded_batch():
+    """Batch scatter over data=8: outputs equal the single-device engine's."""
+    from tpu_engine.runtime.engine import InferenceEngine
+
+    mesh = parse_mesh_spec("data=8")
+    cfg = WorkerConfig(model="mlp", dtype="float32", batch_buckets=(8, 16))
+    eng = _mesh_engine("mlp", cfg, mesh)
+    ref = InferenceEngine("mlp", params=eng.params, dtype="float32",
+                          batch_buckets=(8, 16))
+    inputs = [np.arange(8, dtype=np.float32) + i for i in range(11)]
+    got = eng.batch_predict(inputs)
+    want = ref.batch_predict(inputs)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=1e-5, rtol=1e-5)
+    assert eng.stats()["mesh"] == {"axes": {"data": 8}, "n_devices": 8}
+
+
+def test_mesh_engine_tensor_parallel_weights():
+    """model=2,data=4: TP-sharded params produce the same logits as the
+    replicated single-device engine (XLA inserts the TP collectives)."""
+    from tpu_engine.runtime.engine import InferenceEngine
+
+    mesh = parse_mesh_spec("model=2,data=4")
+    cfg = WorkerConfig(model="mlp", dtype="float32", batch_buckets=(4, 8))
+    eng = _mesh_engine("mlp", cfg, mesh)
+    # At least one kernel must actually be sharded over `model`.
+    shardings = {str(getattr(l, "sharding", None))
+                 for l in __import__("jax").tree_util.tree_leaves(eng.params)}
+    assert any("model" in s for s in shardings), shardings
+    ref = InferenceEngine("mlp", params=__import__("jax").device_put(
+        eng.params), dtype="float32", batch_buckets=(4, 8))
+    inputs = [np.full((8,), i, np.float32) for i in range(5)]
+    got = eng.batch_predict(inputs)
+    want = ref.batch_predict(inputs)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=1e-5, rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def mesh_stack():
+    cfg = WorkerConfig(model="mlp", dtype="float32", batch_buckets=(4, 8))
+    gateway, workers, server = serve_combined(
+        model="mlp", port=0, worker_config=cfg, mesh="model=2,data=4",
+        native_front=False)
+    yield gateway, workers, server
+    server.stop()
+    for w in workers:
+        w.stop()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+
+def test_mesh_serving_http_end_to_end(mesh_stack):
+    """POST /infer against the mesh-sharded lane: reference wire schema,
+    one engine spanning 8 virtual chips."""
+    _, workers, server = mesh_stack
+    resp = _post(server.port, "/infer",
+                 {"request_id": "req_1", "input_data": [1.0, 2.0, 3.0]})
+    assert set(resp) == {"request_id", "output_data", "node_id", "cached",
+                        "inference_time_us"}
+    assert resp["node_id"] == "worker_1"
+    assert np.isfinite(np.asarray(resp["output_data"])).all()
+    # Identical request → cache hit (mesh lane keeps the LRU semantics).
+    again = _post(server.port, "/infer",
+                  {"request_id": "req_2", "input_data": [1.0, 2.0, 3.0]})
+    assert again["cached"] is True
+    assert workers[0].engine.stats()["mesh"]["n_devices"] == 8
+
+
+def test_mesh_serving_health(mesh_stack):
+    _, _, server = mesh_stack
+    h = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/health", timeout=30).read())
+    assert h["healthy"] is True and h["total_requests"] >= 1

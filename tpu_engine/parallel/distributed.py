@@ -43,12 +43,18 @@ def initialize(coordinator_address: Optional[str] = None,
     summary dict {process_id, num_processes, local_devices, global_devices}.
     """
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if explicit and jax.process_count() == 1 and num_processes != 1:
-        jax.distributed.initialize(
-            coordinator_address=explicit,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    if explicit:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=explicit,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as exc:
+            # Idempotent: a second initialize() (same process) is a no-op
+            # rather than an error, so launch scripts can call it freely.
+            if "already" not in str(exc).lower():
+                raise
     return {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),
@@ -90,10 +96,15 @@ def hybrid_mesh(ici_shape: Sequence[int], axis_names: Sequence[str],
 
     from jax.experimental import mesh_utils
 
+    devs = list(devices if devices is not None else jax.devices())
+    # TPU pods expose slice_index (one slice per ICI domain); elsewhere
+    # (multi-process CPU/GPU) the granule that DCN crosses is the process.
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
     arr = mesh_utils.create_hybrid_device_mesh(
         tuple(int(i) for i in ici_shape),
         tuple(int(d) for d in dcn_shape),
-        devices=devices,
+        devices=devs,
+        process_is_granule=n_slices != n_proc,
     )
     return Mesh(arr, tuple(axis_names))
 

@@ -50,6 +50,7 @@ class InferenceEngine:
         shape_buckets: Optional[Sequence[Tuple[int, ...]]] = None,
         mesh=None,
         data_axis: str = "data",
+        param_shardings=None,
         device=None,
         model_kwargs: Optional[dict] = None,
     ):
@@ -79,8 +80,14 @@ class InferenceEngine:
         if mesh is not None and device is not None:
             raise ValueError("pass either mesh or device, not both")
         self.params = params if params is not None else model.init(jax.random.PRNGKey(rng_seed))
+        # With a mesh, params place per `param_shardings` — replicated by
+        # default, or tensor-parallel (training.shard_params_tp trees) so one
+        # model spans the `model` axis; XLA inserts the matmul collectives.
+        self._param_shardings = None
         if mesh is not None:
-            self.params = jax.device_put(self.params, replicated(mesh))
+            self._param_shardings = (param_shardings if param_shardings
+                                     is not None else replicated(mesh))
+            self.params = jax.device_put(self.params, self._param_shardings)
         elif device is not None:
             self.params = jax.device_put(self.params, device)
         self._executables: Dict[int, jax.stages.Compiled] = {}
@@ -140,7 +147,7 @@ class InferenceEngine:
             if self._mesh is not None:
                 jitted = jax.jit(
                     fn,
-                    in_shardings=(replicated(self._mesh),
+                    in_shardings=(self._param_shardings,
                                   data_sharding(self._mesh, self._data_axis, len(shape))),
                     out_shardings=data_sharding(self._mesh, self._data_axis,
                                                 1 + len(self.spec.output_shape)),

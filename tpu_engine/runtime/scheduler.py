@@ -114,11 +114,21 @@ class ContinuousGenerator:
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # Prefilled requests ready for row insertion: (req, row_k, row_v,
+        # first_tok, pb, L). The prefill thread fills this so admission work
+        # (prompt forward + first-token sample, with its host sync) never
+        # stalls in-flight rows' decode chunks (round-1 VERDICT: admission
+        # ran serially on the decode thread → head-of-line latency).
+        self._ready: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._exe_lock = threading.Lock()
         self._prefill_exe: Dict[int, object] = {}
+        self._insert_exe = None
         self._decode_exe = None
         self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
         self._running = True
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_loop, name="continuous-prefill", daemon=True)
+        self._prefill_thread.start()
         self._thread = threading.Thread(target=self._loop,
                                         name="continuous-decode", daemon=True)
         self._thread.start()

@@ -68,6 +68,52 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     return gateway, server
 
 
+def parse_mesh_spec(spec: str):
+    """'data=8' / 'model=2,data=4' → Mesh over the local devices. A missing
+    ``data`` axis is added with size 1 so the engine's batch-scatter axis
+    always exists."""
+    from tpu_engine.parallel.mesh import create_mesh
+
+    axes = []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes.append((name.strip(), int(size)))
+    if "data" not in (n for n, _ in axes):
+        axes.append(("data", 1))
+    return create_mesh(shape=tuple(s for _, s in axes),
+                       axis_names=tuple(n for n, _ in axes))
+
+
+def _mesh_engine(model: str, lane_cfg: WorkerConfig, mesh, params=None):
+    """One engine spanning the whole mesh: batches scatter over ``data``
+    (ICI, XLA collectives — the north-star's in-process replacement for the
+    reference's HTTP worker fan-out), weights shard over ``model`` when that
+    axis is >1 (answering the reference's dead ``shard_id`` stub,
+    worker_node.cpp:32)."""
+    from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.training.train import shard_params_tp
+
+    _ensure_builtin_models_imported()
+    import jax
+
+    spec = create_model(model)
+    if params is None:
+        params = spec.init(jax.random.PRNGKey(0))
+    shardings = None
+    if mesh.shape.get("model", 1) > 1:
+        shardings = shard_params_tp(params, mesh, axis="model")
+    return InferenceEngine(
+        spec,
+        params=params,
+        dtype=lane_cfg.dtype,
+        batch_buckets=lane_cfg.batch_buckets,
+        shape_buckets=lane_cfg.shape_buckets,
+        mesh=mesh,
+        param_shardings=shardings,
+    )
+
+
 def serve_combined(
     model: str = "resnet50",
     lanes: int = 0,
@@ -77,17 +123,23 @@ def serve_combined(
     background: bool = True,
     warmup: bool = False,
     native_front: Optional[bool] = None,
+    mesh=None,
 ):
     """One process: HTTP front door + in-process lanes over local devices.
 
     ``lanes=0`` means one lane per local device. Lanes share nothing but the
     host process: each has its own cache, batcher and engine pinned to a chip
     (round-robin when lanes > devices).
+
+    ``mesh`` (spec string like 'data=8' / 'model=2,data=4', or a
+    jax.sharding.Mesh) switches to mesh-sharded serving: ONE lane whose
+    engine spans all mesh devices — the dynamic batcher aggregates requests
+    and each batch is scattered over the ``data`` axis / computed against
+    ``model``-sharded weights in a single XLA dispatch.
     """
     import jax
 
     devices = jax.devices()
-    n_lanes = lanes or len(devices)
     gateway_config = gateway_config or GatewayConfig(port=port)
     # Real weights (HF/torch/orbax) are loaded once and shared by every lane
     # (each engine device_puts its own copy onto its chip).
@@ -97,20 +149,31 @@ def serve_combined(
 
         params = _load_model_path(model, worker_config.model_path)
     workers = []
-    for i in range(n_lanes):
+    if mesh is not None:
+        if isinstance(mesh, str):
+            mesh = parse_mesh_spec(mesh)
         cfg = worker_config or WorkerConfig()
-        lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": f"worker_{i+1}", "model": model})
-        from tpu_engine.runtime.engine import InferenceEngine
-
-        engine = InferenceEngine(
-            lane_cfg.model,
-            params=params,
-            dtype=lane_cfg.dtype,
-            batch_buckets=lane_cfg.batch_buckets,
-            shape_buckets=lane_cfg.shape_buckets,
-            device=devices[i % len(devices)],
-        )
+        lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": "worker_1",
+                                   "model": model})
+        engine = _mesh_engine(model, lane_cfg, mesh, params=params)
         workers.append(WorkerNode(lane_cfg, engine=engine))
+        n_lanes = 1
+    else:
+        n_lanes = lanes or len(devices)
+        for i in range(n_lanes):
+            cfg = worker_config or WorkerConfig()
+            lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": f"worker_{i+1}", "model": model})
+            from tpu_engine.runtime.engine import InferenceEngine
+
+            engine = InferenceEngine(
+                lane_cfg.model,
+                params=params,
+                dtype=lane_cfg.dtype,
+                batch_buckets=lane_cfg.batch_buckets,
+                shape_buckets=lane_cfg.shape_buckets,
+                device=devices[i % len(devices)],
+            )
+            workers.append(WorkerNode(lane_cfg, engine=engine))
     if warmup:
         # Pre-compile every batch bucket before accepting traffic — the
         # reference pays its graph compile at session load the same way
@@ -126,7 +189,35 @@ def serve_combined(
     # Lane health is addressable through the gateway process in combined mode.
     for w in workers:
         routes[("GET", f"/health/{w.node_id}")] = lambda _b, w=w: (200, w.get_health())
-    routes[("GET", "/health")] = lambda _b: (200, workers[0].get_health())
+
+    def _aggregate_health(_b):
+        """Whole-process /health: counters summed over lanes (so reference
+        tooling scraping one worker URL per process reports truthfully),
+        plus a per-lane breakdown. Field names stay reference-exact."""
+        lanes_h = [w.get_health() for w in workers]
+        total = sum(h["total_requests"] for h in lanes_h)
+        hits = sum(h["cache_hits"] for h in lanes_h)
+        bp_keys = ("total_batches", "timeout_batches", "full_batches")
+        bp = {k: sum(h["batch_processor"][k] for h in lanes_h) for k in bp_keys}
+        n_batches = bp["total_batches"]
+        bp["avg_batch_size"] = round(
+            sum(h["batch_processor"]["avg_batch_size"]
+                * h["batch_processor"]["total_batches"]
+                for h in lanes_h) / n_batches, 4) if n_batches else 0.0
+        agg_hit_rate = (sum(h["cache_hit_rate"] * h["total_requests"]
+                            for h in lanes_h) / total) if total else 0.0
+        return 200, {
+            "healthy": all(h["healthy"] for h in lanes_h),
+            "node_id": lanes_h[0]["node_id"] if len(lanes_h) == 1 else "combined",
+            "total_requests": total,
+            "cache_hits": hits,
+            "cache_size": sum(h["cache_size"] for h in lanes_h),
+            "cache_hit_rate": round(agg_hit_rate, 6),
+            "batch_processor": bp,
+            "lanes": {h["node_id"]: h for h in lanes_h},
+        }
+
+    routes[("GET", "/health")] = _aggregate_health
 
     # Fault injection (BASELINE config 5). The reference injects faults by
     # killing worker processes (README.md:322-349); in-process lanes expose
@@ -166,8 +257,9 @@ def serve_combined(
 
     server = _make_front_server(port, routes, workers, gateway, native_front)
     kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"
-    print(f"tpu_engine combined serving: {n_lanes} lanes over {len(devices)} "
-          f"device(s), port {port} ({kind})")
+    topo = (f"mesh {dict(mesh.shape)}" if mesh is not None
+            else f"{n_lanes} lanes over {len(devices)} device(s)")
+    print(f"tpu_engine combined serving: {topo}, port {port} ({kind})")
     if isinstance(server, JsonHttpServer):
         server.start(background=background)
     elif not background:

@@ -95,6 +95,10 @@ def main(argv=None) -> int:
                             help="HF/torch/orbax checkpoint with real weights "
                                  "(default: random init)")
         parser.add_argument("--lanes", type=int, default=0)
+        parser.add_argument("--mesh", default=None,
+                            help="mesh-sharded serving: one engine spanning "
+                                 "all chips, e.g. data=8 or model=2,data=4 "
+                                 "(batch scatter / TP weights over ICI)")
         parser.add_argument("--port", type=int, default=8000)
         parser.add_argument("--warmup", action="store_true",
                             help="pre-compile all batch buckets before listening")
@@ -105,9 +109,11 @@ def main(argv=None) -> int:
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
         parser.add_argument("--gen-scheduler", choices=["batch", "continuous"],
-                            default="batch",
-                            help="decode scheduling: batch-to-completion or "
-                                 "continuous (iteration-level admission)")
+                            default="continuous",
+                            help="decode scheduling: continuous "
+                                 "(iteration-level admission; 3.1x tokens/s "
+                                 "under Poisson arrivals) or "
+                                 "batch-to-completion")
         args = parser.parse_args(rest)
         gateway_config = None
         if args.breaker_timeout is not None:
@@ -115,21 +121,19 @@ def main(argv=None) -> int:
 
             gateway_config = GatewayConfig(port=args.port,
                                            breaker_timeout_s=args.breaker_timeout)
-        worker_config = None
-        if args.shape_buckets or args.gen_scheduler != "batch" or args.model_path:
-            from tpu_engine.utils.config import WorkerConfig
+        from tpu_engine.utils.config import WorkerConfig
 
-            buckets = None
-            if args.shape_buckets:
-                buckets = tuple(
-                    tuple(int(d) for d in s.split("x"))
-                    for s in args.shape_buckets.split(","))
-            worker_config = WorkerConfig(shape_buckets=buckets,
-                                         gen_scheduler=args.gen_scheduler,
-                                         model_path=args.model_path)
+        buckets = None
+        if args.shape_buckets:
+            buckets = tuple(
+                tuple(int(d) for d in s.split("x"))
+                for s in args.shape_buckets.split(","))
+        worker_config = WorkerConfig(shape_buckets=buckets,
+                                     gen_scheduler=args.gen_scheduler,
+                                     model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
                        warmup=args.warmup, worker_config=worker_config,
-                       gateway_config=gateway_config)
+                       gateway_config=gateway_config, mesh=args.mesh)
         _run_forever()
         return 0
 
