@@ -61,7 +61,9 @@ def test_oversized_sample_does_not_shift_neighbors(engine):
 def test_bucket_selection_and_compile_cache(engine):
     engine.batch_predict([[0.0]] * 3)  # needs bucket 4
     s = engine.stats()
-    assert 4 in s["compiled_buckets"]
+    # Executable keys are ("wire", wire_bucket, batch_bucket): payloads ship
+    # at wire width and pad to the input size on device.
+    assert any(k[-1] == 4 for k in s["compiled_buckets"])
     before = len(s["compiled_buckets"])
     engine.batch_predict([[0.0]] * 3)  # same bucket: no new compile
     assert len(engine.stats()["compiled_buckets"]) == before
@@ -80,7 +82,7 @@ def test_empty_batch(engine):
 
 def test_warmup_precompiles(engine):
     engine.warmup()
-    assert engine.stats()["compiled_buckets"] == [1, 2, 4, 8]
+    assert {k[-1] for k in engine.stats()["compiled_buckets"]} == {1, 2, 4, 8}
 
 
 def test_mesh_sharded_engine_matches_single_device():

@@ -167,6 +167,14 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, max(q.shape[1], 1))
-    block_k = min(block_k, max(k.shape[1], 1))
+    # Mosaic lane alignment: the kernel's k/v/mask loads use in-kernel
+    # `pl.ds(j * block_k, block_k)` along dims whose offsets must be
+    # statically provable multiples of the 128-lane tile. Never shrink
+    # block_k below one lane tile — short sequences instead pad k/v to 128
+    # inside `_flash_call` and the generated padding mask kills the extra
+    # columns. (Observed on-chip: block_k 16/32/64 → "Mosaic failed …
+    # cannot statically prove that index in dimension 2 is a multiple of
+    # 128" at every prompt bucket < 128.)
+    block_k = max(128, min(block_k, max(k.shape[1], 1)))
     return _flash_call(q, k, v, mask, causal=causal, block_q=block_q,
                        block_k=block_k, interpret=bool(interpret))

@@ -77,13 +77,31 @@ class BatchProcessor(Generic[Request, Response]):
         callback: Callable[[List[Request]], Sequence[Response]],
         linger_ms: float = 0.0,
         name: str = "batcher",
+        submit_callback: Optional[Callable[[List[Request]], Any]] = None,
+        collect_callback: Optional[Callable[[Any], Sequence[Response]]] = None,
+        ready_callback: Optional[Callable[[Any], bool]] = None,
+        pipeline_depth: int = 1,
     ):
+        """`submit_callback`/`collect_callback` (both or neither) enable
+        split-phase pipelining: the dispatch thread keeps up to
+        `pipeline_depth` submitted batches in flight and only blocks in
+        `collect_callback` for the oldest — new batches keep dispatching
+        while earlier ones execute. With a remote/async device whose
+        round-trip dwarfs its execute time (the TPU tunnel here), depth K
+        overlaps K round-trips; depth 1 or no split callbacks degrade to
+        the reference's strict batch-at-a-time loop."""
         if max_batch_size <= 0:
             raise ValueError("max_batch_size must be positive")
+        if (submit_callback is None) != (collect_callback is None):
+            raise ValueError("submit_callback and collect_callback go together")
         self._max_batch_size = int(max_batch_size)
         self._timeout_s = float(timeout_ms) / 1000.0
         self._linger_s = float(linger_ms) / 1000.0
         self._callback = callback
+        self._submit_cb = submit_callback
+        self._collect_cb = collect_callback
+        self._ready_cb = ready_callback
+        self._depth = max(1, int(pipeline_depth)) if submit_callback else 1
         self._name = name
         self._queue: List[Tuple[Request, Future]] = []
         self._lock = threading.Lock()
@@ -149,16 +167,24 @@ class BatchProcessor(Generic[Request, Response]):
     # -- dispatch loop -------------------------------------------------------
 
     def _processing_loop(self) -> None:
+        inflight: List[Tuple[List[Tuple[Request, Future]], Any, bool]] = []
         while True:
             with self._cv:
-                timed_out = not self._cv.wait_for(
-                    lambda: bool(self._queue) or not self._running,
-                    timeout=self._timeout_s,
-                )
+                if self._queue or inflight:
+                    # Work pending somewhere — don't sleep on the timer.
+                    timed_out = not bool(self._queue)
+                else:
+                    timed_out = not self._cv.wait_for(
+                        lambda: bool(self._queue) or not self._running,
+                        timeout=self._timeout_s,
+                    )
                 if not self._running:
-                    return
-                if self._linger_s > 0 and self._queue and len(self._queue) < self._max_batch_size:
-                    # Optional accumulation window for better MXU occupancy.
+                    break
+                if (self._linger_s > 0 and not inflight and self._queue
+                        and len(self._queue) < self._max_batch_size):
+                    # Optional accumulation window for better MXU occupancy
+                    # (skipped while pipelining — in-flight work already
+                    # absorbs the arrival jitter linger exists for).
                     deadline = time.monotonic() + self._linger_s
                     while len(self._queue) < self._max_batch_size:
                         remaining = deadline - time.monotonic()
@@ -167,27 +193,98 @@ class BatchProcessor(Generic[Request, Response]):
                             break
                         if not self._running:
                             return
-                batch = self._queue[: self._max_batch_size]
-                del self._queue[: len(batch)]
+                # While batches are in flight, hold back partial batches —
+                # the device is busy anyway, and the queue fills to a whole
+                # batch in the meantime (fewer, fuller round-trips). The
+                # hold is bounded: with spare pipeline slots we linger at
+                # most timeout_ms (the batcher's documented dispatch bound)
+                # then dispatch whatever queued; with the pipeline full the
+                # collect below blocks anyway. An idle pipeline dispatches
+                # partials immediately (latency path).
+                if (self._submit_cb is not None and inflight
+                        and 0 < len(self._queue) < self._max_batch_size):
+                    if len(inflight) >= self._depth:
+                        batch = []
+                    else:
+                        # Bounded linger, cut short the moment the oldest
+                        # in-flight batch completes — its callers must not
+                        # wait out the fill window for ready results.
+                        deadline = time.monotonic() + self._timeout_s
+                        timed_out = False
+                        while (self._running
+                               and len(self._queue) < self._max_batch_size):
+                            if (self._ready_cb is not None
+                                    and self._ready_cb(inflight[0][1])):
+                                break
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                timed_out = True
+                                break
+                            self._cv.wait(timeout=min(remaining, 0.002))
+                        if not self._running:
+                            break
+                        batch = self._queue[: self._max_batch_size]
+                        del self._queue[: len(batch)]
+                else:
+                    batch = self._queue[: self._max_batch_size]
+                    del self._queue[: len(batch)]
             if batch:
-                self._process_batch(batch, timed_out)
+                if self._submit_cb is None:
+                    self._process_batch(batch, timed_out)
+                    continue
+                handle = self._submit(batch)
+                if handle is not None:
+                    inflight.append((batch, handle, timed_out))
+            # Collect the oldest unless queued work can dispatch into spare
+            # pipeline slots (the bounded linger above decides whether it
+            # goes out partial or full). A completed oldest batch is always
+            # collected first — it resolves callers without blocking.
+            while inflight:
+                oldest_ready = (self._ready_cb is not None
+                                and self._ready_cb(inflight[0][1]))
+                with self._lock:
+                    qlen = len(self._queue)
+                if qlen > 0 and len(inflight) < self._depth and not oldest_ready:
+                    break
+                self._collect(*inflight.pop(0))
+        for entry in inflight:  # shutdown: drain what was already dispatched
+            self._collect(*entry)
+
+    def _submit(self, batch: List[Tuple[Request, Future]]):
+        try:
+            return self._submit_cb([r for r, _ in batch])
+        except Exception as exc:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return None
+
+    def _collect(self, batch: List[Tuple[Request, Future]], handle,
+                 is_timeout: bool) -> None:
+        self._fan_out(batch, lambda: self._collect_cb(handle), is_timeout)
 
     def _process_batch(
         self, batch: List[Tuple[Request, Future]], is_timeout: bool
     ) -> None:
-        requests = [r for r, _ in batch]
+        self._fan_out(batch, lambda: self._callback([r for r, _ in batch]),
+                      is_timeout)
+
+    def _fan_out(self, batch: List[Tuple[Request, Future]],
+                 produce: Callable[[], Sequence[Response]],
+                 is_timeout: bool) -> None:
+        """Resolve one batch's futures from `produce()`: one response per
+        request, too-few responses fail the extras (reference
+        ``batch_processor.h:148-155``), an exception fans out to every
+        caller (``:171-180``) and updates no metrics (``:157-169`` sit
+        inside the reference's try block)."""
         try:
-            responses = self._callback(requests)
+            responses = produce()
             for i, (_, fut) in enumerate(batch):
                 if i < len(responses):
                     fut.set_result(responses[i])
                 else:
-                    # Callback returned too few responses (reference fails the
-                    # extras, batch_processor.h:148-155).
                     fut.set_exception(RuntimeError("no response for batched request"))
-        except Exception as exc:  # fan the failure out to every caller (:171-180)
-            # No metrics update on the exception path (reference :157-169 are
-            # inside the try block).
+        except Exception as exc:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(exc)

@@ -95,6 +95,31 @@ class InferenceEngine:
         self._compile_times: Dict[int, float] = {}
         self._stats_lock = threading.Lock()
         self._execute_count = 0
+        # Wire buckets: the host→device payload is only as wide as the bytes
+        # the client actually sent, rounded up to one of these; the compiled
+        # graph zero-pads to the model's input size ON DEVICE. The reference
+        # pads on the host (inference_engine.cpp:151-160) — fine over PCIe,
+        # pathological over a narrow host↔TPU link (measured ~30 MB/s here:
+        # shipping a 3-float benchmark request as a padded 602 KB f32 row
+        # cost ~20 ms/sample of pure transfer; as a 128-lane bf16 row it is
+        # 256 bytes). Payloads also stage in the compute dtype when it is
+        # narrower than f32 — the first dense/conv casts anyway (ops/nn.py).
+        n_in = self.spec.input_size
+        wb, buckets_w = 128, []
+        while wb < n_in:
+            buckets_w.append(wb)
+            wb *= 8
+        buckets_w.append(n_in)
+        self._wire_buckets = tuple(buckets_w)
+        # Token-id models (transformer specs cast x to int32 in apply) must
+        # stage f32: bf16's 8-bit mantissa rounds ids > 256 to the wrong
+        # token. f32 is exact to 2^24 — far beyond any vocab.
+        from tpu_engine.models.transformer import TransformerConfig
+
+        int_input = isinstance(getattr(model, "config", None), TransformerConfig)
+        self._wire_np_dtype = (np.float32
+                               if self._dtype == jnp.float32 or int_input
+                               else self._dtype)
 
     # -- shape contract (reference inference_engine.cpp:211-217) -------------
 
@@ -132,8 +157,14 @@ class InferenceEngine:
                 return b
         return self._buckets[-1]
 
-    def _compiled(self, bucket: int, sample_shape: Optional[Tuple[int, ...]] = None):
-        key = bucket if sample_shape is None else (sample_shape, bucket)
+    def _compiled(self, bucket: int, sample_shape: Optional[Tuple[int, ...]] = None,
+                  wire: Optional[int] = None):
+        if wire is not None:
+            key = ("wire", wire, bucket)
+        elif sample_shape is not None:
+            key = (sample_shape, bucket)
+        else:
+            key = bucket
         exe = self._executables.get(key)
         if exe is not None:
             return exe
@@ -142,8 +173,23 @@ class InferenceEngine:
             if exe is not None:
                 return exe
             start = time.monotonic()
-            shape = (bucket,) + tuple(sample_shape or self.spec.input_shape)
-            fn = lambda params, x: self.spec.apply(params, x, dtype=self._dtype)  # noqa: E731
+            if wire is not None:
+                # Compact-payload variant: x arrives (bucket, wire) in the
+                # wire dtype; zero-pad to the flat input size and reshape to
+                # the model's shape inside the graph (device-side memset —
+                # free vs shipping zeros over the link).
+                shape = (bucket, wire)
+                n_in, in_shape = self.spec.input_size, tuple(self.spec.input_shape)
+
+                def fn(params, xw):
+                    x = xw
+                    if wire < n_in:
+                        x = jnp.pad(x, ((0, 0), (0, n_in - wire)))
+                    x = x.reshape((bucket,) + in_shape)
+                    return self.spec.apply(params, x, dtype=self._dtype)
+            else:
+                shape = (bucket,) + tuple(sample_shape or self.spec.input_shape)
+                fn = lambda params, x: self.spec.apply(params, x, dtype=self._dtype)  # noqa: E731
             if self._mesh is not None:
                 jitted = jax.jit(
                     fn,
@@ -154,12 +200,13 @@ class InferenceEngine:
                 )
             else:
                 jitted = jax.jit(fn)
-            x0 = jnp.zeros(shape, jnp.float32)
+            x0 = jnp.zeros(shape, self._wire_np_dtype if wire is not None
+                           else jnp.float32)
             if self._mesh is not None:
                 x0 = jax.device_put(x0, data_sharding(self._mesh, self._data_axis, len(shape)))
             elif self._device is not None:
                 # Lower against the pinned chip so the AOT executable's
-                # placement matches what _stage_batch will feed it.
+                # placement matches what _stage_wire will feed it.
                 x0 = jax.device_put(x0, self._device)
             exe = jitted.lower(self.params, x0).compile()
             self._executables[key] = exe
@@ -170,10 +217,14 @@ class InferenceEngine:
                shapes: Optional[Sequence[Tuple[int, ...]]] = None) -> None:
         """Pre-compile executables (the reference pays graph compile at
         session load, ``inference_engine.cpp:31``; we pay per bucket here).
+        Each batch bucket warms the narrowest and widest wire variants (tiny
+        benchmark-style payloads and full-size inputs respectively).
         `shapes=None` warms every shape bucket at the largest batch bucket
         (what a loaded batcher produces); pass () to skip shape warmup."""
+        wire_ends = {self._wire_buckets[0], self._wire_buckets[-1]}
         for b in buckets or self._buckets:
-            self._compiled(self._bucket_for(b))
+            for w in wire_ends:
+                self._compiled(self._bucket_for(b), wire=w)
         if shapes is None:
             shapes = self._shape_buckets or ()
         default = tuple(self.spec.input_shape)
@@ -184,26 +235,29 @@ class InferenceEngine:
     # -- input staging ---------------------------------------------------------
 
     def _coerce_sample(self, vec) -> np.ndarray:
-        """Flatten + resize to the model's input size (pad with zeros or
-        truncate — both directions, reference predict semantics :100-103)."""
+        """Flatten + truncate to the model's input size (reference predict
+        truncates oversize, :100-103; the zero-pad half of its resize happens
+        on device in the wire-variant graph)."""
         arr = np.asarray(vec, dtype=np.float32).ravel()
         n = self.spec.input_size
-        if arr.size < n:
-            arr = np.pad(arr, (0, n - arr.size))
-        elif arr.size > n:
-            arr = arr[:n]
-        return arr
+        return arr[:n] if arr.size > n else arr
 
-    def _stage_batch(self, samples: List[np.ndarray], bucket: int) -> jnp.ndarray:
-        buf = np.zeros((bucket, self.spec.input_size), dtype=np.float32)
+    def _wire_bucket_for(self, n: int) -> int:
+        for b in self._wire_buckets:
+            if b >= n:
+                return b
+        return self._wire_buckets[-1]
+
+    def _stage_wire(self, samples: List[np.ndarray], bucket: int,
+                    wire: int) -> jnp.ndarray:
+        buf = np.zeros((bucket, wire), dtype=self._wire_np_dtype)
         for i, s in enumerate(samples):
-            buf[i] = s
-        x = buf.reshape((bucket,) + tuple(self.spec.input_shape))
+            buf[i, :s.size] = s
         if self._mesh is not None:
-            return jax.device_put(x, data_sharding(self._mesh, self._data_axis, x.ndim))
+            return jax.device_put(buf, data_sharding(self._mesh, self._data_axis, 2))
         if self._device is not None:
-            return jax.device_put(x, self._device)
-        return jnp.asarray(x)
+            return jax.device_put(buf, self._device)
+        return jnp.asarray(buf)
 
     def _shape_bucket_for(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
         """Smallest bucket that fits every dim; else the largest (cropped)."""
@@ -246,35 +300,77 @@ class InferenceEngine:
         samples group by shape bucket and each group runs its own compiled
         executable. Entries may be None (use the model's default shape).
         """
+        return self.batch_collect(self.batch_submit(inputs, shapes=shapes))
+
+    def batch_submit(self, inputs: Sequence, shapes: Optional[Sequence] = None):
+        """Dispatch phase only: stage + enqueue the device work and return an
+        opaque handle without waiting. With several handles in flight the
+        host↔device link round-trips overlap — the serving batcher runs the
+        miss path as a K-deep pipeline instead of transfer→execute→readback
+        lockstep (the reference's mutex-serialized ``Session::Run``,
+        ``inference_engine.h:37``, forces exactly that lockstep)."""
         if not inputs:
-            return []
+            return ("flat", 0, [])
         if self._shape_buckets is not None and shapes is not None and any(
                 s is not None for s in shapes):
-            return self._batch_predict_shaped(inputs, shapes)
+            return self._batch_submit_shaped(inputs, shapes)
         samples = [self._coerce_sample(v) for v in inputs]
         max_bucket = self._buckets[-1]
-        # Two phases: dispatch every chunk first (JAX dispatch is async, so
-        # chunk N+1's compute overlaps chunk N's device→host copy), then
-        # materialize.
         pending: List[Tuple[int, object]] = []
         for chunk_start in range(0, len(samples), max_bucket):
             chunk = samples[chunk_start:chunk_start + max_bucket]
             bucket = self._bucket_for(len(chunk))
-            exe = self._compiled(bucket)
-            x = self._stage_batch(chunk, bucket)
-            pending.append((len(chunk), exe(self.params, x)))
+            wire = self._wire_bucket_for(max(s.size for s in chunk))
+            exe = self._compiled(bucket, wire=wire)
+            x = self._stage_wire(chunk, bucket, wire)
+            y = exe(self.params, x)
+            self._start_host_copy(y)
+            pending.append((len(chunk), y))
             with self._stats_lock:
                 self._execute_count += 1
-        out: List[np.ndarray] = []
+        return ("flat", len(inputs), pending)
+
+    @staticmethod
+    def _start_host_copy(y) -> None:
+        """Kick off the device→host copy at dispatch time so `batch_collect`
+        blocks only on data not yet arrived — on a high-latency link the
+        copy rides out concurrently with later batches' work instead of
+        serializing a full round-trip per batch (measured here: 70 ms
+        blocking np.asarray vs <1 ms after an async copy completes)."""
+        try:
+            y.copy_to_host_async()
+        except AttributeError:
+            pass
+
+    def handle_ready(self, handle) -> bool:
+        """True when every device value behind a `batch_submit` handle has
+        finished (non-blocking) — lets the batcher collect completed work
+        promptly instead of lingering for a fuller batch first."""
+        try:
+            return all(y.is_ready() for _, y in handle[2])
+        except AttributeError:
+            return True
+
+    def batch_collect(self, handle) -> List[np.ndarray]:
+        """Materialize phase: block on the handle's device values and split
+        them per request (reference output split, ``:195-206``)."""
+        kind, n, pending = handle
+        if kind == "shaped":
+            out: List[np.ndarray] = [None] * n  # type: ignore
+            for chunk, y in pending:
+                y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
+                for row, i in enumerate(chunk):
+                    out[i] = y_host[row]
+            return out
+        out = []
         for n_real, y in pending:
             y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
             out.extend(y_host[i] for i in range(n_real))
         return out
 
-    def _batch_predict_shaped(self, inputs: Sequence,
-                              shapes: Sequence) -> List[np.ndarray]:
-        """Mixed-shape path: group by shape bucket, dispatch every group's
-        chunks (async), then materialize in request order."""
+    def _batch_submit_shaped(self, inputs: Sequence, shapes: Sequence):
+        """Mixed-shape dispatch: group by shape bucket, dispatch every
+        group's chunks (async); `batch_collect` restores request order."""
         default = tuple(self.spec.input_shape)
         groups: Dict[Tuple[int, ...], List[int]] = {}
         canvases: List[np.ndarray] = [None] * len(inputs)  # type: ignore
@@ -301,15 +397,12 @@ class InferenceEngine:
                     x = jax.device_put(buf, self._device)
                 else:
                     x = jnp.asarray(buf)
-                pending.append((chunk, exe(self.params, x)))
+                y = exe(self.params, x)
+                self._start_host_copy(y)
+                pending.append((chunk, y))
                 with self._stats_lock:
                     self._execute_count += 1
-        out: List[np.ndarray] = [None] * len(inputs)  # type: ignore
-        for chunk, y in pending:
-            y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
-            for row, i in enumerate(chunk):
-                out[i] = y_host[row]
-        return out
+        return ("shaped", len(inputs), pending)
 
     # -- observability ---------------------------------------------------------
 

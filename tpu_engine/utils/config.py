@@ -31,12 +31,15 @@ class WorkerConfig:
     # engine compiles executables for; requests carry "shape": [h, w, c].
     shape_buckets: Optional[Tuple[Tuple[int, ...], ...]] = None
     fake_cached_latency_us: int = 50    # reference worker_node.cpp:65
+    # Miss-path pipeline: number of dispatched batches in flight before the
+    # batcher blocks collecting the oldest (engine.batch_submit/collect).
+    # >1 overlaps host↔device round-trips; 1 = reference-style lockstep.
+    pipeline_depth: int = 4
     gen_max_batch_size: int = 8         # decode-lane batcher (transformers)
     # "batch": collect a batch, decode it to completion (generator.py).
     # "continuous": iteration-level scheduling — requests join/leave the
     # running decode batch between chunks (scheduler.py). Continuous is the
-    # default: 3.1x tokens/s and 3.4x lower p50 latency under Poisson
-    # arrivals (bench.py --scenario decode-ab, recorded round 2).
+    # default (measured A/B: bench.py --scenario decode-ab, BENCH_r04).
     gen_scheduler: str = "continuous"
 
     @classmethod
