@@ -336,20 +336,28 @@ def run_generate_bench(port: int, n_requests: int = 16, max_new: int = 32,
 
 def run_compute_bench(model: str = "resnet50", batch: int = 32,
                       iters: int = 30, dtype: str = "bfloat16") -> dict:
-    """Device-compute benchmark (VERDICT r1 item 2): sustained MISS-path
-    throughput — every input distinct, batch-`batch` executables saturated —
-    with MFU computed from XLA's own cost analysis of the compiled
-    executable against the chip's peak bf16 FLOP/s."""
+    """Device-compute benchmark with honest attribution (VERDICT r3 item 4).
+
+    Two timed loops:
+    - **device loop**: inputs pre-staged on device, outputs not read until
+      the end (one forced scalar materialization — `block_until_ready` is
+      unreliable through the axon tunnel). Per-iter time = executable +
+      per-dispatch stream overhead; `mfu` is computed from THIS number and
+      XLA's own cost analysis, so it reflects the device, not the host.
+    - **e2e loop**: full `batch_predict` path with pre-generated distinct
+      host inputs (RNG hoisted out of the loop) — staging + transfer +
+      readback included; reported separately as `e2e_step_ms` /
+      `host_overhead_ms`, never folded into MFU."""
     import numpy as np
 
     from tpu_engine.runtime.engine import InferenceEngine
 
     eng = InferenceEngine(model, dtype=dtype, batch_buckets=(batch,))
+    wire = eng._wire_buckets[-1]  # full-width: the honest worst-case feed
     t0 = time.perf_counter()
-    eng.warmup()
+    exe = eng._compiled(batch, wire=wire)
     compile_s = time.perf_counter() - t0
 
-    exe = eng._compiled(batch)
     flops_per_exec = None
     try:
         ca = exe.cost_analysis()
@@ -360,27 +368,45 @@ def run_compute_bench(model: str = "resnet50", batch: int = 32,
 
     rng = np.random.default_rng(0)
     n_in = eng.input_size
+    host_batches = [
+        [rng.standard_normal(n_in).astype(np.float32) for _ in range(batch)]
+        for _ in range(iters)
+    ]
 
-    def batch_inputs():
-        # Distinct every time — nothing cacheable anywhere.
-        return [rng.standard_normal(n_in).astype(np.float32)
-                for _ in range(batch)]
+    # -- device loop: a few distinct pre-staged buffers, round-robin -------
+    import jax
 
-    eng.batch_predict(batch_inputs())  # one warm pass through the full path
+    staged = [eng._stage_wire(host_batches[k % iters][:batch], batch, wire)
+              for k in range(min(4, iters))]
+    y = exe(eng.params, staged[0])
+    _ = np.asarray(jax.tree_util.tree_leaves(y)[0])[:1]  # hard sync (warm)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        eng.batch_predict(batch_inputs())
-    wall = time.perf_counter() - t0
+    for k in range(iters):
+        y = exe(eng.params, staged[k % len(staged)])
+    _ = np.asarray(jax.tree_util.tree_leaves(y)[0]).ravel()[:1]  # hard sync
+    device_wall = time.perf_counter() - t0
+    device_step_ms = device_wall / iters * 1e3
+
+    # -- e2e loop: full miss path, distinct inputs, RNG pre-hoisted --------
+    eng.batch_predict(host_batches[0])  # warm the e2e path
+    t0 = time.perf_counter()
+    for hb in host_batches:
+        eng.batch_predict(hb)
+    e2e_wall = time.perf_counter() - t0
+    e2e_step_ms = e2e_wall / iters * 1e3
 
     kind, peak = chip_peak_flops()
-    samples_s = batch * iters / wall
-    achieved = flops_per_exec * iters / wall if flops_per_exec else None
+    achieved = (flops_per_exec / (device_step_ms / 1e3)
+                if flops_per_exec else None)
     return {
         "model": model,
         "batch": batch,
         "iters": iters,
-        "samples_per_s": round(samples_s, 2),
-        "step_ms": round(wall / iters * 1e3, 3),
+        "device_step_ms": round(device_step_ms, 3),
+        "e2e_step_ms": round(e2e_step_ms, 3),
+        "host_overhead_ms": round(e2e_step_ms - device_step_ms, 3),
+        "samples_per_s": round(batch / (e2e_step_ms / 1e3), 2),
+        "device_samples_per_s": round(batch / (device_step_ms / 1e3), 2),
         "compile_s": round(compile_s, 2),
         "flops_per_batch": flops_per_exec,
         "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
@@ -739,7 +765,9 @@ def main() -> int:
             line["miss_path"] = miss
         if compute is not None:
             line["compute"] = {k: compute[k] for k in
-                               ("samples_per_s", "step_ms", "mfu",
+                               ("samples_per_s", "device_samples_per_s",
+                                "device_step_ms", "e2e_step_ms",
+                                "host_overhead_ms", "mfu",
                                 "achieved_tflops", "device_kind") if k in compute}
         if decode is not None:
             line["decode"] = {k: decode[k] for k in

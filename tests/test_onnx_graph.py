@@ -1,0 +1,154 @@
+"""Generic ONNX graph execution: a non-registry ResNet-class .onnx serves
+end-to-end with logits matching a torch eager golden (VERDICT r3 missing
+item 1; reference behavior ``/root/reference/src/inference_engine.cpp:31-87``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from tests import onnx_writer as ow
+from tpu_engine.models.onnx_graph import build_onnx_model, parse_onnx
+
+torch.manual_seed(0)
+
+
+class TorchGolden(nn.Module):
+    """Small residual CNN — the op profile of the reference's benchmark
+    model (Conv/BN/Relu/MaxPool/Add/GlobalAveragePool/Flatten/Gemm)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, stride=2, padding=1)
+        self.bn1 = nn.BatchNorm2d(8)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.conv2 = nn.Conv2d(8, 8, 3, padding=1)
+        self.bn2 = nn.BatchNorm2d(8)
+        self.fc = nn.Linear(8, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.pool(x)
+        y = torch.relu(self.bn2(self.conv2(x)))
+        x = x + y                                   # residual Add
+        x = torch.nn.functional.adaptive_avg_pool2d(x, 1).flatten(1)
+        return torch.softmax(self.fc(x), dim=-1)
+
+
+def _export_onnx(m: TorchGolden, path: str) -> None:
+    """Serialize the torch module's graph by hand (no `onnx` package in
+    this environment — see tests/onnx_writer.py)."""
+    sd = {k: v.detach().numpy() for k, v in m.state_dict().items()}
+    inits = {
+        "w1": sd["conv1.weight"], "b1": sd["conv1.bias"],
+        "g1": sd["bn1.weight"], "be1": sd["bn1.bias"],
+        "m1": sd["bn1.running_mean"], "v1": sd["bn1.running_var"],
+        "w2": sd["conv2.weight"], "b2": sd["conv2.bias"],
+        "g2": sd["bn2.weight"], "be2": sd["bn2.bias"],
+        "m2": sd["bn2.running_mean"], "v2": sd["bn2.running_var"],
+        "fw": sd["fc.weight"], "fb": sd["fc.bias"],
+    }
+    nodes = [
+        ow.node("Conv", ["input", "w1", "b1"], ["c1"],
+                [ow.attr_ints("strides", [2, 2]),
+                 ow.attr_ints("pads", [1, 1, 1, 1])]),
+        ow.node("BatchNormalization", ["c1", "g1", "be1", "m1", "v1"],
+                ["n1"], [ow.attr_float("epsilon", 1e-5)]),
+        ow.node("Relu", ["n1"], ["r1"]),
+        ow.node("MaxPool", ["r1"], ["p1"],
+                [ow.attr_ints("kernel_shape", [2, 2]),
+                 ow.attr_ints("strides", [2, 2])]),
+        ow.node("Conv", ["p1", "w2", "b2"], ["c2"],
+                [ow.attr_ints("pads", [1, 1, 1, 1])]),
+        ow.node("BatchNormalization", ["c2", "g2", "be2", "m2", "v2"],
+                ["n2"], [ow.attr_float("epsilon", 1e-5)]),
+        ow.node("Relu", ["n2"], ["r2"]),
+        ow.node("Add", ["p1", "r2"], ["sum"]),
+        ow.node("GlobalAveragePool", ["sum"], ["gap"]),
+        ow.node("Flatten", ["gap"], ["flat"], [ow.attr_int("axis", 1)]),
+        ow.node("Gemm", ["flat", "fw", "fb"], ["logits"],
+                [ow.attr_int("transB", 1)]),
+        ow.node("Softmax", ["logits"], ["output"], [ow.attr_int("axis", -1)]),
+    ]
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", 3, 32, 32]),
+                    ow.value_info("output", ["N", 10]))
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+@pytest.fixture(scope="module")
+def onnx_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("onnx") / "resnet_tiny.onnx")
+    m = TorchGolden().eval()
+    _export_onnx(m, path)
+    x = np.random.default_rng(3).standard_normal((4, 3, 32, 32),
+                                                 ).astype(np.float32)
+    with torch.no_grad():
+        golden = m(torch.from_numpy(x)).numpy()
+    return path, x, golden
+
+
+def test_parse_introspects_shapes(onnx_file):
+    path, _, _ = onnx_file
+    g = parse_onnx(path)
+    assert g.input_shape == (0, 3, 32, 32)  # dynamic batch like reference
+    assert g.input_name == "input" and g.output_name == "output"
+    assert len(g.nodes) == 12
+
+
+def test_graph_matches_torch_golden(onnx_file):
+    path, x, golden = onnx_file
+    spec, params = build_onnx_model(path)
+    assert spec.input_shape == (3, 32, 32)
+    assert spec.output_shape == (10,)
+    out = np.asarray(spec.apply(params, x))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_reshape_from_initializer_and_negative_flatten(tmp_path):
+    """Reshape's target shape usually arrives as an int64 initializer in
+    real exports — it must resolve statically (not as a traced param) and a
+    negative Flatten axis follows the ONNX r+axis rule."""
+    import jax
+
+    nodes = [
+        ow.node("Reshape", ["input", "shape"], ["r"]),
+        ow.node("Flatten", ["r"], ["output"], [ow.attr_int("axis", -1)]),
+    ]
+    blob = ow.model(nodes, {"shape": np.asarray([-1, 2, 2], np.int64)},
+                    ow.value_info("input", ["N", 4]),
+                    ow.value_info("output", ["N", 4]))
+    path = str(tmp_path / "reshape.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    spec, params = build_onnx_model(path)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out = np.asarray(jax.jit(lambda p, v: spec.apply(p, v))(params, x))
+    # (2,4) -> (2,2,2) -> Flatten axis=-1 (= r+axis = 2) -> (4, 2)
+    np.testing.assert_array_equal(out, x.reshape(2, 2, 2).reshape(4, 2))
+
+
+def test_worker_serves_onnx_end_to_end(onnx_file):
+    """`worker_node <port> <id> model.onnx` semantics: the worker builds its
+    engine from the file and /infer returns the golden logits."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    path, x, golden = onnx_file
+    w = WorkerNode(WorkerConfig(model="onnx", model_path=path,
+                                dtype="float32", batch_buckets=(1, 2, 4)))
+    try:
+        resp = w.handle_infer({"request_id": "onnx_1",
+                               "input_data": x[0].ravel().tolist()})
+        np.testing.assert_allclose(np.asarray(resp["output_data"]),
+                                   golden[0], rtol=1e-4, atol=1e-5)
+        assert resp["cached"] is False
+        # Short input pads on device (reference predict :100-103 semantics).
+        short = w.handle_infer({"request_id": "onnx_2", "input_data": [1.0]})
+        assert len(short["output_data"]) == 10
+    finally:
+        w.batch_processor.stop()

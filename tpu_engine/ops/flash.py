@@ -5,13 +5,16 @@ The reference's compute hot loop is an opaque ONNX `Session::Run`
 kernels at all. Here the attention core — where transformer serving spends
 its FLOPs and HBM bandwidth — is a hand-tiled Pallas kernel:
 
-- Grid: (batch·heads, Sq/BLOCK_Q). Each program owns one query block in
-  VMEM and streams key/value blocks through the MXU with flash-style
-  online-softmax accumulation (f32 running max / denominator), so the
-  (S, S) score matrix never hits HBM — memory is O(S·D) instead of O(S²).
-- Causal programs stop their key loop at the diagonal block
-  (`lax.fori_loop` with a computed upper bound) — ~2× fewer MXU ops than
-  masking a full sweep.
+- Grid: (batch·heads, Sq/BLOCK_Q, Sk/BLOCK_K). The key axis is a
+  *sequential* ("arbitrary") grid dimension: each step streams one
+  (BLOCK_K, D) key/value tile through VMEM and folds it into the running
+  flash accumulators (f32 max / denominator / weighted sum) held in VMEM
+  scratch — the (S, S) score matrix never exists and VMEM holds O(BLOCK·D)
+  regardless of sequence length. (The previous design staged the whole
+  (S, D) K/V per program: ~16 MB VMEM capped it at S≈8k; streaming removes
+  the cap — S=16k+ compiles and runs on one chip.)
+- Causal programs skip key blocks strictly above the diagonal with
+  `pl.when` — ~2× fewer MXU ops than masking a full sweep.
 - Matmuls run on the MXU in the input dtype with f32 accumulation
   (`preferred_element_type`); masks/softmax arithmetic in f32 on the VPU.
 
@@ -30,67 +33,74 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *,
-                  block_q: int, block_k: int, seq_k: int, scale: float,
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *,
+                  block_q: int, block_k: int, scale: float,
                   causal: bool, has_mask: bool):
-    """One (head, q-block) program. Block shapes (leading 1 = head slot):
-    q_ref (1, block_q, D); k_ref/v_ref (1, seq_k, D); mask_ref (1, 1, seq_k)
-    — the singleton middle axis satisfies Mosaic's block-tiling rule (last
-    two block dims must divide (8, 128) or equal the array dims);
-    o_ref (1, block_q, D)."""
+    """One (head, q-block, k-block) grid step. Block shapes (leading 1 =
+    head slot): q_ref/o_ref (1, block_q, D); k_ref/v_ref (1, block_k, D);
+    mask_ref (1, 1, block_k) — the singleton middle axis satisfies Mosaic's
+    block-tiling rule. Scratch (m/l: (block_q,), acc: (block_q, D), all
+    f32) carries the online softmax across the sequential k axis."""
     iq = pl.program_id(1)
-    q = q_ref[0]  # (block_q, D) — stays in the MXU dtype (bf16 on TPU)
-    d = q.shape[-1]
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
 
-    qpos = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    def fold_block():
+        q = q_ref[0]  # (block_q, D) — stays in the MXU dtype (bf16 on TPU)
+        k = k_ref[0]
+        v = v_ref[0]
         # Both dots run on the MXU in the input dtype, accumulating f32.
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-        kpos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
         if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
         if has_mask:
-            mb = mask_ref[0, 0, pl.ds(j * block_k, block_k)]
+            mb = mask_ref[0, 0, :]
             s = jnp.where(mb[None, :] > 0, s, _NEG_INF)
 
+        m = m_sc[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
         p = jnp.exp(s - safe_m[:, None])
         corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - safe_m))
-        l = l * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=-1)
+        acc_sc[...] = acc_sc[...] * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        m_sc[...] = m_new
 
     if causal:
         # Key blocks strictly past this q block's last row are all masked —
-        # stop the sweep at the diagonal.
-        n_blocks = jax.lax.div((iq + 1) * block_q + block_k - 1, block_k)
-        n_blocks = jnp.minimum(n_blocks, seq_k // block_k)
+        # skip their MXU work entirely.
+        @pl.when(j * block_k < (iq + 1) * block_q)
+        def _masked_sweep():
+            fold_block()
     else:
-        n_blocks = seq_k // block_k
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+        fold_block()
 
-    out = acc / jnp.where(l == 0.0, 1.0, l)[:, None]
-    o_ref[0] = out.astype(o_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _pad_to(x, axis: int, size: int):
@@ -132,19 +142,27 @@ def _flash_call(q, k, v, mask, *, causal: bool, block_q: int, block_k: int,
     qh, kh, vh = to_heads(q, sq_p), to_heads(k, sk_p), to_heads(v, sk_p)
 
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, seq_k=sk_p,
+        _flash_kernel, block_q=block_q, block_k=block_k,
         scale=scale, causal=causal, has_mask=has_mask)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq_p // block_q),
+        grid=(b * h, sq_p // block_q, sk_p // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, sk_p, d), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((1, sk_p, d), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sk_p), lambda bh, iq, h=h: (bh // h, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, j: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, iq, j, h=h: (bh // h, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, j: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), v.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qh, kh, vh, mask)
 
@@ -160,21 +178,22 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     q: (B, Sq, H, D); k, v: (B, Sk, H, D); mask: optional (B, Sk) 1=valid.
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere.
 
-    Default 512/512 blocks measured fastest on v5e (B4 S2048 H16 D64 bf16:
-    0.83 ms/iter vs 1.12 ms for the XLA-fused reference path — 26% faster;
-    128/128 is 3.4 ms — small blocks starve the MXU).
+    Measured on-chip (v5lite-1, causal bf16, amortized forced-sync timing,
+    this round): parity with the XLA-fused path at S≤2048 (e.g. B4 S2048
+    H16 D64: 32.5 vs 33.5 ms), 1.18× faster at B1 S4096, and it keeps
+    scaling where XLA cannot compile at all — the fused XLA path OOMs at
+    S8192 (44 GB of S² score temps vs 15.75 GB HBM) while this kernel runs
+    it in 219 ms/iter with O(S·D) memory.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, max(q.shape[1], 1))
-    # Mosaic lane alignment: the kernel's k/v/mask loads use in-kernel
-    # `pl.ds(j * block_k, block_k)` along dims whose offsets must be
-    # statically provable multiples of the 128-lane tile. Never shrink
-    # block_k below one lane tile — short sequences instead pad k/v to 128
-    # inside `_flash_call` and the generated padding mask kills the extra
-    # columns. (Observed on-chip: block_k 16/32/64 → "Mosaic failed …
-    # cannot statically prove that index in dimension 2 is a multiple of
-    # 128" at every prompt bucket < 128.)
+    # Mosaic lane alignment: k/v/mask tiles sit on the 128-lane axis, so
+    # never shrink block_k below one lane tile — short sequences instead
+    # pad k/v to 128 inside `_flash_call` and the generated padding mask
+    # kills the extra columns. (Observed on-chip: block_k 16/32/64 →
+    # "Mosaic failed … cannot statically prove that index in dimension 2
+    # is a multiple of 128" at every prompt bucket < 128.)
     block_k = max(128, min(block_k, max(k.shape[1], 1)))
     return _flash_call(q, k, v, mask, causal=causal, block_q=block_q,
                        block_k=block_k, interpret=bool(interpret))

@@ -100,7 +100,19 @@ class BatchProcessor(Generic[Request, Response]):
         self._callback = callback
         self._submit_cb = submit_callback
         self._collect_cb = collect_callback
-        self._ready_cb = ready_callback
+        # Guarded: a readiness probe that raises (e.g. on an errored device
+        # buffer) must degrade to "not ready" — the real error surfaces in
+        # collect — never unwind the dispatch thread (which would hang every
+        # caller forever with _running still True).
+        if ready_callback is None:
+            self._ready_cb = None
+        else:
+            def _safe_ready(handle, _cb=ready_callback):
+                try:
+                    return bool(_cb(handle))
+                except Exception:
+                    return False
+            self._ready_cb = _safe_ready
         self._depth = max(1, int(pipeline_depth)) if submit_callback else 1
         self._name = name
         self._queue: List[Tuple[Request, Future]] = []

@@ -218,13 +218,18 @@ class InferenceEngine:
         """Pre-compile executables (the reference pays graph compile at
         session load, ``inference_engine.cpp:31``; we pay per bucket here).
         Each batch bucket warms the narrowest and widest wire variants (tiny
-        benchmark-style payloads and full-size inputs respectively).
-        `shapes=None` warms every shape bucket at the largest batch bucket
-        (what a loaded batcher produces); pass () to skip shape warmup."""
+        benchmark-style payloads and full-size inputs respectively); the
+        largest batch bucket — what a loaded batcher produces — additionally
+        warms every interior wire bucket so no mid-size payload pays an
+        inline compile on the serving path.
+        `shapes=None` warms every shape bucket at the largest batch bucket;
+        pass () to skip shape warmup."""
         wire_ends = {self._wire_buckets[0], self._wire_buckets[-1]}
         for b in buckets or self._buckets:
             for w in wire_ends:
                 self._compiled(self._bucket_for(b), wire=w)
+        for w in self._wire_buckets:
+            self._compiled(self._buckets[-1], wire=w)
         if shapes is None:
             shapes = self._shape_buckets or ()
         default = tuple(self.spec.input_shape)

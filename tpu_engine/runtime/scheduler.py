@@ -275,12 +275,25 @@ class ContinuousGenerator:
                 continue
             # Bounded put with a running check: if the decode loop already
             # exited, don't block forever on a full queue.
+            placed = False
             while self._running:
                 try:
                     self._ready.put(item, timeout=0.1)
+                    placed = True
                     break
                 except queue.Full:
                     continue
+            if not placed and not req.future.done():
+                req.future.set_exception(RuntimeError("scheduler stopped"))
+        # Shutdown: fail whatever never got prefilled — a dropped future
+        # would hang its caller for the full result() timeout.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                req.future.set_exception(RuntimeError("scheduler stopped"))
         try:
             self._ready.put_nowait(None)  # propagate shutdown to decode loop
         except queue.Full:

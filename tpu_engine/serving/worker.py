@@ -128,18 +128,34 @@ class WorkerNode:
         if engine is None:
             from tpu_engine.runtime.engine import InferenceEngine
 
-            # model_path (reference positional arg / $MODEL_PATH,
-            # worker_node.cpp:154-168): real weights instead of random init.
-            # Accepts an HF checkpoint dir / .safetensors / torch .bin (via
-            # models.import_weights) or an orbax checkpoint directory.
-            params = _load_model_path(self.config.model, self.config.model_path)
-            engine = InferenceEngine(
-                self.config.model,
-                params=params,
-                dtype=self.config.dtype,
-                batch_buckets=self.config.batch_buckets,
-                shape_buckets=self.config.shape_buckets,
-            )
+            if (self.config.model_path or "").endswith(".onnx"):
+                # Arbitrary-ONNX serving (reference inference_engine.cpp:31-87):
+                # the graph itself is staged to XLA — architecture AND weights
+                # come from the file, no registry entry needed.
+                from tpu_engine.models.onnx_graph import build_onnx_model
+
+                spec, params = build_onnx_model(self.config.model_path)
+                engine = InferenceEngine(
+                    spec,
+                    params=params,
+                    dtype=self.config.dtype,
+                    batch_buckets=self.config.batch_buckets,
+                    shape_buckets=self.config.shape_buckets,
+                )
+            else:
+                # model_path (reference positional arg / $MODEL_PATH,
+                # worker_node.cpp:154-168): real weights instead of random
+                # init. Accepts an HF checkpoint dir / .safetensors / torch
+                # .bin (via models.import_weights) or an orbax checkpoint dir.
+                params = _load_model_path(self.config.model,
+                                          self.config.model_path)
+                engine = InferenceEngine(
+                    self.config.model,
+                    params=params,
+                    dtype=self.config.dtype,
+                    batch_buckets=self.config.batch_buckets,
+                    shape_buckets=self.config.shape_buckets,
+                )
         self.engine = engine
         self.cache = _make_cache(self.config.cache_capacity)
         self.batch_processor: BatchProcessor[_BatchItem, _BatchResult] = BatchProcessor(

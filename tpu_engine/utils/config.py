@@ -39,7 +39,9 @@ class WorkerConfig:
     # "batch": collect a batch, decode it to completion (generator.py).
     # "continuous": iteration-level scheduling — requests join/leave the
     # running decode batch between chunks (scheduler.py). Continuous is the
-    # default (measured A/B: bench.py --scenario decode-ab, BENCH_r04).
+    # default: measured 7.42x tokens/s and ~10x lower p50 under Poisson
+    # arrivals (gpt2, TPU v5lite-1; bench.py --scenario decode-ab, artifact
+    # BENCH_r04_builder.json).
     gen_scheduler: str = "continuous"
 
     @classmethod
