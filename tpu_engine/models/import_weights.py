@@ -40,6 +40,7 @@ __all__ = [
     "load_state_dict",
     "import_gpt2",
     "import_bert",
+    "import_llama",
     "import_resnet50_v1",
     "load_onnx_initializers",
     "load_pretrained",
@@ -166,6 +167,63 @@ def import_gpt2(sd: Dict[str, np.ndarray], cfg=None) -> dict:
         "blocks": _stack(blocks),
         "ln_f": {"scale": _f32(sd["ln_f.weight"]),
                  "bias": _f32(sd["ln_f.bias"])},
+        "head": {"kernel": np.ascontiguousarray(head_w.T),
+                 "bias": np.zeros((head_w.shape[0],), np.float32)},
+    }
+
+
+# -- Llama family --------------------------------------------------------------
+
+def _linear_nobias(sd, key):
+    """torch nn.Linear without bias → dense {kernel (in, out), zero bias}
+    (the compiled graph is unconditional; zero bias ≡ no bias)."""
+    w = _f32(sd[key + ".weight"])
+    return {"kernel": np.ascontiguousarray(w.T),
+            "bias": np.zeros((w.shape[0],), np.float32)}
+
+
+def import_llama(sd: Dict[str, np.ndarray], cfg=None) -> dict:
+    """HF ``LlamaForCausalLM`` state dict → transformer pytree (rmsnorm +
+    rope + swiglu + GQA dialect; models.llama).
+
+    Mapping: ``self_attn.{q,k,v,o}_proj`` → wq/wk/wv/wo (transposed, zero
+    biases); ``mlp.{gate,up,down}_proj`` → mlp gate/up/proj;
+    ``input_layernorm``/``post_attention_layernorm`` → ln1/ln2 (scale-only
+    rmsnorm); ``model.norm`` → ln_f; ``lm_head`` → head (falls back to the
+    tied ``embed_tokens`` when absent).
+    """
+    sd = _strip(sd, "model.")
+    n_layers = 1 + max(int(k.split(".")[1]) for k in sd
+                       if k.startswith("layers."))
+    if cfg is not None:
+        assert cfg.n_layers == n_layers, (cfg.n_layers, n_layers)
+        assert cfg.norm == "rmsnorm" and cfg.pos == "rope", cfg
+
+    blocks = []
+    for i in range(n_layers):
+        p = f"layers.{i}."
+        blocks.append({
+            "ln1": {"scale": _f32(sd[p + "input_layernorm.weight"])},
+            "attn": {
+                "wq": _linear_nobias(sd, p + "self_attn.q_proj"),
+                "wk": _linear_nobias(sd, p + "self_attn.k_proj"),
+                "wv": _linear_nobias(sd, p + "self_attn.v_proj"),
+                "wo": _linear_nobias(sd, p + "self_attn.o_proj"),
+            },
+            "ln2": {"scale": _f32(sd[p + "post_attention_layernorm.weight"])},
+            "mlp": {
+                "gate": _linear_nobias(sd, p + "mlp.gate_proj"),
+                "up": _linear_nobias(sd, p + "mlp.up_proj"),
+                "proj": _linear_nobias(sd, p + "mlp.down_proj"),
+            },
+        })
+
+    embed = _f32(sd["embed_tokens.weight"])
+    head_w = _f32(sd["lm_head.weight"]) if "lm_head.weight" in sd else embed
+    return {
+        "tok_embed": {"table": embed},
+        "blocks": _stack(blocks),
+        "ln_f": {"scale": _f32(sd["norm.weight"])},
         "head": {"kernel": np.ascontiguousarray(head_w.T),
                  "bias": np.zeros((head_w.shape[0],), np.float32)},
     }
@@ -397,6 +455,7 @@ def load_onnx_initializers(path: str) -> Dict[str, np.ndarray]:
 _IMPORTERS = {
     "gpt2": lambda sd, spec: import_gpt2(sd, getattr(spec, "config", None)),
     "bert": lambda sd, spec: import_bert(sd, getattr(spec, "config", None)),
+    "llama": lambda sd, spec: import_llama(sd, getattr(spec, "config", None)),
     "resnet50-v1": lambda sd, spec: import_resnet50_v1(sd),
 }
 
@@ -418,7 +477,8 @@ def importer_for(model_name: str):
 # HF config.json model_type → registry family with an importer. ResNet maps
 # to the v1.5 model (HF/torchvision layout) — the v2 flagship has a
 # different (pre-activation) graph that HF checkpoints cannot fill.
-_HF_MODEL_TYPES = {"gpt2": "gpt2", "bert": "bert", "resnet": "resnet50-v1"}
+_HF_MODEL_TYPES = {"gpt2": "gpt2", "bert": "bert", "llama": "llama",
+                   "resnet": "resnet50-v1"}
 
 
 def model_name_from_hf(path: str) -> Optional[str]:
@@ -432,9 +492,48 @@ def model_name_from_hf(path: str) -> Optional[str]:
     return _HF_MODEL_TYPES.get(cfg.get("model_type", ""))
 
 
+def hf_spec_kwargs(path: str) -> dict:
+    """Registry-model kwargs derived from an HF checkpoint dir's
+    config.json, so shape-INVARIANT fields (rope_theta, norm eps) and
+    geometry come from the checkpoint, not the registry defaults — a
+    llama-family fine-tune with rope_theta=1e6 must not silently import
+    against theta=1e4 (wrong rotary phases, no crash to signal it)."""
+    cpath = os.path.join(path, "config.json") if os.path.isdir(path) else None
+    if not cpath or not os.path.exists(cpath):
+        return {}
+    with open(cpath) as f:
+        cfg = json.load(f)
+    mt = cfg.get("model_type", "")
+    if mt == "llama":
+        return {
+            "vocab": cfg["vocab_size"],
+            "n_layers": cfg["num_hidden_layers"],
+            "d_model": cfg["hidden_size"],
+            "n_heads": cfg["num_attention_heads"],
+            "n_kv_heads": cfg.get("num_key_value_heads",
+                                  cfg["num_attention_heads"]),
+            "d_ff": cfg["intermediate_size"],
+            "max_seq": cfg["max_position_embeddings"],
+            "rope_theta": cfg.get("rope_theta", 10000.0),
+            "ln_eps": cfg.get("rms_norm_eps", 1e-5),
+        }
+    if mt == "gpt2":
+        return {
+            "vocab": cfg["vocab_size"],
+            "n_layers": cfg["n_layer"],
+            "d_model": cfg["n_embd"],
+            "n_heads": cfg["n_head"],
+            "d_ff": cfg.get("n_inner") or 4 * cfg["n_embd"],
+            "max_seq": cfg["n_positions"],
+        }
+    return {}
+
+
 def load_pretrained(model_name: str, path: str, spec=None):
     """Checkpoint file/dir → parameter pytree for registry model
-    ``model_name``. Raises ValueError when the family has no importer."""
+    ``model_name``. Raises ValueError when the family has no importer.
+    For HF checkpoint dirs the spec is built with `hf_spec_kwargs` so the
+    architecture matches the checkpoint's own config.json."""
     imp = importer_for(model_name)
     if imp is None:
         raise ValueError(f"no pretrained-weight importer for '{model_name}'")
@@ -443,5 +542,5 @@ def load_pretrained(model_name: str, path: str, spec=None):
             _ensure_builtin_models_imported
 
         _ensure_builtin_models_imported()
-        spec = create_model(model_name)
+        spec = create_model(model_name, **hf_spec_kwargs(path))
     return imp(load_state_dict(path), spec)

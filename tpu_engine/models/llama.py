@@ -1,0 +1,56 @@
+"""Llama family — RMSNorm + RoPE + SwiGLU + grouped-query attention.
+
+Reference counterpart: BASELINE.json config 5 ("GPT-2 / distil-Llama ONNX
+autoregressive decode"); the reference can only run such a graph one-shot
+through ONNX Runtime (`/root/reference/src/inference_engine.cpp:31`). Here
+the llama dialect is the same scanned-block transformer program as GPT-2
+(models.transformer) with the dialect knobs flipped: rmsnorm, rotary
+positions (no learned table), SwiGLU FFN, and `n_kv_heads < n_heads` so the
+device-resident KV cache stores only the grouped KV heads. All serving
+surfaces (one-shot /infer, /generate under both decode schedulers, HF
+weight import) come for free from the shared runtime.
+
+`llama` defaults to the TinyLlama-1.1B geometry — the "distil-Llama" class
+the baseline names: small enough to serve on one chip, real GQA (32 query /
+4 KV heads).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import ModelSpec, register
+from tpu_engine.models.transformer import TransformerConfig
+from tpu_engine.models.gpt2 import _spec_from_config
+
+
+def _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff, max_seq,
+               rope_theta=10000.0, ln_eps=1e-5) -> TransformerConfig:
+    return TransformerConfig(
+        vocab=vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
+        d_ff=d_ff, max_seq=max_seq, causal=True,
+        norm="rmsnorm", pos="rope", mlp_act="swiglu",
+        n_kv_heads=n_kv_heads, rope_theta=rope_theta, ln_eps=ln_eps)
+
+
+@register("llama")
+def make_llama(seq_len: int = 128, vocab: int = 32000, n_layers: int = 22,
+               d_model: int = 2048, n_heads: int = 32, n_kv_heads: int = 4,
+               d_ff: int = 5632, max_seq: int = 2048,
+               rope_theta: float = 10000.0, ln_eps: float = 1e-5) -> ModelSpec:
+    """TinyLlama-1.1B geometry (the distil-llama serving class). All
+    fields overridable — `import_weights.hf_spec_kwargs` feeds a
+    checkpoint's own config.json values through here."""
+    cfg = _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff,
+                     max_seq, rope_theta, ln_eps)
+    return _spec_from_config("llama", cfg, seq_len)
+
+
+@register("llama-small-test")
+def make_llama_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
+                     d_model: int = 64, n_heads: int = 4, n_kv_heads: int = 2,
+                     d_ff: int = 128, max_seq: int = 64) -> ModelSpec:
+    """Tiny config for tests/CI — same code path (incl. GQA), ms compiles."""
+    cfg = _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff,
+                     max_seq)
+    return _spec_from_config("llama-small-test", cfg, seq_len)

@@ -69,6 +69,6 @@ def _ensure_builtin_models_imported():
 
     from tpu_engine.models import mlp, resnet  # noqa: F401
 
-    for optional in ("bert", "gpt2", "yolo"):
+    for optional in ("bert", "gpt2", "llama", "yolo"):
         if importlib.util.find_spec(f"tpu_engine.models.{optional}") is not None:
             importlib.import_module(f"tpu_engine.models.{optional}")

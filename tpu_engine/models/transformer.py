@@ -32,6 +32,8 @@ from tpu_engine.ops.attention import (
     KVCache,
     dot_product_attention,
     mha_init,
+    repeat_kv,
+    rope,
     _split_heads,
 )
 
@@ -54,6 +56,15 @@ class TransformerConfig:
     type_vocab: int = 0         # token-type (segment) embedding table size
     gelu_tanh: bool = True      # tanh-approx GELU (GPT-2) vs erf GELU (BERT)
     ln_eps: float = 1e-5
+    # Llama-family dialect knobs (import_llama produces bit-compatible
+    # forwards): RMSNorm blocks, rotary positions (no learned table),
+    # SwiGLU FFN, grouped-query attention via n_kv_heads < n_heads.
+    norm: str = "layernorm"     # "layernorm" | "rmsnorm"
+    pos: str = "learned"        # "learned" | "rope"
+    mlp_act: str = "gelu"       # "gelu" | "swiglu"
+    n_kv_heads: Optional[int] = None   # None = n_heads (full MHA)
+    rope_theta: float = 10000.0  # (bias-free llama projections import as
+    #                              zero biases — the graph is unconditional)
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the `expert`
     # mesh axis (ops.moe); top-k routing, static capacity slots.
     n_experts: int = 0
@@ -65,6 +76,10 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
     @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
     def moe(self):
         from tpu_engine.ops.moe import MoEConfig
 
@@ -73,17 +88,35 @@ class TransformerConfig:
                          capacity_factor=self.moe_capacity_factor)
 
 
+def _norm_init(cfg: TransformerConfig):
+    return (nn.rmsnorm_init(cfg.d_model) if cfg.norm == "rmsnorm"
+            else nn.layernorm_init(cfg.d_model))
+
+
+def _norm(params, x, cfg: TransformerConfig):
+    return (nn.rmsnorm(params, x, eps=cfg.ln_eps) if cfg.norm == "rmsnorm"
+            else nn.layernorm(params, x, eps=cfg.ln_eps))
+
+
 def _block_init(key, cfg: TransformerConfig):
     k_attn, k_fc, k_proj = jax.random.split(key, 3)
     out = {
-        "ln1": nn.layernorm_init(cfg.d_model),
-        "attn": mha_init(k_attn, cfg.d_model, cfg.n_heads),
-        "ln2": nn.layernorm_init(cfg.d_model),
+        "ln1": _norm_init(cfg),
+        "attn": mha_init(k_attn, cfg.d_model, cfg.n_heads,
+                         n_kv_heads=cfg.n_kv_heads),
+        "ln2": _norm_init(cfg),
     }
     if cfg.n_experts > 0:
         from tpu_engine.ops.moe import moe_init
 
         out["mlp"] = moe_init(k_fc, cfg.moe)
+    elif cfg.mlp_act == "swiglu":
+        k_gate, k_up = jax.random.split(k_fc)
+        out["mlp"] = {
+            "gate": nn.dense_init(k_gate, cfg.d_model, cfg.d_ff),
+            "up": nn.dense_init(k_up, cfg.d_model, cfg.d_ff),
+            "proj": nn.dense_init(k_proj, cfg.d_ff, cfg.d_model),
+        }
     else:
         out["mlp"] = {
             "fc": nn.dense_init(k_fc, cfg.d_model, cfg.d_ff),
@@ -99,16 +132,18 @@ def transformer_init(key, cfg: TransformerConfig):
     blocks = jax.vmap(lambda k: _block_init(k, cfg))(block_keys)
     params = {
         "tok_embed": nn.embedding_init(k_tok, cfg.vocab, cfg.d_model),
-        "pos_embed": nn.embedding_init(k_pos, cfg.max_seq, cfg.d_model),
         "blocks": blocks,
         # LM head tied to tok_embed would save params; kept separate so the
         # vocab dim can shard over `model` independently.
         "head": nn.dense_init(k_head, cfg.d_model, cfg.vocab),
     }
+    if cfg.pos == "learned":
+        params["pos_embed"] = nn.embedding_init(k_pos, cfg.max_seq,
+                                                cfg.d_model)
     if not cfg.post_ln:
         # Post-LN dialects (BERT) normalize inside every block and have no
         # final LayerNorm.
-        params["ln_f"] = nn.layernorm_init(cfg.d_model)
+        params["ln_f"] = _norm_init(cfg)
     if cfg.embed_ln:
         params["embed_ln"] = nn.layernorm_init(cfg.d_model)
     if cfg.type_vocab > 0:
@@ -122,6 +157,11 @@ def _mlp(params, h, dtype, cfg: TransformerConfig = None):
         from tpu_engine.ops.moe import moe_apply
 
         return moe_apply(params, h, cfg.moe, dtype=dtype)
+    if cfg is not None and cfg.mlp_act == "swiglu":
+        gate = jax.nn.silu(nn.dense(params["gate"], h, dtype=dtype))
+        return nn.dense(params["proj"],
+                        gate * nn.dense(params["up"], h, dtype=dtype),
+                        dtype=dtype)
     h = nn.dense(params["fc"], h, dtype=dtype)
     h = jax.nn.gelu(h, approximate=cfg.gelu_tanh if cfg is not None else True)
     return nn.dense(params["proj"], h, dtype=dtype)
@@ -134,8 +174,11 @@ def default_attention():
     """The serving-path attention implementation.
 
     On TPU this is the Pallas flash kernel (ops.flash) — the framework's
-    hot op, measured 26% faster than the XLA-fused path at bert-class
-    shapes — selected once per process. `TPU_ENGINE_FLASH` overrides:
+    hot op: measured at parity with the XLA-fused path through S2048,
+    faster beyond (1.18x at S4096), and still running at S8192+ where the
+    fused path cannot compile (O(S^2) score temps exceed HBM; see
+    ops/flash.py docstring for the on-chip numbers) — selected once per
+    process. `TPU_ENGINE_FLASH` overrides:
     "1" forces flash (Pallas interpreter off-TPU — slow, for parity tests),
     "0" forces the XLA reference path, unset/"auto" picks by backend.
     """
@@ -157,31 +200,50 @@ def default_attention():
     return fn
 
 
-def _attn(bp, x, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
-    attn_fn = attn_fn or default_attention()
+def _project_qkv(bp, x, cfg: TransformerConfig, *, dtype, positions=None):
+    """qkv projections + rotary phases — the ONE implementation every path
+    (full-seq, prefill, scalar decode, per-row decode) shares, so a dialect
+    change can't silently diverge between cached and uncached forwards.
+    `positions`: logical positions for rope ((B, S), (B, 1) or None →
+    arange over the sequence)."""
     q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
-    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
-    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
-    a = attn_fn(q, k, v, causal=cfg.causal, mask=mask)
+    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.kv_heads)
+    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.kv_heads)
+    if cfg.pos == "rope":
+        pos = jnp.arange(x.shape[1]) if positions is None else positions
+        q = rope(q, pos, cfg.rope_theta)
+        k = rope(k, pos, cfg.rope_theta)
+    return q, k, v
+
+
+def _attn(bp, x, cfg: TransformerConfig, *, mask, dtype, attn_fn=None,
+          pos_ids=None):
+    attn_fn = attn_fn or default_attention()
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype, positions=pos_ids)
+    # Full-sequence attn_fn implementations (flash kernel, ring attention)
+    # expect equal head counts — expand grouped KV here (a one-time
+    # prompt-pass cost; the decode paths below attend grouped, unexpanded).
+    n_rep = cfg.n_heads // cfg.kv_heads
+    a = attn_fn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                causal=cfg.causal, mask=mask)
     b, s = a.shape[:2]
     return nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
 
 
-def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None):
-    eps = cfg.ln_eps
+def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None,
+                 pos_ids=None):
     if cfg.post_ln:
         # BERT dialect: sublayer → residual add → LayerNorm.
-        h = nn.layernorm(bp["ln1"], h + _attn(bp, h, cfg, mask=mask,
-                                              dtype=dtype, attn_fn=attn_fn),
-                         eps=eps)
-        h = nn.layernorm(bp["ln2"], h + _mlp(bp["mlp"], h, dtype, cfg),
-                         eps=eps)
+        h = _norm(bp["ln1"], h + _attn(bp, h, cfg, mask=mask, dtype=dtype,
+                                       attn_fn=attn_fn, pos_ids=pos_ids),
+                  cfg)
+        h = _norm(bp["ln2"], h + _mlp(bp["mlp"], h, dtype, cfg), cfg)
     else:
-        # GPT dialect: LayerNorm → sublayer → residual add.
-        h = h + _attn(bp, nn.layernorm(bp["ln1"], h, eps=eps), cfg,
-                      mask=mask, dtype=dtype, attn_fn=attn_fn)
-        h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=eps), dtype,
-                     cfg)
+        # GPT/llama dialect: norm → sublayer → residual add.
+        h = h + _attn(bp, _norm(bp["ln1"], h, cfg), cfg,
+                      mask=mask, dtype=dtype, attn_fn=attn_fn,
+                      pos_ids=pos_ids)
+        h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
     # nn.dense accumulates in f32; keep the residual-stream carry in the
     # compute dtype so the layer scan's carry type is stable.
     return h.astype(dtype)
@@ -199,7 +261,8 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
     type vocabulary (BERT); defaults to all-zeros."""
     b, s = tokens.shape
     h = nn.embedding(params["tok_embed"], tokens)
-    h = h + params["pos_embed"]["table"][None, :s]
+    if cfg.pos == "learned":
+        h = h + params["pos_embed"]["table"][None, :s]
     if cfg.type_vocab > 0:
         if token_type_ids is None:
             h = h + params["type_embed"]["table"][0]
@@ -215,7 +278,7 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
 
     h, _ = jax.lax.scan(body, h, params["blocks"])
     if not cfg.post_ln:
-        h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
+        h = _norm(params["ln_f"], h, cfg)
     return nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
 
 
@@ -223,20 +286,23 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
 
 def init_caches(cfg: TransformerConfig, batch: int, max_seq: Optional[int] = None,
                 dtype=jnp.bfloat16) -> KVCache:
-    """Stacked (L-leading) KV cache matching the scanned blocks."""
+    """Stacked (L-leading) KV cache matching the scanned blocks. GQA models
+    cache only `kv_heads` heads — the llama-family memory win."""
     max_seq = max_seq or cfg.max_seq
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.d_head)
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.d_head)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
 def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
                   pos, cfg: TransformerConfig, *, dtype, prefill: bool,
-                  attn_mask=None, start=None):
+                  attn_mask=None, start=None, pos_ids=None):
+    """`pos_ids`: LOGICAL positions for rotary phases — (B, S) in prefill,
+    (B, 1) in decode. RoPE rotates k BEFORE it enters the cache, so cached
+    keys are phase-complete and decode only rotates the new column."""
     ck, cv = cache_kv
-    x = nn.layernorm(bp["ln1"], h, eps=cfg.ln_eps)
-    q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
-    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
-    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
+    n_rep = cfg.n_heads // cfg.kv_heads
+    x = _norm(bp["ln1"], h, cfg)
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype, positions=pos_ids)
     write_at = 0 if prefill else pos
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, write_at, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, write_at, 0, 0))
@@ -244,7 +310,8 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
         # Prefill is a full-sequence pass — the flash kernel's home turf.
         # Decode (below) keeps the XLA path: a 1-token query block can't
         # feed the MXU enough to win.
-        a = default_attention()(q, k, v, causal=True, mask=attn_mask)
+        a = default_attention()(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                                causal=True, mask=attn_mask)
     else:
         max_seq = ck.shape[1]
         kpos = jnp.arange(max_seq)[None, :]
@@ -253,10 +320,12 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
             # Left-padded batch: positions before each sample's first real
             # token are dead cache slots.
             valid = valid * (kpos >= start[:, None])
+        # Grouped attention directly against the un-expanded cache — decode
+        # is the bandwidth-bound path GQA exists for.
         a = dot_product_attention(q, ck, cv, mask=valid)
     b, s = a.shape[:2]
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=cfg.ln_eps), dtype, cfg)
+    h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
     return h.astype(dtype), (ck, cv)
 
 
@@ -272,21 +341,22 @@ def transformer_prefill(params, tokens, caches: KVCache, cfg: TransformerConfig,
     """
     b, s = tokens.shape
     h = nn.embedding(params["tok_embed"], tokens)
-    if pos_ids is None:
-        h = h + params["pos_embed"]["table"][None, :s]
-    else:
-        h = h + params["pos_embed"]["table"][pos_ids]
+    if cfg.pos == "learned":
+        if pos_ids is None:
+            h = h + params["pos_embed"]["table"][None, :s]
+        else:
+            h = h + params["pos_embed"]["table"][pos_ids]
     h = h.astype(dtype)
 
     def body(carry, layer):
         bp, ck, cv = layer
         h, (ck, cv) = _block_decode(bp, carry, (ck, cv), 0, cfg,
                                     dtype=dtype, prefill=True,
-                                    attn_mask=attn_mask)
+                                    attn_mask=attn_mask, pos_ids=pos_ids)
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h[:, -1:], eps=cfg.ln_eps)
+    h = _norm(params["ln_f"], h[:, -1:], cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)
 
@@ -298,19 +368,18 @@ def _block_decode_rows(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
     depths). pos_vec/start_vec: (B,) int32."""
     ck, cv = cache_kv
     b = h.shape[0]
-    x = nn.layernorm(bp["ln1"], h, eps=cfg.ln_eps)
-    q = _split_heads(nn.dense(bp["attn"]["wq"], x, dtype=dtype), cfg.n_heads)
-    k = _split_heads(nn.dense(bp["attn"]["wk"], x, dtype=dtype), cfg.n_heads)
-    v = _split_heads(nn.dense(bp["attn"]["wv"], x, dtype=dtype), cfg.n_heads)
+    x = _norm(bp["ln1"], h, cfg)
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype,
+                           positions=(pos_vec - start_vec)[:, None])
     rows = jnp.arange(b)
     ck = ck.at[rows, pos_vec].set(k[:, 0].astype(ck.dtype))
     cv = cv.at[rows, pos_vec].set(v[:, 0].astype(cv.dtype))
     kpos = jnp.arange(ck.shape[1])[None, :]
     valid = ((kpos <= pos_vec[:, None]) & (kpos >= start_vec[:, None])
              ).astype(jnp.int32)
-    a = dot_product_attention(q, ck, cv, mask=valid)
+    a = dot_product_attention(q, ck, cv, mask=valid)  # grouped, unexpanded
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
-    h = h + _mlp(bp["mlp"], nn.layernorm(bp["ln2"], h, eps=cfg.ln_eps), dtype, cfg)
+    h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
     return h.astype(dtype), (ck, cv)
 
 
@@ -326,9 +395,10 @@ def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
     if start_vec is None:
         start_vec = jnp.zeros_like(pos_vec)
     h = nn.embedding(params["tok_embed"], token_t[:, None])
-    logical = jnp.clip(pos_vec - start_vec, 0,
-                       params["pos_embed"]["table"].shape[0] - 1)
-    h = h + params["pos_embed"]["table"][logical][:, None, :]
+    if cfg.pos == "learned":
+        logical = jnp.clip(pos_vec - start_vec, 0,
+                           params["pos_embed"]["table"].shape[0] - 1)
+        h = h + params["pos_embed"]["table"][logical][:, None, :]
     h = h.astype(dtype)
 
     def body(carry, layer):
@@ -338,7 +408,7 @@ def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
+    h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)
 
@@ -350,24 +420,25 @@ def transformer_decode_step(params, token_t, caches: KVCache, pos,
     Returns (logits (B, vocab), caches). Compiles once; shapes are static.
 
     `start` (B,) marks each sample's first valid cache column (left-padded
-    batches); `pos_ids` (B,) overrides the position-embedding index per
-    sample (defaults to `pos` for all)."""
+    batches); `pos_ids` (B,) overrides the logical position per sample
+    (position-embedding index / rotary phase; defaults to `pos` for all)."""
+    b = token_t.shape[0]
     h = nn.embedding(params["tok_embed"], token_t[:, None])
-    table = params["pos_embed"]["table"]
-    if pos_ids is None:
-        pos_vec = jax.lax.dynamic_slice(table, (pos, 0), (1, table.shape[1]))
-        h = h + pos_vec[None]
-    else:
-        h = h + table[pos_ids][:, None, :]
+    logical = (jnp.full((b,), pos, jnp.int32) if pos_ids is None
+               else jnp.asarray(pos_ids))
+    if cfg.pos == "learned":
+        h = h + params["pos_embed"]["table"][logical][:, None, :]
     h = h.astype(dtype)
+    rope_pos = logical[:, None] if cfg.pos == "rope" else None
 
     def body(carry, layer):
         bp, ck, cv = layer
         h, (ck, cv) = _block_decode(bp, carry, (ck, cv), pos, cfg,
-                                    dtype=dtype, prefill=False, start=start)
+                                    dtype=dtype, prefill=False, start=start,
+                                    pos_ids=rope_pos)
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
-    h = nn.layernorm(params["ln_f"], h, eps=cfg.ln_eps)
+    h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits[:, 0], KVCache(k_new, v_new)

@@ -33,18 +33,23 @@ import jax.numpy as jnp
 from tpu_engine.ops import nn
 
 
-def mha_init(key, d_model: int, n_heads: int, d_head: Optional[int] = None):
+def mha_init(key, d_model: int, n_heads: int, d_head: Optional[int] = None,
+             n_kv_heads: Optional[int] = None):
+    """`n_kv_heads < n_heads` gives grouped-query attention (llama family):
+    wk/wv project to the smaller KV width, shrinking both the projections
+    and — the real win — the device-resident KV cache."""
     d_head = d_head or d_model // n_heads
     inner = n_heads * d_head
+    kv_inner = (n_kv_heads or n_heads) * d_head
     kq, kk, kv, ko = jax.random.split(key, 4)
     scale = 1.0 / math.sqrt(d_model)
     return {
         "wq": {"kernel": jax.random.normal(kq, (d_model, inner)) * scale,
                "bias": jnp.zeros((inner,))},
-        "wk": {"kernel": jax.random.normal(kk, (d_model, inner)) * scale,
-               "bias": jnp.zeros((inner,))},
-        "wv": {"kernel": jax.random.normal(kv, (d_model, inner)) * scale,
-               "bias": jnp.zeros((inner,))},
+        "wk": {"kernel": jax.random.normal(kk, (d_model, kv_inner)) * scale,
+               "bias": jnp.zeros((kv_inner,))},
+        "wv": {"kernel": jax.random.normal(kv, (d_model, kv_inner)) * scale,
+               "bias": jnp.zeros((kv_inner,))},
         "wo": {"kernel": jax.random.normal(ko, (inner, d_model)) * scale,
                "bias": jnp.zeros((d_model,))},
     }
@@ -57,23 +62,41 @@ def _split_heads(x, n_heads: int):
 
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
                           base_pos: int = 0):
-    """q: (B, Sq, H, D); k, v: (B, Sk, H, D). Softmax in f32 (numerics),
-    matmuls in the input dtype (MXU). `base_pos` offsets the query positions
-    for causal masking when q is a suffix of the kv sequence (decode)."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    """q: (B, Sq, H, D); k, v: (B, Sk, H_kv, D) with H_kv dividing H.
+    Softmax in f32 (numerics), matmuls in the input dtype (MXU). `base_pos`
+    offsets the query positions for causal masking when q is a suffix of the
+    kv sequence (decode).
+
+    H_kv < H is grouped-query attention, computed by folding the group axis
+    into the einsum against the UN-expanded K/V — never materializing an
+    H-wide copy of the cache (for the llama default, 32q/4kv, repeating the
+    cached K/V would move 8× the bytes the cache actually holds on every
+    decode step — exactly the bandwidth GQA exists to save)."""
+    b, sq, h, d = q.shape
+    h_kv = k.shape[2]
+    if h_kv != h:
+        g = h // h_kv
+        qg = q.reshape(b, sq, h_kv, g, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(d)
     if causal:
-        sq, sk = q.shape[1], k.shape[1]
+        sk = k.shape[1]
         qpos = base_pos + jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
         scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
     if mask is not None:
         # mask: (B, Sk) 1=valid, 0=pad — broadcast over heads and queries.
-        scores = jnp.where(mask[:, None, None, :] > 0, scores, -jnp.inf)
+        extra = (None,) * (scores.ndim - 2)
+        scores = jnp.where(mask[(slice(None),) + extra + (slice(None),)] > 0,
+                           scores, -jnp.inf)
     # Guard fully-masked rows (all -inf → NaN softmax): treat as uniform.
     weights = jax.nn.softmax(scores, axis=-1)
     weights = jnp.nan_to_num(weights)
+    if h_kv != h:
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", weights.astype(v.dtype), v)
+        return out.reshape(b, sq, h, d)
     return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
 
 
@@ -82,3 +105,34 @@ class KVCache(NamedTuple):
     (stacked with a leading layer axis by models.transformer.init_caches)."""
     k: jnp.ndarray
     v: jnp.ndarray
+
+
+def rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding, HF-llama rotate-half convention.
+
+    x: (B, S, H, D); positions: (B, S) or (S,) int — LOGICAL positions
+    (left-padded batches pass col - start so padding never shifts phase).
+    Angles in f32 on the VPU; output cast back to x.dtype for the MXU.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    inv = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) * 2.0 / d))
+    pos = jnp.maximum(jnp.asarray(positions), 0).astype(jnp.float32)
+    if pos.ndim == 1:
+        pos = pos[None, :]
+    ang = pos[..., None] * inv                       # (B, S, half)
+    cos = jnp.cos(ang)[:, :, None, :]                # (B, S, 1, half)
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x, n_rep: int):
+    """(B, S, H_kv, D) -> (B, S, H_kv*n_rep, D): expand grouped KV heads to
+    the query head count right before the attention matmuls (the cache and
+    projections stay at the small KV width)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)

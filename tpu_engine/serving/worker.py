@@ -78,18 +78,23 @@ class _GenResult:
     generate_time_us: int
 
 
-def _load_model_path(model: str, model_path: Optional[str]):
+def _load_model_path(model, model_path: Optional[str]):
     """Resolve the worker's model_path into a parameter pytree (or None for
     random init). HF checkpoint layouts (config.json / *.safetensors /
     pytorch_model.bin, or those files directly) go through the pretrained
-    importers; other directories are treated as orbax checkpoints."""
+    importers; other directories are treated as orbax checkpoints.
+    `model` may be a registry name or an already-built ModelSpec (the
+    HF-config-driven path) — a spec is passed through so the importer's
+    architecture assertions run against it."""
+    name = model if isinstance(model, str) else model.name
+    spec = None if isinstance(model, str) else model
     if not model_path:
         return None
     if os.path.isfile(model_path):
         if model_path.endswith((".safetensors", ".bin", ".pt", ".pth")):
             from tpu_engine.models.import_weights import load_pretrained
 
-            return load_pretrained(model, model_path)
+            return load_pretrained(name, model_path, spec=spec)
         return None  # e.g. a reference-style .onnx path used only for naming
     if os.path.isdir(model_path):
         if any(os.path.exists(os.path.join(model_path, f))
@@ -98,7 +103,7 @@ def _load_model_path(model: str, model_path: Optional[str]):
                          "model.safetensors.index.json")):
             from tpu_engine.models.import_weights import load_pretrained
 
-            return load_pretrained(model, model_path)
+            return load_pretrained(name, model_path, spec=spec)
         from tpu_engine.utils.checkpoint import load_params
 
         return load_params(model_path)
@@ -146,11 +151,24 @@ class WorkerNode:
                 # model_path (reference positional arg / $MODEL_PATH,
                 # worker_node.cpp:154-168): real weights instead of random
                 # init. Accepts an HF checkpoint dir / .safetensors / torch
-                # .bin (via models.import_weights) or an orbax checkpoint dir.
-                params = _load_model_path(self.config.model,
-                                          self.config.model_path)
+                # .bin (via models.import_weights) or an orbax checkpoint
+                # dir. An HF dir's config.json drives the architecture
+                # (geometry AND shape-invariant fields like rope_theta) so
+                # the engine spec matches the imported weights exactly.
+                model = self.config.model
+                if self.config.model_path and os.path.isdir(
+                        self.config.model_path):
+                    from tpu_engine.models.import_weights import hf_spec_kwargs
+                    from tpu_engine.models.registry import (
+                        create_model, _ensure_builtin_models_imported)
+
+                    kwargs = hf_spec_kwargs(self.config.model_path)
+                    if kwargs:
+                        _ensure_builtin_models_imported()
+                        model = create_model(self.config.model, **kwargs)
+                params = _load_model_path(model, self.config.model_path)
                 engine = InferenceEngine(
-                    self.config.model,
+                    model,
                     params=params,
                     dtype=self.config.dtype,
                     batch_buckets=self.config.batch_buckets,
