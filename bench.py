@@ -28,6 +28,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 BASELINE_REQ_S = 522.64  # reference README.md:283 (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -594,7 +595,53 @@ def run_mixed_shape_bench(port: int, n_requests: int = 2000,
     }
 
 
+def probe_device(timeout_s: float = 300.0) -> None:
+    """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
+    wedged (observed after compile-OOM storms), hangs `jax.devices()` in
+    every new process — an in-process hang would leave the driver with NO
+    bench artifact at all. Raises on a dead/hung device.
+
+    A hung child can sit in uninterruptible sleep and survive SIGKILL, so
+    pipes are abandoned on timeout instead of drained (subprocess.run's
+    post-kill communicate() has no timeout and would hang right here)."""
+    code = ("import os, jax\n"
+            "p = os.environ.get('TPU_ENGINE_PLATFORM')\n"
+            "jax.config.update('jax_platforms', p) if p else None\n"
+            "print(jax.devices()[0].device_kind)\n")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        for pipe in (proc.stdout, proc.stderr):
+            if pipe is not None:
+                pipe.close()
+        raise RuntimeError(
+            f"device probe hung >{timeout_s:.0f}s (tunnel wedged?)")
+    if proc.returncode != 0:
+        raise RuntimeError(f"device probe failed: {err[-300:]}")
+    log(f"device probe OK: {out.strip()}")
+
+
+_SCENARIO = "infer"  # set by _main after arg parsing; read by the handler
+
+
 def main() -> int:
+    try:
+        return _main()
+    except Exception as exc:  # ALWAYS leave the driver one JSON line
+        log(f"bench failed: {exc!r}")
+        print(json.dumps({
+            "metric": "bench_error", "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0, "scenario": _SCENARIO,
+            "error": repr(exc)[:500],
+        }), flush=True)
+        return 1
+
+
+def _main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=10_000)
     ap.add_argument("--threads", type=int, default=50)
@@ -626,6 +673,13 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    global _SCENARIO
+    _SCENARIO = args.scenario
+    # Preflight the device — except in --port mode, where a live server
+    # already holds the (exclusive) chip and a second jax.devices() would
+    # false-negative against a healthy deployment.
+    if args.port == 0:
+        probe_device()
     if args.quick:
         args.requests, args.threads = 1000, 20
     if args.scenario in ("generate", "decode-ab") and args.model == "resnet50":
