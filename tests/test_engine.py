@@ -85,6 +85,42 @@ def test_warmup_precompiles(engine):
     assert {k[-1] for k in engine.stats()["compiled_buckets"]} == {1, 2, 4, 8}
 
 
+def test_mid_size_wire_bucket_correctness():
+    """A payload landing in an interior wire bucket (not narrowest, not
+    full width) must produce the same outputs as the full-width path —
+    the on-device zero-pad is bucket-independent."""
+    eng = InferenceEngine("mlp", dtype="float32", batch_buckets=(2,),
+                          model_kwargs=dict(input_dim=2048, hidden_dim=16,
+                                            output_dim=4))
+    assert len(eng._wire_buckets) >= 3  # 128, 1024, 2048
+    short = [1.0, 2.0, 3.0]                  # narrowest bucket
+    mid = [float(i) for i in range(500)]     # interior bucket (1024)
+    full = [float(i) for i in range(2048)]   # full width
+    outs = eng.batch_predict([short, mid, full])
+    # Reference semantics: each equals the zero-padded full-width forward.
+    for vec, got in zip((short, mid, full), outs):
+        padded = np.zeros((2048,), np.float32)
+        padded[:len(vec)] = vec
+        np.testing.assert_allclose(
+            got, eng.batch_predict([padded])[0], rtol=1e-5)
+
+
+def test_pipelined_and_lockstep_agree():
+    """batch_submit/collect with several handles in flight returns the
+    same per-request outputs as lockstep batch_predict."""
+    eng = InferenceEngine("mlp", dtype="float32", batch_buckets=(4,),
+                          model_kwargs=dict(input_dim=8, hidden_dim=16,
+                                            output_dim=4))
+    batches = [[[float(i + j)] * 8 for j in range(4)] for i in range(6)]
+    handles = [eng.batch_submit(b) for b in batches]  # all in flight at once
+    piped = [eng.batch_collect(h) for h in handles]
+    for b, outs in zip(batches, piped):
+        ref = eng.batch_predict(b)
+        for got, want in zip(outs, ref):
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert all(eng.handle_ready(h) for h in handles)
+
+
 def test_mesh_sharded_engine_matches_single_device():
     mesh = create_mesh(shape=(8,), axis_names=("data",))
     e_mesh = InferenceEngine("mlp", dtype="float32",

@@ -1,0 +1,119 @@
+"""Streaming /generate/stream (SSE): iteration-level token delivery.
+
+Beyond-reference capability (the reference can only run one-shot graphs):
+tokens stream as they decode under the continuous scheduler. The streamed
+concatenation must equal the blocking /generate result for the same seed —
+the one-definition-of-visible-tokens contract in
+runtime/scheduler.py:_visible_tokens.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port: int, path: str, payload: dict, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(payload),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()  # http.client decodes chunked transfer transparently
+    conn.close()
+    return resp, data
+
+
+def _parse_sse(data: bytes):
+    events = []
+    for block in data.decode().split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            events.append(json.loads(block[len("data: "):]))
+    return events
+
+
+@pytest.fixture(scope="module")
+def worker_server():
+    from tpu_engine.serving.app import serve_worker
+    from tpu_engine.utils.config import WorkerConfig
+
+    port = _free_port()
+    worker, server = serve_worker(
+        WorkerConfig(port=port, node_id="w_stream", model="gpt2-small-test",
+                     dtype="float32"), background=True)
+    time.sleep(0.2)
+    yield port
+    worker.stop()
+    server.stop()
+
+
+def test_stream_matches_blocking_generate(worker_server):
+    port = worker_server
+    req = {"request_id": "s1", "prompt_tokens": [5, 3, 8],
+           "max_new_tokens": 12, "temperature": 0.9, "seed": 11}
+    blocking = json.loads(_post(port, "/generate", dict(req))[1])
+
+    resp, data = _post(port, "/generate/stream",
+                       dict(req, request_id="s2"))
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events = _parse_sse(data)
+    assert events, data
+    final = events[-1]
+    assert final.get("done") is True and "error" not in final, final
+    streamed = [t for e in events[:-1] for t in e["tokens"]]
+    assert streamed == blocking["tokens"]
+    assert final["tokens"] == blocking["tokens"]
+    assert final["node_id"] == "w_stream"
+
+
+def test_stream_eos_truncation(worker_server):
+    """EOS mid-stream: no token after EOS is ever streamed."""
+    port = worker_server
+    # Greedy with eos likely unseen for small vocab; force a tiny budget
+    # and assert stream == blocking under identical params regardless.
+    req = {"request_id": "e1", "prompt_tokens": [1, 2],
+           "max_new_tokens": 6, "eos_id": 7, "temperature": 1.3, "seed": 5}
+    blocking = json.loads(_post(port, "/generate", dict(req))[1])
+    _, data = _post(port, "/generate/stream", dict(req, request_id="e2"))
+    events = _parse_sse(data)
+    streamed = [t for e in events[:-1] for t in e["tokens"]]
+    assert streamed == blocking["tokens"]
+    assert 7 not in streamed
+
+
+def test_stream_through_combined_gateway():
+    """/generate/stream routes through the gateway (ring + breakers) in
+    combined mode; through the C++ front the events arrive as one SSE body."""
+    from tpu_engine.serving.app import serve_combined
+    from tpu_engine.utils.config import WorkerConfig
+
+    port = _free_port()
+    gateway, workers, server = serve_combined(
+        model="gpt2-small-test", lanes=1, port=port,
+        worker_config=WorkerConfig(model="gpt2-small-test", dtype="float32"))
+    try:
+        req = {"request_id": "g1", "prompt_tokens": [2, 4, 6],
+               "max_new_tokens": 8, "temperature": 0.5, "seed": 3}
+        blocking = json.loads(_post(port, "/generate", dict(req))[1])
+        _, data = _post(port, "/generate/stream", dict(req, request_id="g2"))
+        events = _parse_sse(data)
+        assert events and events[-1].get("done") is True, data
+        streamed = [t for e in events[:-1] for t in e["tokens"]]
+        assert streamed == blocking["tokens"]
+    finally:
+        for w in workers:
+            w.stop()
+        server.stop()

@@ -302,6 +302,11 @@ class Generator:
             caches, tok, done, toks = decode(
                 self.params, caches, tok, pos, start_dev, done, seeds_dev,
                 temps_dev, topp_dev, eos_dev)
+            for dv in (toks, done):  # one round trip for both host reads
+                try:
+                    dv.copy_to_host_async()
+                except AttributeError:
+                    pass
             pieces.append(np.asarray(toks))
             pos += self._step_chunk
             remaining -= self._step_chunk

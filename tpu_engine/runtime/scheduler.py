@@ -58,6 +58,11 @@ class _Request:
     seed: int
     top_p: float
     future: Future = field(default_factory=Future)
+    # Streaming: freshly-visible tokens are pushed as lists between decode
+    # chunks; None is the end-of-stream sentinel (the future then holds the
+    # final result or the error). `streamed` counts tokens already pushed.
+    stream: Optional["queue.Queue"] = None
+    streamed: int = 0
 
 
 class ContinuousGenerator:
@@ -219,12 +224,15 @@ class ContinuousGenerator:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
-               top_p: float = 1.0) -> Future:
-        """Enqueue one request; resolves to its generated token list."""
+               top_p: float = 1.0, stream=None) -> Future:
+        """Enqueue one request; resolves to its generated token list.
+        `stream`: optional queue.Queue — fresh token lists are pushed as
+        they decode (iteration-level granularity), then a None sentinel."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
-                       float(temperature), int(seed), float(top_p))
+                       float(temperature), int(seed), float(top_p),
+                       stream=stream)
         self._queue.put(req)
         return req.future
 
@@ -255,6 +263,15 @@ class ContinuousGenerator:
     def _free_rows(self) -> List[int]:
         return [r for r in range(self.n_slots) if self._row_req[r] is None]
 
+    @staticmethod
+    def _fail_request(req: _Request, exc: BaseException) -> None:
+        """Resolve a request with an error AND unblock its stream consumer
+        (a dropped sentinel would hang an SSE reader forever)."""
+        if not req.future.done():
+            req.future.set_exception(exc)
+        if req.stream is not None:
+            req.stream.put(None)
+
     def _prefill_loop(self) -> None:
         """Prefill thread: drains submissions, runs each prompt's forward
         pass + first-token sample (the host-sync-heavy admission work), and
@@ -270,8 +287,7 @@ class ContinuousGenerator:
             try:
                 item = self._run_prefill(req)
             except Exception as exc:
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                self._fail_request(req, exc)
                 continue
             # Bounded put with a running check: if the decode loop already
             # exited, don't block forever on a full queue.
@@ -283,8 +299,8 @@ class ContinuousGenerator:
                     break
                 except queue.Full:
                     continue
-            if not placed and not req.future.done():
-                req.future.set_exception(RuntimeError("scheduler stopped"))
+            if not placed:
+                self._fail_request(req, RuntimeError("scheduler stopped"))
         # Shutdown: fail whatever never got prefilled — a dropped future
         # would hang its caller for the full result() timeout.
         while True:
@@ -292,8 +308,8 @@ class ContinuousGenerator:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if req is not None and not req.future.done():
-                req.future.set_exception(RuntimeError("scheduler stopped"))
+            if req is not None:
+                self._fail_request(req, RuntimeError("scheduler stopped"))
         try:
             self._ready.put_nowait(None)  # propagate shutdown to decode loop
         except queue.Full:
@@ -340,7 +356,26 @@ class ContinuousGenerator:
         self._row_emitted[row] = [first_tok]
         self._done[row] = (req.eos_id >= 0 and first_tok == req.eos_id)
         self._stats["admitted"] += 1
+        self._push_stream(row, req)  # first token flushes at admission
         self._maybe_complete(row)
+
+    def _visible_tokens(self, row: int, req: _Request) -> List[int]:
+        """The request's client-visible tokens so far: budget-capped and
+        EOS-truncated (EOS excluded) — one definition shared by the final
+        result and the streaming deltas so a stream never shows a token the
+        result would retract."""
+        toks = self._row_emitted[row][:req.max_new]
+        if req.eos_id >= 0 and req.eos_id in toks:
+            toks = toks[:toks.index(req.eos_id)]
+        return toks
+
+    def _push_stream(self, row: int, req: _Request) -> None:
+        if req.stream is None:
+            return
+        vis = self._visible_tokens(row, req)
+        if len(vis) > req.streamed:
+            req.stream.put(vis[req.streamed:])
+            req.streamed = len(vis)
 
     def _maybe_complete(self, row: int) -> None:
         req = self._row_req[row]
@@ -351,10 +386,11 @@ class ContinuousGenerator:
         budget = len(emitted) >= req.max_new
         out_of_cache = int(self._pos[row]) >= self.max_seq - 1
         if hit_eos or budget or out_of_cache or self._done[row]:
-            toks = emitted[:req.max_new]
-            if req.eos_id >= 0 and req.eos_id in toks:
-                toks = toks[:toks.index(req.eos_id)]
+            toks = self._visible_tokens(row, req)
+            self._push_stream(row, req)
             req.future.set_result(toks)
+            if req.stream is not None:
+                req.stream.put(None)  # end of stream
             self._row_req[row] = None
             self._row_emitted[row] = []
             self._done[row] = True
@@ -369,8 +405,8 @@ class ContinuousGenerator:
         silently kill the daemon and hang all future /generate calls —
         ADVICE round 1, scheduler.py:310)."""
         for r, req in enumerate(self._row_req):
-            if req is not None and not req.future.done():
-                req.future.set_exception(exc)
+            if req is not None:
+                self._fail_request(req, exc)
             self._row_req[r] = None
             self._row_emitted[r] = []
         self._pos[:] = 0
@@ -405,7 +441,7 @@ class ContinuousGenerator:
                 except Exception as exc:
                     # Row insertion donates the shared cache — treat any
                     # admit failure as a device-state loss.
-                    item[0].future.set_exception(exc)
+                    self._fail_request(item[0], exc)
                     self._recover(exc)
                     break
             if all(r is None for r in self._row_req):
@@ -427,6 +463,14 @@ class ContinuousGenerator:
                     jnp.asarray(self._done), jnp.asarray(self._seeds),
                     jnp.asarray(self._temps), jnp.asarray(self._topps),
                     jnp.asarray(eos_vec))
+                # Start all four host copies together — on a high-latency
+                # link, four sequential blocking reads would pay four round
+                # trips per chunk.
+                for dv in (tok, pos, done, toks):
+                    try:
+                        dv.copy_to_host_async()
+                    except AttributeError:
+                        pass
                 # np.array (copy): np.asarray of a jax.Array is read-only
                 # and the admit path mutates these vectors in place.
                 self._tok = np.array(tok)
@@ -445,4 +489,5 @@ class ContinuousGenerator:
                 if need > 0:
                     self._row_emitted[r].extend(
                         int(t) for t in toks_host[r, :need])
+                self._push_stream(r, req)  # fresh tokens flush per chunk
                 self._maybe_complete(r)

@@ -46,6 +46,8 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     server = JsonHttpServer(config.port)
     server.route("POST", "/infer", lambda body: (200, worker.handle_infer_raw(body)))
     server.route("POST", "/generate", lambda body: (200, worker.handle_generate(body)))
+    server.route("POST", "/generate/stream",
+                 lambda body: (200, worker.handle_generate_stream(body)))
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
     _print_worker_banner(worker, config)
     server.start(background=background)
@@ -59,6 +61,8 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     server = JsonHttpServer(config.port)
     server.route("POST", "/infer", lambda body: (200, gateway.route_request_raw(body)))
     server.route("POST", "/generate", lambda body: (200, gateway.route_generate(body)))
+    server.route("POST", "/generate/stream",
+                 lambda body: (200, gateway.route_generate_stream(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
@@ -185,6 +189,8 @@ def serve_combined(
     routes = {}
     routes[("POST", "/infer")] = lambda body: (200, gateway.route_request_raw(body))
     routes[("POST", "/generate")] = lambda body: (200, gateway.route_generate(body))
+    routes[("POST", "/generate/stream")] = (
+        lambda body: (200, gateway.route_generate_stream(body)))
     routes[("GET", "/stats")] = lambda _body: (200, gateway.get_stats())
     # Lane health is addressable through the gateway process in combined mode.
     for w in workers:
@@ -309,12 +315,23 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
         try:
             parsed = _json.loads(body) if method == "POST" else None
             status, payload = handler(parsed)
+            if not isinstance(payload, (bytes, bytearray)):
+                if (hasattr(payload, "__iter__")
+                        and not isinstance(payload, (dict, list, str))):
+                    # SSE iterator (/generate/stream): the C++ front
+                    # replies with one complete buffer, so the events ship
+                    # as a single SSE-formatted body — same wire contract,
+                    # no incremental flush (use the python front or a
+                    # worker port for true streaming granularity). Drained
+                    # INSIDE this try: an iterator error must become a
+                    # 500 response, never escape into the C++ callback.
+                    payload = b"".join(payload)
+                else:
+                    payload = _json.dumps(payload).encode()
         except (KeyError, ValueError, TypeError) as exc:
             return 400, _json.dumps({"error": str(exc)}).encode()
         except Exception as exc:
             return 500, _json.dumps({"error": str(exc)}).encode()
-        if not isinstance(payload, (bytes, bytearray)):
-            payload = _json.dumps(payload).encode()
         return status, payload
 
     front = NativeHttpFront(port, fallback)

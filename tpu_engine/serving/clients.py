@@ -58,6 +58,16 @@ class LocalWorkerClient:
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
 
+    def generate_stream(self, payload: dict):
+        """SSE event-chunk iterator (in-process: the worker's iterator
+        passes straight through — no proxy buffering)."""
+        try:
+            return self.worker.handle_generate_stream(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def health(self) -> dict:
         return self.worker.get_health()
 
@@ -166,6 +176,20 @@ class HttpWorkerClient:
     def generate(self, payload: dict) -> dict:
         return self._request("POST", "/generate", payload,
                              timeout_s=self._gen_timeout)
+
+    def generate_stream(self, payload: dict):
+        """Streaming across an HTTP hop degrades to one terminal event
+        (the blocking /generate result re-framed as SSE): multi-host
+        deployments keep the wire contract; per-chunk streaming granularity
+        is a combined-mode (in-process lane) property."""
+        from tpu_engine.serving.http import sse_event
+
+        result = self.generate(payload)
+
+        def events():
+            yield sse_event({"tokens": result["tokens"]})
+            yield sse_event({"done": True, **result})
+        return events()
 
     def health(self) -> dict:
         return self._request("GET", "/health")

@@ -122,6 +122,14 @@ class Gateway:
         breaker-gated, ring-order failover."""
         return self._route(payload, op="generate")
 
+    def route_generate_stream(self, payload: dict):
+        """Streaming variant: same routing; the selected lane's SSE
+        event-chunk iterator is handed back for chunked transfer. Breaker
+        accounting happens at admission (iterator creation) — a mid-stream
+        failure terminates that stream with an error event instead of
+        failing over (tokens already sent can't be replayed elsewhere)."""
+        return self._route(payload, op="generate_stream")
+
     def _route(self, payload: dict, op: str) -> dict:
         with self._lock:
             self._total_requests += 1

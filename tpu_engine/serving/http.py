@@ -58,6 +58,13 @@ class _TrackingServer(ThreadingHTTPServer):
                 pass
 
 
+def sse_event(payload: dict) -> bytes:
+    """One Server-Sent-Events frame. The single definition of the SSE wire
+    format — worker streams, cross-host degraded streams, and any future
+    framing change (event:/id: lines) all go through here."""
+    return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+
 class JsonHttpServer:
     def __init__(self, port: int, host: str = "0.0.0.0"):
         self._routes: Dict[Tuple[str, str], Handler] = {}
@@ -85,8 +92,16 @@ class JsonHttpServer:
                 pass
 
             def _respond(self, status: int, payload) -> None:
-                # Handlers may return pre-serialized bytes (hot /infer path)
-                # or a dict.
+                # Handlers may return pre-serialized bytes (hot /infer
+                # path), a dict, or an ITERATOR of byte chunks (streaming
+                # SSE, e.g. /generate/stream) sent with chunked
+                # transfer-encoding.
+                if (not isinstance(payload, (bytes, bytearray, dict, list,
+                                             str, int, float, bool,
+                                             type(None)))
+                        and hasattr(payload, "__iter__")):
+                    self._respond_stream(status, payload)
+                    return
                 body = (payload if isinstance(payload, (bytes, bytearray))
                         else json.dumps(payload).encode())
                 self.send_response(status)
@@ -94,6 +109,39 @@ class JsonHttpServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _respond_stream(self, status: int, chunks) -> None:
+                """HTTP/1.1 chunked transfer of an event-chunk iterator;
+                each chunk flushes immediately (SSE consumers read
+                incrementally). An iterator error after the headers are out
+                cannot become a 500 — the connection closes WITHOUT the
+                terminal 0-chunk so clients see the truncation
+                (IncompleteRead) instead of a well-formed-but-short
+                stream."""
+                self.send_response(status)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(b"%x\r\n" % len(chunk))
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+                except Exception:
+                    # Never re-raise into _dispatch (a second response would
+                    # corrupt the chunked framing); drop the connection so
+                    # the truncation is detectable.
+                    self.close_connection = True
+                    return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass  # client went away mid-stream
 
             def _dispatch(self, method: str) -> None:
                 handler = routes.get((method, self.path.split("?", 1)[0]))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
 import time
 from dataclasses import dataclass
@@ -32,6 +33,7 @@ import numpy as np
 
 from tpu_engine.core.lru_cache import LRUCache
 from tpu_engine.runtime.batch_processor import BatchProcessor
+from tpu_engine.serving.http import sse_event
 from tpu_engine.utils.config import WorkerConfig
 from tpu_engine.utils.tracing import SpanRecorder
 
@@ -447,6 +449,76 @@ class WorkerNode:
             "node_id": self.node_id,
             "generate_time_us": result.generate_time_us,
         }
+
+    def handle_generate_stream(self, request: dict):
+        """Streaming /generate: returns an iterator of SSE event byte
+        chunks. Under the continuous scheduler tokens stream at
+        iteration-level granularity (fresh tokens after each decode chunk);
+        under the batch scheduler the full result arrives as one event —
+        same wire contract, coarser cadence. Events:
+
+          data: {"tokens": [..]}          incremental tokens
+          data: {"done": true, "request_id", "tokens", "node_id",
+                 "generate_time_us"}      terminal summary (or "error")
+        """
+        if self.generator is None:
+            raise ValueError(
+                f"model '{self.config.model}' does not support generation")
+        if self._injected_fault is not None:
+            raise RuntimeError(f"fault injected: {self._injected_fault}")
+        # Validate required fields EAGERLY — after the generator is handed
+        # back, the response is already committed to a 200 SSE stream, and a
+        # bad request must be a 400 like the blocking endpoint's.
+        request_id = request["request_id"]
+        prompt = [int(t) for t in request["prompt_tokens"]]
+        if not self._continuous:
+            def one_shot():
+                try:
+                    result = self.handle_generate(request)
+                except Exception as exc:  # terminal error event, stream ends
+                    yield sse_event({"done": True, "error": str(exc)[:300]})
+                    return
+                yield sse_event({"tokens": result["tokens"]})
+                yield sse_event({"done": True, **result})
+            return one_shot()
+
+        with self._counter_lock:
+            self._total_requests += 1
+        q: "queue.Queue" = queue.Queue()
+        t0 = time.perf_counter()
+        fut = self.generator.submit(
+            prompt,
+            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            eos_id=int(request.get("eos_id", -1)),
+            temperature=float(request.get("temperature", 0.0)),
+            seed=int(request.get("seed", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            stream=q)
+
+        def events():
+            while True:
+                try:
+                    item = q.get(timeout=600)
+                except queue.Empty:
+                    yield sse_event({"done": True,
+                                     "error": "generation stalled (no "
+                                              "tokens for 600s)"})
+                    return
+                if item is None:
+                    break
+                yield sse_event({"tokens": item})
+            elapsed_us = int((time.perf_counter() - t0) * 1e6)
+            try:
+                tokens = fut.result(timeout=10)
+            except Exception as exc:
+                yield sse_event({"done": True, "error": str(exc)[:300]})
+                return
+            self.tracer.record(request_id, "generate_stream", self.node_id,
+                               elapsed_us)
+            yield sse_event({"done": True, "request_id": request_id,
+                             "tokens": tokens, "node_id": self.node_id,
+                             "generate_time_us": elapsed_us})
+        return events()
 
     def _process_gen_batch(self, items: List[_GenItem]) -> List[_GenResult]:
         """Group by eos_id (a compile-time scalar of the decode executable);
