@@ -161,3 +161,37 @@ def test_seq_parallel_transformer_forward(seq_mesh):
     out = fwd(params, tokens_s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_seq_parallel_llama_forward(seq_mesh):
+    """Long-context llama dialect: rope + rmsnorm + swiglu + GQA through
+    ring attention on the seq mesh — rotary phases are applied before the
+    ring (in _project_qkv) and the grouped KV heads are expanded for the
+    rotation, so the sharded forward must match single-device exactly."""
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_engine.models.transformer import (
+        TransformerConfig, transformer_apply, transformer_init)
+
+    cfg = TransformerConfig(vocab=128, n_layers=2, d_model=32, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=64, causal=True,
+                            norm="rmsnorm", pos="rope", mlp_act="swiglu")
+    params = transformer_init(jax.random.PRNGKey(2), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 0, 128)
+
+    ref = transformer_apply(params, tokens, cfg, dtype=jnp.float32)
+
+    ring = functools.partial(ring_attention, mesh=seq_mesh, axis_name="seq")
+    tokens_s = jax.device_put(tokens, NamedSharding(seq_mesh, P(None, "seq")))
+
+    @jax.jit
+    def fwd(params, tokens):
+        return transformer_apply(params, tokens, cfg, dtype=jnp.float32,
+                                 attn_fn=lambda q, k, v, causal, mask:
+                                 ring(q, k, v, causal=causal, kv_mask=mask))
+
+    out = fwd(params, tokens_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

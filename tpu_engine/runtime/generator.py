@@ -43,6 +43,17 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def start_host_copies(*arrays) -> None:
+    """Kick off device→host copies for several arrays together — the
+    subsequent blocking reads then share one link round trip instead of
+    paying one each (matters on the high-latency device tunnel)."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except AttributeError:
+            pass
+
+
 def _sample(logits, seeds, positions, temperature, top_p=None):
     """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p (B,).
 
@@ -302,11 +313,7 @@ class Generator:
             caches, tok, done, toks = decode(
                 self.params, caches, tok, pos, start_dev, done, seeds_dev,
                 temps_dev, topp_dev, eos_dev)
-            for dv in (toks, done):  # one round trip for both host reads
-                try:
-                    dv.copy_to_host_async()
-                except AttributeError:
-                    pass
+            start_host_copies(toks, done)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk
             remaining -= self._step_chunk

@@ -46,7 +46,7 @@ from tpu_engine.models.transformer import (
     transformer_decode_rows,
     transformer_prefill,
 )
-from tpu_engine.runtime.generator import _DTYPES, _sample
+from tpu_engine.runtime.generator import _DTYPES, _sample, start_host_copies
 
 
 @dataclass
@@ -421,6 +421,27 @@ class ContinuousGenerator:
         self._caches = caches
 
     def _loop(self) -> None:
+        try:
+            self._loop_body()
+        finally:
+            # Exit (stop() sentinel or _running flip): fail every in-flight
+            # row and every already-prefilled item still queued — a dropped
+            # future/sentinel would hang its blocking caller or SSE reader.
+            exc = RuntimeError("scheduler stopped")
+            for r, req in enumerate(self._row_req):
+                if req is not None:
+                    self._fail_request(req, exc)
+                    self._row_req[r] = None
+                    self._row_emitted[r] = []
+            while True:
+                try:
+                    item = self._ready.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._fail_request(item[0], exc)
+
+    def _loop_body(self) -> None:
         while self._running:
             # Admit as many prefilled requests as there are free rows; block
             # briefly when completely idle.
@@ -463,14 +484,7 @@ class ContinuousGenerator:
                     jnp.asarray(self._done), jnp.asarray(self._seeds),
                     jnp.asarray(self._temps), jnp.asarray(self._topps),
                     jnp.asarray(eos_vec))
-                # Start all four host copies together — on a high-latency
-                # link, four sequential blocking reads would pay four round
-                # trips per chunk.
-                for dv in (tok, pos, done, toks):
-                    try:
-                        dv.copy_to_host_async()
-                    except AttributeError:
-                        pass
+                start_host_copies(tok, pos, done, toks)
                 # np.array (copy): np.asarray of a jax.Array is read-only
                 # and the admit path mutates these vectors in place.
                 self._tok = np.array(tok)

@@ -185,6 +185,16 @@ def serve_combined(
         # XLA's compile cache, so this is ~one compile per bucket.
         for w in workers:
             w.engine.warmup()
+            if w.generator is not None:
+                # Also compile the generation lane (smallest prompt bucket
+                # + one decode chunk) — a cold /generate otherwise pays
+                # tens of seconds of XLA compiles on its first request.
+                try:
+                    w.handle_generate({"request_id": "_warmup",
+                                       "prompt_tokens": [1, 2, 3],
+                                       "max_new_tokens": 2})
+                except Exception as exc:  # warmup is best-effort
+                    print(f"generate warmup skipped: {exc}")
     gateway = Gateway(workers, gateway_config)
     routes = {}
     routes[("POST", "/infer")] = lambda body: (200, gateway.route_request_raw(body))

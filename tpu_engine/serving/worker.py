@@ -213,6 +213,7 @@ class WorkerNode:
                         self.engine.spec, params=self.engine.params,
                         dtype=self.config.dtype,
                         n_slots=self.config.gen_max_batch_size,
+                        step_chunk=self.config.gen_step_chunk,
                         device=getattr(engine, "_device", None))
                 else:
                     from tpu_engine.runtime.generator import Generator
@@ -220,6 +221,7 @@ class WorkerNode:
                     self.generator = Generator(
                         self.engine.spec, params=self.engine.params,
                         dtype=self.config.dtype,
+                        step_chunk=self.config.gen_step_chunk,
                         device=getattr(engine, "_device", None))
                     self._gen_processor = BatchProcessor(
                         self.config.gen_max_batch_size,
@@ -466,15 +468,25 @@ class WorkerNode:
                 f"model '{self.config.model}' does not support generation")
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
-        # Validate required fields EAGERLY — after the generator is handed
+        # Parse/validate EVERY field EAGERLY — after the iterator is handed
         # back, the response is already committed to a 200 SSE stream, and a
-        # bad request must be a 400 like the blocking endpoint's.
+        # bad request must be a 400 like the blocking endpoint's (on both
+        # scheduler paths).
         request_id = request["request_id"]
         prompt = [int(t) for t in request["prompt_tokens"]]
+        max_new = int(request.get("max_new_tokens", 32))
+        eos_id = int(request.get("eos_id", -1))
+        temperature = float(request.get("temperature", 0.0))
+        seed = int(request.get("seed", 0))
+        top_p = float(request.get("top_p", 1.0))
+        normalized = {"request_id": request_id, "prompt_tokens": prompt,
+                      "max_new_tokens": max_new, "eos_id": eos_id,
+                      "temperature": temperature, "seed": seed,
+                      "top_p": top_p}
         if not self._continuous:
             def one_shot():
                 try:
-                    result = self.handle_generate(request)
+                    result = self.handle_generate(normalized)
                 except Exception as exc:  # terminal error event, stream ends
                     yield sse_event({"done": True, "error": str(exc)[:300]})
                     return
@@ -487,13 +499,8 @@ class WorkerNode:
         q: "queue.Queue" = queue.Queue()
         t0 = time.perf_counter()
         fut = self.generator.submit(
-            prompt,
-            max_new_tokens=int(request.get("max_new_tokens", 32)),
-            eos_id=int(request.get("eos_id", -1)),
-            temperature=float(request.get("temperature", 0.0)),
-            seed=int(request.get("seed", 0)),
-            top_p=float(request.get("top_p", 1.0)),
-            stream=q)
+            prompt, max_new_tokens=max_new, eos_id=eos_id,
+            temperature=temperature, seed=seed, top_p=top_p, stream=q)
 
         def events():
             while True:

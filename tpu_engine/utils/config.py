@@ -36,6 +36,12 @@ class WorkerConfig:
     # >1 overlaps host↔device round-trips; 1 = reference-style lockstep.
     pipeline_depth: int = 4
     gen_max_batch_size: int = 8         # decode-lane batcher (transformers)
+    # Decode steps per compiled chunk (host syncs once per chunk). Larger
+    # chunks amortize the per-dispatch link round-trip — on the measured
+    # ~15-70 ms/op tunnel, 16 steps/chunk roughly halves decode overhead vs
+    # 8 — at the cost of admission granularity (requests join the
+    # continuous batch between chunks).
+    gen_step_chunk: int = 16
     # "batch": collect a batch, decode it to completion (generator.py).
     # "continuous": iteration-level scheduling — requests join/leave the
     # running decode batch between chunks (scheduler.py). Continuous is the
