@@ -54,9 +54,9 @@ def _post(hostport: str, path: str, body: dict, timeout=30.0):
         conn.close()
 
 
-def _strip(url: str) -> str:
+def _strip(url: str, default_port: int = 8000) -> str:
     u = url.split("://", 1)[-1].split("/", 1)[0]
-    return u if ":" in u else u + ":8000"
+    return u if ":" in u else f"{u}:{default_port}"
 
 
 def main() -> int:
@@ -65,7 +65,9 @@ def main() -> int:
     ap.add_argument("--workers", nargs="*", default=[])
     args = ap.parse_args()
     gw = _strip(args.gateway)
-    workers = [w if ":" in w else w + ":8080" for w in args.workers]
+    # Accept both bare host:port (reference diagnostics.sh style) and full
+    # http:// URLs — same normalization as the gateway address.
+    workers = [_strip(w, default_port=8080) for w in args.workers]
     combined = not workers
 
     # 1. process check (reference :9-24)

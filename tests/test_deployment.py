@@ -129,6 +129,26 @@ def test_reference_benchmark_runs_unmodified():
         _terminate(server)
 
 
+def test_diagnostics_six_steps_pass_against_live_server():
+    """diagnostics.py (the reference diagnostics.sh's 6 checks ported) must
+    pass 6/6 against a live combined server and exit 0."""
+    port = _free_port()
+    server = _spawn(["serve", "--model", "mlp", "--port", str(port)],
+                    _child_env())
+    try:
+        _wait_http(port, "/stats")
+        out = subprocess.run(
+            [sys.executable, "diagnostics.py",
+             "--gateway", f"http://127.0.0.1:{port}",
+             "--workers", f"127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=180, cwd=REPO,
+            env=_child_env())
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "6/6 checks passed" in out.stdout, out.stdout
+    finally:
+        _terminate(server)
+
+
 def _spread_until_both(pg: int, prefix: str, cap: int = 400,
                        min_each: int = 1) -> dict:
     """POST distinct ids until both nodes have served >= min_each; returns

@@ -109,6 +109,59 @@ def test_graph_matches_torch_golden(onnx_file):
     np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
 
 
+def test_extended_op_subset_matches_torch(tmp_path):
+    """The advertised ops beyond the ResNet profile — AveragePool, Sigmoid,
+    Sub, Clip, Mul, Div, Transpose, Reshape, Concat, MatMul — golden-checked
+    against the equivalent torch eager computation."""
+    torch.manual_seed(2)
+    w96 = torch.randn(96, 10)
+
+    def torch_forward(x):
+        a = torch.nn.functional.avg_pool2d(x, 2, 2)   # (N, 3, 4, 4)
+        s = torch.sigmoid(a)
+        c = torch.clamp(s - 0.25, 0.0, 0.9)           # Sub + Clip
+        m = c * a                                     # Mul
+        d = m / 2.0                                   # Div
+        t = d.permute(0, 2, 3, 1)                     # Transpose → NHWC
+        flat = t.reshape(t.shape[0], -1)              # (N, 48)
+        cat = torch.cat([flat, flat], 1)              # (N, 96)
+        return cat @ w96                              # MatMul
+
+    nodes = [
+        ow.node("AveragePool", ["input"], ["a"],
+                [ow.attr_ints("kernel_shape", [2, 2]),
+                 ow.attr_ints("strides", [2, 2])]),
+        ow.node("Sigmoid", ["a"], ["s"]),
+        ow.node("Sub", ["s", "q"], ["sub"]),
+        ow.node("Clip", ["sub"], ["c"],
+                [ow.attr_float("min", 0.0), ow.attr_float("max", 0.9)]),
+        ow.node("Mul", ["c", "a"], ["m"]),
+        ow.node("Div", ["m", "h"], ["d"]),
+        ow.node("Transpose", ["d"], ["t"],
+                [ow.attr_ints("perm", [0, 2, 3, 1])]),
+        ow.node("Reshape", ["t", "flatshape"], ["flat"]),
+        ow.node("Concat", ["flat", "flat"], ["cat"],
+                [ow.attr_int("axis", 1)]),
+        ow.node("MatMul", ["cat", "w"], ["output"]),
+    ]
+    inits = {"q": np.full((1,), 0.25, np.float32),
+             "h": np.full((1,), 2.0, np.float32),
+             "flatshape": np.asarray([0, -1], np.int64),
+             "w": w96.numpy()}
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", 3, 8, 8]),
+                    ow.value_info("output", ["N", 10]))
+    path = str(tmp_path / "ops.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    spec, params = build_onnx_model(path)
+    x = np.random.default_rng(8).standard_normal((3, 3, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        golden = torch_forward(torch.from_numpy(x)).numpy()
+    out = np.asarray(spec.apply(params, x))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
 def test_reshape_from_initializer_and_negative_flatten(tmp_path):
     """Reshape's target shape usually arrives as an int64 initializer in
     real exports — it must resolve statically (not as a traced param) and a

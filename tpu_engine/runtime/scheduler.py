@@ -257,6 +257,15 @@ class ContinuousGenerator:
         self._queue.put(None)  # wakes prefill; forwarded to decode via _ready
         self._prefill_thread.join(timeout=10)
         self._thread.join(timeout=10)
+        # Post-join sweep: a prefilled item whose put landed after the
+        # decode thread's exit drain would otherwise strand its caller.
+        while True:
+            try:
+                item = self._ready.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._fail_request(item[0], RuntimeError("scheduler stopped"))
 
     # -- scheduler loop --------------------------------------------------------
 
@@ -424,9 +433,13 @@ class ContinuousGenerator:
         try:
             self._loop_body()
         finally:
-            # Exit (stop() sentinel or _running flip): fail every in-flight
-            # row and every already-prefilled item still queued — a dropped
-            # future/sentinel would hang its blocking caller or SSE reader.
+            # Exit (stop() sentinel, _running flip, or the loop body itself
+            # raising): mark the scheduler dead FIRST so submit() fails fast
+            # and the prefill thread's bounded put stops retrying, then fail
+            # every in-flight row and every already-prefilled item still
+            # queued — a dropped future/sentinel would hang its blocking
+            # caller or SSE reader.
+            self._running = False
             exc = RuntimeError("scheduler stopped")
             for r, req in enumerate(self._row_req):
                 if req is not None:

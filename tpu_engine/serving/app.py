@@ -189,10 +189,11 @@ def serve_combined(
                 # Also compile the generation lane (smallest prompt bucket
                 # + one decode chunk) — a cold /generate otherwise pays
                 # tens of seconds of XLA compiles on its first request.
+                # Straight to the generator: the worker's request path would
+                # pollute the reference-exact /health counters and the trace
+                # with a phantom request.
                 try:
-                    w.handle_generate({"request_id": "_warmup",
-                                       "prompt_tokens": [1, 2, 3],
-                                       "max_new_tokens": 2})
+                    w.generator.generate([[1, 2, 3]], max_new_tokens=2)
                 except Exception as exc:  # warmup is best-effort
                     print(f"generate warmup skipped: {exc}")
     gateway = Gateway(workers, gateway_config)

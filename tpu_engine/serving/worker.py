@@ -563,7 +563,7 @@ class WorkerNode:
             ext_total, ext_hits = self.external_counters()
             total += ext_total
             hits += ext_hits
-        return {
+        out = {
             "healthy": self._injected_fault is None,
             "node_id": self.node_id,
             "total_requests": total,
@@ -572,6 +572,14 @@ class WorkerNode:
             "cache_hit_rate": self.cache.hit_rate(),
             "batch_processor": m.as_dict(),
         }
+        # Additive (reference schema untouched — its parsers ignore extra
+        # keys): decode-lane scheduler counters for transformer workers.
+        if self.generator is not None and hasattr(self.generator, "stats"):
+            try:
+                out["generator"] = self.generator.stats()
+            except Exception:
+                pass
+        return out
 
     def stop(self) -> None:
         self.batch_processor.stop()
