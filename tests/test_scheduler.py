@@ -149,3 +149,42 @@ def test_worker_continuous_scheduler(spec, params):
         assert w.generator.stats()["completed"] >= 6
     finally:
         w.stop()
+
+
+def test_stop_under_load_resolves_every_future():
+    """stop() mid-flight must resolve EVERY submitted future promptly —
+    either with tokens or 'scheduler stopped' — and drain every stream
+    sentinel; nothing may hang for the full result timeout."""
+    import queue as _queue
+    import time
+
+    from tpu_engine.models.registry import create_model
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    spec = create_model("gpt2-small-test")
+    sched = ContinuousGenerator(spec, n_slots=2, step_chunk=2,
+                                dtype="float32")
+    streams = [_queue.Queue() for _ in range(6)]
+    futs = [sched.submit([1 + i, 2, 3], max_new_tokens=40, seed=i,
+                         stream=streams[i]) for i in range(6)]
+    time.sleep(0.3)  # let some admit/decode happen
+    t0 = time.time()
+    sched.stop()
+    outcomes = []
+    for f in futs:
+        try:
+            outcomes.append(("ok", f.result(timeout=15)))
+        except RuntimeError as exc:
+            outcomes.append(("stopped", str(exc)))
+    assert time.time() - t0 < 30, "stop() left futures hanging"
+    assert len(outcomes) == 6
+    # Every stream must terminate with the None sentinel.
+    for q in streams:
+        items = []
+        while True:
+            try:
+                items.append(q.get(timeout=5))
+            except _queue.Empty:
+                raise AssertionError("stream never received its sentinel")
+            if items[-1] is None:
+                break

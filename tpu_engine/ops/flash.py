@@ -179,11 +179,13 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere.
 
     Measured on-chip (v5lite-1, causal bf16, amortized forced-sync timing,
-    this round): parity with the XLA-fused path at S≤2048 (e.g. B4 S2048
-    H16 D64: 32.5 vs 33.5 ms), 1.18× faster at B1 S4096, and it keeps
-    scaling where XLA cannot compile at all — the fused XLA path OOMs at
-    S8192 (44 GB of S² score temps vs 15.75 GB HBM) while this kernel runs
-    it in 219 ms/iter with O(S·D) memory.
+    this round, with the pre-streamed-K revision of this kernel — the
+    streamed-K restructure is interpreter-exact but awaits on-chip re-timing,
+    BENCH_r04_builder.json): parity with the XLA-fused path at S≤2048
+    (e.g. B4 S2048 H16 D64: 32.5 vs 33.5 ms), 1.18× faster at B1 S4096,
+    and it keeps scaling where XLA cannot compile at all — the fused XLA
+    path OOMs at S8192 (44 GB of S² score temps vs 15.75 GB HBM) while
+    this kernel runs it in 219 ms/iter with O(S·D) memory.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
