@@ -138,3 +138,47 @@ def test_top_p_batch_invariant(gen):
                            temperature=[0.7, 0.9], seed=[4, 11],
                            top_p=[0.5, 0.8])[1]
     assert alone == batched
+
+
+def test_top_k_one_equals_greedy():
+    """top_k=1 collapses categorical sampling to argmax at any temperature,
+    on both scheduler paths and through the /generate wire field."""
+    import jax
+
+    from tpu_engine.models.registry import (
+        create_model, _ensure_builtin_models_imported)
+    from tpu_engine.runtime.generator import Generator
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    _ensure_builtin_models_imported()
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    prompts = [[5, 3, 8], [2, 9]]
+
+    gen = Generator(spec, params=params, dtype="float32", batch_buckets=(2,))
+    greedy = gen.generate(prompts, max_new_tokens=6, temperature=0.0)
+    topk1 = gen.generate(prompts, max_new_tokens=6, temperature=1.7,
+                         seed=[1, 2], top_k=1)
+    assert topk1 == greedy
+
+    sched = ContinuousGenerator(spec, params=params, dtype="float32",
+                                n_slots=2, step_chunk=4)
+    try:
+        cont = sched.generate(prompts, max_new_tokens=6, temperature=1.7,
+                              seed=[1, 2], top_k=1)
+    finally:
+        sched.stop()
+    assert cont == greedy
+
+    w = WorkerNode(WorkerConfig(model="gpt2-small-test", dtype="float32"),
+                   engine=None)
+    try:
+        resp = w.handle_generate({"request_id": "k1",
+                                  "prompt_tokens": prompts[0],
+                                  "max_new_tokens": 6, "temperature": 1.7,
+                                  "seed": 1, "top_k": 1})
+        assert resp["tokens"] == greedy[0]
+    finally:
+        w.stop()

@@ -54,32 +54,42 @@ def start_host_copies(*arrays) -> None:
             pass
 
 
-def _sample(logits, seeds, positions, temperature, top_p=None):
-    """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p (B,).
+def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
+    """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p/
+    top_k (B,).
 
-    Greedy where temperature == 0, else categorical (optionally
-    nucleus-filtered to the smallest token set with cumulative probability
-    >= top_p) with key fold_in(PRNGKey(seed_r), position_r) — deterministic
-    per (seed, position) so co-batching and bucketing never change a
-    request's tokens."""
+    Greedy where temperature == 0, else categorical — optionally filtered
+    to the nucleus (smallest token set with cumulative probability >=
+    top_p) and/or the top_k highest-logit tokens (0 = disabled) — with key
+    fold_in(PRNGKey(seed_r), position_r): deterministic per
+    (seed, position) so co-batching and bucketing never change a request's
+    tokens."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if top_p is None:
         top_p = jnp.ones(logits.shape[:1], jnp.float32)
+    if top_k is None:
+        top_k = jnp.zeros(logits.shape[:1], jnp.int32)
 
-    def row(key_seed, pos, lg, t, p):
+    def row(key_seed, pos, lg, t, p, k_limit):
         key = jax.random.fold_in(jax.random.PRNGKey(key_seed), pos)
         lg = lg / jnp.maximum(t, 1e-6)
+        sorted_lg = jnp.sort(lg)[::-1]
         # Nucleus filter: keep the top tokens whose cumulative softmax mass
         # reaches p (always at least one). p >= 1 keeps everything.
-        sorted_lg = jnp.sort(lg)[::-1]
         cum = jnp.cumsum(jax.nn.softmax(sorted_lg))
         k = jnp.minimum(jnp.sum(cum < p) + 1, lg.shape[-1])
+        # top_k caps the kept set (0 disables). NOTE: when both filters
+        # are active this is min-of-counts over the UNFILTERED distribution
+        # — HF instead renormalizes after top_k before applying top_p, so
+        # its kept set can be strictly smaller; don't expect draw-level HF
+        # parity with both filters on.
+        k = jnp.where(k_limit > 0, jnp.minimum(k, k_limit), k)
         thresh = sorted_lg[k - 1]
         lg = jnp.where(lg >= thresh, lg, -jnp.inf)
         return jax.random.categorical(key, lg)
 
     sampled = jax.vmap(row)(seeds, positions, logits, temperature,
-                            top_p).astype(jnp.int32)
+                            top_p, top_k).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
@@ -180,9 +190,10 @@ class Generator:
             cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
             def decode_chunk(params, caches, tok, pos0, start, done, seeds,
-                             temperature, top_p, eos_id):
+                             temperature, top_p, top_k, eos_id):
                 """Scan `chunk` decode steps. tok: (B,) last emitted token;
-                seeds/temperature/top_p: per-row (B,) sampling params."""
+                seeds/temperature/top_p/top_k: per-row (B,) sampling
+                params."""
                 def body(carry, i):
                     caches, tok, done = carry
                     logits, caches = transformer_decode_step(
@@ -192,7 +203,7 @@ class Generator:
                     # pos0+i+1-start in its own sequence — fold that in so
                     # the stream is batch- and bucket-independent.
                     nxt = _sample(logits, seeds, pos0 + i + 1 - start,
-                                  temperature, top_p)
+                                  temperature, top_p, top_k)
                     nxt = jnp.where(done, eos_id, nxt)
                     done = done | (nxt == eos_id)
                     return (caches, nxt, done), nxt
@@ -214,6 +225,7 @@ class Generator:
         temperature: Union[float, Sequence[float]] = 0.0,
         seed: Union[int, Sequence[int]] = 0,
         top_p: Union[float, Sequence[float]] = 1.0,
+        top_k: Union[int, Sequence[int]] = 0,
     ) -> List[List[int]]:
         """Batched generation. Returns per-prompt generated token lists
         (EOS-truncated, EOS not included). `eos_id=-1` disables early stop.
@@ -232,21 +244,27 @@ class Generator:
                  else [int(s) for s in seed])
         top_ps = ([float(top_p)] * n if np.isscalar(top_p)
                   else [float(p) for p in top_p])
-        if len(temps) != n or len(seeds) != n or len(top_ps) != n:
-            raise ValueError("temperature/seed/top_p sequence length != n prompts")
+        top_ks = ([int(top_k)] * n if np.isscalar(top_k)
+                  else [int(k) for k in top_k])
+        top_ks = [max(0, min(k, 0x7FFFFFFF)) for k in top_ks]
+        if (len(temps) != n or len(seeds) != n or len(top_ps) != n
+                or len(top_ks) != n):
+            raise ValueError(
+                "temperature/seed/top_p/top_k sequence length != n prompts")
         out: List[List[int]] = []
         max_bb = self._batch_buckets[-1]
         for i in range(0, n, max_bb):
             out.extend(self._generate_batch(
                 [list(p) for p in prompts[i:i + max_bb]],
                 max_new_tokens, eos_id, temps[i:i + max_bb],
-                seeds[i:i + max_bb], top_ps[i:i + max_bb]))
+                seeds[i:i + max_bb], top_ps[i:i + max_bb],
+                top_ks[i:i + max_bb]))
         return out
 
     def _generate_batch(self, prompts: List[List[int]], max_new: int,
                         eos_id: int, temps: List[float],
-                        seeds: List[int],
-                        top_ps: List[float]) -> List[List[int]]:
+                        seeds: List[int], top_ps: List[float],
+                        top_ks: List[int]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -286,19 +304,23 @@ class Generator:
         temps_arr = np.zeros((bb,), np.float32)
         seeds_arr = np.zeros((bb,), np.int32)
         topp_arr = np.ones((bb,), np.float32)
+        topk_arr = np.zeros((bb,), np.int32)
+        topk_arr[:n] = top_ks
         temps_arr[:n] = temps
         # Same normalization as the continuous scheduler (& 0x7FFFFFFF):
         # seeds >= 2**31 must sample identically under both gen_scheduler
         # settings (documented seeded-reproducibility contract).
         seeds_arr[:n] = [int(s) & 0x7FFFFFFF for s in seeds]
         topp_arr[:n] = top_ps
-        temps_dev, seeds_dev, topp_dev = put(temps_arr), put(seeds_arr), put(topp_arr)
+        temps_dev, seeds_dev = put(temps_arr), put(seeds_arr)
+        topp_dev, topk_dev = put(topp_arr), put(topk_arr)
         start_dev = put(start)
 
         # First generated token comes from the prefill logits; its logical
         # position in each row is the prompt length pb - start.
         first = _sample(logits, seeds_dev, pb - jnp.asarray(start_dev),
-                        jnp.asarray(temps_dev), jnp.asarray(topp_dev))
+                        jnp.asarray(temps_dev), jnp.asarray(topp_dev),
+                        jnp.asarray(topk_dev))
         done = (first == eos_id)
 
         pieces = [np.asarray(first)[:, None]]
@@ -312,7 +334,7 @@ class Generator:
         while remaining > 0 and pos < self.max_seq:
             caches, tok, done, toks = decode(
                 self.params, caches, tok, pos, start_dev, done, seeds_dev,
-                temps_dev, topp_dev, eos_dev)
+                temps_dev, topp_dev, topk_dev, eos_dev)
             start_host_copies(toks, done)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk

@@ -57,6 +57,7 @@ class _Request:
     temperature: float
     seed: int
     top_p: float
+    top_k: int
     future: Future = field(default_factory=Future)
     # Streaming: freshly-visible tokens are pushed as lists between decode
     # chunks; None is the end-of-stream sentinel (the future then holds the
@@ -114,6 +115,7 @@ class ContinuousGenerator:
         self._seeds = np.zeros((self.n_slots,), np.int32)
         self._temps = np.zeros((self.n_slots,), np.float32)
         self._topps = np.ones((self.n_slots,), np.float32)
+        self._topks = np.zeros((self.n_slots,), np.int32)
         self._done = np.ones((self.n_slots,), bool)          # sampling mask
         self._row_req: List[Optional[_Request]] = [None] * self.n_slots
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
@@ -196,14 +198,14 @@ class ContinuousGenerator:
                 cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
                 def decode_chunk(params, caches, tok, pos, start, done,
-                                 seeds, temps, topps, eos_vec):
+                                 seeds, temps, topps, topks, eos_vec):
                     def body(carry, _):
                         caches, tok, pos, done = carry
                         logits, caches = transformer_decode_rows(
                             params, tok, caches, pos, cfg, dtype=dtype,
                             start_vec=start)
                         nxt = _sample(logits, seeds, pos + 1 - start, temps,
-                                      topps)
+                                      topps, topks)
                         nxt = jnp.where(done, eos_vec, nxt)
                         done = done | (nxt == eos_vec)
                         # Only live rows advance their write position (and
@@ -224,7 +226,7 @@ class ContinuousGenerator:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
-               top_p: float = 1.0, stream=None) -> Future:
+               top_p: float = 1.0, top_k: int = 0, stream=None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel."""
@@ -232,20 +234,22 @@ class ContinuousGenerator:
             raise RuntimeError("scheduler stopped")
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
                        float(temperature), int(seed), float(top_p),
-                       stream=stream)
+                       max(0, min(int(top_k), 0x7FFFFFFF)), stream=stream)
         self._queue.put(req)
         return req.future
 
     def generate(self, prompts, max_new_tokens: int = 32, eos_id: int = -1,
-                 temperature=0.0, seed=0, top_p=1.0) -> List[List[int]]:
+                 temperature=0.0, seed=0, top_p=1.0,
+                 top_k=0) -> List[List[int]]:
         """Blocking convenience over submit() (Generator-compatible)."""
         n = len(prompts)
         temps = [temperature] * n if np.isscalar(temperature) else temperature
         seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
                  else seed)
         topps = [top_p] * n if np.isscalar(top_p) else top_p
+        topks = [top_k] * n if np.isscalar(top_k) else top_k
         futs = [self.submit(p, max_new_tokens, eos_id, temps[i], seeds[i],
-                            topps[i]) for i, p in enumerate(prompts)]
+                            topps[i], topks[i]) for i, p in enumerate(prompts)]
         return [f.result(timeout=600) for f in futs]
 
     def stats(self) -> dict:
@@ -346,7 +350,8 @@ class ContinuousGenerator:
                         jnp.asarray([seed], jnp.int32),
                         jnp.asarray([L], jnp.int32),
                         jnp.asarray([req.temperature], jnp.float32),
-                        jnp.asarray([req.top_p], jnp.float32))
+                        jnp.asarray([req.top_p], jnp.float32),
+                        jnp.asarray([req.top_k], jnp.int32))
         return req, row_caches, int(first[0]), pb, L
 
     def _admit(self, item, row: int) -> None:
@@ -360,6 +365,7 @@ class ContinuousGenerator:
         self._seeds[row] = int(req.seed) & 0x7FFFFFFF
         self._temps[row] = req.temperature
         self._topps[row] = req.top_p
+        self._topks[row] = req.top_k
         self._tok[row] = first_tok
         self._row_req[row] = req
         self._row_emitted[row] = [first_tok]
@@ -496,7 +502,7 @@ class ContinuousGenerator:
                     jnp.asarray(self._pos), jnp.asarray(self._start),
                     jnp.asarray(self._done), jnp.asarray(self._seeds),
                     jnp.asarray(self._temps), jnp.asarray(self._topps),
-                    jnp.asarray(eos_vec))
+                    jnp.asarray(self._topks), jnp.asarray(eos_vec))
                 start_host_copies(tok, pos, done, toks)
                 # np.array (copy): np.asarray of a jax.Array is read-only
                 # and the admit path mutates these vectors in place.

@@ -72,6 +72,7 @@ class _GenItem:
     temperature: float
     seed: int
     top_p: float = 1.0
+    top_k: int = 0
 
 
 @dataclass
@@ -431,13 +432,16 @@ class WorkerNode:
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)),
             top_p=float(request.get("top_p", 1.0)),
+            # Clamped like seed (& 0x7FFFFFFF): an out-of-int32 wire value
+            # must not OverflowError inside a shared batch.
+            top_k=max(0, min(int(request.get("top_k", 0)), 0x7FFFFFFF)),
         )
         if self._continuous:
             t0 = time.perf_counter()
             fut = self.generator.submit(
                 item.prompt, max_new_tokens=item.max_new_tokens,
                 eos_id=item.eos_id, temperature=item.temperature,
-                seed=item.seed, top_p=item.top_p)
+                seed=item.seed, top_p=item.top_p, top_k=item.top_k)
             tokens = fut.result(timeout=600)
             elapsed_us = int((time.perf_counter() - t0) * 1e6)
             result = _GenResult(tokens, elapsed_us)
@@ -479,10 +483,11 @@ class WorkerNode:
         temperature = float(request.get("temperature", 0.0))
         seed = int(request.get("seed", 0))
         top_p = float(request.get("top_p", 1.0))
+        top_k = max(0, min(int(request.get("top_k", 0)), 0x7FFFFFFF))
         normalized = {"request_id": request_id, "prompt_tokens": prompt,
                       "max_new_tokens": max_new, "eos_id": eos_id,
                       "temperature": temperature, "seed": seed,
-                      "top_p": top_p}
+                      "top_p": top_p, "top_k": top_k}
         if not self._continuous:
             def one_shot():
                 try:
@@ -500,7 +505,8 @@ class WorkerNode:
         t0 = time.perf_counter()
         fut = self.generator.submit(
             prompt, max_new_tokens=max_new, eos_id=eos_id,
-            temperature=temperature, seed=seed, top_p=top_p, stream=q)
+            temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
+            stream=q)
 
         def events():
             while True:
@@ -544,7 +550,8 @@ class WorkerNode:
                 eos_id=eos_id,
                 temperature=[items[i].temperature for i in idxs],
                 seed=[items[i].seed for i in idxs],
-                top_p=[items[i].top_p for i in idxs])
+                top_p=[items[i].top_p for i in idxs],
+                top_k=[items[i].top_k for i in idxs])
             # Reference semantic: per-request time = batch_duration /
             # batch_size, per group (worker_node.cpp:123).
             elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))
