@@ -141,8 +141,10 @@ def test_top_p_batch_invariant(gen):
 
 
 def test_top_k_one_equals_greedy():
-    """top_k=1 collapses categorical sampling to argmax at any temperature,
-    on both scheduler paths and through the /generate wire field."""
+    """top_k=1 collapses categorical sampling to argmax at any temperature
+    (given the model's max logit is unique — boundary ties are all kept,
+    matching HF's top_k mask), on both scheduler paths and through the
+    /generate wire field."""
     import jax
 
     from tpu_engine.models.registry import (

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_engine.models.registry import ModelSpec, create_model, _ensure_builtin_models_imported
+from tpu_engine.utils.sampling import expand_sampling_params
 from tpu_engine.models.transformer import (
     TransformerConfig,
     init_caches,
@@ -82,7 +83,10 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
         # are active this is min-of-counts over the UNFILTERED distribution
         # — HF instead renormalizes after top_k before applying top_p, so
         # its kept set can be strictly smaller; don't expect draw-level HF
-        # parity with both filters on.
+        # parity with both filters on. Tokens TIED at the threshold logit
+        # are all kept (same boundary behavior as HF's `logits <
+        # topk[-1]` mask), so top_k=1 equals greedy only when the max
+        # logit is unique — ties are broken by seed, not argmax order.
         k = jnp.where(k_limit > 0, jnp.minimum(k, k_limit), k)
         thresh = sorted_lg[k - 1]
         lg = jnp.where(lg >= thresh, lg, -jnp.inf)
@@ -238,19 +242,8 @@ class Generator:
         if not prompts:
             return []
         n = len(prompts)
-        temps = ([float(temperature)] * n if np.isscalar(temperature)
-                 else [float(t) for t in temperature])
-        seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
-                 else [int(s) for s in seed])
-        top_ps = ([float(top_p)] * n if np.isscalar(top_p)
-                  else [float(p) for p in top_p])
-        top_ks = ([int(top_k)] * n if np.isscalar(top_k)
-                  else [int(k) for k in top_k])
-        top_ks = [max(0, min(k, 0x7FFFFFFF)) for k in top_ks]
-        if (len(temps) != n or len(seeds) != n or len(top_ps) != n
-                or len(top_ks) != n):
-            raise ValueError(
-                "temperature/seed/top_p/top_k sequence length != n prompts")
+        temps, seeds, top_ps, top_ks = expand_sampling_params(
+            n, temperature, seed, top_p, top_k)
         out: List[List[int]] = []
         max_bb = self._batch_buckets[-1]
         for i in range(0, n, max_bb):

@@ -47,6 +47,7 @@ from tpu_engine.models.transformer import (
     transformer_prefill,
 )
 from tpu_engine.runtime.generator import _DTYPES, _sample, start_host_copies
+from tpu_engine.utils.sampling import clamp_top_k, expand_sampling_params
 
 
 @dataclass
@@ -234,7 +235,7 @@ class ContinuousGenerator:
             raise RuntimeError("scheduler stopped")
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
                        float(temperature), int(seed), float(top_p),
-                       max(0, min(int(top_k), 0x7FFFFFFF)), stream=stream)
+                       clamp_top_k(top_k), stream=stream)
         self._queue.put(req)
         return req.future
 
@@ -243,11 +244,8 @@ class ContinuousGenerator:
                  top_k=0) -> List[List[int]]:
         """Blocking convenience over submit() (Generator-compatible)."""
         n = len(prompts)
-        temps = [temperature] * n if np.isscalar(temperature) else temperature
-        seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
-                 else seed)
-        topps = [top_p] * n if np.isscalar(top_p) else top_p
-        topks = [top_k] * n if np.isscalar(top_k) else top_k
+        temps, seeds, topps, topks = expand_sampling_params(
+            n, temperature, seed, top_p, top_k)
         futs = [self.submit(p, max_new_tokens, eos_id, temps[i], seeds[i],
                             topps[i], topks[i]) for i, p in enumerate(prompts)]
         return [f.result(timeout=600) for f in futs]

@@ -35,6 +35,7 @@ from tpu_engine.core.lru_cache import LRUCache
 from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.serving.http import sse_event
 from tpu_engine.utils.config import WorkerConfig
+from tpu_engine.utils.sampling import clamp_top_k as _clamp_top_k
 from tpu_engine.utils.tracing import SpanRecorder
 
 
@@ -432,9 +433,7 @@ class WorkerNode:
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)),
             top_p=float(request.get("top_p", 1.0)),
-            # Clamped like seed (& 0x7FFFFFFF): an out-of-int32 wire value
-            # must not OverflowError inside a shared batch.
-            top_k=max(0, min(int(request.get("top_k", 0)), 0x7FFFFFFF)),
+            top_k=_clamp_top_k(request.get("top_k", 0)),
         )
         if self._continuous:
             t0 = time.perf_counter()
@@ -483,7 +482,7 @@ class WorkerNode:
         temperature = float(request.get("temperature", 0.0))
         seed = int(request.get("seed", 0))
         top_p = float(request.get("top_p", 1.0))
-        top_k = max(0, min(int(request.get("top_k", 0)), 0x7FFFFFFF))
+        top_k = _clamp_top_k(request.get("top_k", 0))
         normalized = {"request_id": request_id, "prompt_tokens": prompt,
                       "max_new_tokens": max_new, "eos_id": eos_id,
                       "temperature": temperature, "seed": seed,

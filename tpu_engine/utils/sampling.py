@@ -1,0 +1,40 @@
+"""Wire-level sampling-parameter normalization, shared by every entry
+point that accepts temperature/seed/top_p/top_k (the two decode
+schedulers in tpu_engine.runtime and the /generate HTTP surface in
+tpu_engine.serving.worker).
+
+Deliberately jax-free: the serving worker imports its runtime modules
+lazily so a worker process doesn't pay jax import/backend-init at module
+load, and this module must be importable from both sides.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def clamp_top_k(k) -> int:
+    """Clamp a wire top_k to int32 range (like seed's & 0x7FFFFFFF): an
+    out-of-range value must not OverflowError inside a shared batch."""
+    return max(0, min(int(k), 0x7FFFFFFF))
+
+
+def expand_sampling_params(n, temperature, seed, top_p, top_k):
+    """Normalize scalar-or-sequence sampling params to per-row lists of
+    length n (scalar seed expands to seed+row so rows of one call still
+    sample independently; top_k clamps to int32 range at the boundary).
+    Shared by both decode schedulers so the wire semantics can't drift."""
+    temps = ([float(temperature)] * n if np.isscalar(temperature)
+             else [float(t) for t in temperature])
+    seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
+             else [int(s) for s in seed])
+    top_ps = ([float(top_p)] * n if np.isscalar(top_p)
+              else [float(p) for p in top_p])
+    top_ks = ([int(top_k)] * n if np.isscalar(top_k)
+              else [int(k) for k in top_k])
+    top_ks = [clamp_top_k(k) for k in top_ks]
+    if (len(temps) != n or len(seeds) != n or len(top_ps) != n
+            or len(top_ks) != n):
+        raise ValueError(
+            "temperature/seed/top_p/top_k sequence length != n prompts")
+    return temps, seeds, top_ps, top_ks
