@@ -1,0 +1,260 @@
+"""Speculative decoding (runtime.speculative).
+
+The load-bearing contract: with temperature 0, speculative output is
+IDENTICAL to plain greedy decode of the target model for ANY draft — a
+good draft only changes speed. Verified here with three drafts: the
+target itself (acceptance ~1), an independently-initialized same-size
+model (acceptance ~chance), and a differently-shaped draft.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    available_models,
+    create_model,
+)
+
+_ensure_builtin_models_imported()
+from tpu_engine.runtime.generator import Generator
+from tpu_engine.runtime.speculative import SpeculativeGenerator
+
+PROMPTS = [[5, 9, 12, 7], [3, 3, 3], [40, 2, 19, 60, 21, 9], [1]]
+
+
+@pytest.fixture(scope="module")
+def target():
+    return create_model("gpt2-small-test")
+
+
+@pytest.fixture(scope="module")
+def plain(target):
+    gen = Generator(target, rng_seed=0, dtype="float32",
+                    batch_buckets=(4,))
+    return gen
+
+
+def _spec_gen(target, draft, k=3, **kw):
+    return SpeculativeGenerator(target, draft, rng_seed=0, dtype="float32",
+                                batch_buckets=(4,), k=k, **kw)
+
+
+def test_greedy_matches_plain_self_draft(target, plain):
+    """Draft == target weights: near-total acceptance, identical output."""
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    spec.draft_params = spec.params  # perfect draft
+    want = plain.generate(PROMPTS, max_new_tokens=12)
+    got = spec.generate(PROMPTS, max_new_tokens=12)
+    assert got == want
+    # Perfect draft: every round advances k+1 tokens.
+    assert spec.last_stats["mean_tokens_per_round"] > spec.k * 0.9
+
+
+def test_greedy_matches_plain_disagreeing_draft(target, plain):
+    """Random independent draft: rejects nearly everything, output still
+    exactly the plain greedy stream (speculation never changes content)."""
+    draft = create_model("gpt2-small-test")
+    spec = SpeculativeGenerator(target, draft, rng_seed=0, dtype="float32",
+                                batch_buckets=(4,), k=3)
+    # rng_seed+1 initializes the draft independently of the target.
+    want = plain.generate(PROMPTS, max_new_tokens=12)
+    got = spec.generate(PROMPTS, max_new_tokens=12)
+    assert got == want
+
+
+def test_greedy_matches_plain_small_draft(target, plain):
+    """Differently-shaped draft (1 layer, same vocab)."""
+    draft = create_model("gpt2-small-test", n_layers=1, d_model=32,
+                         n_heads=2, d_ff=64)
+    spec = _spec_gen(target, draft)
+    want = plain.generate(PROMPTS, max_new_tokens=10)
+    got = spec.generate(PROMPTS, max_new_tokens=10)
+    assert got == want
+
+
+def test_eos_truncation(target, plain):
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    spec.draft_params = spec.params
+    want = plain.generate(PROMPTS, max_new_tokens=16, eos_id=7)
+    got = spec.generate(PROMPTS, max_new_tokens=16, eos_id=7)
+    assert got == want
+    for row in got:
+        assert 7 not in row
+
+
+def test_budget_respected(target):
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    spec.draft_params = spec.params
+    out = spec.generate(PROMPTS, max_new_tokens=5)
+    assert all(len(r) == 5 for r in out)
+
+
+def test_stochastic_deterministic_per_seed(target):
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    a = spec.generate(PROMPTS, max_new_tokens=8, temperature=0.8,
+                      seed=[11, 22, 33, 44])
+    b = spec.generate(PROMPTS, max_new_tokens=8, temperature=0.8,
+                      seed=[11, 22, 33, 44])
+    assert a == b
+    c = spec.generate(PROMPTS, max_new_tokens=8, temperature=0.8,
+                      seed=[12, 22, 33, 44])
+    assert c[0] != a[0] or c[1:] == a[1:]  # changing a seed may change only that row
+    assert c[1:] == a[1:]
+
+
+def test_stochastic_tokens_valid(target):
+    cfg = target.config
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    out = spec.generate(PROMPTS, max_new_tokens=8, temperature=1.2, seed=5)
+    for row in out:
+        assert len(row) == 8
+        assert all(0 <= t < cfg.vocab for t in row)
+
+
+def test_mixed_temperature_batch(target, plain):
+    """Greedy rows of a mixed batch still match plain greedy exactly."""
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    temps = [0.0, 0.9, 0.0, 0.9]
+    got = spec.generate(PROMPTS, max_new_tokens=8, temperature=temps,
+                        seed=[1, 2, 3, 4])
+    want = plain.generate(PROMPTS, max_new_tokens=8)
+    assert got[0] == want[0]
+    assert got[2] == want[2]
+
+
+def test_top_p_rejected(target):
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    with pytest.raises(ValueError):
+        spec.generate(PROMPTS, max_new_tokens=4, top_p=0.9)
+    with pytest.raises(ValueError):
+        spec.generate(PROMPTS, max_new_tokens=4, top_k=5)
+
+
+def test_vocab_mismatch_rejected(target):
+    draft = create_model("gpt2-small-test", vocab=128)
+    with pytest.raises(ValueError):
+        SpeculativeGenerator(target, draft)
+
+
+def test_non_causal_rejected():
+    if "bert-small-test" not in available_models():
+        pytest.skip("no bert-small-test in registry")
+    bert = create_model("bert-small-test")
+    with pytest.raises(ValueError):
+        SpeculativeGenerator(bert, bert)
+
+
+def test_large_batch_splits(target, plain):
+    spec = _spec_gen(target, create_model("gpt2-small-test"))
+    spec.draft_params = spec.params
+    prompts = PROMPTS * 3  # 12 rows > max bucket 4
+    want = plain.generate(prompts, max_new_tokens=6)
+    got = spec.generate(prompts, max_new_tokens=6)
+    assert got == want
+
+
+def test_gqa_rope_target(plain):
+    """Speculation over the llama dialect (RoPE + GQA + RMSNorm)."""
+    tgt = create_model("llama-small-test")
+    drf = create_model("llama-small-test")
+    spec = SpeculativeGenerator(tgt, drf, rng_seed=0, dtype="float32",
+                                batch_buckets=(4,), k=3)
+    spec.draft_params = spec.params
+    gen = Generator(tgt, rng_seed=0, dtype="float32", batch_buckets=(4,))
+    want = gen.generate(PROMPTS, max_new_tokens=10)
+    got = spec.generate(PROMPTS, max_new_tokens=10)
+    assert got == want
+
+
+# -- serving integration ------------------------------------------------------
+
+def test_worker_speculative_lane_matches_plain():
+    """gen_scheduler=speculative serves /generate; greedy output identical
+    to the batch scheduler's (the content-preservation contract, on the
+    wire)."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    req = {"request_id": "s1", "prompt_tokens": [5, 9, 3],
+           "max_new_tokens": 6}
+    plain_w = WorkerNode(WorkerConfig(
+        node_id="w_plain", model="gpt2-small-test", dtype="float32",
+        gen_scheduler="batch"))
+    try:
+        want = plain_w.handle_generate(dict(req))["tokens"]
+    finally:
+        plain_w.stop()
+
+    spec_w = WorkerNode(WorkerConfig(
+        node_id="w_spec", model="gpt2-small-test", dtype="float32",
+        gen_scheduler="speculative", gen_spec_k=3))
+    try:
+        resp = spec_w.handle_generate(dict(req))
+        assert resp["tokens"] == want
+        # health surfaces the speculative lane's stats
+        h = spec_w.get_health()
+        assert h["generator"]["draft"] == "gpt2-small-test"
+        assert h["generator"]["k"] == 3
+        # top_p / top_k requests are rejected loudly, not mis-sampled
+        with pytest.raises(ValueError):
+            spec_w.handle_generate({"request_id": "s2",
+                                    "prompt_tokens": [1, 2],
+                                    "max_new_tokens": 4, "top_p": 0.9})
+    finally:
+        spec_w.stop()
+
+
+def test_worker_speculative_unresolvable_draft():
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    with pytest.raises(RuntimeError):
+        WorkerNode(WorkerConfig(node_id="w_bad", model="llama-small-test",
+                                dtype="float32",
+                                gen_scheduler="speculative"))
+
+
+def test_partial_bucket_idle_rows_do_not_gate(target):
+    """Idle bucket-padding rows start done: a 1-prompt batch in an 8-wide
+    bucket with a disagreeing draft must not run ~max_new rounds because
+    pad rows reject everything (code-review r4 finding)."""
+    spec = SpeculativeGenerator(target, create_model("gpt2-small-test"),
+                                rng_seed=0, dtype="float32",
+                                batch_buckets=(8,), k=3)
+    spec.draft_params = spec.params  # perfect draft for live rows
+    out = spec.generate([PROMPTS[0]], max_new_tokens=12)
+    assert len(out) == 1 and len(out[0]) == 12
+    # Perfect draft: the single live row needs ~12/(k+1)=3 rounds; idle
+    # rows must not stretch the loop toward 12 rounds.
+    assert spec.last_stats["rounds"] <= 5
+
+
+def test_speculative_misconfig_is_loud():
+    """k<1 / vocab-mismatch misconfig fails worker startup (RuntimeError),
+    never a silent no-generation worker (code-review r4 finding)."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    with pytest.raises(RuntimeError):
+        WorkerNode(WorkerConfig(node_id="w_k0", model="gpt2-small-test",
+                                dtype="float32",
+                                gen_scheduler="speculative", gen_spec_k=0))
+
+
+def test_stream_rejects_top_p_eagerly():
+    """/generate/stream with top_p on the speculative lane raises BEFORE
+    the SSE iterator is handed back (400, not an in-stream error event)."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    w = WorkerNode(WorkerConfig(node_id="w_sse", model="gpt2-small-test",
+                                dtype="float32",
+                                gen_scheduler="speculative", gen_spec_k=2))
+    try:
+        with pytest.raises(ValueError):
+            w.handle_generate_stream({"request_id": "e1",
+                                      "prompt_tokens": [1, 2],
+                                      "max_new_tokens": 4, "top_p": 0.5})
+    finally:
+        w.stop()

@@ -62,6 +62,19 @@ def make_gpt2(seq_len: int = 128, vocab: int = 50257, n_layers: int = 12,
     return _spec_from_config("gpt2", cfg, seq_len)
 
 
+@register("distilgpt2")
+def make_distilgpt2(seq_len: int = 128, vocab: int = 50257, n_layers: int = 6,
+                    d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,
+                    max_seq: int = 1024) -> ModelSpec:
+    """6-layer GPT-2 (HF distilgpt2 architecture) — importable via
+    models.import_weights like gpt2, and the natural DRAFT model for
+    speculative decoding against a gpt2 target (runtime.speculative)."""
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True)
+    return _spec_from_config("distilgpt2", cfg, seq_len)
+
+
 @register("gpt2-small-test")
 def make_gpt2_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
                     d_model: int = 64, n_heads: int = 4, d_ff: int = 128,

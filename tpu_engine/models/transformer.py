@@ -413,6 +413,74 @@ def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
     return logits[:, 0], KVCache(k_new, v_new)
 
 
+def _block_decode_window(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
+                         dtype, start_vec):
+    """Width-W decode with PER-ROW cache positions — the speculative-decode
+    verify primitive. h: (B, W, d_model); row b writes cache columns
+    [pos_vec[b], pos_vec[b]+W) and each window query attends causally to
+    its own column and everything before it (>= start_vec[b]).
+
+    The whole window's K/V is scattered into the cache BEFORE the attention
+    matmul, so window query w attends fresh values for columns <= its own —
+    stale entries from a previous round's rejected speculation are always
+    either overwritten first or masked out (kpos <= own column)."""
+    ck, cv = cache_kv
+    b, w = h.shape[:2]
+    x = _norm(bp["ln1"], h, cfg)
+    offs = jnp.arange(w)[None, :]                           # (1, W)
+    logical = (pos_vec - start_vec)[:, None] + offs          # (B, W)
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype, positions=logical)
+    rows = jnp.arange(b)[:, None]
+    cols = pos_vec[:, None] + offs                           # (B, W)
+    ck = ck.at[rows, cols].set(k.astype(ck.dtype))
+    cv = cv.at[rows, cols].set(v.astype(cv.dtype))
+    kpos = jnp.arange(ck.shape[1])[None, None, :]            # (1, 1, S)
+    valid = ((kpos <= cols[:, :, None]) &
+             (kpos >= start_vec[:, None, None])).astype(jnp.int32)
+    a = dot_product_attention(q, ck, cv, mask=valid)  # grouped, unexpanded
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, w, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
+    return h.astype(dtype), (ck, cv)
+
+
+def transformer_decode_window(params, tokens, caches: KVCache, pos_vec,
+                              cfg: TransformerConfig, *, dtype=jnp.bfloat16,
+                              start_vec=None):
+    """Consume a W-token window per row against the KV cache in ONE pass.
+
+    tokens: (B, W) int32 — row b's stream tokens at absolute cache columns
+    [pos_vec[b], pos_vec[b]+W); start_vec: (B,) first valid column per row
+    (left-padded batches). Returns (logits (B, W, vocab), caches) where
+    logits[:, i] predicts the token AFTER tokens[:, i].
+
+    This is speculative decoding's verify step: scoring k draft tokens
+    costs one batched MXU pass instead of k sequential decode dispatches.
+    Columns below start_vec may be written with garbage values by window
+    slots that precede a short row's prompt — they are never attended
+    (mask kpos >= start). Callers must keep pos_vec + W <= max_seq."""
+    if start_vec is None:
+        start_vec = jnp.zeros_like(pos_vec)
+    b, w = tokens.shape
+    h = nn.embedding(params["tok_embed"], tokens)
+    if cfg.pos == "learned":
+        logical = jnp.clip(
+            (pos_vec - start_vec)[:, None] + jnp.arange(w)[None, :],
+            0, params["pos_embed"]["table"].shape[0] - 1)
+        h = h + params["pos_embed"]["table"][logical]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_decode_window(bp, carry, (ck, cv), pos_vec, cfg,
+                                           dtype=dtype, start_vec=start_vec)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
+    h = _norm(params["ln_f"], h, cfg)
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    return logits, KVCache(k_new, v_new)
+
+
 def transformer_decode_step(params, token_t, caches: KVCache, pos,
                             cfg: TransformerConfig, *, dtype=jnp.bfloat16,
                             start=None, pos_ids=None):

@@ -87,10 +87,19 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         kpos = jnp.arange(sk)[None, :]
         scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
     if mask is not None:
-        # mask: (B, Sk) 1=valid, 0=pad — broadcast over heads and queries.
-        extra = (None,) * (scores.ndim - 2)
-        scores = jnp.where(mask[(slice(None),) + extra + (slice(None),)] > 0,
-                           scores, -jnp.inf)
+        if mask.ndim == 3:
+            # mask: (B, Sq, Sk) 1=valid — per-query-position masking (the
+            # window-verify path of speculative decode, where each of the W
+            # suffix queries may attend a different cache depth per row).
+            if h_kv != h:
+                m = mask[:, None, None, :, :]   # scores (b, h, g, q, k)
+            else:
+                m = mask[:, None, :, :]         # scores (b, h, q, k)
+        else:
+            # mask: (B, Sk) 1=valid, 0=pad — broadcast over heads/queries.
+            extra = (None,) * (scores.ndim - 2)
+            m = mask[(slice(None),) + extra + (slice(None),)]
+        scores = jnp.where(m > 0, scores, -jnp.inf)
     # Guard fully-masked rows (all -inf → NaN softmax): treat as uniform.
     weights = jax.nn.softmax(scores, axis=-1)
     weights = jnp.nan_to_num(weights)

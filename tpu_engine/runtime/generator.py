@@ -55,6 +55,43 @@ def start_host_copies(*arrays) -> None:
             pass
 
 
+def pick_bucket(buckets: Sequence[int], n: int) -> int:
+    """Smallest bucket >= n (largest bucket when n exceeds them all) —
+    the ONE bucketing rule every decode scheduler shares."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+def left_pad_batch(prompts: Sequence[Sequence[int]], bb: int, pb: int,
+                   min_len: int = 0):
+    """Left-pad prompts into (bb, pb) buckets — the shared batch-assembly
+    step of every decode path (mixed-length batches are LEFT-padded so all
+    rows end at column pb-1 and decode advances together).
+
+    Returns (tokens, attn_mask, pos_ids, start) as numpy arrays. `min_len`
+    forces at least that many valid trailing columns per row (the
+    speculative scheduler's idle bucket rows need one valid column so
+    their attention isn't fully masked); 0 leaves empty prompts fully
+    padded (start == pb)."""
+    tokens = np.zeros((bb, pb), np.int32)
+    attn_mask = np.zeros((bb, pb), np.int32)
+    pos_ids = np.zeros((bb, pb), np.int32)
+    start = np.full((bb,), pb - min_len, np.int32)
+    if min_len:
+        attn_mask[:, pb - min_len:] = 1
+        pos_ids[:, pb - min_len:] = np.arange(min_len)
+    for r, p in enumerate(prompts):
+        p = list(p)[-pb:]  # truncate over-long prompts from the left
+        L = max(len(p), min_len)
+        tokens[r, pb - len(p):] = np.asarray(p, np.int32)
+        attn_mask[r, pb - L:] = 1
+        pos_ids[r, pb - L:] = np.arange(L)
+        start[r] = pb - L
+    return tokens, attn_mask, pos_ids, start
+
+
 def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
     """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p/
     top_k (B,).
@@ -157,10 +194,7 @@ class Generator:
     # -- bucketing -------------------------------------------------------------
 
     def _bucket(self, buckets: Tuple[int, ...], n: int) -> int:
-        for b in buckets:
-            if b >= n:
-                return b
-        return buckets[-1]
+        return pick_bucket(buckets, n)
 
     # -- compiled stages -------------------------------------------------------
 
@@ -264,18 +298,7 @@ class Generator:
         pb = self._bucket(self._prompt_buckets, min(longest, self.max_seq))
         max_new = max(1, min(max_new, self.max_seq - pb))
 
-        # Left-pad into the (bb, pb) buckets.
-        tokens = np.zeros((bb, pb), np.int32)
-        attn_mask = np.zeros((bb, pb), np.int32)
-        pos_ids = np.zeros((bb, pb), np.int32)
-        start = np.full((bb,), pb, np.int32)
-        for r, p in enumerate(prompts):
-            p = p[-pb:]  # truncate over-long prompts from the left
-            L = len(p)
-            tokens[r, pb - L:] = np.asarray(p, np.int32)
-            attn_mask[r, pb - L:] = 1
-            pos_ids[r, pb - L:] = np.arange(L)
-            start[r] = pb - L
+        tokens, attn_mask, pos_ids, start = left_pad_batch(prompts, bb, pb)
         dev = self._device
 
         def put(x):

@@ -116,13 +116,26 @@ def main(argv=None) -> int:
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
-        parser.add_argument("--gen-scheduler", choices=["batch", "continuous"],
+        parser.add_argument("--gen-scheduler",
+                            choices=["batch", "continuous", "speculative"],
                             default="continuous",
                             help="decode scheduling: continuous "
                                  "(iteration-level admission; measured 7.4x "
                                  "tokens/s under Poisson arrivals, "
-                                 "BENCH_r04_builder.json) or "
-                                 "batch-to-completion")
+                                 "BENCH_r04_builder.json), "
+                                 "batch-to-completion, or speculative "
+                                 "(draft-model proposals verified by the "
+                                 "target in one windowed pass; temperature "
+                                 "sampling only)")
+        parser.add_argument("--gen-draft-model", default=None,
+                            help="draft model for --gen-scheduler "
+                                 "speculative (default: auto, e.g. "
+                                 "gpt2 -> distilgpt2)")
+        parser.add_argument("--gen-draft-path", default=None,
+                            help="draft model weights checkpoint")
+        parser.add_argument("--gen-spec-k", type=int, default=4,
+                            help="speculation depth: draft tokens proposed "
+                                 "per verify round")
         args = parser.parse_args(rest)
         gateway_config = None
         if args.breaker_timeout is not None:
@@ -139,6 +152,9 @@ def main(argv=None) -> int:
                 for s in args.shape_buckets.split(","))
         worker_config = WorkerConfig(shape_buckets=buckets,
                                      gen_scheduler=args.gen_scheduler,
+                                     gen_draft_model=args.gen_draft_model,
+                                     gen_draft_path=args.gen_draft_path,
+                                     gen_spec_k=args.gen_spec_k,
                                      model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
                        warmup=args.warmup, worker_config=worker_config,

@@ -203,9 +203,23 @@ class WorkerNode:
         self.generator = None
         self._gen_processor: Optional[BatchProcessor[_GenItem, _GenResult]] = None
         self._continuous = self.config.gen_scheduler == "continuous"
+        self._speculative = self.config.gen_scheduler == "speculative"
         if getattr(self.engine.spec, "config", None) is not None:
             try:
-                if self._continuous:
+                if self._speculative:
+                    # Draft-model speculation: batch-mode lane; the target
+                    # verifies gen_spec_k draft tokens per windowed pass
+                    # (runtime.speculative). Wire contract narrows to
+                    # temperature sampling (handle_generate validates).
+                    self.generator = self._build_speculative()
+                    self._gen_processor = BatchProcessor(
+                        self.config.gen_max_batch_size,
+                        self.config.batch_timeout_ms,
+                        self._process_gen_batch,
+                        name=f"{self.node_id}-gen-batcher",
+                    )
+                    self._gen_processor.start()
+                elif self._continuous:
                     # Iteration-level scheduling: the scheduler IS the
                     # batcher — HTTP handler threads submit directly and
                     # requests join the running decode batch between chunks.
@@ -259,6 +273,61 @@ class WorkerNode:
         self.tracer = SpanRecorder()
 
     # -- fault injection -------------------------------------------------------
+
+    _AUTO_DRAFT = {"gpt2": "distilgpt2", "gpt2-small-test": "gpt2-small-test"}
+
+    def _build_speculative(self):
+        """Construct the speculative-decoding lane (gen_scheduler=
+        "speculative"): resolve the draft model (explicit config or the
+        auto map), load optional draft weights, share the target's params
+        with the engine.
+
+        Error contract: the caller treats ValueError as "this model can't
+        generate" (non-transformer targets fall back to no generation lane,
+        same as the other schedulers), so ONLY the target-isn't-a-decoder
+        case may raise ValueError here. Every speculative-specific
+        misconfiguration (unresolvable draft, vocab mismatch, bad k) is
+        re-raised as RuntimeError so startup fails loudly instead of
+        silently serving without a generation lane."""
+        from tpu_engine.models.registry import (
+            create_model, _ensure_builtin_models_imported)
+        from tpu_engine.models.transformer import TransformerConfig
+        from tpu_engine.runtime.speculative import SpeculativeGenerator
+
+        tgt_cfg = getattr(self.engine.spec, "config", None)
+        if not isinstance(tgt_cfg, TransformerConfig) or not tgt_cfg.causal:
+            raise ValueError(
+                f"model '{self.engine.spec.name}' is not a decoder "
+                "transformer; generation unsupported")
+        draft_name = (self.config.gen_draft_model
+                      or self._AUTO_DRAFT.get(self.engine.spec.name))
+        if draft_name is None:
+            raise RuntimeError(
+                f"gen_scheduler=speculative needs a draft model for "
+                f"'{self.engine.spec.name}': set gen_draft_model "
+                f"(--gen-draft-model)")
+        _ensure_builtin_models_imported()
+        draft_spec = create_model(draft_name)
+        draft_params = None
+        if self.config.gen_draft_path:
+            draft_params = _load_model_path(draft_spec,
+                                            self.config.gen_draft_path)
+        else:
+            # A random-init draft accepts ~nothing: the lane degrades to
+            # pure overhead (bench.py spec-ab's measured floor). Loud
+            # warning, not an error — random drafts are the test fixture.
+            print(f"[{self.node_id}] WARNING: speculative draft "
+                  f"'{draft_name}' is randomly initialized (no "
+                  f"gen_draft_path); expect ~zero acceptance and worse "
+                  f"throughput than gen_scheduler=batch", flush=True)
+        try:
+            return SpeculativeGenerator(
+                self.engine.spec, draft_spec, params=self.engine.params,
+                draft_params=draft_params, k=self.config.gen_spec_k,
+                dtype=self.config.dtype,
+                device=getattr(self.engine, "_device", None))
+        except ValueError as exc:
+            raise RuntimeError(f"speculative lane misconfigured: {exc}")
 
     def inject_fault(self, reason: str = "injected") -> None:
         self._injected_fault = reason
@@ -435,6 +504,13 @@ class WorkerNode:
             top_p=float(request.get("top_p", 1.0)),
             top_k=_clamp_top_k(request.get("top_k", 0)),
         )
+        if self._speculative and (item.top_p < 1.0 or item.top_k > 0):
+            # Reject BEFORE the item enters a shared batch: rejection
+            # sampling is exact for the temperature distribution only, and
+            # one filtered request must not poison its co-batched group.
+            raise ValueError(
+                "speculative scheduler supports temperature sampling only "
+                "(top_p/top_k unavailable; use gen_scheduler=continuous)")
         if self._continuous:
             t0 = time.perf_counter()
             fut = self.generator.submit(
@@ -483,6 +559,12 @@ class WorkerNode:
         seed = int(request.get("seed", 0))
         top_p = float(request.get("top_p", 1.0))
         top_k = _clamp_top_k(request.get("top_k", 0))
+        if self._speculative and (top_p < 1.0 or top_k > 0):
+            # Must fire HERE, before the iterator commits a 200 SSE stream
+            # — same 400 the blocking endpoint gives this payload.
+            raise ValueError(
+                "speculative scheduler supports temperature sampling only "
+                "(top_p/top_k unavailable; use gen_scheduler=continuous)")
         normalized = {"request_id": request_id, "prompt_tokens": prompt,
                       "max_new_tokens": max_new, "eos_id": eos_id,
                       "temperature": temperature, "seed": seed,

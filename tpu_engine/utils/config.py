@@ -48,7 +48,15 @@ class WorkerConfig:
     # default: measured 7.42x tokens/s and ~10x lower p50 under Poisson
     # arrivals (gpt2, TPU v5lite-1; bench.py --scenario decode-ab, artifact
     # BENCH_r04_builder.json).
+    # "speculative": batch-mode lane where a DRAFT model proposes
+    # gen_spec_k tokens per round and the target verifies them in one
+    # windowed pass (runtime.speculative); temperature sampling only.
     gen_scheduler: str = "continuous"
+    # Draft model for the speculative scheduler. None = auto by target
+    # (gpt2 -> distilgpt2); set explicitly for other families.
+    gen_draft_model: Optional[str] = None
+    gen_draft_path: Optional[str] = None  # draft weights checkpoint
+    gen_spec_k: int = 4                 # speculation depth (draft tokens/round)
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
