@@ -418,10 +418,13 @@ def run_compute_bench(model: str = "resnet50", batch: int = 32,
 
 
 def run_decode_compute(model: str = "gpt2", batch: int = 8,
-                       max_new: int = 64, dtype: str = "bfloat16") -> dict:
+                       max_new: int = 64, dtype: str = "bfloat16",
+                       quantize: bool = False) -> dict:
     """On-chip decode throughput: tokens/s/chip through the KV-cache decode
     loop, with decode MFU ≈ tokens/s x 2 x params / peak (decode is
-    HBM-bandwidth-bound; low MFU is expected and honest)."""
+    HBM-bandwidth-bound; low MFU is expected and honest). `quantize` runs
+    the same loop over int8 weight-only params (ops.quant) — decode streams
+    every weight per step, so int8 halves its HBM bytes."""
     import numpy as np
 
     from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
@@ -430,7 +433,14 @@ def run_decode_compute(model: str = "gpt2", batch: int = 8,
 
     _ensure_builtin_models_imported()
     spec = create_model(model)
-    gen = Generator(spec, dtype=dtype, batch_buckets=(batch,))
+    params = None
+    if quantize:
+        import jax
+
+        from tpu_engine.ops.quant import quantize_params
+
+        params = quantize_params(spec.init(jax.random.PRNGKey(0)))
+    gen = Generator(spec, params=params, dtype=dtype, batch_buckets=(batch,))
     n_params = count_params(gen.params)
 
     rng = np.random.default_rng(1)
@@ -451,6 +461,7 @@ def run_decode_compute(model: str = "gpt2", batch: int = 8,
         "model": model,
         "batch": batch,
         "max_new_tokens": max_new,
+        "quantize": "int8" if quantize else None,
         "tokens_per_s": round(tok_s, 2),
         "wall_s": round(wall, 3),
         "compile_s": round(compile_s, 2),
@@ -710,7 +721,10 @@ def probe_device(timeout_s: float = 240.0, attempts: int = 3,
             if proc.returncode == 0:
                 log(f"device probe OK: {out.strip()}")
                 return
-            last = RuntimeError(f"device probe failed: {err[-300:]}")
+            # A nonzero exit is deterministic (bad install/platform env) —
+            # retrying cannot help; fail fast so the driver still gets its
+            # artifact. Only HANGS (transient tunnel wedges) retry.
+            raise RuntimeError(f"device probe failed: {err[-300:]}")
         log(f"device probe attempt {attempt}/{attempts} failed: {last}")
         if attempt < attempts:
             time.sleep(retry_sleep_s)
@@ -785,12 +799,14 @@ def _main() -> int:
         compute = run_compute_bench(model=args.model
                                     if args.model != "gpt2" else "resnet50")
         decode = run_decode_compute()
-        log(json.dumps({"compute": compute, "decode": decode}, indent=2))
+        decode_q = run_decode_compute(quantize=True)
+        log(json.dumps({"compute": compute, "decode": decode,
+                        "decode_int8": decode_q}, indent=2))
         print(json.dumps({
             "metric": "device_compute", "value": compute["samples_per_s"],
             "unit": "samples/s", "vs_baseline": None,
             "mfu": compute["mfu"], "decode_tokens_per_s": decode["tokens_per_s"],
-            "compute": compute, "decode": decode,
+            "compute": compute, "decode": decode, "decode_int8": decode_q,
         }), flush=True)
         return 0
 

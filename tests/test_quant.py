@@ -1,0 +1,165 @@
+"""Weight-only int8 quantization (ops.quant).
+
+Contracts: per-channel round-trip error bounded by scale/2; the scaled
+output path is EXACTLY the dequantized-weight matmul (rearrangement adds
+no error); quantized models serve through every lane; bytes halve.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    create_model,
+)
+
+_ensure_builtin_models_imported()
+
+from tpu_engine.ops import nn
+from tpu_engine.ops.quant import (
+    dequantize_kernel,
+    dequantize_params,
+    param_bytes,
+    quantize_kernel,
+    quantize_params,
+)
+
+
+def test_roundtrip_error_bound():
+    k = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 3.0
+    q, s = quantize_kernel(k)
+    assert q.dtype == jnp.int8 and s.shape == (32,)
+    err = jnp.abs(dequantize_kernel(q, s) - k)
+    # symmetric round-to-nearest: per-channel error <= scale/2
+    assert float(jnp.max(err - s[None, :] / 2)) <= 1e-6
+
+
+def test_stacked_kernel_scales_per_layer():
+    k = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 8))
+    k = k * jnp.array([1.0, 10.0, 100.0])[:, None, None]
+    q, s = quantize_kernel(k)
+    assert s.shape == (3, 8)
+    # layer 2's scales ~100x layer 0's
+    assert float(jnp.mean(s[2]) / jnp.mean(s[0])) > 50
+
+
+def test_dense_scaled_output_exact():
+    """X @ deq(Wq) == (X @ Wq) * s — the rearrangement adds NO error."""
+    key = jax.random.PRNGKey(2)
+    k1, k2 = jax.random.split(key)
+    w = jax.random.normal(k1, (32, 16))
+    x = jax.random.normal(k2, (4, 32))
+    p = {"kernel": w, "bias": jnp.zeros((16,))}
+    pq = quantize_params(p)
+    assert "kernel_q" in pq and "kernel" not in pq
+    want = nn.dense({"kernel": dequantize_kernel(
+        pq["kernel_q"], pq["kernel_scale"]), "bias": p["bias"]}, x)
+    got = nn.dense(pq, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_quantized_close():
+    key = jax.random.PRNGKey(3)
+    p = nn.conv_init(key, 3, 3, 8, 16)
+    x = jax.random.normal(key, (2, 10, 10, 8))
+    pq = quantize_params(p)
+    want = nn.conv2d(p, x)
+    got = nn.conv2d(pq, x)
+    rel = float(jnp.max(jnp.abs(got - want)) / jnp.max(jnp.abs(want)))
+    assert rel < 0.05
+
+
+def test_tree_transform_selective():
+    """Norms/embeddings untouched; dense dicts rewritten; idempotent."""
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    q = quantize_params(params)
+    assert "kernel_q" in q["head"] and "kernel" not in q["head"]
+    assert "kernel_q" in q["blocks"]["attn"]["wq"]
+    assert q["blocks"]["attn"]["wq"]["kernel_q"].dtype == jnp.int8
+    assert "table" in q["tok_embed"]          # embeddings untouched
+    assert "scale" in q["ln_f"]               # norms untouched
+    q2 = quantize_params(q)                   # idempotent
+    assert q2["head"]["kernel_q"].dtype == jnp.int8
+    # round-trip restores the plain tree structure
+    rt = dequantize_params(q)
+    assert "kernel" in rt["head"] and "kernel_q" not in rt["head"]
+
+
+def test_transformer_logits_close():
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).integers(
+        1, 250, size=(2, 16)), jnp.float32)
+    full = spec.apply(params, x, dtype=jnp.float32)
+    quant = spec.apply(quantize_params(params), x, dtype=jnp.float32)
+    rel = float(jnp.max(jnp.abs(quant - full))
+                / (float(jnp.max(jnp.abs(full))) + 1e-9))
+    assert rel < 0.1, rel
+
+
+def test_bytes_halved():
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    q = quantize_params(params)
+    # dense kernels dominate this model; int8 vs f32 storage ~4x there.
+    assert param_bytes(q) < 0.55 * param_bytes(params)
+
+
+def test_moe_gate_quantized_applies():
+    spec = create_model("gpt2-moe-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((1, spec.input_shape[0])).at[0, :4].set(
+        jnp.asarray([3.0, 5.0, 7.0, 2.0]))
+    out = spec.apply(quantize_params(params), x, dtype=jnp.float32)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quantized_generation_deterministic():
+    from tpu_engine.runtime.generator import Generator
+
+    spec = create_model("gpt2-small-test")
+    params = quantize_params(spec.init(jax.random.PRNGKey(0)))
+    gen = Generator(spec, params=params, dtype="float32", batch_buckets=(2,))
+    a = gen.generate([[5, 9, 3], [7, 2]], max_new_tokens=6)
+    b = gen.generate([[5, 9, 3], [7, 2]], max_new_tokens=6)
+    assert a == b
+    assert all(len(r) == 6 for r in a)
+
+
+def test_worker_quantized_serves():
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    w = WorkerNode(WorkerConfig(node_id="w_q8", model="gpt2-small-test",
+                                dtype="float32", quantize="int8"))
+    try:
+        r = w.handle_infer({"request_id": "q1", "input_data": [5.0, 9.0]})
+        assert len(r["output_data"]) == 256
+        g = w.handle_generate({"request_id": "q2", "prompt_tokens": [5, 9],
+                               "max_new_tokens": 4})
+        assert len(g["tokens"]) == 4
+    finally:
+        w.stop()
+
+
+def test_engine_rejects_unknown_mode():
+    from tpu_engine.runtime.engine import InferenceEngine
+
+    with pytest.raises(ValueError):
+        InferenceEngine("mlp", quantize="int4")
+
+
+def test_onnx_worker_rejects_quantize():
+    """--quantize on a raw .onnx worker fails loudly (flat initializers are
+    not kernel dicts; silently serving unquantized would be a lie). The
+    check fires before the file is even opened."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    with pytest.raises(RuntimeError, match="quantize"):
+        WorkerNode(WorkerConfig(node_id="w_onnx_q", model_path="m.onnx",
+                                quantize="int8"))

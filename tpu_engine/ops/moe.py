@@ -103,8 +103,11 @@ def moe_apply(params, x, cfg: MoEConfig, dtype=jnp.bfloat16):
     b, t, d = x.shape
     n = b * t
     xf = x.reshape(n, d)
-    logits = nn.dense({"kernel": params["gate"]["kernel"],
-                       "bias": jnp.zeros((cfg.n_experts,))}, xf, dtype=dtype)
+    # Pass the gate dict through (plus a zero bias) so both the plain
+    # {"kernel"} and the ops.quant {"kernel_q","kernel_scale"} forms work.
+    gate = dict(params["gate"])
+    gate.setdefault("bias", jnp.zeros((cfg.n_experts,)))
+    logits = nn.dense(gate, xf, dtype=dtype)
     dispatch, combine = _dispatch_tensors(logits, cfg, n)
 
     xc = xf.astype(dtype)

@@ -37,15 +37,24 @@ def dense_init(key, in_dim: int, out_dim: int):
 
 
 def dense(params, x, dtype=None):
-    kernel = params["kernel"]
+    quantized = "kernel_q" in params
+    kernel = params["kernel_q"] if quantized else params["kernel"]
     if dtype is not None:
         x = x.astype(dtype)
         kernel = kernel.astype(dtype)
+    elif quantized:
+        kernel = kernel.astype(x.dtype)
     # f32 accumulation on the MXU regardless of input dtype.
     y = jax.lax.dot_general(
         x, kernel, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if quantized:
+        # Weight-only int8 (ops.quant): per-output-channel scale applied to
+        # the OUTPUT — exact for X @ (Wq*s_j), while weights stream from
+        # HBM at 1 byte each (the int8->MXU-dtype convert fuses into the
+        # matmul's weight read).
+        y = y * params["kernel_scale"]
     return y + params["bias"]
 
 
@@ -57,11 +66,14 @@ def conv_init(key, kh: int, kw: int, in_ch: int, out_ch: int):
 
 
 def conv2d(params, x, stride: int = 1, padding="SAME", dtype=None):
-    kernel = params["kernel"]
+    quantized = "kernel_q" in params
+    kernel = params["kernel_q"] if quantized else params["kernel"]
     if dtype is not None:
         x = x.astype(dtype)
         kernel = kernel.astype(dtype)
-    return jax.lax.conv_general_dilated(
+    elif quantized:
+        kernel = kernel.astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
         x,
         kernel,
         window_strides=(stride, stride),
@@ -69,6 +81,9 @@ def conv2d(params, x, stride: int = 1, padding="SAME", dtype=None):
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32,
     )
+    if quantized:
+        y = y * params["kernel_scale"]  # per-out-channel, exact (ops.quant)
+    return y
 
 
 # -- norm -------------------------------------------------------------------

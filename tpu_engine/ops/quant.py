@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization for serving.
+
+The reference serves whatever precision the ONNX file carries (fp32 end to
+end, ``/root/reference/src/inference_engine.cpp:96-132`` builds f32
+tensors). Here quantization is a first-class serving mode because it maps
+directly onto TPU economics: autoregressive decode is HBM-bandwidth-bound
+(every step streams all weights), so storing dense/conv kernels as int8
+halves the bytes-per-step vs bf16 — the int8→bf16 convert fuses into the
+matmul's weight read, and the per-output-channel scale is applied to the
+matmul OUTPUT, which is mathematically exact:
+
+    X @ (Wq * s_j)  ==  (X @ Wq) * s_j      (s_j per output column)
+
+so quantization error comes only from the int8 rounding of W, never from
+the rearrangement. Scales reduce over the input axis (and conv's spatial
+axes), keeping any leading stacked-layer axes — models.transformer's
+(L, in, out) scanned blocks quantize to (L, in, out) int8 + (L, out)
+scales, and `lax.scan` slices both per layer.
+
+Scope: dicts holding a 2-D/3-D dense "kernel" or 4-D conv "kernel".
+Norm/bias/embedding params stay f32 (quality-sensitive, not
+bandwidth-relevant). Tensor-parallel sharding rules match on the "kernel"
+path name and therefore leave quantized trees replicated — use one or the
+other per deployment (documented in training.shard_params_tp).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_dense_kernel(kernel) -> bool:
+    return kernel.ndim in (2, 3)  # (in, out) or stacked (L, in, out)
+
+
+def _is_conv_kernel(kernel) -> bool:
+    return kernel.ndim in (4, 5)  # HWIO or stacked (L, kh, kw, in, out)
+
+
+def quantize_kernel(kernel):
+    """kernel (f32) -> (int8 kernel_q, f32 per-out-channel scale).
+
+    Symmetric round-to-nearest onto [-127, 127]; scale reduces over the
+    input axis (dense) or spatial+input axes (conv), keeping leading
+    stacked axes."""
+    kernel = jnp.asarray(kernel, jnp.float32)
+    if _is_dense_kernel(kernel):
+        axes = (kernel.ndim - 2,)
+    elif _is_conv_kernel(kernel):
+        axes = tuple(range(kernel.ndim - 4, kernel.ndim - 1))
+    else:
+        raise ValueError(f"unsupported kernel rank {kernel.ndim}")
+    amax = jnp.max(jnp.abs(kernel), axis=axes)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(kernel / jnp.expand_dims(scale, axes))
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kernel(kernel_q, scale):
+    axes = ((kernel_q.ndim - 2,) if _is_dense_kernel(kernel_q)
+            else tuple(range(kernel_q.ndim - 4, kernel_q.ndim - 1)))
+    return kernel_q.astype(jnp.float32) * jnp.expand_dims(scale, axes)
+
+
+def is_quantized(params) -> bool:
+    return isinstance(params, dict) and "kernel_q" in params
+
+
+def quantize_params(params):
+    """Tree transform: every dict holding a dense/conv "kernel" becomes
+    {"kernel_q": int8, "kernel_scale": f32, ...rest} (bias etc. kept).
+    Dicts without a "kernel" key (norms, embeddings, MoE expert stacks)
+    pass through untouched. Idempotent on already-quantized dicts."""
+    if not isinstance(params, dict):
+        return params
+    if "kernel_q" in params:
+        return params
+    if "kernel" in params and hasattr(params["kernel"], "ndim") and (
+            _is_dense_kernel(params["kernel"])
+            or _is_conv_kernel(params["kernel"])):
+        out = {k: v for k, v in params.items() if k != "kernel"}
+        out["kernel_q"], out["kernel_scale"] = quantize_kernel(
+            params["kernel"])
+        return out
+    return {k: quantize_params(v) for k, v in params.items()}
+
+
+def dequantize_params(params):
+    """Inverse transform (for tests / round-trip bounds)."""
+    if not isinstance(params, dict):
+        return params
+    if "kernel_q" in params:
+        out = {k: v for k, v in params.items()
+               if k not in ("kernel_q", "kernel_scale")}
+        out["kernel"] = dequantize_kernel(params["kernel_q"],
+                                          params["kernel_scale"])
+        return out
+    return {k: dequantize_params(v) for k, v in params.items()}
+
+
+def param_bytes(params) -> int:
+    return int(sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(params)))

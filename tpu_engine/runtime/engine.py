@@ -53,6 +53,7 @@ class InferenceEngine:
         param_shardings=None,
         device=None,
         model_kwargs: Optional[dict] = None,
+        quantize: Optional[str] = None,
     ):
         if isinstance(model, str):
             _ensure_builtin_models_imported()
@@ -80,6 +81,19 @@ class InferenceEngine:
         if mesh is not None and device is not None:
             raise ValueError("pass either mesh or device, not both")
         self.params = params if params is not None else model.init(jax.random.PRNGKey(rng_seed))
+        # Weight-only int8 (ops.quant): dense/conv kernels stored int8 with
+        # per-out-channel scales — halves weight HBM traffic vs bf16, which
+        # is where bandwidth-bound decode spends its time. Downstream lanes
+        # (generator/scheduler/speculative) share these params, so one flag
+        # quantizes every serving path of the worker.
+        self.quantize = quantize
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(f"unsupported quantize mode '{quantize}' "
+                                 "(supported: int8)")
+            from tpu_engine.ops.quant import quantize_params
+
+            self.params = quantize_params(self.params)
         # With a mesh, params place per `param_shardings` — replicated by
         # default, or tensor-parallel (training.shard_params_tp trees) so one
         # model spans the `model` axis; XLA inserts the matmul collectives.

@@ -170,9 +170,14 @@ class SpeculativeGenerator:
 
     # -- compiled whole-generation function --------------------------------
 
-    def _build(self, bb: int, pb: int, cap: int):
+    def _build(self, bb: int, pb: int, cap: int, stochastic: bool):
         """One jitted function running the full speculative loop for batch
-        bucket bb, prompt bucket pb, output capacity cap."""
+        bucket bb, prompt bucket pb, output capacity cap. `stochastic` is a
+        COMPILE-TIME flag: greedy-only batches (the default wire value)
+        skip the rejection-sampling path entirely — temps is a traced
+        array, so without the static flag XLA could not dead-code the two
+        (B, W, V) softmaxes and per-row draws whose results an all-greedy
+        batch discards."""
         tcfg, dcfg, k = self.tcfg, self.dcfg, self.k
         w = k + 1
         dtype = self._dtype
@@ -251,44 +256,47 @@ class SpeculativeGenerator:
                 acc_g = (d == g[:, :k])
                 cum_g = jnp.cumprod(acc_g.astype(jnp.int32), axis=1)
                 n_acc_g = jnp.sum(cum_g, axis=1)                # (B,)
-                e_g = g
-
-                # ---- stochastic acceptance (rejection sampling).
-                t_safe = jnp.maximum(temps, 1e-6)[:, None, None]
-                p = jax.nn.softmax(tl / t_safe, axis=-1)        # (B, W, V)
-                q = jax.nn.softmax(dlg / t_safe, axis=-1)       # (B, k, V)
-                p_d = jnp.take_along_axis(
-                    p[:, :k], d[..., None], axis=2)[..., 0]     # (B, k)
-                q_d = jnp.take_along_axis(
-                    q, d[..., None], axis=2)[..., 0]
-                u = _tagged_uniform(seeds, logical, _TAG_ACCEPT, (k,))
-                ratio = p_d / jnp.maximum(q_d, 1e-30)
-                acc_s = u < jnp.minimum(ratio, 1.0)
-                cum_s = jnp.cumprod(acc_s.astype(jnp.int32), axis=1)
-                n_acc_s = jnp.sum(cum_s, axis=1)
-                # Residual/bonus distribution at the first rejected slot
-                # (or p_k when all k accepted; q padded with zeros there).
-                q_pad = jnp.concatenate(
-                    [q, jnp.zeros((bb, 1, q.shape[-1]), q.dtype)], axis=1)
-                p_j = jnp.take_along_axis(
-                    p, n_acc_s[:, None, None], axis=1)[:, 0]    # (B, V)
-                q_j = jnp.take_along_axis(
-                    q_pad, n_acc_s[:, None, None], axis=1)[:, 0]
-                resid = jnp.maximum(p_j - q_j, 0.0)
-                tot = jnp.sum(resid, axis=-1, keepdims=True)
-                dist = jnp.where(tot > 0, resid, p_j)
-                corr = _tagged_categorical(
-                    seeds, logical, _TAG_RESID,
-                    jnp.log(jnp.maximum(dist, 1e-30)))
                 slot = jnp.arange(w)[None, :]
-                d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
-                e_s = jnp.where(slot == n_acc_s[:, None],
-                                corr[:, None], d_ext)
 
-                # ---- per-row greedy/stochastic select.
-                use_s = temps > 0
-                n_acc = jnp.where(use_s, n_acc_s, n_acc_g)
-                emitted = jnp.where(use_s[:, None], e_s, e_g)   # (B, W)
+                if stochastic:
+                    # ---- stochastic acceptance (rejection sampling).
+                    t_safe = jnp.maximum(temps, 1e-6)[:, None, None]
+                    p = jax.nn.softmax(tl / t_safe, axis=-1)    # (B, W, V)
+                    q = jax.nn.softmax(dlg / t_safe, axis=-1)   # (B, k, V)
+                    p_d = jnp.take_along_axis(
+                        p[:, :k], d[..., None], axis=2)[..., 0]  # (B, k)
+                    q_d = jnp.take_along_axis(
+                        q, d[..., None], axis=2)[..., 0]
+                    u = _tagged_uniform(seeds, logical, _TAG_ACCEPT, (k,))
+                    ratio = p_d / jnp.maximum(q_d, 1e-30)
+                    acc_s = u < jnp.minimum(ratio, 1.0)
+                    cum_s = jnp.cumprod(acc_s.astype(jnp.int32), axis=1)
+                    n_acc_s = jnp.sum(cum_s, axis=1)
+                    # Residual/bonus distribution at the first rejected
+                    # slot (p_k when all k accepted; q zero-padded there).
+                    q_pad = jnp.concatenate(
+                        [q, jnp.zeros((bb, 1, q.shape[-1]), q.dtype)],
+                        axis=1)
+                    p_j = jnp.take_along_axis(
+                        p, n_acc_s[:, None, None], axis=1)[:, 0]  # (B, V)
+                    q_j = jnp.take_along_axis(
+                        q_pad, n_acc_s[:, None, None], axis=1)[:, 0]
+                    resid = jnp.maximum(p_j - q_j, 0.0)
+                    tot = jnp.sum(resid, axis=-1, keepdims=True)
+                    dist = jnp.where(tot > 0, resid, p_j)
+                    corr = _tagged_categorical(
+                        seeds, logical, _TAG_RESID,
+                        jnp.log(jnp.maximum(dist, 1e-30)))
+                    d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
+                    e_s = jnp.where(slot == n_acc_s[:, None],
+                                    corr[:, None], d_ext)
+                    # ---- per-row greedy/stochastic select.
+                    use_s = temps > 0
+                    n_acc = jnp.where(use_s, n_acc_s, n_acc_g)
+                    emitted = jnp.where(use_s[:, None], e_s, g)  # (B, W)
+                else:
+                    n_acc = n_acc_g
+                    emitted = g
                 n_emit = n_acc + 1
 
                 # ---- write emitted tokens, advance bookkeeping.
@@ -324,12 +332,12 @@ class SpeculativeGenerator:
         # cache buffers can never alias an output — XLA frees them at exit.
         return jax.jit(run)
 
-    def _exe_for(self, bb: int, pb: int, cap: int):
-        key = (bb, pb, cap)
+    def _exe_for(self, bb: int, pb: int, cap: int, stochastic: bool):
+        key = (bb, pb, cap, stochastic)
         with self._lock:
             exe = self._exe.get(key)
             if exe is None:
-                exe = self._build(bb, pb, cap)
+                exe = self._build(bb, pb, cap, stochastic)
                 self._exe[key] = exe
         return exe
 
@@ -397,7 +405,8 @@ class SpeculativeGenerator:
             tcaches = jax.device_put(tcaches, dev)
             dcaches = jax.device_put(dcaches, dev)
 
-        exe = self._exe_for(bb, pb, cap_bucket)
+        exe = self._exe_for(bb, pb, cap_bucket,
+                            stochastic=any(t > 0 for t in temps))
         out_buf, n_out, stats = exe(
             self.params, self.draft_params, put(tokens), put(attn_mask),
             put(pos_ids), put(start), put(alive), tcaches, dcaches,

@@ -136,6 +136,10 @@ def main(argv=None) -> int:
         parser.add_argument("--gen-spec-k", type=int, default=4,
                             help="speculation depth: draft tokens proposed "
                                  "per verify round")
+        parser.add_argument("--quantize", choices=["int8"], default=None,
+                            help="weight-only quantization: dense/conv "
+                                 "kernels stored int8 with per-channel "
+                                 "scales (halves weight HBM traffic)")
         args = parser.parse_args(rest)
         gateway_config = None
         if args.breaker_timeout is not None:
@@ -155,6 +159,7 @@ def main(argv=None) -> int:
                                      gen_draft_model=args.gen_draft_model,
                                      gen_draft_path=args.gen_draft_path,
                                      gen_spec_k=args.gen_spec_k,
+                                     quantize=args.quantize,
                                      model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,
                        warmup=args.warmup, worker_config=worker_config,

@@ -143,6 +143,14 @@ class WorkerNode:
                 # come from the file, no registry entry needed.
                 from tpu_engine.models.onnx_graph import build_onnx_model
 
+                if self.config.quantize is not None:
+                    # ONNX initializers are flat named arrays, not the
+                    # kernel dicts ops.quant rewrites — forwarding the flag
+                    # would silently quantize nothing. Fail loudly instead.
+                    raise RuntimeError(
+                        "quantize is not supported for raw .onnx graphs "
+                        "(import the checkpoint into a registry "
+                        "architecture to serve quantized)")
                 spec, params = build_onnx_model(self.config.model_path)
                 engine = InferenceEngine(
                     spec,
@@ -177,6 +185,7 @@ class WorkerNode:
                     dtype=self.config.dtype,
                     batch_buckets=self.config.batch_buckets,
                     shape_buckets=self.config.shape_buckets,
+                    quantize=self.config.quantize,
                 )
         self.engine = engine
         self.cache = _make_cache(self.config.cache_capacity)
@@ -307,7 +316,21 @@ class WorkerNode:
                 f"'{self.engine.spec.name}': set gen_draft_model "
                 f"(--gen-draft-model)")
         _ensure_builtin_models_imported()
-        draft_spec = create_model(draft_name)
+        # Same geometry sync the target path gets (worker init above): an
+        # HF draft checkpoint dir's config.json overrides registry-default
+        # shape-invariant fields (rope_theta etc.) so imported weights
+        # compute with the right architecture, not defaults.
+        draft_kwargs = {}
+        if self.config.gen_draft_path and os.path.isdir(
+                self.config.gen_draft_path):
+            from tpu_engine.models.import_weights import hf_spec_kwargs
+
+            draft_kwargs = hf_spec_kwargs(self.config.gen_draft_path) or {}
+        try:
+            draft_spec = create_model(draft_name, **draft_kwargs)
+        except KeyError as exc:
+            raise RuntimeError(f"speculative lane misconfigured: unknown "
+                               f"draft model {exc}")
         draft_params = None
         if self.config.gen_draft_path:
             draft_params = _load_model_path(draft_spec,

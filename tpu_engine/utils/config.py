@@ -26,6 +26,11 @@ class WorkerConfig:
     batch_timeout_ms: float = 20.0      # reference worker_node.cpp:36
     batch_linger_ms: float = 0.0        # TPU extension: accumulation window
     dtype: str = "bfloat16"             # MXU-native compute dtype
+    # Weight-only quantization ("int8" | None): dense/conv kernels stored
+    # int8 + per-out-channel scales (ops.quant) — halves weight HBM bytes,
+    # the bandwidth-bound decode path's budget. Applies to every lane of
+    # the worker (one-shot /infer and all /generate schedulers).
+    quantize: Optional[str] = None
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32)
     # Mixed-shape serving (BASELINE config 4): per-sample input shapes the
     # engine compiles executables for; requests carry "shape": [h, w, c].
