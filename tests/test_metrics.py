@@ -1,0 +1,91 @@
+"""Prometheus /metrics endpoint (utils.metrics)."""
+
+import http.client
+
+import pytest
+
+from tpu_engine.utils.metrics import render_prometheus
+
+
+def test_render_worker_metrics():
+    health = {
+        "healthy": True, "node_id": "w1", "total_requests": 42,
+        "cache_hits": 40, "cache_size": 7, "cache_hit_rate": 0.952,
+        "batch_processor": {"total_batches": 5, "timeout_batches": 2,
+                            "full_batches": 3, "avg_batch_size": 6.4},
+    }
+    text = render_prometheus([health]).decode()
+    assert 'tpu_engine_requests_total{node="w1"} 42' in text
+    assert 'tpu_engine_cache_hit_rate{node="w1"} 0.952' in text
+    assert "# TYPE tpu_engine_batches_total counter" in text
+    assert 'tpu_engine_healthy{node="w1"} 1' in text
+
+
+def test_render_breaker_states():
+    stats = {"total_workers": 2, "total_requests": 10, "failovers": 1,
+             "circuit_breakers": [
+                 {"node": "a:1", "state": "CLOSED", "failures": 0,
+                  "successes": 4},
+                 {"node": "b:2", "state": "OPEN", "failures": 5,
+                  "successes": 0}]}
+    text = render_prometheus([], stats).decode()
+    assert 'tpu_engine_breaker_state{node="a:1"} 0' in text
+    assert 'tpu_engine_breaker_state{node="b:2"} 1' in text
+    assert "tpu_engine_gateway_failovers_total 1" in text
+
+
+def test_label_escaping():
+    health = {"healthy": False, "node_id": 'w"x\\y', "total_requests": 0,
+              "cache_hits": 0, "cache_size": 0, "cache_hit_rate": 0.0,
+              "batch_processor": {}}
+    text = render_prometheus([health]).decode()
+    assert 'node="w\\"x\\\\y"' in text
+    assert "tpu_engine_healthy" in text
+
+
+def test_metrics_over_http():
+    from tpu_engine.serving.app import serve_worker
+    from tpu_engine.utils.config import WorkerConfig
+
+    cfg = WorkerConfig(port=0, node_id="metrics_w", model="mlp")
+    w, server = serve_worker(cfg, background=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/infer",
+                     body='{"request_id":"m1","input_data":[1.0,2.0]}',
+                     headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert 'tpu_engine_requests_total{node="metrics_w"} 1' in body
+        conn.close()
+    finally:
+        server.stop()
+        w.stop()
+
+
+def test_metrics_through_combined_front():
+    """/metrics works through combined mode (native C++ front fallback
+    path returns 3-tuples; regression for the 2-tuple unpack)."""
+    from tpu_engine.serving.app import serve_combined
+
+    gateway, workers, server = serve_combined(model="mlp", lanes=1,
+                                              port=0, background=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert "tpu_engine_healthy" in body
+        assert "tpu_engine_breaker_state" in body
+        conn.close()
+    finally:
+        server.stop()
+        for w in workers:
+            w.stop()

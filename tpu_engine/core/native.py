@@ -106,6 +106,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tpu_front_lane_hits.argtypes = [P, ctypes.c_char_p]
     lib.tpu_front_reply.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                     ctypes.c_char_p, c_size]
+    if hasattr(lib, "tpu_front_reply2"):  # older .so: plain reply only
+        lib.tpu_front_reply2.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_char_p, c_size,
+                                         ctypes.c_char_p]
     return lib
 
 
@@ -409,14 +413,25 @@ class NativeHttpFront:
         self._lanes: List[str] = []
         lib = self._lib
 
+        can_ctype = hasattr(lib, "tpu_front_reply2")
+
         def _handler(reply_ctx, method, path, body, body_len):
+            ctype = None
             try:
-                status, payload = fallback(
-                    method.decode(), path.decode(), body or b"")
+                result = fallback(method.decode(), path.decode(), body or b"")
+                # (status, payload) or (status, payload, content_type) —
+                # the latter e.g. /metrics' text/plain exposition.
+                status, payload = result[0], result[1]
+                if len(result) == 3:
+                    ctype = result[2]
             except Exception as exc:  # never let an exception cross ctypes
                 status, payload = 500, (
                     b'{"error": ' + _json_str(str(exc)) + b"}")
-            lib.tpu_front_reply(reply_ctx, status, payload, len(payload))
+            if ctype is not None and can_ctype:
+                lib.tpu_front_reply2(reply_ctx, status, payload,
+                                     len(payload), ctype.encode())
+            else:
+                lib.tpu_front_reply(reply_ctx, status, payload, len(payload))
 
         # Keep a reference: the C side stores the raw function pointer.
         self._handler_ref = HANDLER_FN(_handler)

@@ -207,4 +207,17 @@ void tpu_front_reply(void* reply_ctx, int status, const char* data,
   slot->body.assign(data, len);
 }
 
+// Variant carrying an explicit Content-Type (e.g. /metrics' Prometheus
+// text exposition). Kept separate so older .so builds stay ABI-compatible
+// with the plain tpu_front_reply.
+void tpu_front_reply2(void* reply_ctx, int status, const char* data,
+                      std::size_t len, const char* content_type) {
+  auto* slot = static_cast<ReplySlot*>(reply_ctx);
+  slot->status = status;
+  slot->body.assign(data, len);
+  if (content_type != nullptr && content_type[0] != '\0') {
+    slot->content_type = content_type;
+  }
+}
+
 }  // extern "C"

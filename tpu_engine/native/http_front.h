@@ -41,10 +41,14 @@
 
 namespace tpucore {
 
-// Filled by the Python fallback handler through tpu_front_reply(ctx, ...).
+// Filled by the Python fallback handler through tpu_front_reply(ctx, ...)
+// or tpu_front_reply2(ctx, ..., content_type) — the latter carries a
+// non-JSON content type (e.g. /metrics' Prometheus text exposition, which
+// Prometheus 3.x refuses to scrape under application/json).
 struct ReplySlot {
   int status = 500;
   std::string body = "{\"error\": \"python handler did not reply\"}";
+  std::string content_type = "application/json";
 };
 
 // void handler(void* reply_ctx, method, path, body, body_len)
@@ -434,11 +438,12 @@ class HttpFront {
     if (handler_ != nullptr) {
       handler_(&slot, method.c_str(), path.c_str(), body.data(), body.size());
     }
-    WrapHttp(slot.status, slot.body, resp);
+    WrapHttp(slot.status, slot.body, resp, slot.content_type.c_str());
   }
 
   static void WrapHttp(int status, const std::string& payload,
-                       std::string* resp) {
+                       std::string* resp,
+                       const char* content_type = "application/json") {
     const char* reason = status == 200 ? "OK"
                          : status == 400 ? "Bad Request"
                          : status == 404 ? "Not Found"
@@ -446,12 +451,14 @@ class HttpFront {
                          : status == 431 ? "Request Header Fields Too Large"
                                          : "Internal Server Error";
     resp->clear();
-    resp->reserve(payload.size() + 128);
+    resp->reserve(payload.size() + 160);
     *resp += "HTTP/1.1 ";
     *resp += std::to_string(status);
     *resp += " ";
     *resp += reason;
-    *resp += "\r\nContent-Type: application/json\r\nContent-Length: ";
+    *resp += "\r\nContent-Type: ";
+    *resp += content_type;
+    *resp += "\r\nContent-Length: ";
     *resp += std::to_string(payload.size());
     *resp += "\r\n\r\n";
     *resp += payload;

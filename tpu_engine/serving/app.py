@@ -20,6 +20,7 @@ from tpu_engine.serving.gateway import Gateway
 from tpu_engine.serving.http import JsonHttpServer
 from tpu_engine.serving.worker import WorkerNode
 from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+from tpu_engine.utils.metrics import render_prometheus
 
 
 def model_from_path(path_or_name: str) -> str:
@@ -49,6 +50,9 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     server.route("POST", "/generate/stream",
                  lambda body: (200, worker.handle_generate_stream(body)))
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
+    server.route("GET", "/metrics", lambda _body: (
+        200, render_prometheus([worker.get_health()]),
+        "text/plain; version=0.0.4"))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -64,6 +68,9 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     server.route("POST", "/generate/stream",
                  lambda body: (200, gateway.route_generate_stream(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
+    server.route("GET", "/metrics", lambda _body: (
+        200, render_prometheus([], gateway.get_stats()),
+        "text/plain; version=0.0.4"))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
     print("Circuit breakers enabled")
@@ -271,6 +278,10 @@ def serve_combined(
 
     routes[("GET", "/trace")] = _trace
     routes[("POST", "/admin/profile")] = _admin_profile
+    routes[("GET", "/metrics")] = lambda _b: (
+        200, render_prometheus([w.get_health() for w in workers],
+                               gateway.get_stats()),
+        "text/plain; version=0.0.4")
 
     server = _make_front_server(port, routes, workers, gateway, native_front)
     kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"
@@ -325,7 +336,13 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
             return 404, _json.dumps({"error": f"no route {method} {path}"}).encode()
         try:
             parsed = _json.loads(body) if method == "POST" else None
-            status, payload = handler(parsed)
+            result = handler(parsed)
+            # (status, payload) or (status, payload, content_type); the
+            # content type rides through tpu_front_reply2 so /metrics is
+            # text/plain even behind the C++ front (Prometheus 3.x rejects
+            # scrapes served as application/json).
+            ctype = result[2] if len(result) == 3 else None
+            status, payload = result[0], result[1]
             if not isinstance(payload, (bytes, bytearray)):
                 if (hasattr(payload, "__iter__")
                         and not isinstance(payload, (dict, list, str))):
@@ -343,6 +360,8 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
             return 400, _json.dumps({"error": str(exc)}).encode()
         except Exception as exc:
             return 500, _json.dumps({"error": str(exc)}).encode()
+        if ctype is not None:
+            return status, payload, ctype
         return status, payload
 
     front = NativeHttpFront(port, fallback)

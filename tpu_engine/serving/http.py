@@ -91,7 +91,8 @@ class JsonHttpServer:
             def log_message(self, *args):  # silence per-request stderr noise
                 pass
 
-            def _respond(self, status: int, payload) -> None:
+            def _respond(self, status: int, payload,
+                         content_type: str = "application/json") -> None:
                 # Handlers may return pre-serialized bytes (hot /infer
                 # path), a dict, or an ITERATOR of byte chunks (streaming
                 # SSE, e.g. /generate/stream) sent with chunked
@@ -105,7 +106,7 @@ class JsonHttpServer:
                 body = (payload if isinstance(payload, (bytes, bytearray))
                         else json.dumps(payload).encode())
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -154,8 +155,14 @@ class JsonHttpServer:
                         length = int(self.headers.get("Content-Length", 0))
                         raw = self.rfile.read(length) if length else b"{}"
                         body = json.loads(raw)
-                    status, payload = handler(body)
-                    self._respond(status, payload)
+                    result = handler(body)
+                    # (status, payload) or (status, payload, content_type)
+                    # — e.g. /metrics returns Prometheus text exposition.
+                    if len(result) == 3:
+                        self._respond(result[0], result[1],
+                                      content_type=result[2])
+                    else:
+                        self._respond(result[0], result[1])
                 except (KeyError, ValueError, TypeError) as exc:
                     # Malformed/unsupported request → 400 so gateways can
                     # tell client errors from worker failures (the reference

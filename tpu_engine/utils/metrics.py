@@ -1,0 +1,93 @@
+"""Prometheus text-exposition rendering of the serving metrics.
+
+The reference exposes metrics only as ad-hoc JSON (``/health``
+``worker_node.cpp:85-103``, ``/stats`` ``gateway.cpp:63-77``) that its own
+benchmark scrapes. Those JSON schemas stay reference-exact; `/metrics`
+additionally renders the same counters in the Prometheus exposition format
+(version 0.0.4) so standard scrapers/alerting work against a worker or the
+combined front without an adapter.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_BREAKER_STATE_IDS = {"CLOSED": 0, "OPEN": 1, "HALF_OPEN": 2}
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None) -> bytes:
+    """healths: per-lane WorkerNode.get_health() dicts; stats: optional
+    Gateway.get_stats(). Returns the exposition body (text/plain 0.0.4)."""
+    lines: List[str] = []
+
+    def metric(name, mtype, help_text, samples):
+        # samples: list of (labels-dict, value); skip metrics with no data.
+        vals = [(lbl, v) for lbl, v in samples if v is not None]
+        if not vals:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for lbl, v in vals:
+            label_s = ",".join(f'{k}="{_esc(val)}"' for k, val in lbl.items())
+            label_s = "{" + label_s + "}" if label_s else ""
+            lines.append(f"{name}{label_s} {v}")
+
+    def node(h):
+        return {"node": h.get("node_id", "?")}
+
+    metric("tpu_engine_healthy", "gauge", "1 = lane serving, 0 = faulted",
+           [(node(h), int(bool(h.get("healthy")))) for h in healths])
+    metric("tpu_engine_requests_total", "counter",
+           "Requests handled (reference /health total_requests)",
+           [(node(h), h.get("total_requests")) for h in healths])
+    metric("tpu_engine_cache_hits_total", "counter",
+           "LRU result-cache hits (reference /health cache_hits)",
+           [(node(h), h.get("cache_hits")) for h in healths])
+    metric("tpu_engine_cache_size", "gauge", "Entries in the result cache",
+           [(node(h), h.get("cache_size")) for h in healths])
+    metric("tpu_engine_cache_hit_rate", "gauge",
+           "Result-cache hit rate [0,1]",
+           [(node(h), h.get("cache_hit_rate")) for h in healths])
+    bp = [(h, h.get("batch_processor") or {}) for h in healths]
+    metric("tpu_engine_batches_total", "counter", "Batches executed",
+           [(node(h), m.get("total_batches")) for h, m in bp])
+    metric("tpu_engine_batches_timeout_total", "counter",
+           "Batches flushed by the timeout timer",
+           [(node(h), m.get("timeout_batches")) for h, m in bp])
+    metric("tpu_engine_batches_full_total", "counter",
+           "Batches flushed at max size",
+           [(node(h), m.get("full_batches")) for h, m in bp])
+    metric("tpu_engine_batch_size_avg", "gauge", "Mean batch size",
+           [(node(h), m.get("avg_batch_size")) for h, m in bp])
+    gen = [(h, h.get("generator")) for h in healths if h.get("generator")]
+    metric("tpu_engine_decode_scheduler_info", "gauge",
+           "Decode lane present (labels carry scheduler metadata)",
+           [({**node(h), "model": g.get("model", g.get("target", "?"))}, 1)
+            for h, g in gen])
+
+    if stats:
+        metric("tpu_engine_gateway_requests_total", "counter",
+               "Requests routed by the gateway",
+               [({}, stats.get("total_requests"))])
+        metric("tpu_engine_gateway_failovers_total", "counter",
+               "Requests that failed over off their primary worker",
+               [({}, stats.get("failovers"))])
+        workers = stats.get("circuit_breakers") or []
+        metric("tpu_engine_breaker_state", "gauge",
+               "Circuit breaker: 0=CLOSED 1=OPEN 2=HALF_OPEN",
+               [({"node": w.get("node", "?")},
+                 _BREAKER_STATE_IDS.get(w.get("state"), -1))
+                for w in workers])
+        metric("tpu_engine_breaker_failures", "gauge",
+               "Consecutive failures recorded by the breaker",
+               [({"node": w.get("node", "?")}, w.get("failures"))
+                for w in workers])
+        metric("tpu_engine_breaker_successes", "gauge",
+               "Successes recorded by the breaker",
+               [({"node": w.get("node", "?")}, w.get("successes"))
+                for w in workers])
+    return ("\n".join(lines) + "\n").encode()
