@@ -721,10 +721,17 @@ def probe_device(timeout_s: float = 240.0, attempts: int = 3,
             if proc.returncode == 0:
                 log(f"device probe OK: {out.strip()}")
                 return
-            # A nonzero exit is deterministic (bad install/platform env) —
-            # retrying cannot help; fail fast so the driver still gets its
-            # artifact. Only HANGS (transient tunnel wedges) retry.
-            raise RuntimeError(f"device probe failed: {err[-300:]}")
+            # Nonzero exits split two ways: device-contention errors (the
+            # previous round's server still releasing the chip) are
+            # transient and retry; anything else (bad install/platform
+            # env) is deterministic and fails fast so the driver still
+            # gets its artifact.
+            transient = any(sig in err for sig in (
+                "already in use", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+                "RESOURCE_EXHAUSTED"))
+            if not transient:
+                raise RuntimeError(f"device probe failed: {err[-300:]}")
+            last = RuntimeError(f"device busy: {err[-300:]}")
         log(f"device probe attempt {attempt}/{attempts} failed: {last}")
         if attempt < attempts:
             time.sleep(retry_sleep_s)

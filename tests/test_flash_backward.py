@@ -1,0 +1,112 @@
+"""Flash attention backward (custom_vjp over the Pallas kernels).
+
+Gradients through flash_attention must match autodiff through the XLA
+reference attention — this is what makes the kernel TRAINING-grade: the
+layer stack picks flash on TPU (transformer.default_attention), and
+jax.value_and_grad through a raw pallas_call would fail there.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.ops.attention import dot_product_attention
+from tpu_engine.ops.flash import flash_attention
+
+
+def _grads(attn, q, k, v, mask=None, causal=True):
+    def loss(q, k, v):
+        out = attn(q, k, v, causal=causal, mask=mask)
+        # Non-uniform weighting so dq/dk/dv are all exercised.
+        w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape)
+        return jnp.sum(out.astype(jnp.float32) * jnp.sin(w * 0.1))
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def _assert_close(got, want, rtol=2e-2):
+    for g, w in zip(got, want):
+        g, w = np.asarray(g, np.float32), np.asarray(w, np.float32)
+        denom = np.max(np.abs(w)) + 1e-6
+        assert np.max(np.abs(g - w)) / denom < rtol, \
+            np.max(np.abs(g - w)) / denom
+
+
+@pytest.mark.parametrize("shape", [(2, 64, 2, 32), (1, 200, 4, 64)])
+def test_causal_grads_match_xla(shape):
+    b, s, h, d = shape
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    want = _grads(dot_product_attention, q, k, v)
+    got = _grads(functools.partial(flash_attention, block_q=64, block_k=128),
+                 q, k, v)
+    _assert_close(got, want)
+
+
+def test_masked_grads_match_xla():
+    b, s, h, d = 2, 96, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    mask = (jax.random.uniform(ks[3], (b, s)) > 0.3).astype(jnp.int32)
+    mask = mask.at[:, :2].set(1)
+    want = _grads(dot_product_attention, q, k, v, mask=mask)
+    got = _grads(flash_attention, q, k, v, mask=mask)
+    _assert_close(got, want)
+
+
+def test_noncausal_grads_match_xla():
+    shape = (2, 48, 2, 32)
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    want = _grads(dot_product_attention, q, k, v, causal=False)
+    got = _grads(flash_attention, q, k, v, causal=False)
+    _assert_close(got, want)
+
+
+def test_fully_masked_rows_zero_grads():
+    """A row with every key masked contributes zero gradient (no NaNs from
+    the lse = -inf sentinel)."""
+    b, s, h, d = 2, 32, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    mask = jnp.zeros((b, s), jnp.int32).at[0, :].set(1)  # row 1 fully masked
+    dq, dk, dv = _grads(flash_attention, q, k, v, mask=mask)
+    assert np.isfinite(np.asarray(dq)).all()
+    assert np.isfinite(np.asarray(dk)).all()
+    np.testing.assert_allclose(np.asarray(dk)[1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dv)[1], 0.0, atol=1e-6)
+
+
+def test_training_step_through_flash():
+    """A transformer train step with attn_fn=flash compiles and produces
+    finite grads (the end-to-end training-grade contract)."""
+    from tpu_engine.models.registry import (
+        _ensure_builtin_models_imported, create_model)
+    from tpu_engine.models.transformer import transformer_apply
+
+    _ensure_builtin_models_imported()
+    spec = create_model("gpt2-small-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        1, 250, size=(2, 16)), jnp.int32)
+
+    def loss(p):
+        logits = transformer_apply(p, tokens, spec.config,
+                                   dtype=jnp.float32,
+                                   attn_fn=flash_attention)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)

@@ -109,13 +109,32 @@ def test_bytes_halved():
     assert param_bytes(q) < 0.55 * param_bytes(params)
 
 
-def test_moe_gate_quantized_applies():
+def test_moe_experts_quantized_router_full_precision():
+    """MoE inverts the default rule: expert stacks (the HBM bytes)
+    quantize; the ROUTER gate stays f32 (top-k is discontinuous — a
+    perturbed router flips tokens to different experts)."""
     spec = create_model("gpt2-moe-test")
     params = spec.init(jax.random.PRNGKey(0))
+    q = quantize_params(params)
+    mlp = q["blocks"]["mlp"]
+    assert "kernel" in mlp["gate"] and "kernel_q" not in mlp["gate"]
+    assert mlp["wi_q"].dtype == jnp.int8 and "wi" not in mlp
+    assert mlp["wo_q"].dtype == jnp.int8 and "wo" not in mlp
+    # stacked (L, E, d, f) experts: per-(layer, expert, out-channel) scales
+    assert mlp["wi_scale"].shape == mlp["wi_q"].shape[:2] + (
+        mlp["wi_q"].shape[-1],)
     x = jnp.zeros((1, spec.input_shape[0])).at[0, :4].set(
         jnp.asarray([3.0, 5.0, 7.0, 2.0]))
-    out = spec.apply(quantize_params(params), x, dtype=jnp.float32)
-    assert np.isfinite(np.asarray(out)).all()
+    full = spec.apply(params, x, dtype=jnp.float32)
+    quant = spec.apply(q, x, dtype=jnp.float32)
+    assert np.isfinite(np.asarray(quant)).all()
+    # identical routing (router untouched) => output close to f32
+    rel = float(jnp.max(jnp.abs(quant - full))
+                / (float(jnp.max(jnp.abs(full))) + 1e-9))
+    assert rel < 0.1, rel
+    # round-trip restores the plain tree
+    rt = dequantize_params(q)
+    assert "wi" in rt["blocks"]["mlp"] and "wi_q" not in rt["blocks"]["mlp"]
 
 
 def test_quantized_generation_deterministic():

@@ -114,9 +114,24 @@ def moe_apply(params, x, cfg: MoEConfig, dtype=jnp.bfloat16):
     # Dispatch: (N, D) x (N, E, C) -> (E, C, D); expert dim shards over
     # the `expert` mesh axis -> XLA all-to-alls tokens to their experts.
     expert_in = jnp.einsum("nd,nec->ecd", xc, dispatch.astype(dtype))
-    h = jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(dtype))
+    # Expert stacks may be ops.quant int8 ({wi_q, wi_scale}): the
+    # per-(expert, out-channel) scale applies to the einsum OUTPUT —
+    # exact, with weights streaming from HBM at 1 byte each. The router
+    # gate above deliberately stays full precision (top-k is
+    # discontinuous; see ops/quant.quantize_params).
+    if "wi_q" in params:
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       params["wi_q"].astype(dtype))
+        h = h * params["wi_scale"][:, None, :]
+    else:
+        h = jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(dtype))
     h = jax.nn.gelu(h)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(dtype))
+    if "wo_q" in params:
+        expert_out = jnp.einsum("ecf,efd->ecd", h.astype(dtype),
+                                params["wo_q"].astype(dtype))
+        expert_out = expert_out * params["wo_scale"][:, None, :]
+    else:
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(dtype))
     # Combine: weighted return of expert outputs to token positions.
     out = jnp.einsum("ecd,nec->nd", expert_out,
                      combine.astype(dtype))

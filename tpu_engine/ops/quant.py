@@ -26,6 +26,8 @@ other per deployment (documented in training.shard_params_tp).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,16 +41,18 @@ def _is_conv_kernel(kernel) -> bool:
     return kernel.ndim in (4, 5)  # HWIO or stacked (L, kh, kw, in, out)
 
 
-def quantize_kernel(kernel):
+def quantize_kernel(kernel, kind: Optional[str] = None):
     """kernel (f32) -> (int8 kernel_q, f32 per-out-channel scale).
 
     Symmetric round-to-nearest onto [-127, 127]; scale reduces over the
     input axis (dense) or spatial+input axes (conv), keeping leading
-    stacked axes."""
+    stacked axes. `kind` overrides rank-based detection — MoE expert
+    stacks are dense at any rank ((E, d, f), or (L, E, d, f) under the
+    scanned layer stack, which rank detection would misread as conv)."""
     kernel = jnp.asarray(kernel, jnp.float32)
-    if _is_dense_kernel(kernel):
+    if kind == "dense" or (kind is None and _is_dense_kernel(kernel)):
         axes = (kernel.ndim - 2,)
-    elif _is_conv_kernel(kernel):
+    elif kind == "conv" or (kind is None and _is_conv_kernel(kernel)):
         axes = tuple(range(kernel.ndim - 4, kernel.ndim - 1))
     else:
         raise ValueError(f"unsupported kernel rank {kernel.ndim}")
@@ -71,12 +75,24 @@ def is_quantized(params) -> bool:
 def quantize_params(params):
     """Tree transform: every dict holding a dense/conv "kernel" becomes
     {"kernel_q": int8, "kernel_scale": f32, ...rest} (bias etc. kept).
-    Dicts without a "kernel" key (norms, embeddings, MoE expert stacks)
-    pass through untouched. Idempotent on already-quantized dicts."""
+    Dicts without a "kernel" key (norms, embeddings) pass through
+    untouched. Idempotent on already-quantized dicts.
+
+    MoE FFN dicts ({"gate", "wi", "wo"}, ops.moe) invert the default rule:
+    the expert stacks wi/wo — the actual per-step HBM bytes — quantize to
+    {"wi_q","wi_scale"} / {"wo_q","wo_scale"}, while the tiny ROUTER gate
+    stays full precision (top-k expert choice is discontinuous; perturbing
+    router logits flips boundary tokens to different experts, an error
+    class int8 rounding of a linear layer never produces)."""
     if not isinstance(params, dict):
         return params
-    if "kernel_q" in params:
+    if "kernel_q" in params or "wi_q" in params:
         return params
+    if "gate" in params and "wi" in params and "wo" in params:
+        out = {k: v for k, v in params.items() if k not in ("wi", "wo")}
+        out["wi_q"], out["wi_scale"] = quantize_kernel(params["wi"], "dense")
+        out["wo_q"], out["wo_scale"] = quantize_kernel(params["wo"], "dense")
+        return out
     if "kernel" in params and hasattr(params["kernel"], "ndim") and (
             _is_dense_kernel(params["kernel"])
             or _is_conv_kernel(params["kernel"])):
@@ -96,6 +112,13 @@ def dequantize_params(params):
                if k not in ("kernel_q", "kernel_scale")}
         out["kernel"] = dequantize_kernel(params["kernel_q"],
                                           params["kernel_scale"])
+        return out
+    if "wi_q" in params:
+        out = {k: v for k, v in params.items()
+               if k not in ("wi_q", "wi_scale", "wo_q", "wo_scale")}
+        for name in ("wi", "wo"):
+            q, s = params[f"{name}_q"], params[f"{name}_scale"]
+            out[name] = q.astype(jnp.float32) * jnp.expand_dims(s, q.ndim - 2)
         return out
     return {k: dequantize_params(v) for k, v in params.items()}
 
