@@ -1,0 +1,103 @@
+"""Continuous-scheduler prefix cache (runtime.scheduler._PrefixCache).
+
+Contracts: exact repeats skip prefill (hits count up); output streams are
+IDENTICAL hit vs miss for seeded requests (logits cached, sampling per
+request); byte budget evicts LRU; 0 disables.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.runtime.scheduler import ContinuousGenerator, _PrefixCache
+
+
+@pytest.fixture(scope="module")
+def sched():
+    g = ContinuousGenerator("gpt2-small-test", dtype="float32", n_slots=4,
+                            step_chunk=4, prefix_cache_mb=16)
+    yield g
+    g.stop()
+
+
+def test_repeat_prompt_hits(sched):
+    prompt = [5, 9, 3, 7]
+    a = sched.generate([prompt], max_new_tokens=6, seed=1)
+    before = sched.stats()["prefix_cache"]
+    b = sched.generate([prompt], max_new_tokens=6, seed=1)
+    after = sched.stats()["prefix_cache"]
+    assert a == b
+    assert after["hits"] == before["hits"] + 1
+    assert after["entries"] >= 1
+
+
+def test_hit_respects_per_request_sampling(sched):
+    """Different seeds/temperatures sample differently FROM the cached
+    logits — the cache must never bake the first token in."""
+    prompt = [8, 1, 4]
+    sched.generate([prompt], max_new_tokens=4, seed=3, temperature=0.9)
+    h0 = sched.stats()["prefix_cache"]["hits"]
+    outs = {tuple(sched.generate([prompt], max_new_tokens=4, seed=s,
+                                 temperature=0.9)[0])
+            for s in (11, 22, 33, 44, 55)}
+    assert sched.stats()["prefix_cache"]["hits"] >= h0 + 4
+    assert len(outs) > 1  # seeds actually vary the stream
+
+
+def test_different_prompts_miss(sched):
+    m0 = sched.stats()["prefix_cache"]["misses"]
+    sched.generate([[9, 9, 9, 1]], max_new_tokens=3)
+    sched.generate([[9, 9, 9, 2]], max_new_tokens=3)
+    assert sched.stats()["prefix_cache"]["misses"] >= m0 + 2
+
+
+def test_budget_eviction():
+    import collections
+
+    cache = _PrefixCache(budget_bytes=3000)
+    logits = jnp.zeros((250,), jnp.float32)   # 1000 B
+    Item = collections.namedtuple("Item", "k v")
+    kv = Item(np.zeros((100,), np.float32), np.zeros((100,), np.float32))
+    # each entry = 1000 + 800 = 1800 B; two entries exceed 3000 -> evict
+    cache.put(("a",), logits, kv)
+    cache.put(("b",), logits, kv)
+    assert cache.bytes <= 3000
+    assert cache.get(("a",)) is None       # LRU evicted
+    assert cache.get(("b",)) is not None
+
+
+def test_oversized_entry_skipped():
+    cache = _PrefixCache(budget_bytes=100)
+    kv = __import__("collections").namedtuple("Item", "k v")(
+        np.zeros((100,), np.float32), np.zeros((100,), np.float32))
+    cache.put(("big",), jnp.zeros((250,), jnp.float32), kv)
+    assert cache.bytes == 0 and cache.get(("big",)) is None
+
+
+def test_disabled_cache():
+    g = ContinuousGenerator("gpt2-small-test", dtype="float32", n_slots=2,
+                            step_chunk=4, prefix_cache_mb=0)
+    try:
+        p = [4, 4, 2]
+        a = g.generate([p], max_new_tokens=4)
+        b = g.generate([p], max_new_tokens=4)
+        assert a == b
+        st = g.stats()["prefix_cache"]
+        assert st["entries"] == 0 and st["hits"] == 0
+    finally:
+        g.stop()
+
+
+def test_leading_zero_token_no_collision(sched):
+    """[5] and [0, 5] pad to identical token arrays at one bucket — the
+    length in the key must keep them distinct (code-review r4 finding:
+    token id 0 is a real vocab token)."""
+    h0 = sched.stats()["prefix_cache"]
+    a = sched.generate([[5]], max_new_tokens=4, seed=2)
+    b = sched.generate([[0, 5]], max_new_tokens=4, seed=2)
+    after = sched.stats()["prefix_cache"]
+    # both were misses (distinct entries), not a false hit
+    assert after["misses"] >= h0["misses"] + 2
+    # and repeats of each still hit their own entry
+    assert sched.generate([[5]], max_new_tokens=4, seed=2) == a
+    assert sched.generate([[0, 5]], max_new_tokens=4, seed=2) == b

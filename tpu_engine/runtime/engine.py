@@ -91,6 +91,15 @@ class InferenceEngine:
             if quantize != "int8":
                 raise ValueError(f"unsupported quantize mode '{quantize}' "
                                  "(supported: int8)")
+            if mesh is not None and param_shardings is not None:
+                # Tensor-parallel sharding rules match parameters by their
+                # "kernel" path name; a quantized tree's kernel_q/scale
+                # leaves wouldn't match and would silently replicate —
+                # refuse rather than serve a half-sharded model.
+                raise ValueError(
+                    "quantize=int8 with tensor-parallel param_shardings is "
+                    "unsupported (shard rules address 'kernel' paths); "
+                    "serve quantized on replicated/data meshes")
             from tpu_engine.ops.quant import quantize_params
 
             self.params = quantize_params(self.params)

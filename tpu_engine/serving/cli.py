@@ -136,6 +136,10 @@ def main(argv=None) -> int:
         parser.add_argument("--gen-spec-k", type=int, default=4,
                             help="speculation depth: draft tokens proposed "
                                  "per verify round")
+        parser.add_argument("--gen-prefix-cache-mb", type=int, default=64,
+                            help="continuous-scheduler prefix cache budget "
+                                 "(device KV MB; repeated prompts skip "
+                                 "prefill; 0 disables)")
         parser.add_argument("--quantize", choices=["int8"], default=None,
                             help="weight-only quantization: dense/conv "
                                  "kernels stored int8 with per-channel "
@@ -159,6 +163,7 @@ def main(argv=None) -> int:
                                      gen_draft_model=args.gen_draft_model,
                                      gen_draft_path=args.gen_draft_path,
                                      gen_spec_k=args.gen_spec_k,
+                                     gen_prefix_cache_mb=args.gen_prefix_cache_mb,
                                      quantize=args.quantize,
                                      model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,

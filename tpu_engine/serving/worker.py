@@ -239,6 +239,7 @@ class WorkerNode:
                         dtype=self.config.dtype,
                         n_slots=self.config.gen_max_batch_size,
                         step_chunk=self.config.gen_step_chunk,
+                        prefix_cache_mb=self.config.gen_prefix_cache_mb,
                         device=getattr(engine, "_device", None))
                 else:
                     from tpu_engine.runtime.generator import Generator

@@ -62,6 +62,11 @@ class WorkerConfig:
     gen_draft_model: Optional[str] = None
     gen_draft_path: Optional[str] = None  # draft weights checkpoint
     gen_spec_k: int = 4                 # speculation depth (draft tokens/round)
+    # Continuous-scheduler prefix cache (MB of device KV blocks, 0 = off):
+    # an exact repeat of a prompt skips its prefill forward at admission
+    # (runtime.scheduler._PrefixCache) — the KV-level analog of the /infer
+    # result LRU for repeated system prompts.
+    gen_prefix_cache_mb: int = 64
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
