@@ -1,0 +1,135 @@
+"""Repetition penalty + stop-token lists across the decode schedulers.
+
+Contracts: HF-penalty semantics (seen tokens' probability shrinks, counts
+cover prompt + generated, device-resident through the compiled loops);
+stop tokens end a row like EOS (excluded); both schedulers agree for
+seeded requests; the wire carries both fields.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    create_model,
+)
+
+_ensure_builtin_models_imported()
+
+from tpu_engine.runtime.generator import Generator
+from tpu_engine.runtime.scheduler import ContinuousGenerator
+from tpu_engine.utils.sampling import expand_stopping_params
+
+PROMPTS = [[5, 9, 12, 7], [3, 3, 3]]
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return Generator("gpt2-small-test", rng_seed=0, dtype="float32",
+                     batch_buckets=(2,))
+
+
+@pytest.fixture(scope="module")
+def sched():
+    g = ContinuousGenerator("gpt2-small-test", rng_seed=0, dtype="float32",
+                            n_slots=2, step_chunk=4)
+    yield g
+    g.stop()
+
+
+def test_penalty_changes_greedy_stream(gen):
+    plain = gen.generate(PROMPTS, max_new_tokens=12)
+    pen = gen.generate(PROMPTS, max_new_tokens=12, repetition_penalty=1.8)
+    assert plain != pen
+    # greedy + strong penalty: no immediate token repeats in the stream
+    for row in pen:
+        assert all(a != b for a, b in zip(row, row[1:]))
+
+
+def test_penalty_covers_prompt_tokens(gen):
+    """A token present only in the PROMPT is penalized from step one."""
+    base = gen.generate([[7, 7, 7, 7]], max_new_tokens=1)[0]
+    pen = gen.generate([[7, 7, 7, 7]], max_new_tokens=1,
+                       repetition_penalty=50.0)[0]
+    # With an extreme penalty the prompt token cannot win the argmax
+    # unless it was already losing (base != 7 keeps the test meaningful
+    # either way: outputs must be valid and deterministic).
+    assert pen != [7] or base != [7]
+
+
+def test_stop_tokens_end_row(gen):
+    plain = gen.generate(PROMPTS, max_new_tokens=12)
+    stop_at = plain[0][3]  # 4th greedy token becomes a stop token
+    stopped = gen.generate(PROMPTS, max_new_tokens=12,
+                           stop_tokens=[[stop_at], []])
+    assert stopped[0] == plain[0][:plain[0].index(stop_at)]
+    assert stopped[1] == plain[1]  # other row unaffected
+    assert stop_at not in stopped[0]
+
+
+def test_schedulers_agree_with_penalty(gen, sched):
+    a = gen.generate(PROMPTS, max_new_tokens=8, repetition_penalty=1.5,
+                     seed=[1, 2])
+    b = sched.generate(PROMPTS, max_new_tokens=8, repetition_penalty=1.5,
+                       seed=[1, 2])
+    assert a == b
+
+
+def test_schedulers_agree_with_stops(gen, sched):
+    plain = gen.generate(PROMPTS, max_new_tokens=10)
+    stop = plain[1][2]
+    a = gen.generate(PROMPTS, max_new_tokens=10, stop_tokens=[stop])
+    b = sched.generate(PROMPTS, max_new_tokens=10, stop_tokens=[stop])
+    assert a == b
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        expand_stopping_params(1, 0.0, None)       # penalty must be > 0
+    with pytest.raises(ValueError):
+        expand_stopping_params(1, 1.0, [list(range(9))])  # > 8 stop ids
+    pens, stops = expand_stopping_params(2, 1.1, [4, 5])
+    assert pens == [1.1, 1.1] and stops == [[4, 5], [4, 5]]
+
+
+def test_wire_carries_stopping_params():
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    w = WorkerNode(WorkerConfig(node_id="w_stop", model="gpt2-small-test",
+                                dtype="float32", gen_scheduler="batch"))
+    try:
+        plain = w.handle_generate({"request_id": "a",
+                                   "prompt_tokens": [5, 9, 3],
+                                   "max_new_tokens": 8})["tokens"]
+        stop = plain[2]
+        r = w.handle_generate({"request_id": "b",
+                               "prompt_tokens": [5, 9, 3],
+                               "max_new_tokens": 8,
+                               "stop_tokens": [stop]})
+        assert r["tokens"] == plain[:plain.index(stop)]
+        p = w.handle_generate({"request_id": "c",
+                               "prompt_tokens": [5, 9, 3],
+                               "max_new_tokens": 8,
+                               "repetition_penalty": 1.7})
+        assert p["tokens"] != plain
+    finally:
+        w.stop()
+
+
+def test_speculative_rejects_penalty_trims_stops():
+    from tpu_engine.runtime.speculative import SpeculativeGenerator
+
+    tgt = create_model("gpt2-small-test")
+    spec = SpeculativeGenerator(tgt, create_model("gpt2-small-test"),
+                                rng_seed=0, dtype="float32",
+                                batch_buckets=(2,), k=3)
+    spec.draft_params = spec.params
+    with pytest.raises(ValueError):
+        spec.generate(PROMPTS, max_new_tokens=4, repetition_penalty=1.3)
+    plain = spec.generate(PROMPTS, max_new_tokens=10)
+    stop = plain[0][3]
+    got = spec.generate(PROMPTS, max_new_tokens=10,
+                        stop_tokens=[[stop], []])
+    assert got[0] == plain[0][:plain[0].index(stop)]
+    assert got[1] == plain[1]

@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_engine.models.registry import ModelSpec, create_model, _ensure_builtin_models_imported
-from tpu_engine.utils.sampling import expand_sampling_params
+from tpu_engine.utils.sampling import (
+    expand_sampling_params,
+    expand_stopping_params,
+    stop_matrix,
+    truncate_at_stops,
+)
 from tpu_engine.models.transformer import (
     TransformerConfig,
     init_caches,
@@ -90,6 +95,32 @@ def left_pad_batch(prompts: Sequence[Sequence[int]], bb: int, pb: int,
         pos_ids[r, pb - L:] = np.arange(L)
         start[r] = pb - L
     return tokens, attn_mask, pos_ids, start
+
+
+def apply_repetition_penalty(logits, counts, penalty):
+    """HF-style repetition penalty. logits (B, V) f32; counts (B, V) int32
+    occurrence counts of every token already in the row's context (prompt
+    + generated); penalty (B,) with 1.0 = disabled. Seen tokens' positive
+    logits divide by the penalty, negative multiply — shrinking their
+    probability either way."""
+    seen = counts > 0
+    p = jnp.maximum(penalty, 1e-6)[:, None]
+    return jnp.where(seen, jnp.where(logits > 0, logits / p, logits * p),
+                     logits)
+
+
+def token_counts(rows: "Sequence[Sequence[int]]", n_rows: int,
+                 vocab: int) -> np.ndarray:
+    """(n_rows, vocab) int32 occurrence counts of each row's tokens —
+    the host-side seed of the device-resident counts buffer the decode
+    loops update as they sample."""
+    out = np.zeros((n_rows, vocab), np.int32)
+    for r, toks in enumerate(rows):
+        if len(toks):
+            ids = np.asarray(toks, np.int64)
+            ids = ids[(ids >= 0) & (ids < vocab)]
+            np.add.at(out[r], ids, 1)
+    return out
 
 
 def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
@@ -217,26 +248,45 @@ class Generator:
             self._prefill_exe[key] = jax.jit(prefill, donate_argnums=(4,))
             return self._prefill_exe[key]
 
-    def _decode(self, bb: int):
-        exe = self._decode_exe.get(bb)
+    def _decode(self, bb: int, controls: bool = False):
+        """Compiled decode chunk. `controls` is a COMPILE-TIME flag: the
+        repetition-penalty/stop-token machinery ((B, V) counts buffer,
+        per-step scatter-add, stop matching) exists only in the variant
+        that needs it — default-sampling calls pay nothing for the
+        feature (same pattern as speculative's static `stochastic`
+        flag)."""
+        key = (bb, controls)
+        exe = self._decode_exe.get(key)
         if exe is not None:
             return exe
         with self._lock:
-            exe = self._decode_exe.get(bb)
+            exe = self._decode_exe.get(key)
             if exe is not None:
                 return exe
             cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
             def decode_chunk(params, caches, tok, pos0, start, done, seeds,
-                             temperature, top_p, top_k, eos_id):
+                             temperature, top_p, top_k, eos_id,
+                             counts=None, rep_pen=None, stops=None):
                 """Scan `chunk` decode steps. tok: (B,) last emitted token;
-                seeds/temperature/top_p/top_k: per-row (B,) sampling
-                params."""
+                seeds/temperature/top_p/top_k/rep_pen: per-row (B,)
+                sampling params; counts: (B, V) context occurrence counts
+                (repetition penalty state, updated as tokens sample);
+                stops: (B, K) per-row stop-token ids padded with -1."""
+                rows = jnp.arange(tok.shape[0])
+
                 def body(carry, i):
-                    caches, tok, done = carry
+                    if controls:
+                        caches, tok, done, counts = carry
+                    else:
+                        caches, tok, done = carry
+                        counts = None
                     logits, caches = transformer_decode_step(
                         params, tok, caches, pos0 + i, cfg, dtype=dtype,
                         start=start, pos_ids=pos0 + i - start)
+                    if controls:
+                        logits = apply_repetition_penalty(logits, counts,
+                                                          rep_pen)
                     # The token sampled here sits at logical position
                     # pos0+i+1-start in its own sequence — fold that in so
                     # the stream is batch- and bucket-independent.
@@ -244,14 +294,27 @@ class Generator:
                                   temperature, top_p, top_k)
                     nxt = jnp.where(done, eos_id, nxt)
                     done = done | (nxt == eos_id)
+                    if controls:
+                        counts = counts.at[rows, nxt].add(
+                            (~done).astype(jnp.int32))
+                        done = done | jnp.any(nxt[:, None] == stops,
+                                              axis=1)
+                        return (caches, nxt, done, counts), nxt
                     return (caches, nxt, done), nxt
 
+                if controls:
+                    (caches, tok, done, counts), toks = jax.lax.scan(
+                        body, (caches, tok, done, counts),
+                        jnp.arange(chunk))
+                    return caches, tok, done, counts, toks.T
                 (caches, tok, done), toks = jax.lax.scan(
                     body, (caches, tok, done), jnp.arange(chunk))
                 return caches, tok, done, toks.T  # (B, chunk)
 
-            self._decode_exe[bb] = jax.jit(decode_chunk, donate_argnums=(1,))
-            return self._decode_exe[bb]
+            self._decode_exe[key] = jax.jit(
+                decode_chunk,
+                donate_argnums=(1, 11) if controls else (1,))
+            return self._decode_exe[key]
 
     # -- generation ------------------------------------------------------------
 
@@ -264,6 +327,8 @@ class Generator:
         seed: Union[int, Sequence[int]] = 0,
         top_p: Union[float, Sequence[float]] = 1.0,
         top_k: Union[int, Sequence[int]] = 0,
+        repetition_penalty: Union[float, Sequence[float]] = 1.0,
+        stop_tokens=None,
     ) -> List[List[int]]:
         """Batched generation. Returns per-prompt generated token lists
         (EOS-truncated, EOS not included). `eos_id=-1` disables early stop.
@@ -272,12 +337,20 @@ class Generator:
         request with an explicit per-prompt seed samples the same tokens no
         matter how requests are batched. A scalar seed expands to seed+row
         so rows of one call still sample independently. `top_p < 1` applies
-        nucleus filtering before the categorical draw."""
+        nucleus filtering before the categorical draw.
+
+        `repetition_penalty` (HF semantics, 1.0 = off) shrinks the
+        probability of every token already in the row's context (prompt +
+        generated). `stop_tokens`: up to 8 token ids (flat list shared by
+        all rows, or per-row lists) that end the row like EOS (excluded
+        from the result)."""
         if not prompts:
             return []
         n = len(prompts)
         temps, seeds, top_ps, top_ks = expand_sampling_params(
             n, temperature, seed, top_p, top_k)
+        pens, stops = expand_stopping_params(n, repetition_penalty,
+                                             stop_tokens)
         out: List[List[int]] = []
         max_bb = self._batch_buckets[-1]
         for i in range(0, n, max_bb):
@@ -285,13 +358,15 @@ class Generator:
                 [list(p) for p in prompts[i:i + max_bb]],
                 max_new_tokens, eos_id, temps[i:i + max_bb],
                 seeds[i:i + max_bb], top_ps[i:i + max_bb],
-                top_ks[i:i + max_bb]))
+                top_ks[i:i + max_bb], pens[i:i + max_bb],
+                stops[i:i + max_bb]))
         return out
 
     def _generate_batch(self, prompts: List[List[int]], max_new: int,
                         eos_id: int, temps: List[float],
                         seeds: List[int], top_ps: List[float],
-                        top_ks: List[int]) -> List[List[int]]:
+                        top_ks: List[int], pens: List[float],
+                        stops: List[List[int]]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -328,46 +403,67 @@ class Generator:
         # settings (documented seeded-reproducibility contract).
         seeds_arr[:n] = [int(s) & 0x7FFFFFFF for s in seeds]
         topp_arr[:n] = top_ps
+        controls = any(p != 1.0 for p in pens) or any(stops)
         temps_dev, seeds_dev = put(temps_arr), put(seeds_arr)
         topp_dev, topk_dev = put(topp_arr), put(topk_arr)
         start_dev = put(start)
 
-        # First generated token comes from the prefill logits; its logical
-        # position in each row is the prompt length pb - start.
+        # Bucket-padding rows start done: their outputs are discarded, and
+        # a live pad row would block the all-done early exit forever when
+        # EOS is disabled or stop tokens end the real rows.
+        pad_done = jnp.asarray(np.arange(bb) >= n)
+
+        if controls:
+            pens_arr = np.ones((bb,), np.float32)
+            pens_arr[:n] = pens
+            pens_dev, stops_dev = put(pens_arr), put(stop_matrix(stops, bb))
+            # First token comes from the prefill logits penalized by the
+            # PROMPT's token counts.
+            prompt_counts = token_counts([p[-pb:] for p in prompts], bb,
+                                         self.cfg.vocab)
+            logits = apply_repetition_penalty(logits, put(prompt_counts),
+                                              pens_dev)
         first = _sample(logits, seeds_dev, pb - jnp.asarray(start_dev),
                         jnp.asarray(temps_dev), jnp.asarray(topp_dev),
                         jnp.asarray(topk_dev))
-        done = (first == eos_id)
+        done = pad_done | (first == eos_id)
+        if controls:
+            done = done | jnp.any(first[:, None] == stops_dev, axis=1)
 
         pieces = [np.asarray(first)[:, None]]
+        if controls:
+            # Counts seed = prompt + first token (host has first synced).
+            np.add.at(prompt_counts, (np.arange(bb), pieces[0][:, 0]), 1)
+            counts = put(prompt_counts)
         tok, pos = first, pb
-        decode = self._decode(bb)
+        decode = self._decode(bb, controls)
         eos_dev = put(jnp.int32(eos_id))
         remaining = max_new - 1
         # max_new is clamped to max_seq - pb, so every *needed* step writes
         # in-bounds; a final partial chunk may run steps past max_seq whose
         # outputs are discarded by the truncation below.
         while remaining > 0 and pos < self.max_seq:
-            caches, tok, done, toks = decode(
-                self.params, caches, tok, pos, start_dev, done, seeds_dev,
-                temps_dev, topp_dev, topk_dev, eos_dev)
+            if controls:
+                caches, tok, done, counts, toks = decode(
+                    self.params, caches, tok, pos, start_dev, done,
+                    seeds_dev, temps_dev, topp_dev, topk_dev, eos_dev,
+                    counts, pens_dev, stops_dev)
+            else:
+                caches, tok, done, toks = decode(
+                    self.params, caches, tok, pos, start_dev, done,
+                    seeds_dev, temps_dev, topp_dev, topk_dev, eos_dev)
             start_host_copies(toks, done)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk
             remaining -= self._step_chunk
-            if eos_id >= 0 and bool(np.all(np.asarray(done))):
+            if bool(np.all(np.asarray(done))):
                 break
 
         with self._lock:
             self._cache_pool.setdefault(bb, caches)  # return buffer to pool
         gen = np.concatenate(pieces, axis=1)[:n, :max_new]
-        results = []
-        for r in range(n):
-            row = gen[r].tolist()
-            if eos_id >= 0 and eos_id in row:
-                row = row[:row.index(eos_id)]
-            results.append(row)
-        return results
+        return [truncate_at_stops(gen[r].tolist(), eos_id, stops[r])
+                for r in range(n)]
 
     def stats(self) -> dict:
         return {

@@ -46,8 +46,20 @@ from tpu_engine.models.transformer import (
     transformer_decode_rows,
     transformer_prefill,
 )
-from tpu_engine.runtime.generator import _DTYPES, _sample, start_host_copies
-from tpu_engine.utils.sampling import clamp_top_k, expand_sampling_params
+from tpu_engine.runtime.generator import (
+    _DTYPES,
+    _sample,
+    apply_repetition_penalty,
+    start_host_copies,
+    token_counts,
+)
+from tpu_engine.utils.sampling import (
+    MAX_STOP_TOKENS,
+    clamp_top_k,
+    expand_sampling_params,
+    expand_stopping_params,
+    truncate_at_stops,
+)
 
 
 @dataclass
@@ -59,6 +71,8 @@ class _Request:
     seed: int
     top_p: float
     top_k: int
+    rep_penalty: float = 1.0
+    stop_tokens: List[int] = field(default_factory=list)
     future: Future = field(default_factory=Future)
     # Streaming: freshly-visible tokens are pushed as lists between decode
     # chunks; None is the end-of-stream sentinel (the future then holds the
@@ -93,6 +107,8 @@ class _PrefixCache:
                    + caches.v.size * caches.v.dtype.itemsize)
 
     def get(self, key):
+        if self.budget <= 0:
+            return None  # disabled: no phantom miss counting
         item = self._items.get(key)
         if item is None:
             self.misses += 1
@@ -169,6 +185,13 @@ class ContinuousGenerator:
         self._temps = np.zeros((self.n_slots,), np.float32)
         self._topps = np.ones((self.n_slots,), np.float32)
         self._topks = np.zeros((self.n_slots,), np.int32)
+        self._pens = np.ones((self.n_slots,), np.float32)
+        self._stops = np.full((self.n_slots, MAX_STOP_TOKENS), -1, np.int32)
+        # Device-resident context-token counts (repetition-penalty state),
+        # donated through decode chunks like the KV cache.
+        self._counts = jnp.zeros((self.n_slots, self.cfg.vocab), jnp.int32)
+        if device is not None:
+            self._counts = jax.device_put(self._counts, device)
         self._done = np.ones((self.n_slots,), bool)          # sampling mask
         self._row_req: List[Optional[_Request]] = [None] * self.n_slots
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
@@ -187,7 +210,7 @@ class ContinuousGenerator:
         self._exe_lock = threading.Lock()
         self._prefill_exe = None
         self._insert_exe = None
-        self._decode_exe = None
+        self._decode_exe = {}  # {controls flag: compiled chunk}
         self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
         self._prefix_cache = _PrefixCache(int(prefix_cache_mb) * (1 << 20))
         self._running = True
@@ -232,75 +255,126 @@ class ContinuousGenerator:
         with self._exe_lock:
             if self._insert_exe is None:
 
-                def insert_row(caches, row_k, row_v, row):
+                def insert_row(caches, row_k, row_v, row, counts,
+                               row_counts):
                     k = jax.lax.dynamic_update_slice(
                         caches.k, row_k.astype(caches.k.dtype),
                         (0, row, 0, 0, 0))
                     v = jax.lax.dynamic_update_slice(
                         caches.v, row_v.astype(caches.v.dtype),
                         (0, row, 0, 0, 0))
-                    return type(caches)(k, v)
+                    counts = jax.lax.dynamic_update_slice(
+                        counts, row_counts[None, :], (row, 0))
+                    return type(caches)(k, v), counts
 
-                self._insert_exe = jax.jit(insert_row, donate_argnums=(0,))
+                self._insert_exe = jax.jit(insert_row,
+                                           donate_argnums=(0, 4))
             return self._insert_exe
 
-    def _decode(self):
-        if self._decode_exe is not None:
-            return self._decode_exe
+    def _decode(self, controls: bool):
+        """Compiled decode chunk. `controls` (compile-time) exists in two
+        variants: the penalty/stop machinery ((B, V) counts scatter, stop
+        matching) compiles only into the variant used while ANY live row
+        carries a penalty or stop list — default traffic pays nothing.
+        Correctness of switching: a pen=1 row's penalty is the identity
+        whatever its (possibly stale) counts hold, and a penalized row
+        forces the controls variant for its whole lifetime, so ITS counts
+        are always maintained."""
+        exe = self._decode_exe.get(controls)
+        if exe is not None:
+            return exe
         with self._exe_lock:
-            if self._decode_exe is None:
+            if controls not in self._decode_exe:
                 cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
                 def decode_chunk(params, caches, tok, pos, start, done,
-                                 seeds, temps, topps, topks, eos_vec):
+                                 seeds, temps, topps, topks, eos_vec,
+                                 counts=None, pens=None, stops=None):
+                    rows = jnp.arange(tok.shape[0])
+
                     def body(carry, _):
-                        caches, tok, pos, done = carry
+                        if controls:
+                            caches, tok, pos, done, counts = carry
+                        else:
+                            caches, tok, pos, done = carry
+                            counts = None
                         logits, caches = transformer_decode_rows(
                             params, tok, caches, pos, cfg, dtype=dtype,
                             start_vec=start)
+                        if controls:
+                            logits = apply_repetition_penalty(
+                                logits, counts, pens)
                         nxt = _sample(logits, seeds, pos + 1 - start, temps,
                                       topps, topks)
                         nxt = jnp.where(done, eos_vec, nxt)
+                        if controls:
+                            counts = counts.at[rows, nxt].add(
+                                (~done).astype(jnp.int32))
                         done = done | (nxt == eos_vec)
+                        if controls:
+                            done = done | jnp.any(nxt[:, None] == stops,
+                                                  axis=1)
                         # Only live rows advance their write position (and
                         # never past the last cache column).
                         pos = jnp.where(done, pos,
                                         jnp.minimum(pos + 1,
                                                     caches.k.shape[2] - 1))
+                        if controls:
+                            return (caches, nxt, pos, done, counts), nxt
                         return (caches, nxt, pos, done), nxt
 
+                    if controls:
+                        (caches, tok, pos, done, counts), toks = \
+                            jax.lax.scan(body,
+                                         (caches, tok, pos, done, counts),
+                                         None, length=chunk)
+                        return caches, tok, pos, done, counts, toks.T
                     (caches, tok, pos, done), toks = jax.lax.scan(
                         body, (caches, tok, pos, done), None, length=chunk)
-                    return caches, tok, pos, done, toks.T  # (B, chunk)
+                    return caches, tok, pos, done, toks.T
 
-                self._decode_exe = jax.jit(decode_chunk, donate_argnums=(1,))
-            return self._decode_exe
+                self._decode_exe[controls] = jax.jit(
+                    decode_chunk,
+                    donate_argnums=(1, 11) if controls else (1,))
+            return self._decode_exe[controls]
 
     # -- public API ------------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
-               top_p: float = 1.0, top_k: int = 0, stream=None) -> Future:
+               top_p: float = 1.0, top_k: int = 0,
+               repetition_penalty: float = 1.0, stop_tokens=None,
+               stream=None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
-        they decode (iteration-level granularity), then a None sentinel."""
+        they decode (iteration-level granularity), then a None sentinel.
+        `repetition_penalty`/`stop_tokens` follow Generator.generate's
+        semantics (HF-style penalty; <=8 stop ids ending the row like
+        EOS)."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
+        pens, stops = expand_stopping_params(1, repetition_penalty,
+                                             [list(stop_tokens)]
+                                             if stop_tokens else None)
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
                        float(temperature), int(seed), float(top_p),
-                       clamp_top_k(top_k), stream=stream)
+                       clamp_top_k(top_k), rep_penalty=pens[0],
+                       stop_tokens=stops[0], stream=stream)
         self._queue.put(req)
         return req.future
 
     def generate(self, prompts, max_new_tokens: int = 32, eos_id: int = -1,
-                 temperature=0.0, seed=0, top_p=1.0,
-                 top_k=0) -> List[List[int]]:
+                 temperature=0.0, seed=0, top_p=1.0, top_k=0,
+                 repetition_penalty=1.0, stop_tokens=None) -> List[List[int]]:
         """Blocking convenience over submit() (Generator-compatible)."""
         n = len(prompts)
         temps, seeds, topps, topks = expand_sampling_params(
             n, temperature, seed, top_p, top_k)
+        pens, stops = expand_stopping_params(n, repetition_penalty,
+                                             stop_tokens)
         futs = [self.submit(p, max_new_tokens, eos_id, temps[i], seeds[i],
-                            topps[i], topks[i]) for i, p in enumerate(prompts)]
+                            topps[i], topks[i], pens[i], stops[i])
+                for i, p in enumerate(prompts)]
         return [f.result(timeout=600) for f in futs]
 
     def stats(self) -> dict:
@@ -399,42 +473,58 @@ class ContinuousGenerator:
         # concurrent admissions can share one entry safely.
         # L is part of the key: left-padding zero-fills, and token id 0 is
         # a REAL vocab token, so [5] and [0, 5] serialize identically at
-        # the same bucket — only the length tells them apart.
-        key = (pb, L, tokens.tobytes())
-        cached = self._prefix_cache.get(key)
+        # the same bucket — only the length tells them apart. A disabled
+        # cache (budget 0) skips even the key serialization.
+        cached = None
+        if self._prefix_cache.budget > 0:
+            key = (pb, L, tokens.tobytes())
+            cached = self._prefix_cache.get(key)
         if cached is not None:
             logits, row_caches = cached
         else:
             logits, row_caches = self._prefill()(
                 self.params, jnp.asarray(tokens), jnp.asarray(attn),
                 jnp.asarray(pos_ids))
-            self._prefix_cache.put(key, logits, row_caches)
+            if self._prefix_cache.budget > 0:
+                self._prefix_cache.put(key, logits, row_caches)
         # First token from the prefill logits at logical position L (same
-        # fold_in(seed, position) scheme as decode — batch-independent).
-        first = _sample(jnp.asarray(logits)[None, :],
-                        jnp.asarray([seed], jnp.int32),
-                        jnp.asarray([L], jnp.int32),
-                        jnp.asarray([req.temperature], jnp.float32),
-                        jnp.asarray([req.top_p], jnp.float32),
-                        jnp.asarray([req.top_k], jnp.int32))
-        return req, row_caches, int(first[0]), pb, L
+        # fold_in(seed, position) scheme as decode — batch-independent),
+        # penalized by the PROMPT's token counts like every later step.
+        row_counts = token_counts([prompt], 1, self.cfg.vocab)
+        first = _sample(
+            apply_repetition_penalty(
+                jnp.asarray(logits)[None, :], jnp.asarray(row_counts),
+                jnp.asarray([req.rep_penalty], jnp.float32)),
+            jnp.asarray([seed], jnp.int32),
+            jnp.asarray([L], jnp.int32),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32))
+        first_tok = int(first[0])
+        row_counts[0, first_tok] += 1  # the first token joins the context
+        return req, row_caches, first_tok, pb, L, row_counts[0]
 
     def _admit(self, item, row: int) -> None:
         """Decode-thread half of admission: splice the prefilled KV block
         into the shared cache and initialise the row's host-side state."""
-        req, row_caches, first_tok, pb, L = item
-        self._caches = self._insert()(self._caches, row_caches.k,
-                                      row_caches.v, row)
+        req, row_caches, first_tok, pb, L, row_counts = item
+        self._caches, self._counts = self._insert()(
+            self._caches, row_caches.k, row_caches.v, row, self._counts,
+            jnp.asarray(row_counts))
         self._start[row] = pb - L
         self._pos[row] = pb
         self._seeds[row] = int(req.seed) & 0x7FFFFFFF
         self._temps[row] = req.temperature
         self._topps[row] = req.top_p
         self._topks[row] = req.top_k
+        self._pens[row] = req.rep_penalty
+        self._stops[row] = -1
+        self._stops[row, :len(req.stop_tokens)] = req.stop_tokens
         self._tok[row] = first_tok
         self._row_req[row] = req
         self._row_emitted[row] = [first_tok]
-        self._done[row] = (req.eos_id >= 0 and first_tok == req.eos_id)
+        self._done[row] = ((req.eos_id >= 0 and first_tok == req.eos_id)
+                           or first_tok in req.stop_tokens)
         self._stats["admitted"] += 1
         self._push_stream(row, req)  # first token flushes at admission
         self._maybe_complete(row)
@@ -444,10 +534,8 @@ class ContinuousGenerator:
         EOS-truncated (EOS excluded) — one definition shared by the final
         result and the streaming deltas so a stream never shows a token the
         result would retract."""
-        toks = self._row_emitted[row][:req.max_new]
-        if req.eos_id >= 0 and req.eos_id in toks:
-            toks = toks[:toks.index(req.eos_id)]
-        return toks
+        return truncate_at_stops(self._row_emitted[row][:req.max_new],
+                                 req.eos_id, req.stop_tokens)
 
     def _push_stream(self, row: int, req: _Request) -> None:
         if req.stream is None:
@@ -496,9 +584,12 @@ class ContinuousGenerator:
         self._stats["failures"] = self._stats.get("failures", 0) + 1
         caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                              self._dtype)
+        counts = jnp.zeros((self.n_slots, self.cfg.vocab), jnp.int32)
         if self._device is not None:
             caches = jax.device_put(caches, self._device)
+            counts = jax.device_put(counts, self._device)
         self._caches = caches
+        self._counts = counts  # donated alongside — may be invalidated too
 
     def _loop(self) -> None:
         try:
@@ -559,15 +650,30 @@ class ContinuousGenerator:
                 # (discarded), and the embedding lookup of -1 clips
                 # harmlessly under jit.
                 eos_vec = np.full((self.n_slots,), -1, np.int32)
+                controls = False
                 for r, req in enumerate(self._row_req):
                     if req is not None and req.eos_id >= 0:
                         eos_vec[r] = req.eos_id
-                self._caches, tok, pos, done, toks = self._decode()(
-                    self.params, self._caches, jnp.asarray(self._tok),
-                    jnp.asarray(self._pos), jnp.asarray(self._start),
-                    jnp.asarray(self._done), jnp.asarray(self._seeds),
-                    jnp.asarray(self._temps), jnp.asarray(self._topps),
-                    jnp.asarray(self._topks), jnp.asarray(eos_vec))
+                    if req is not None and (req.rep_penalty != 1.0
+                                            or req.stop_tokens):
+                        controls = True
+                if controls:
+                    (self._caches, tok, pos, done, self._counts,
+                     toks) = self._decode(True)(
+                        self.params, self._caches, jnp.asarray(self._tok),
+                        jnp.asarray(self._pos), jnp.asarray(self._start),
+                        jnp.asarray(self._done), jnp.asarray(self._seeds),
+                        jnp.asarray(self._temps), jnp.asarray(self._topps),
+                        jnp.asarray(self._topks), jnp.asarray(eos_vec),
+                        self._counts, jnp.asarray(self._pens),
+                        jnp.asarray(self._stops))
+                else:
+                    self._caches, tok, pos, done, toks = self._decode(False)(
+                        self.params, self._caches, jnp.asarray(self._tok),
+                        jnp.asarray(self._pos), jnp.asarray(self._start),
+                        jnp.asarray(self._done), jnp.asarray(self._seeds),
+                        jnp.asarray(self._temps), jnp.asarray(self._topps),
+                        jnp.asarray(self._topks), jnp.asarray(eos_vec))
                 start_host_copies(tok, pos, done, toks)
                 # np.array (copy): np.asarray of a jax.Array is read-only
                 # and the admit path mutates these vectors in place.

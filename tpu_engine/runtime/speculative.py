@@ -71,7 +71,11 @@ from tpu_engine.runtime.generator import (
     left_pad_batch,
     pick_bucket,
 )
-from tpu_engine.utils.sampling import expand_sampling_params
+from tpu_engine.utils.sampling import (
+    expand_sampling_params,
+    expand_stopping_params,
+    truncate_at_stops,
+)
 
 # Key-derivation tags: keep the accept/residual uniforms independent of the
 # draft's proposal draws at the same logical position.
@@ -354,17 +358,23 @@ class SpeculativeGenerator:
         seed: Union[int, Sequence[int]] = 0,
         top_p: Union[float, Sequence[float]] = 1.0,
         top_k: Union[int, Sequence[int]] = 0,
+        repetition_penalty: Union[float, Sequence[float]] = 1.0,
+        stop_tokens=None,
     ) -> List[List[int]]:
         n = len(prompts)
         if n == 0:
             return []
         temps, seeds, top_ps, top_ks = expand_sampling_params(
             n, temperature, seed, top_p, top_k)
+        pens, stops = expand_stopping_params(n, repetition_penalty,
+                                             stop_tokens)
         seeds = [s & 0x7FFFFFFF for s in seeds]
-        if any(p < 1.0 for p in top_ps) or any(k > 0 for k in top_ks):
+        if any(p < 1.0 for p in top_ps) or any(k > 0 for k in top_ks) \
+                or any(p != 1.0 for p in pens):
             raise ValueError(
                 "speculative decoding supports temperature sampling only; "
-                "route top_p/top_k requests to the plain schedulers")
+                "route top_p/top_k/repetition_penalty requests to the "
+                "plain schedulers")
         max_bb = self._batch_buckets[-1]
         if n > max_bb:
             out: List[List[int]] = []
@@ -372,7 +382,8 @@ class SpeculativeGenerator:
                 out.extend(self.generate(
                     prompts[i:i + max_bb], max_new_tokens, temperature=
                     temps[i:i + max_bb], eos_id=eos_id,
-                    seed=seeds[i:i + max_bb]))
+                    seed=seeds[i:i + max_bb],
+                    stop_tokens=stops[i:i + max_bb]))
             return out
 
         bb = pick_bucket(self._batch_buckets, n)
@@ -439,13 +450,13 @@ class SpeculativeGenerator:
             "k": self.k,
         }
 
-        results = []
-        for r in range(n):
-            row = out_buf[r, :min(int(n_out[r]), max_new)].tolist()
-            if eos_id >= 0 and eos_id in row:
-                row = row[:row.index(eos_id)]
-            results.append(row)
-        return results
+        # Stop tokens trim host-side (the compiled loop knows only EOS, so
+        # a stopped row may burn budget to max_new — the plain schedulers
+        # stop it on-device; acceptable for this lane's narrower contract).
+        return [truncate_at_stops(
+                    out_buf[r, :min(int(n_out[r]), max_new)].tolist(),
+                    eos_id, stops[r])
+                for r in range(n)]
 
     def stats(self) -> dict:
         return {

@@ -74,6 +74,8 @@ class _GenItem:
     seed: int
     top_p: float = 1.0
     top_k: int = 0
+    repetition_penalty: float = 1.0
+    stop_tokens: tuple = ()
 
 
 @dataclass
@@ -527,20 +529,28 @@ class WorkerNode:
             seed=int(request.get("seed", 0)),
             top_p=float(request.get("top_p", 1.0)),
             top_k=_clamp_top_k(request.get("top_k", 0)),
+            repetition_penalty=float(
+                request.get("repetition_penalty", 1.0)),
+            stop_tokens=tuple(int(t)
+                              for t in request.get("stop_tokens", ())),
         )
-        if self._speculative and (item.top_p < 1.0 or item.top_k > 0):
+        if self._speculative and (item.top_p < 1.0 or item.top_k > 0
+                                  or item.repetition_penalty != 1.0):
             # Reject BEFORE the item enters a shared batch: rejection
             # sampling is exact for the temperature distribution only, and
             # one filtered request must not poison its co-batched group.
             raise ValueError(
                 "speculative scheduler supports temperature sampling only "
-                "(top_p/top_k unavailable; use gen_scheduler=continuous)")
+                "(top_p/top_k/repetition_penalty unavailable; use "
+                "gen_scheduler=continuous)")
         if self._continuous:
             t0 = time.perf_counter()
             fut = self.generator.submit(
                 item.prompt, max_new_tokens=item.max_new_tokens,
                 eos_id=item.eos_id, temperature=item.temperature,
-                seed=item.seed, top_p=item.top_p, top_k=item.top_k)
+                seed=item.seed, top_p=item.top_p, top_k=item.top_k,
+                repetition_penalty=item.repetition_penalty,
+                stop_tokens=list(item.stop_tokens))
             tokens = fut.result(timeout=600)
             elapsed_us = int((time.perf_counter() - t0) * 1e6)
             result = _GenResult(tokens, elapsed_us)
@@ -583,16 +593,22 @@ class WorkerNode:
         seed = int(request.get("seed", 0))
         top_p = float(request.get("top_p", 1.0))
         top_k = _clamp_top_k(request.get("top_k", 0))
-        if self._speculative and (top_p < 1.0 or top_k > 0):
+        rep_pen = float(request.get("repetition_penalty", 1.0))
+        stop_toks = [int(t) for t in request.get("stop_tokens", ())]
+        if self._speculative and (top_p < 1.0 or top_k > 0
+                                  or rep_pen != 1.0):
             # Must fire HERE, before the iterator commits a 200 SSE stream
             # — same 400 the blocking endpoint gives this payload.
             raise ValueError(
                 "speculative scheduler supports temperature sampling only "
-                "(top_p/top_k unavailable; use gen_scheduler=continuous)")
+                "(top_p/top_k/repetition_penalty unavailable; use "
+                "gen_scheduler=continuous)")
         normalized = {"request_id": request_id, "prompt_tokens": prompt,
                       "max_new_tokens": max_new, "eos_id": eos_id,
                       "temperature": temperature, "seed": seed,
-                      "top_p": top_p, "top_k": top_k}
+                      "top_p": top_p, "top_k": top_k,
+                      "repetition_penalty": rep_pen,
+                      "stop_tokens": stop_toks}
         if not self._continuous:
             def one_shot():
                 try:
@@ -611,7 +627,7 @@ class WorkerNode:
         fut = self.generator.submit(
             prompt, max_new_tokens=max_new, eos_id=eos_id,
             temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
-            stream=q)
+            repetition_penalty=rep_pen, stop_tokens=stop_toks, stream=q)
 
         def events():
             while True:
@@ -656,7 +672,10 @@ class WorkerNode:
                 temperature=[items[i].temperature for i in idxs],
                 seed=[items[i].seed for i in idxs],
                 top_p=[items[i].top_p for i in idxs],
-                top_k=[items[i].top_k for i in idxs])
+                top_k=[items[i].top_k for i in idxs],
+                repetition_penalty=[items[i].repetition_penalty
+                                    for i in idxs],
+                stop_tokens=[list(items[i].stop_tokens) for i in idxs])
             # Reference semantic: per-request time = batch_duration /
             # batch_size, per group (worker_node.cpp:123).
             elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))

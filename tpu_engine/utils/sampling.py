@@ -38,3 +38,59 @@ def expand_sampling_params(n, temperature, seed, top_p, top_k):
         raise ValueError(
             "temperature/seed/top_p/top_k sequence length != n prompts")
     return temps, seeds, top_ps, top_ks
+
+
+MAX_STOP_TOKENS = 8
+
+
+def expand_stopping_params(n, repetition_penalty, stop_tokens):
+    """Normalize repetition_penalty (scalar-or-sequence, 1.0 = off) and
+    stop_tokens (None | flat id list shared by all rows | per-row list of
+    lists) to per-row lists. Each row allows at most MAX_STOP_TOKENS stop
+    ids (they pad a fixed-width device tensor)."""
+    pens = ([float(repetition_penalty)] * n
+            if np.isscalar(repetition_penalty)
+            else [float(p) for p in repetition_penalty])
+    if len(pens) != n:
+        raise ValueError("repetition_penalty sequence length != n prompts")
+    for p in pens:
+        if p <= 0:
+            raise ValueError(f"repetition_penalty must be > 0, got {p}")
+    if stop_tokens is None:
+        stops = [[] for _ in range(n)]
+    else:
+        stop_tokens = list(stop_tokens)
+        if stop_tokens and isinstance(stop_tokens[0], (list, tuple)):
+            stops = [[int(t) for t in row] for row in stop_tokens]
+            if len(stops) != n:
+                raise ValueError("stop_tokens rows != n prompts")
+        else:
+            shared = [int(t) for t in stop_tokens]
+            stops = [list(shared) for _ in range(n)]
+    for row in stops:
+        if len(row) > MAX_STOP_TOKENS:
+            raise ValueError(
+                f"at most {MAX_STOP_TOKENS} stop tokens per request")
+    return pens, stops
+
+
+def stop_matrix(stops, n_rows):
+    """(n_rows, MAX_STOP_TOKENS) int32 padded with -1 (matches no token)."""
+    out = np.full((n_rows, MAX_STOP_TOKENS), -1, np.int32)
+    for r, row in enumerate(stops[:n_rows]):
+        out[r, :len(row)] = row
+    return out
+
+
+def truncate_at_stops(row, eos_id, stops):
+    """Client-visible tokens: cut (exclusive) at the first EOS or stop
+    token. The ONE truncation rule all decode lanes share."""
+    enders = set(stops or ())
+    if eos_id >= 0:
+        enders.add(eos_id)
+    if not enders:
+        return row
+    for i, t in enumerate(row):
+        if t in enders:
+            return row[:i]
+    return row
