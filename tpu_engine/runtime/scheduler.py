@@ -44,6 +44,7 @@ from tpu_engine.models.transformer import (
     TransformerConfig,
     init_caches,
     transformer_decode_rows,
+    transformer_decode_window,
     transformer_prefill,
 )
 from tpu_engine.runtime.generator import (
@@ -147,6 +148,7 @@ class ContinuousGenerator:
         max_seq: Optional[int] = None,
         device=None,
         prefix_cache_mb: int = 64,
+        prefill_chunk: int = 256,
     ):
         if isinstance(model, str):
             _ensure_builtin_models_imported()
@@ -188,10 +190,11 @@ class ContinuousGenerator:
         self._pens = np.ones((self.n_slots,), np.float32)
         self._stops = np.full((self.n_slots, MAX_STOP_TOKENS), -1, np.int32)
         # Device-resident context-token counts (repetition-penalty state),
-        # donated through decode chunks like the KV cache.
-        self._counts = jnp.zeros((self.n_slots, self.cfg.vocab), jnp.int32)
-        if device is not None:
-            self._counts = jax.device_put(self._counts, device)
+        # donated through decode chunks like the KV cache. LAZY: the
+        # (n_slots, vocab) buffer allocates only when the first request
+        # carrying a penalty or stop list arrives — default traffic pins
+        # no memory and pays no admission bookkeeping for the feature.
+        self._counts = None
         self._done = np.ones((self.n_slots,), bool)          # sampling mask
         self._row_req: List[Optional[_Request]] = [None] * self.n_slots
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
@@ -209,10 +212,16 @@ class ContinuousGenerator:
             maxsize=max(1, self.n_slots))
         self._exe_lock = threading.Lock()
         self._prefill_exe = None
-        self._insert_exe = None
+        self._insert_exe = {}  # {with_counts flag: compiled insert}
         self._decode_exe = {}  # {controls flag: compiled chunk}
         self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
         self._prefix_cache = _PrefixCache(int(prefix_cache_mb) * (1 << 20))
+        # Chunked prefill: prompts longer than this admit via a sequence
+        # of window-decode dispatches instead of one monolithic prefill,
+        # so in-flight rows' decode chunks interleave at dispatch
+        # granularity instead of stalling behind a long prompt (0 = off).
+        self._prefill_chunk = int(prefill_chunk)
+        self._window_exe = None
         self._running = True
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="continuous-prefill", daemon=True)
@@ -245,31 +254,69 @@ class ContinuousGenerator:
                 self._prefill_exe = jax.jit(prefill_one)
             return self._prefill_exe
 
-    def _insert(self):
+    def _window(self):
+        """One prefill window: consume W prompt tokens against the
+        request's own (1, pb) cache via transformer_decode_window —
+        semantically identical to the same slice of a monolithic causal
+        prefill (write-before-attend + kpos <= col masking), but each
+        window is its own dispatch, so the decode thread's chunks slot in
+        between. Returns (logits (1, W, V), caches)."""
+        if self._window_exe is not None:
+            return self._window_exe
+        with self._exe_lock:
+            if self._window_exe is None:
+                cfg, dtype = self.cfg, self._dtype
+
+                def window(params, tokens, caches, pos0, start):
+                    return transformer_decode_window(
+                        params, tokens, caches, pos0, cfg, dtype=dtype,
+                        start_vec=start)
+
+                self._window_exe = jax.jit(window, donate_argnums=(2,))
+            return self._window_exe
+
+    def _insert(self, with_counts: bool):
         """Row insertion into the shared cache — decode-thread only (the
         only compiled stage besides decode that owns/donates the shared
-        KV buffer). One jitted fn; distinct pb block widths recompile
-        automatically."""
-        if self._insert_exe is not None:
-            return self._insert_exe
+        KV buffer). Two variants: only admissions that carry penalty/stop
+        state also splice their token-count row (distinct pb block widths
+        recompile automatically)."""
+        exe = self._insert_exe.get(with_counts)
+        if exe is not None:
+            return exe
         with self._exe_lock:
-            if self._insert_exe is None:
+            if with_counts not in self._insert_exe:
 
-                def insert_row(caches, row_k, row_v, row, counts,
-                               row_counts):
+                def insert_kv(caches, row_k, row_v, row):
                     k = jax.lax.dynamic_update_slice(
                         caches.k, row_k.astype(caches.k.dtype),
                         (0, row, 0, 0, 0))
                     v = jax.lax.dynamic_update_slice(
                         caches.v, row_v.astype(caches.v.dtype),
                         (0, row, 0, 0, 0))
-                    counts = jax.lax.dynamic_update_slice(
-                        counts, row_counts[None, :], (row, 0))
-                    return type(caches)(k, v), counts
+                    return type(caches)(k, v)
 
-                self._insert_exe = jax.jit(insert_row,
-                                           donate_argnums=(0, 4))
-            return self._insert_exe
+                if with_counts:
+                    def insert_row(caches, row_k, row_v, row, counts,
+                                   row_counts):
+                        counts = jax.lax.dynamic_update_slice(
+                            counts, row_counts[None, :], (row, 0))
+                        return insert_kv(caches, row_k, row_v, row), counts
+
+                    self._insert_exe[True] = jax.jit(
+                        insert_row, donate_argnums=(0, 4))
+                else:
+                    self._insert_exe[False] = jax.jit(
+                        insert_kv, donate_argnums=(0,))
+            return self._insert_exe[with_counts]
+
+    def _ensure_counts(self):
+        if self._counts is None:
+            counts = jnp.zeros((self.n_slots, self.cfg.vocab), jnp.int32)
+            if self._device is not None:
+                counts = jax.device_put(counts, self._device)
+            self._counts = counts
+        return self._counts
 
     def _decode(self, controls: bool):
         """Compiled decode chunk. `controls` (compile-time) exists in two
@@ -482,35 +529,68 @@ class ContinuousGenerator:
         if cached is not None:
             logits, row_caches = cached
         else:
-            logits, row_caches = self._prefill()(
-                self.params, jnp.asarray(tokens), jnp.asarray(attn),
-                jnp.asarray(pos_ids))
+            w = self._prefill_chunk
+            if 0 < w < pb:
+                # Chunked prefill: ceil(pb/w) window dispatches; decode
+                # chunks interleave between them instead of waiting out one
+                # long prompt forward. A non-divisor chunk just gets one
+                # narrower remainder window (its own compiled width) —
+                # never a silent fallback to monolithic prefill.
+                row_caches = init_caches(self.cfg, 1, pb, self._dtype)
+                if self._device is not None:
+                    row_caches = jax.device_put(row_caches, self._device)
+                start_vec = jnp.asarray([pb - L], jnp.int32)
+                win_exe = self._window()
+                for w0 in range(0, pb, w):
+                    wlog, row_caches = win_exe(
+                        self.params,
+                        jnp.asarray(tokens[:, w0:min(w0 + w, pb)]),
+                        row_caches, jnp.asarray([w0], jnp.int32),
+                        start_vec)
+                logits = wlog[0, -1]
+            else:
+                logits, row_caches = self._prefill()(
+                    self.params, jnp.asarray(tokens), jnp.asarray(attn),
+                    jnp.asarray(pos_ids))
             if self._prefix_cache.budget > 0:
                 self._prefix_cache.put(key, logits, row_caches)
         # First token from the prefill logits at logical position L (same
         # fold_in(seed, position) scheme as decode — batch-independent),
         # penalized by the PROMPT's token counts like every later step.
-        row_counts = token_counts([prompt], 1, self.cfg.vocab)
+        # Count bookkeeping exists only for requests that need it
+        # (penalty != 1 or stop tokens — the latter ride the same
+        # controls decode variant, which carries the counts buffer).
+        row_counts = None
+        first_logits = jnp.asarray(logits)[None, :]
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            row_counts = token_counts([prompt], 1, self.cfg.vocab)
+            if req.rep_penalty != 1.0:
+                first_logits = apply_repetition_penalty(
+                    first_logits, jnp.asarray(row_counts),
+                    jnp.asarray([req.rep_penalty], jnp.float32))
         first = _sample(
-            apply_repetition_penalty(
-                jnp.asarray(logits)[None, :], jnp.asarray(row_counts),
-                jnp.asarray([req.rep_penalty], jnp.float32)),
+            first_logits,
             jnp.asarray([seed], jnp.int32),
             jnp.asarray([L], jnp.int32),
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32))
         first_tok = int(first[0])
-        row_counts[0, first_tok] += 1  # the first token joins the context
-        return req, row_caches, first_tok, pb, L, row_counts[0]
+        if row_counts is not None:
+            row_counts[0, first_tok] += 1  # first token joins the context
+        return req, row_caches, first_tok, pb, L, row_counts
 
     def _admit(self, item, row: int) -> None:
         """Decode-thread half of admission: splice the prefilled KV block
         into the shared cache and initialise the row's host-side state."""
         req, row_caches, first_tok, pb, L, row_counts = item
-        self._caches, self._counts = self._insert()(
-            self._caches, row_caches.k, row_caches.v, row, self._counts,
-            jnp.asarray(row_counts))
+        if row_counts is not None:
+            self._caches, self._counts = self._insert(True)(
+                self._caches, row_caches.k, row_caches.v, row,
+                self._ensure_counts(), jnp.asarray(row_counts[0]))
+        else:
+            self._caches = self._insert(False)(
+                self._caches, row_caches.k, row_caches.v, row)
         self._start[row] = pb - L
         self._pos[row] = pb
         self._seeds[row] = int(req.seed) & 0x7FFFFFFF
@@ -584,12 +664,10 @@ class ContinuousGenerator:
         self._stats["failures"] = self._stats.get("failures", 0) + 1
         caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                              self._dtype)
-        counts = jnp.zeros((self.n_slots, self.cfg.vocab), jnp.int32)
         if self._device is not None:
             caches = jax.device_put(caches, self._device)
-            counts = jax.device_put(counts, self._device)
         self._caches = caches
-        self._counts = counts  # donated alongside — may be invalidated too
+        self._counts = None  # donated alongside — realloc lazily if needed
 
     def _loop(self) -> None:
         try:
@@ -665,7 +743,7 @@ class ContinuousGenerator:
                         jnp.asarray(self._done), jnp.asarray(self._seeds),
                         jnp.asarray(self._temps), jnp.asarray(self._topps),
                         jnp.asarray(self._topks), jnp.asarray(eos_vec),
-                        self._counts, jnp.asarray(self._pens),
+                        self._ensure_counts(), jnp.asarray(self._pens),
                         jnp.asarray(self._stops))
                 else:
                     self._caches, tok, pos, done, toks = self._decode(False)(

@@ -136,6 +136,11 @@ def main(argv=None) -> int:
         parser.add_argument("--gen-spec-k", type=int, default=4,
                             help="speculation depth: draft tokens proposed "
                                  "per verify round")
+        parser.add_argument("--gen-prefill-chunk", type=int, default=256,
+                            help="chunked prefill window (continuous "
+                                 "scheduler): longer prompts admit in "
+                                 "window dispatches so decode interleaves "
+                                 "(0 disables)")
         parser.add_argument("--gen-prefix-cache-mb", type=int, default=64,
                             help="continuous-scheduler prefix cache budget "
                                  "(device KV MB; repeated prompts skip "
@@ -164,6 +169,7 @@ def main(argv=None) -> int:
                                      gen_draft_path=args.gen_draft_path,
                                      gen_spec_k=args.gen_spec_k,
                                      gen_prefix_cache_mb=args.gen_prefix_cache_mb,
+                                     gen_prefill_chunk=args.gen_prefill_chunk,
                                      quantize=args.quantize,
                                      model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,

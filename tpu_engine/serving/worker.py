@@ -36,6 +36,7 @@ from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.serving.http import sse_event
 from tpu_engine.utils.config import WorkerConfig
 from tpu_engine.utils.sampling import clamp_top_k as _clamp_top_k
+from tpu_engine.utils.sampling import expand_stopping_params
 from tpu_engine.utils.tracing import SpanRecorder
 
 
@@ -242,6 +243,7 @@ class WorkerNode:
                         n_slots=self.config.gen_max_batch_size,
                         step_chunk=self.config.gen_step_chunk,
                         prefix_cache_mb=self.config.gen_prefix_cache_mb,
+                        prefill_chunk=self.config.gen_prefill_chunk,
                         device=getattr(engine, "_device", None))
                 else:
                     from tpu_engine.runtime.generator import Generator
@@ -534,6 +536,13 @@ class WorkerNode:
             stop_tokens=tuple(int(t)
                               for t in request.get("stop_tokens", ())),
         )
+        # Validate stopping params BEFORE the item can join a shared batch
+        # — a malformed request must 400 alone, never poison its
+        # co-batched group (the batch lane would otherwise surface
+        # expand_stopping_params' error to every request in the group).
+        expand_stopping_params(1, item.repetition_penalty,
+                               [list(item.stop_tokens)]
+                               if item.stop_tokens else None)
         if self._speculative and (item.top_p < 1.0 or item.top_k > 0
                                   or item.repetition_penalty != 1.0):
             # Reject BEFORE the item enters a shared batch: rejection

@@ -67,6 +67,10 @@ class WorkerConfig:
     # (runtime.scheduler._PrefixCache) — the KV-level analog of the /infer
     # result LRU for repeated system prompts.
     gen_prefix_cache_mb: int = 64
+    # Chunked prefill (continuous scheduler): prompts longer than this
+    # admit via window-decode dispatches so decode chunks interleave
+    # instead of stalling behind one long prompt forward (0 = off).
+    gen_prefill_chunk: int = 256
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
