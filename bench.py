@@ -419,12 +419,14 @@ def run_compute_bench(model: str = "resnet50", batch: int = 32,
 
 def run_decode_compute(model: str = "gpt2", batch: int = 8,
                        max_new: int = 64, dtype: str = "bfloat16",
-                       quantize: bool = False) -> dict:
+                       quantize: bool = False, fused: bool = False) -> dict:
     """On-chip decode throughput: tokens/s/chip through the KV-cache decode
     loop, with decode MFU ≈ tokens/s x 2 x params / peak (decode is
     HBM-bandwidth-bound; low MFU is expected and honest). `quantize` runs
     the same loop over int8 weight-only params (ops.quant) — decode streams
-    every weight per step, so int8 halves its HBM bytes."""
+    every weight per step, so int8 halves its HBM bytes. `fused` runs the
+    single-dispatch whole-loop mode (zero per-chunk host syncs — the
+    honest device-capability number on a high-latency dispatch link)."""
     import numpy as np
 
     from tpu_engine.models.registry import create_model, _ensure_builtin_models_imported
@@ -447,11 +449,14 @@ def run_decode_compute(model: str = "gpt2", batch: int = 8,
     prompts = [[int(t) for t in rng.integers(1, 1000, size=12)]
                for _ in range(batch)]
     t0 = time.perf_counter()
-    gen.generate(prompts, max_new_tokens=4)  # compile prefill+decode
+    # Compile with the measured max_new (fused caches one executable per
+    # output-capacity bucket; a 4-token warm compile would miss it).
+    gen.generate(prompts, max_new_tokens=max_new, fused=fused)
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    out = gen.generate(prompts, max_new_tokens=max_new, temperature=0.0)
+    out = gen.generate(prompts, max_new_tokens=max_new, temperature=0.0,
+                       fused=fused)
     wall = time.perf_counter() - t0
     tokens = sum(len(o) for o in out)
     kind, peak = chip_peak_flops()
@@ -462,6 +467,7 @@ def run_decode_compute(model: str = "gpt2", batch: int = 8,
         "batch": batch,
         "max_new_tokens": max_new,
         "quantize": "int8" if quantize else None,
+        "fused": fused,
         "tokens_per_s": round(tok_s, 2),
         "wall_s": round(wall, 3),
         "compile_s": round(compile_s, 2),
@@ -806,14 +812,17 @@ def _main() -> int:
         compute = run_compute_bench(model=args.model
                                     if args.model != "gpt2" else "resnet50")
         decode = run_decode_compute()
-        decode_q = run_decode_compute(quantize=True)
+        decode_f = run_decode_compute(fused=True)
+        decode_q = run_decode_compute(quantize=True, fused=True)
         log(json.dumps({"compute": compute, "decode": decode,
+                        "decode_fused": decode_f,
                         "decode_int8": decode_q}, indent=2))
         print(json.dumps({
             "metric": "device_compute", "value": compute["samples_per_s"],
             "unit": "samples/s", "vs_baseline": None,
             "mfu": compute["mfu"], "decode_tokens_per_s": decode["tokens_per_s"],
-            "compute": compute, "decode": decode, "decode_int8": decode_q,
+            "compute": compute, "decode": decode, "decode_fused": decode_f,
+            "decode_int8": decode_q,
         }), flush=True)
         return 0
 
@@ -916,13 +925,15 @@ def _main() -> int:
                 proc.kill()
             proc = None
 
-        compute = decode = None
+        compute = decode = decode_fused = None
         if not args.no_compute:
             try:
                 compute = run_compute_bench()
                 log(json.dumps({"compute": compute}, indent=2))
                 decode = run_decode_compute()
                 log(json.dumps({"decode": decode}, indent=2))
+                decode_fused = run_decode_compute(fused=True)
+                log(json.dumps({"decode_fused": decode_fused}, indent=2))
             except Exception as exc:
                 log(f"compute addendum failed: {exc}")
 
@@ -952,6 +963,10 @@ def _main() -> int:
         if decode is not None:
             line["decode"] = {k: decode[k] for k in
                               ("tokens_per_s", "decode_mfu") if k in decode}
+        if decode_fused is not None:
+            line["decode_fused"] = {
+                k: decode_fused[k] for k in ("tokens_per_s", "decode_mfu")
+                if k in decode_fused}
         print(json.dumps(line), flush=True)
         return 0 if result["success_rate"] > 0.99 else 1
     finally:

@@ -445,16 +445,21 @@ def _block_decode_window(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
 
 def transformer_decode_window(params, tokens, caches: KVCache, pos_vec,
                               cfg: TransformerConfig, *, dtype=jnp.bfloat16,
-                              start_vec=None):
+                              start_vec=None, head: str = "all"):
     """Consume a W-token window per row against the KV cache in ONE pass.
 
     tokens: (B, W) int32 — row b's stream tokens at absolute cache columns
     [pos_vec[b], pos_vec[b]+W); start_vec: (B,) first valid column per row
-    (left-padded batches). Returns (logits (B, W, vocab), caches) where
-    logits[:, i] predicts the token AFTER tokens[:, i].
+    (left-padded batches). Returns (logits, caches) where logits[:, i]
+    predicts the token AFTER tokens[:, i].
 
-    This is speculative decoding's verify step: scoring k draft tokens
-    costs one batched MXU pass instead of k sequential decode dispatches.
+    `head` controls the LM-head projection — the (W, vocab) matmul
+    dominates a window's FLOPs on small models: "all" projects every slot
+    ((B, W, vocab) — speculative verify needs them all), "last" only the
+    final slot ((B, 1, vocab) — the final window of a chunked prefill),
+    "none" skips it entirely (logits is None — interior prefill windows,
+    which only exist to write KV).
+
     Columns below start_vec may be written with garbage values by window
     slots that precede a short row's prompt — they are never attended
     (mask kpos >= start). Callers must keep pos_vec + W <= max_seq."""
@@ -476,6 +481,10 @@ def transformer_decode_window(params, tokens, caches: KVCache, pos_vec,
         return h, (ck, cv)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["blocks"], caches.k, caches.v))
+    if head == "none":
+        return None, KVCache(k_new, v_new)
+    if head == "last":
+        h = h[:, -1:]
     h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     return logits, KVCache(k_new, v_new)

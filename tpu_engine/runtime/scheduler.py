@@ -267,12 +267,13 @@ class ContinuousGenerator:
             if self._window_exe is None:
                 cfg, dtype = self.cfg, self._dtype
 
-                def window(params, tokens, caches, pos0, start):
+                def window(params, tokens, caches, pos0, start, head):
                     return transformer_decode_window(
                         params, tokens, caches, pos0, cfg, dtype=dtype,
-                        start_vec=start)
+                        start_vec=start, head=head)
 
-                self._window_exe = jax.jit(window, donate_argnums=(2,))
+                self._window_exe = jax.jit(window, donate_argnums=(2,),
+                                           static_argnums=(5,))
             return self._window_exe
 
     def _insert(self, with_counts: bool):
@@ -541,12 +542,17 @@ class ContinuousGenerator:
                     row_caches = jax.device_put(row_caches, self._device)
                 start_vec = jnp.asarray([pb - L], jnp.int32)
                 win_exe = self._window()
-                for w0 in range(0, pb, w):
+                starts = list(range(0, pb, w))
+                for w0 in starts:
+                    # Interior windows exist only to write KV — skip their
+                    # (W, vocab) LM-head matmul; the final window projects
+                    # its last slot only.
+                    head = "last" if w0 == starts[-1] else "none"
                     wlog, row_caches = win_exe(
                         self.params,
                         jnp.asarray(tokens[:, w0:min(w0 + w, pb)]),
                         row_caches, jnp.asarray([w0], jnp.int32),
-                        start_vec)
+                        start_vec, head)
                 logits = wlog[0, -1]
             else:
                 logits, row_caches = self._prefill()(

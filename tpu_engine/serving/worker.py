@@ -604,6 +604,10 @@ class WorkerNode:
         top_k = _clamp_top_k(request.get("top_k", 0))
         rep_pen = float(request.get("repetition_penalty", 1.0))
         stop_toks = [int(t) for t in request.get("stop_tokens", ())]
+        # Same eager validation as the blocking endpoint: a malformed
+        # request must 400 before the 200 SSE stream is committed.
+        expand_stopping_params(1, rep_pen,
+                               [stop_toks] if stop_toks else None)
         if self._speculative and (top_p < 1.0 or top_k > 0
                                   or rep_pen != 1.0):
             # Must fire HERE, before the iterator commits a 200 SSE stream
