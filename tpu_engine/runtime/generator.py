@@ -243,6 +243,7 @@ class Generator:
         self._prefill_exe: Dict[Tuple[int, int], object] = {}
         self._decode_exe: Dict[Tuple[int, bool], object] = {}
         self._fused_exe: Dict[Tuple[int, int, int, bool], object] = {}
+        self._beam_exe: Dict[Tuple[int, int, int], object] = {}
         # Per-batch-bucket KV cache, reused across _generate_batch calls
         # (VERDICT r3 item 9: reallocating a donated cache every batch was
         # pure allocation churn). The prefill/decode executables donate it;
@@ -406,6 +407,129 @@ class Generator:
 
             self._fused_exe[key] = jax.jit(run, donate_argnums=(6,))
             return self._fused_exe[key]
+
+    def _beam(self, bw: int, pb: int, cap: int):
+        """Compiled beam search for one request: beams ride the batch axis
+        of one fused while_loop dispatch (beam candidates scored by
+        summed log-probs; cache rows gathered on beam reorder — on TPU
+        this is a contiguous batched gather of the dense cache, the
+        layout ops.attention's decode path wants anyway). Returns every
+        beam's tokens + raw scores; the host applies the length penalty
+        and picks (normalization needs final lengths, which EOS decides)."""
+        key = (bw, pb, cap)
+        exe = self._beam_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._lock:
+            if key in self._beam_exe:
+                return self._beam_exe[key]
+            cfg, dtype = self.cfg, self._dtype
+            max_seq = self.max_seq
+
+            def run(params, tokens, attn_mask, pos_ids, start1, caches,
+                    max_new, eos_id):
+                rows = jnp.arange(bw)
+                logits, caches = transformer_prefill(
+                    params, tokens, caches, cfg, dtype=dtype,
+                    attn_mask=attn_mask, pos_ids=pos_ids)   # (1, V)
+                logp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+                scores, first = jax.lax.top_k(logp0, bw)    # (bw,), (bw,)
+                first = first.astype(jnp.int32)
+                # Broadcast the prompt's KV to every beam row.
+                caches = jax.tree_util.tree_map(
+                    lambda a: jnp.repeat(a, bw, axis=1), caches)
+                start = jnp.repeat(start1, bw)
+                out_buf = jnp.zeros((bw, cap), jnp.int32).at[:, 0].set(first)
+                n_out = jnp.int32(1)
+                done = (first == eos_id) | (max_new <= 1)
+
+                def cond(c):
+                    return (jnp.any(~c[2]) & (c[4] < max_seq)
+                            & (c[3] < max_new))
+
+                def body(c):
+                    caches, tok, done, n_out, pos, out_buf, scores = c
+                    logits, caches = transformer_decode_step(
+                        params, tok, caches, pos, cfg, dtype=dtype,
+                        start=start, pos_ids=pos - start)
+                    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                    # Live beams extend by any token; a finished beam
+                    # survives as ONE candidate (unchanged score, re-emits
+                    # EOS — trimmed on the host).
+                    cand = jnp.where(done[:, None], -jnp.inf,
+                                     scores[:, None] + logp)    # (bw, V)
+                    eos_col = jnp.maximum(eos_id, 0)
+                    cand = cand.at[rows, eos_col].set(
+                        jnp.where(done, scores, cand[rows, eos_col]))
+                    vals, idx = jax.lax.top_k(cand.reshape(-1), bw)
+                    src = (idx // cfg.vocab).astype(jnp.int32)
+                    nxt = (idx % cfg.vocab).astype(jnp.int32)
+                    caches = jax.tree_util.tree_map(
+                        lambda a: a[:, src], caches)
+                    out_buf = out_buf[src]
+                    done = done[src]
+                    nxt = jnp.where(done, eos_id, nxt)
+                    out_buf = out_buf.at[
+                        rows, jnp.minimum(n_out, cap - 1)
+                    ].set(jnp.where(done, out_buf[
+                        rows, jnp.minimum(n_out, cap - 1)], nxt))
+                    done = done | (nxt == eos_id)
+                    return (caches, nxt, done, n_out + 1, pos + 1, out_buf,
+                            vals)
+
+                carry = (caches, first, done, n_out, jnp.int32(pb), out_buf,
+                         scores)
+                carry = jax.lax.while_loop(cond, body, carry)
+                return carry[5], carry[6], carry[3]  # out_buf, scores, n
+
+            self._beam_exe[key] = jax.jit(run)
+            return self._beam_exe[key]
+
+    def beam_search(self, prompt: Sequence[int], beam_width: int = 4,
+                    max_new_tokens: int = 32, eos_id: int = -1,
+                    length_penalty: float = 1.0) -> List[int]:
+        """Deterministic beam decode of ONE prompt; returns the best beam
+        (summed log-prob / len**length_penalty, GNMT-style). Beams occupy
+        the batch axis of a single fused dispatch."""
+        bw = int(beam_width)
+        if bw < 1:
+            raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+        prompt = list(prompt)
+        pb = self._bucket(self._prompt_buckets,
+                          min(max(len(prompt), 1), self.max_seq))
+        max_new = max(1, min(int(max_new_tokens), self.max_seq - pb))
+        cap = 1 << (max_new - 1).bit_length() if max_new > 1 else 1
+        tokens, attn_mask, pos_ids, start = left_pad_batch([prompt], 1, pb)
+        dev = self._device
+
+        def put(x):
+            return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+
+        # Reuse the width-1 cache from the pool; the jit doesn't donate it
+        # (the loop works on the bw-row tiled copy), so the buffer goes
+        # straight back afterwards — no per-call allocation churn.
+        with self._lock:
+            caches = self._cache_pool.pop(1, None)
+        if caches is None:
+            caches = init_caches(self.cfg, 1, self.max_seq, self._dtype)
+            if dev is not None:
+                caches = jax.device_put(caches, dev)
+        out_buf, scores, _ = self._beam(bw, pb, cap)(
+            self.params, put(tokens), put(attn_mask), put(pos_ids),
+            put(start), caches, put(jnp.int32(max_new)),
+            put(jnp.int32(eos_id)))
+        with self._lock:
+            self._cache_pool.setdefault(1, caches)
+        out_buf = np.asarray(out_buf)
+        scores = np.asarray(scores)
+        best, best_norm = [], -np.inf
+        for b in range(bw):
+            row = truncate_at_stops(out_buf[b, :max_new].tolist(),
+                                    eos_id, ())
+            norm = scores[b] / max(len(row), 1) ** float(length_penalty)
+            if norm > best_norm:
+                best, best_norm = row, norm
+        return best
 
     # -- generation ------------------------------------------------------------
 

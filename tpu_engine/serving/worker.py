@@ -77,6 +77,8 @@ class _GenItem:
     top_k: int = 0
     repetition_penalty: float = 1.0
     stop_tokens: tuple = ()
+    beam_width: int = 1
+    length_penalty: float = 1.0
 
 
 @dataclass
@@ -287,6 +289,29 @@ class WorkerNode:
         self.tracer = SpanRecorder()
 
     # -- fault injection -------------------------------------------------------
+
+    # Wire-facing beam cap: each distinct width compiles (and permanently
+    # caches) its own while_loop executable and multiplies the KV cache by
+    # the width — an unclamped client value is a compile/memory DoS.
+    MAX_BEAM_WIDTH = 8
+
+    def _validate_beam(self, beam_width, temperature, top_p, top_k,
+                       rep_penalty, stop_tokens) -> None:
+        if beam_width == 1:
+            return
+        if not 1 <= beam_width <= self.MAX_BEAM_WIDTH:
+            raise ValueError(
+                f"beam_width must be in [1, {self.MAX_BEAM_WIDTH}], got "
+                f"{beam_width}")
+        # Beam decode (batch lane's Generator only): deterministic,
+        # incompatible with sampling controls by construction.
+        if self._continuous or self._speculative:
+            raise ValueError("beam_width > 1 needs gen_scheduler=batch")
+        if (temperature > 0 or top_p < 1.0 or top_k > 0
+                or rep_penalty != 1.0 or stop_tokens):
+            raise ValueError(
+                "beam_width is deterministic: temperature/top_p/top_k/"
+                "repetition_penalty/stop_tokens do not apply")
 
     _AUTO_DRAFT = {"gpt2": "distilgpt2", "gpt2-small-test": "gpt2-small-test"}
 
@@ -535,7 +560,12 @@ class WorkerNode:
                 request.get("repetition_penalty", 1.0)),
             stop_tokens=tuple(int(t)
                               for t in request.get("stop_tokens", ())),
+            beam_width=int(request.get("beam_width", 1)),
+            length_penalty=float(request.get("length_penalty", 1.0)),
         )
+        self._validate_beam(item.beam_width, item.temperature, item.top_p,
+                            item.top_k, item.repetition_penalty,
+                            item.stop_tokens)
         # Validate stopping params BEFORE the item can join a shared batch
         # — a malformed request must 400 alone, never poison its
         # co-batched group (the batch lane would otherwise surface
@@ -604,10 +634,14 @@ class WorkerNode:
         top_k = _clamp_top_k(request.get("top_k", 0))
         rep_pen = float(request.get("repetition_penalty", 1.0))
         stop_toks = [int(t) for t in request.get("stop_tokens", ())]
+        beam_width = int(request.get("beam_width", 1))
+        length_penalty = float(request.get("length_penalty", 1.0))
         # Same eager validation as the blocking endpoint: a malformed
         # request must 400 before the 200 SSE stream is committed.
         expand_stopping_params(1, rep_pen,
                                [stop_toks] if stop_toks else None)
+        self._validate_beam(beam_width, temperature, top_p, top_k,
+                            rep_pen, stop_toks)
         if self._speculative and (top_p < 1.0 or top_k > 0
                                   or rep_pen != 1.0):
             # Must fire HERE, before the iterator commits a 200 SSE stream
@@ -621,7 +655,9 @@ class WorkerNode:
                       "temperature": temperature, "seed": seed,
                       "top_p": top_p, "top_k": top_k,
                       "repetition_penalty": rep_pen,
-                      "stop_tokens": stop_toks}
+                      "stop_tokens": stop_toks,
+                      "beam_width": beam_width,
+                      "length_penalty": length_penalty}
         if not self._continuous:
             def one_shot():
                 try:
@@ -675,6 +711,17 @@ class WorkerNode:
         results: List[Optional[_GenResult]] = [None] * len(items)
         groups = {}
         for idx, it in enumerate(items):
+            if it.beam_width > 1:
+                # Beam requests run alone (beams occupy the batch axis).
+                t0 = time.perf_counter()
+                row = self.generator.beam_search(
+                    it.prompt, beam_width=it.beam_width,
+                    max_new_tokens=it.max_new_tokens, eos_id=it.eos_id,
+                    length_penalty=it.length_penalty)
+                results[idx] = _GenResult(
+                    row[: it.max_new_tokens],
+                    int((time.perf_counter() - t0) * 1e6))
+                continue
             groups.setdefault(it.eos_id, []).append(idx)
         for eos_id, idxs in groups.items():
             t0 = time.perf_counter()
