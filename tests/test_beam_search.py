@@ -1,6 +1,7 @@
 """Beam search (Generator.beam_search): single-dispatch beams on the
-batch axis. Contracts: beam_width=1 == greedy; wider beams never score
-worse (sum log-prob of the chosen sequence); EOS ends beams; wire routes
+batch axis. Contracts: beam_width=1 == greedy; on this model the chosen sequence's
+sum log-prob matches or beats greedy's (empirical — width-k beam search
+can prune the greedy path in principle); EOS ends beams; wire routes
 beam_width through the batch lane and rejects it elsewhere."""
 
 import jax

@@ -47,3 +47,28 @@ def test_fused_partial_bucket(gen):
     a = gen.generate([PROMPTS[0]], max_new_tokens=8)
     b = gen.generate([PROMPTS[0]], max_new_tokens=8, fused=True)
     assert a == b and len(b) == 1
+
+
+def test_worker_batch_lane_fused_flag():
+    """gen_decode_fused=True routes the batch lane through the fused
+    executable with identical wire output."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    req = {"request_id": "f1", "prompt_tokens": [5, 9, 3],
+           "max_new_tokens": 6, "seed": 2}
+    plain_w = WorkerNode(WorkerConfig(node_id="w_fp", dtype="float32",
+                                      model="gpt2-small-test",
+                                      gen_scheduler="batch"))
+    try:
+        want = plain_w.handle_generate(dict(req))["tokens"]
+    finally:
+        plain_w.stop()
+    fused_w = WorkerNode(WorkerConfig(node_id="w_ff", dtype="float32",
+                                      model="gpt2-small-test",
+                                      gen_scheduler="batch",
+                                      gen_decode_fused=True))
+    try:
+        assert fused_w.handle_generate(dict(req))["tokens"] == want
+    finally:
+        fused_w.stop()

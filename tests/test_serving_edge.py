@@ -230,13 +230,13 @@ def test_scheduler_recovers_from_decode_failure():
         def boom(*_a, **_k):
             raise RuntimeError("injected device failure")
 
-        s._decode_exe = boom
+        s._decode_exe = {False: boom, True: boom}
         fut = s.submit([5, 9, 3], max_new_tokens=6)
         with pytest.raises(RuntimeError, match="injected device failure"):
             fut.result(60)
         assert s.stats()["failures"] == 1
 
-        s._decode_exe = None  # let the real executable rebuild
+        s._decode_exe = {}  # let the real executables rebuild
         got = s.submit([5, 9, 3], max_new_tokens=6).result(60)
 
         seq, ref = [5, 9, 3], []

@@ -256,6 +256,29 @@ class Generator:
     def _bucket(self, buckets: Tuple[int, ...], n: int) -> int:
         return pick_bucket(buckets, n)
 
+    @staticmethod
+    def _out_cap(max_new: int) -> int:
+        """Output-buffer capacity bucket (power of two >= max_new): ONE
+        rounding rule for every single-dispatch mode, so a capacity change
+        can't silently diverge between the fused and beam executables."""
+        return 1 << (max_new - 1).bit_length() if max_new > 1 else 1
+
+    def _pooled_cache(self, bb: int):
+        """Pop the bucket's KV buffer from the pool (alloc+place on miss).
+        Stale contents are never read: prefill rewrites [0, pb) and decode
+        attends only within [start, pos]."""
+        with self._lock:
+            caches = self._cache_pool.pop(bb, None)
+        if caches is None:
+            caches = init_caches(self.cfg, bb, self.max_seq, self._dtype)
+            if self._device is not None:
+                caches = jax.device_put(caches, self._device)
+        return caches
+
+    def _return_cache(self, bb: int, caches) -> None:
+        with self._lock:
+            self._cache_pool.setdefault(bb, caches)
+
     # -- compiled stages -------------------------------------------------------
 
     def _prefill(self, bb: int, pb: int):
@@ -498,7 +521,7 @@ class Generator:
         pb = self._bucket(self._prompt_buckets,
                           min(max(len(prompt), 1), self.max_seq))
         max_new = max(1, min(int(max_new_tokens), self.max_seq - pb))
-        cap = 1 << (max_new - 1).bit_length() if max_new > 1 else 1
+        cap = self._out_cap(max_new)
         tokens, attn_mask, pos_ids, start = left_pad_batch([prompt], 1, pb)
         dev = self._device
 
@@ -508,18 +531,12 @@ class Generator:
         # Reuse the width-1 cache from the pool; the jit doesn't donate it
         # (the loop works on the bw-row tiled copy), so the buffer goes
         # straight back afterwards — no per-call allocation churn.
-        with self._lock:
-            caches = self._cache_pool.pop(1, None)
-        if caches is None:
-            caches = init_caches(self.cfg, 1, self.max_seq, self._dtype)
-            if dev is not None:
-                caches = jax.device_put(caches, dev)
+        caches = self._pooled_cache(1)
         out_buf, scores, _ = self._beam(bw, pb, cap)(
             self.params, put(tokens), put(attn_mask), put(pos_ids),
             put(start), caches, put(jnp.int32(max_new)),
             put(jnp.int32(eos_id)))
-        with self._lock:
-            self._cache_pool.setdefault(1, caches)
+        self._return_cache(1, caches)
         out_buf = np.asarray(out_buf)
         scores = np.asarray(scores)
         best, best_norm = [], -np.inf
@@ -594,7 +611,7 @@ class Generator:
         longest = max(1, max(len(p) for p in prompts))
         pb = self._bucket(self._prompt_buckets, min(longest, self.max_seq))
         max_new = max(1, min(max_new, self.max_seq - pb))
-        cap = 1 << (max_new - 1).bit_length() if max_new > 1 else 1
+        cap = self._out_cap(max_new)
         controls = any(p != 1.0 for p in pens) or any(stops)
 
         tokens, attn_mask, pos_ids, start = left_pad_batch(prompts, bb, pb)
@@ -605,12 +622,7 @@ class Generator:
         def put(x):
             return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
 
-        with self._lock:
-            caches = self._cache_pool.pop(bb, None)
-        if caches is None:
-            caches = init_caches(self.cfg, bb, self.max_seq, self._dtype)
-            if dev is not None:
-                caches = jax.device_put(caches, dev)
+        caches = self._pooled_cache(bb)
 
         temps_arr = np.zeros((bb,), np.float32)
         seeds_arr = np.zeros((bb,), np.int32)
@@ -632,8 +644,7 @@ class Generator:
             args += [put(pens_arr), put(stop_matrix(stops, bb)),
                      put(counts0)]
         out_buf, n_out, caches = self._fused(bb, pb, cap, controls)(*args)
-        with self._lock:
-            self._cache_pool.setdefault(bb, caches)  # loop's final buffer
+        self._return_cache(bb, caches)  # the loop's final buffer
         out_buf = np.asarray(out_buf)
         n_out = np.asarray(n_out)
         return [truncate_at_stops(
@@ -658,15 +669,7 @@ class Generator:
         def put(x):
             return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
 
-        # Reuse the bucket's cache buffer from the previous batch (stale
-        # contents are never read: prefill rewrites [0, pb) and decode
-        # attends only within [start, pos], all written by this batch).
-        with self._lock:
-            caches = self._cache_pool.pop(bb, None)
-        if caches is None:
-            caches = init_caches(self.cfg, bb, self.max_seq, self._dtype)
-            if dev is not None:
-                caches = jax.device_put(caches, dev)
+        caches = self._pooled_cache(bb)
         logits, caches = self._prefill(bb, pb)(
             self.params, put(tokens), put(attn_mask), put(pos_ids), caches)
 
@@ -738,8 +741,7 @@ class Generator:
             if bool(np.all(np.asarray(done))):
                 break
 
-        with self._lock:
-            self._cache_pool.setdefault(bb, caches)  # return buffer to pool
+        self._return_cache(bb, caches)
         gen = np.concatenate(pieces, axis=1)[:n, :max_new]
         return [truncate_at_stops(gen[r].tolist(), eos_id, stops[r])
                 for r in range(n)]

@@ -136,6 +136,10 @@ def main(argv=None) -> int:
         parser.add_argument("--gen-spec-k", type=int, default=4,
                             help="speculation depth: draft tokens proposed "
                                  "per verify round")
+        parser.add_argument("--gen-decode-fused", action="store_true",
+                            help="batch scheduler: whole decode loop as "
+                                 "one dispatch (zero per-chunk host "
+                                 "syncs; identical streams)")
         parser.add_argument("--gen-prefill-chunk", type=int, default=256,
                             help="chunked prefill window (continuous "
                                  "scheduler): longer prompts admit in "
@@ -170,6 +174,7 @@ def main(argv=None) -> int:
                                      gen_spec_k=args.gen_spec_k,
                                      gen_prefix_cache_mb=args.gen_prefix_cache_mb,
                                      gen_prefill_chunk=args.gen_prefill_chunk,
+                                     gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)
         serve_combined(model=args.model, lanes=args.lanes, port=args.port,

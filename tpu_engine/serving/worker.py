@@ -22,6 +22,7 @@ or in-process by the gateway (TPU-native shape: one process, lanes = chips).
 from __future__ import annotations
 
 import json
+import math
 import os
 import queue
 import threading
@@ -296,7 +297,14 @@ class WorkerNode:
     MAX_BEAM_WIDTH = 8
 
     def _validate_beam(self, beam_width, temperature, top_p, top_k,
-                       rep_penalty, stop_tokens) -> None:
+                       rep_penalty, stop_tokens,
+                       length_penalty: float = 1.0) -> None:
+        if not math.isfinite(length_penalty) or abs(length_penalty) > 10:
+            # json.loads accepts NaN/Infinity; a non-finite penalty makes
+            # every beam's normalized score NaN and silently returns [].
+            raise ValueError(
+                f"length_penalty must be finite in [-10, 10], got "
+                f"{length_penalty}")
         if beam_width == 1:
             return
         if not 1 <= beam_width <= self.MAX_BEAM_WIDTH:
@@ -565,7 +573,7 @@ class WorkerNode:
         )
         self._validate_beam(item.beam_width, item.temperature, item.top_p,
                             item.top_k, item.repetition_penalty,
-                            item.stop_tokens)
+                            item.stop_tokens, item.length_penalty)
         # Validate stopping params BEFORE the item can join a shared batch
         # — a malformed request must 400 alone, never poison its
         # co-batched group (the batch lane would otherwise surface
@@ -641,7 +649,7 @@ class WorkerNode:
         expand_stopping_params(1, rep_pen,
                                [stop_toks] if stop_toks else None)
         self._validate_beam(beam_width, temperature, top_p, top_k,
-                            rep_pen, stop_toks)
+                            rep_pen, stop_toks, length_penalty)
         if self._speculative and (top_p < 1.0 or top_k > 0
                                   or rep_pen != 1.0):
             # Must fire HERE, before the iterator commits a 200 SSE stream
@@ -735,7 +743,11 @@ class WorkerNode:
                 top_k=[items[i].top_k for i in idxs],
                 repetition_penalty=[items[i].repetition_penalty
                                     for i in idxs],
-                stop_tokens=[list(items[i].stop_tokens) for i in idxs])
+                stop_tokens=[list(items[i].stop_tokens) for i in idxs],
+                # The speculative generator is single-dispatch by design
+                # and takes no fused flag.
+                **({} if self._speculative
+                   else {"fused": self.config.gen_decode_fused}))
             # Reference semantic: per-request time = batch_duration /
             # batch_size, per group (worker_node.cpp:123).
             elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))

@@ -71,6 +71,11 @@ class WorkerConfig:
     # admit via window-decode dispatches so decode chunks interleave
     # instead of stalling behind one long prompt forward (0 = off).
     gen_prefill_chunk: int = 256
+    # Batch scheduler only: run each group's decode as ONE fused dispatch
+    # (lax.while_loop, zero per-chunk host syncs; identical streams).
+    # Worth enabling where dispatch latency is high; costs one compile per
+    # (batch, prompt, output-capacity) bucket triple.
+    gen_decode_fused: bool = False
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
