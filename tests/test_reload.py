@@ -1,0 +1,152 @@
+"""Hot weight reload (/admin/reload, WorkerNode.reload_weights).
+
+Contracts: outputs change to the new checkpoint's with zero downtime;
+mismatched architectures are rejected with the OLD weights still
+serving; the /infer result cache and the prefix cache are invalidated
+(entries computed under old weights must not leak)."""
+
+import http.client
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    create_model,
+)
+
+_ensure_builtin_models_imported()
+
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.checkpoint import save_params
+from tpu_engine.utils.config import WorkerConfig
+
+
+@pytest.fixture(scope="module")
+def ckpts(tmp_path_factory):
+    d = tmp_path_factory.mktemp("reload")
+    spec = create_model("gpt2-small-test")
+    p1 = save_params(str(d / "w1"), spec.init(jax.random.PRNGKey(1)))
+    p2 = save_params(str(d / "w2"), spec.init(jax.random.PRNGKey(2)))
+    other = create_model("gpt2-small-test", n_layers=1, d_model=32,
+                         n_heads=2, d_ff=64)
+    p_bad = save_params(str(d / "bad"), other.init(jax.random.PRNGKey(3)))
+    return p1, p2, p_bad
+
+
+def test_reload_changes_outputs_and_clears_caches(ckpts):
+    p1, p2, _ = ckpts
+    w = WorkerNode(WorkerConfig(node_id="w_reload", model="gpt2-small-test",
+                                dtype="float32", model_path=p1))
+    try:
+        req = {"request_id": "r1", "input_data": [5.0, 9.0]}
+        before = w.handle_infer(dict(req))["output_data"]
+        gen_before = w.handle_generate({"request_id": "g1",
+                                        "prompt_tokens": [5, 9, 3],
+                                        "max_new_tokens": 6})["tokens"]
+        out = w.reload_weights(p2)
+        assert out["ok"]
+        after = w.handle_infer(dict(req))
+        # same request id + input: a stale cache would replay `before`
+        assert after["output_data"] != before
+        assert not after["cached"]
+        gen_after = w.handle_generate({"request_id": "g2",
+                                       "prompt_tokens": [5, 9, 3],
+                                       "max_new_tokens": 6})["tokens"]
+        assert gen_after != gen_before
+    finally:
+        w.stop()
+
+
+def test_reload_rejects_mismatched_architecture(ckpts):
+    p1, _, p_bad = ckpts
+    w = WorkerNode(WorkerConfig(node_id="w_reload2",
+                                model="gpt2-small-test",
+                                dtype="float32", model_path=p1))
+    try:
+        req = {"request_id": "m1", "input_data": [4.0, 2.0]}
+        before = w.handle_infer(dict(req))["output_data"]
+        with pytest.raises(Exception):
+            w.reload_weights(p_bad)
+        # old weights still serve
+        again = w.handle_infer({"request_id": "m2",
+                                "input_data": [4.0, 2.0]})["output_data"]
+        assert again == before
+    finally:
+        w.stop()
+
+
+def test_reload_over_http(ckpts):
+    p1, p2, _ = ckpts
+    from tpu_engine.serving.app import serve_worker
+
+    cfg = WorkerConfig(port=0, node_id="w_http_reload",
+                       model="gpt2-small-test", dtype="float32",
+                       model_path=p1)
+    w, server = serve_worker(cfg, background=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        body = json.dumps({"request_id": "h1", "input_data": [1.0, 2.0]})
+        conn.request("POST", "/infer", body=body,
+                     headers={"Content-Type": "application/json"})
+        before = json.loads(conn.getresponse().read())["output_data"]
+        conn.request("POST", "/admin/reload",
+                     body=json.dumps({"model_path": p2}),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read())["ok"]
+        conn.request("POST", "/infer", body=body,
+                     headers={"Content-Type": "application/json"})
+        after = json.loads(conn.getresponse().read())["output_data"]
+        assert after != before
+        conn.close()
+    finally:
+        server.stop()
+        w.stop()
+
+
+def test_reload_quantized_worker(ckpts):
+    p1, p2, _ = ckpts
+    w = WorkerNode(WorkerConfig(node_id="w_reload_q8",
+                                model="gpt2-small-test", dtype="float32",
+                                model_path=p1, quantize="int8"))
+    try:
+        before = w.handle_infer({"request_id": "q1",
+                                 "input_data": [5.0]})["output_data"]
+        w.reload_weights(p2)  # re-quantizes on the way in
+        after = w.handle_infer({"request_id": "q2",
+                                "input_data": [5.0]})["output_data"]
+        assert after != before
+    finally:
+        w.stop()
+
+
+def test_combined_reload_all_lanes(ckpts):
+    """Combined mode: one disk load, every lane swapped, per-node
+    outcomes reported (code-review r4 findings)."""
+    p1, p2, _ = ckpts
+    from tpu_engine.serving.app import serve_combined
+
+    gateway, workers, server = serve_combined(
+        model="gpt2-small-test", lanes=2, port=0, background=True,
+        worker_config=WorkerConfig(model="gpt2-small-test",
+                                   dtype="float32", model_path=p1))
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        conn.request("POST", "/admin/reload",
+                     body=json.dumps({"model_path": p2}),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        data = json.loads(r.read())
+        assert r.status == 200 and data["ok"]
+        assert len(data["reloaded"]) == 2
+        assert all(o["ok"] for o in data["reloaded"])
+        conn.close()
+    finally:
+        server.stop()
+        for w in workers:
+            w.stop()

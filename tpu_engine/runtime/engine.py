@@ -146,6 +146,36 @@ class InferenceEngine:
 
     # -- shape contract (reference inference_engine.cpp:211-217) -------------
 
+    def set_params(self, params) -> None:
+        """Hot weight swap: validate the new tree against the served one
+        (same treedef + leaf shapes — executables are compiled for these
+        shapes, a mismatch would poison every compiled bucket), apply the
+        engine's quantize mode, place like the old params, and swap the
+        reference atomically. In-flight executions keep the old buffers
+        (params are jit INPUTS, not captured constants), so a reload never
+        tears a running batch — the reference can only restart the worker
+        process to change weights."""
+        if self.quantize is not None:
+            from tpu_engine.ops.quant import quantize_params
+
+            params = quantize_params(params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                "reload rejected: parameter tree structure differs from "
+                "the served model's")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            if tuple(o.shape) != tuple(n.shape):
+                raise ValueError(
+                    f"reload rejected: leaf {i} shape {tuple(n.shape)} != "
+                    f"served {tuple(o.shape)}")
+        if self._param_shardings is not None:
+            params = jax.device_put(params, self._param_shardings)
+        elif self._device is not None:
+            params = jax.device_put(params, self._device)
+        self.params = params
+
     @property
     def input_size(self) -> int:
         return self.spec.input_size

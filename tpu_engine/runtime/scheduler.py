@@ -425,6 +425,16 @@ class ContinuousGenerator:
                 for i, p in enumerate(prompts)]
         return [f.result(timeout=600) for f in futs]
 
+    def set_params(self, params) -> None:
+        """Hot weight swap. The prefix cache holds (logits, KV) computed
+        under the OLD weights — serving them against new weights would mix
+        models mid-stream, so it empties with the swap. In-flight rows
+        finish their current chunk on whichever params reference the chunk
+        captured; subsequent chunks use the new weights (acceptable for a
+        reload; stop the scheduler first for a hard cut)."""
+        self.params = params
+        self._prefix_cache = _PrefixCache(self._prefix_cache.budget)
+
     def stats(self) -> dict:
         return dict(self._stats, n_slots=self.n_slots,
                     active=int(sum(r is not None for r in self._row_req)),
@@ -523,10 +533,15 @@ class ContinuousGenerator:
         # a REAL vocab token, so [5] and [0, 5] serialize identically at
         # the same bucket — only the length tells them apart. A disabled
         # cache (budget 0) skips even the key serialization.
+        # Capture the cache OBJECT once: set_params (hot reload) swaps
+        # self._prefix_cache, and a put issued after the swap must land in
+        # the abandoned old cache (GC'd), never seed the fresh one with
+        # old-weight logits/KV.
+        prefix_cache = self._prefix_cache
         cached = None
-        if self._prefix_cache.budget > 0:
+        if prefix_cache.budget > 0:
             key = (pb, L, tokens.tobytes())
-            cached = self._prefix_cache.get(key)
+            cached = prefix_cache.get(key)
         if cached is not None:
             logits, row_caches = cached
         else:
@@ -558,8 +573,8 @@ class ContinuousGenerator:
                 logits, row_caches = self._prefill()(
                     self.params, jnp.asarray(tokens), jnp.asarray(attn),
                     jnp.asarray(pos_ids))
-            if self._prefix_cache.budget > 0:
-                self._prefix_cache.put(key, logits, row_caches)
+            if prefix_cache.budget > 0:
+                prefix_cache.put(key, logits, row_caches)
         # First token from the prefill logits at logical position L (same
         # fold_in(seed, position) scheme as decode — batch-independent),
         # penalized by the PROMPT's token counts like every later step.

@@ -53,6 +53,8 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     server.route("GET", "/metrics", lambda _body: (
         200, render_prometheus([worker.get_health()]),
         "text/plain; version=0.0.4"))
+    server.route("POST", "/admin/reload", lambda body: (
+        200, worker.reload_weights(body["model_path"])))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -282,6 +284,36 @@ def serve_combined(
         200, render_prometheus([w.get_health() for w in workers],
                                gateway.get_stats()),
         "text/plain; version=0.0.4")
+
+    # Hot weight reload (no serving pause; the reference restarts worker
+    # processes to change weights). {"model_path": ..., "node": optional}
+    # — all lanes by default. The checkpoint loads from disk ONCE; each
+    # lane then swaps independently, and per-node outcomes are reported
+    # even on partial failure (an error mid-fleet must not hide which
+    # lanes already serve the new weights).
+    def _admin_reload(body):
+        from tpu_engine.serving.worker import _load_model_path
+
+        node = body.get("node")
+        targets = [w for w in workers
+                   if node in (None, "*") or w.node_id == node]
+        if not targets:
+            return 404, {"error": f"unknown node '{node}'"}
+        path = body["model_path"]
+        params = _load_model_path(targets[0].engine.spec, path)
+        if params is None:
+            return 400, {"error": f"no loadable weights at '{path}'"}
+        outcomes, ok = [], True
+        for w in targets:
+            try:
+                outcomes.append(w.apply_weights(params, source=path))
+            except Exception as exc:
+                ok = False
+                outcomes.append({"ok": False, "node_id": w.node_id,
+                                 "error": str(exc)[:300]})
+        return (200 if ok else 500), {"ok": ok, "reloaded": outcomes}
+
+    routes[("POST", "/admin/reload")] = _admin_reload
 
     server = _make_front_server(port, routes, workers, gateway, native_front)
     kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"

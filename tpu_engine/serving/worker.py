@@ -276,6 +276,9 @@ class WorkerNode:
         # gateway's breaker sees it exactly like a dead worker.
         self._injected_fault: Optional[str] = None
         self._fault_listeners: list = []
+        # Bumped by reload_weights: in-flight /infer results computed
+        # under an older generation must not enter the cleared cache.
+        self._weights_gen = 0
         # In-flight coalescing: concurrent identical misses share ONE
         # execution. The reference deliberately lacks this — simultaneous
         # identical requests all enter the batch because the cache is only
@@ -299,14 +302,14 @@ class WorkerNode:
     def _validate_beam(self, beam_width, temperature, top_p, top_k,
                        rep_penalty, stop_tokens,
                        length_penalty: float = 1.0) -> None:
+        if beam_width == 1:
+            return  # non-beam paths never read length_penalty
         if not math.isfinite(length_penalty) or abs(length_penalty) > 10:
             # json.loads accepts NaN/Infinity; a non-finite penalty makes
             # every beam's normalized score NaN and silently returns [].
             raise ValueError(
                 f"length_penalty must be finite in [-10, 10], got "
                 f"{length_penalty}")
-        if beam_width == 1:
-            return
         if not 1 <= beam_width <= self.MAX_BEAM_WIDTH:
             raise ValueError(
                 f"beam_width must be in [1, {self.MAX_BEAM_WIDTH}], got "
@@ -390,6 +393,36 @@ class WorkerNode:
         except ValueError as exc:
             raise RuntimeError(f"speculative lane misconfigured: {exc}")
 
+    def reload_weights(self, model_path: str) -> dict:
+        """Hot weight reload: load a checkpoint for the SERVED architecture
+        and swap it into every lane (one-shot engine + generation
+        scheduler) without pausing serving. Swap semantics: a one-shot
+        /infer batch completes atomically on whichever params it captured;
+        a decode stream mid-flight picks up the new weights from its NEXT
+        chunk (stop the lane first for a hard cut). Caches of old-weight
+        results (/infer LRU, prefix cache) are invalidated, and late
+        writes from in-flight old-weight work are fenced by a generation
+        stamp. Architecture mismatches are rejected with the old weights
+        still serving. (The reference's only weight-update path is
+        restarting the worker process.)"""
+        params = _load_model_path(self.engine.spec, model_path)
+        if params is None:
+            raise ValueError(f"no loadable weights at '{model_path}'")
+        return self.apply_weights(params, source=model_path)
+
+    def apply_weights(self, params, source: str = "<params>") -> dict:
+        """The swap half of reload_weights — combined mode loads the
+        checkpoint once and applies it per lane."""
+        self.engine.set_params(params)  # validates + quantizes + places
+        if self.generator is not None:
+            if hasattr(self.generator, "set_params"):
+                self.generator.set_params(self.engine.params)
+            else:
+                self.generator.params = self.engine.params
+        self._weights_gen += 1
+        self.cache.clear()  # cached /infer results came from old weights
+        return {"ok": True, "node_id": self.node_id, "model_path": source}
+
     def inject_fault(self, reason: str = "injected") -> None:
         self._injected_fault = reason
         for listener in self._fault_listeners:
@@ -462,10 +495,14 @@ class WorkerNode:
             return request_id, entry.frag, False, entry.time_us
 
         try:
+            gen0 = self._weights_gen  # stamp BEFORE the compute
             result = self.batch_processor.process(
                 _BatchItem(request_id, input_data, shape))
             frag = json.dumps(result.output_data.tolist()).encode()
-            self.cache.put(key, frag)
+            # A hot reload between compute and put would otherwise re-seed
+            # the freshly cleared cache with an old-weight result forever.
+            if gen0 == self._weights_gen:
+                self.cache.put(key, frag)
             entry.frag = frag
             entry.time_us = result.inference_time_us
         except BaseException as exc:
