@@ -150,3 +150,70 @@ def test_combined_reload_all_lanes(ckpts):
         server.stop()
         for w in workers:
             w.stop()
+
+
+def test_reload_under_concurrent_load(ckpts):
+    """Reload races live traffic: no request may fail, and the cache must
+    never serve an old-weight result after the swap settles."""
+    import threading
+
+    p1, p2, _ = ckpts
+    w = WorkerNode(WorkerConfig(node_id="w_reload_load",
+                                model="gpt2-small-test", dtype="float32",
+                                model_path=p1))
+    try:
+        errors = []
+        stop = threading.Event()
+
+        def hammer(tid):
+            i = 0
+            while not stop.is_set():
+                try:
+                    w.handle_infer({"request_id": f"t{tid}_{i}",
+                                    "input_data": [float(i % 7), 2.0]})
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+                    return
+                i += 1
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        w.reload_weights(p2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        # post-settle: identical inputs must reflect the NEW weights
+        a = w.handle_infer({"request_id": "post1",
+                            "input_data": [3.0, 2.0]})["output_data"]
+        w.cache.clear()
+        b = w.handle_infer({"request_id": "post2",
+                            "input_data": [3.0, 2.0]})["output_data"]
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+    finally:
+        w.stop()
+
+
+def test_reload_rejects_dtype_drift(ckpts, tmp_path):
+    """A checkpoint whose leaves restore in a different dtype must be
+    rejected — compiled buckets are lowered for the served avals
+    (code-review r4 finding)."""
+    import jax.numpy as jnp
+
+    p1, _, _ = ckpts
+    spec = create_model("gpt2-small-test")
+    bf16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
+                                  spec.init(jax.random.PRNGKey(5)))
+    path = save_params(str(tmp_path / "bf16"), bf16)
+    w = WorkerNode(WorkerConfig(node_id="w_dtype", model="gpt2-small-test",
+                                dtype="float32", model_path=p1))
+    try:
+        with pytest.raises(Exception):
+            w.reload_weights(path)
+        # still serving
+        assert w.handle_infer({"request_id": "d1",
+                               "input_data": [1.0]})["output_data"]
+    finally:
+        w.stop()

@@ -456,6 +456,10 @@ _IMPORTERS = {
     "gpt2": lambda sd, spec: import_gpt2(sd, getattr(spec, "config", None)),
     "bert": lambda sd, spec: import_bert(sd, getattr(spec, "config", None)),
     "llama": lambda sd, spec: import_llama(sd, getattr(spec, "config", None)),
+    # Mistral checkpoints use the llama parameter layout verbatim (the
+    # dialect delta — sliding_window — lives in the config, not weights).
+    "mistral": lambda sd, spec: import_llama(sd,
+                                             getattr(spec, "config", None)),
     "resnet50-v1": lambda sd, spec: import_resnet50_v1(sd),
 }
 
@@ -504,8 +508,8 @@ def hf_spec_kwargs(path: str) -> dict:
     with open(cpath) as f:
         cfg = json.load(f)
     mt = cfg.get("model_type", "")
-    if mt == "llama":
-        return {
+    if mt in ("llama", "mistral"):
+        out = {
             "vocab": cfg["vocab_size"],
             "n_layers": cfg["num_hidden_layers"],
             "d_model": cfg["hidden_size"],
@@ -517,6 +521,14 @@ def hf_spec_kwargs(path: str) -> dict:
             "rope_theta": cfg.get("rope_theta", 10000.0),
             "ln_eps": cfg.get("rms_norm_eps", 1e-5),
         }
+        if mt == "mistral":
+            # ALWAYS forwarded — "sliding_window": null (v0.2+ configs)
+            # must override the registry default 4096 to full-causal, not
+            # silently fall back to it. (Only the mistral registry entry
+            # accepts this kwarg; importing a mistral checkpoint as model
+            # "llama" fails loudly on the unexpected key.)
+            out["sliding_window"] = cfg.get("sliding_window")
+        return out
     if mt == "gpt2":
         return {
             "vocab": cfg["vocab_size"],

@@ -25,12 +25,14 @@ from tpu_engine.models.gpt2 import _spec_from_config
 
 
 def _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff, max_seq,
-               rope_theta=10000.0, ln_eps=1e-5) -> TransformerConfig:
+               rope_theta=10000.0, ln_eps=1e-5,
+               sliding_window=None) -> TransformerConfig:
     return TransformerConfig(
         vocab=vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
         d_ff=d_ff, max_seq=max_seq, causal=True,
         norm="rmsnorm", pos="rope", mlp_act="swiglu",
-        n_kv_heads=n_kv_heads, rope_theta=rope_theta, ln_eps=ln_eps)
+        n_kv_heads=n_kv_heads, rope_theta=rope_theta, ln_eps=ln_eps,
+        sliding_window=sliding_window)
 
 
 @register("llama")
@@ -44,6 +46,37 @@ def make_llama(seq_len: int = 128, vocab: int = 32000, n_layers: int = 22,
     cfg = _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff,
                      max_seq, rope_theta, ln_eps)
     return _spec_from_config("llama", cfg, seq_len)
+
+
+@register("mistral")
+def make_mistral(seq_len: int = 128, vocab: int = 32000, n_layers: int = 32,
+                 d_model: int = 4096, n_heads: int = 32,
+                 n_kv_heads: int = 8, d_ff: int = 14336,
+                 max_seq: int = 4096, rope_theta: float = 10000.0,
+                 ln_eps: float = 1e-5,
+                 sliding_window: int = 4096) -> ModelSpec:
+    """Mistral-7B geometry: llama dialect + sliding-window attention
+    (cfg.sliding_window band-masks every attention path incl. the flash
+    kernel, which also skips blocks below the band). HF mistral
+    checkpoints import via the llama importer; hf_spec_kwargs maps
+    config.json's sliding_window through here."""
+    cfg = _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff,
+                     max_seq, rope_theta, ln_eps,
+                     sliding_window=sliding_window)
+    return _spec_from_config("mistral", cfg, seq_len)
+
+
+@register("mistral-small-test")
+def make_mistral_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
+                       d_model: int = 64, n_heads: int = 4,
+                       n_kv_heads: int = 2, d_ff: int = 128,
+                       max_seq: int = 64,
+                       sliding_window: int = 8) -> ModelSpec:
+    """Tiny sliding-window config — the band is narrower than the test
+    sequences, so window masking is actually load-bearing in CI."""
+    cfg = _llama_cfg(vocab, n_layers, d_model, n_heads, n_kv_heads, d_ff,
+                     max_seq, sliding_window=sliding_window)
+    return _spec_from_config("mistral-small-test", cfg, seq_len)
 
 
 @register("llama-small-test")

@@ -65,6 +65,11 @@ class TransformerConfig:
     n_kv_heads: Optional[int] = None   # None = n_heads (full MHA)
     rope_theta: float = 10000.0  # (bias-free llama projections import as
     #                              zero biases — the graph is unconditional)
+    # Sliding-window attention (Mistral family): each position attends at
+    # most the last `sliding_window` positions (None = full causal). Mask
+    # semantics only — the KV cache stays max_seq-wide (a rolling cache is
+    # a memory optimization this knob does not imply).
+    sliding_window: Optional[int] = None
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the `expert`
     # mesh axis (ops.moe); top-k routing, static capacity slots.
     n_experts: int = 0
@@ -224,8 +229,14 @@ def _attn(bp, x, cfg: TransformerConfig, *, mask, dtype, attn_fn=None,
     # expect equal head counts — expand grouped KV here (a one-time
     # prompt-pass cost; the decode paths below attend grouped, unexpanded).
     n_rep = cfg.n_heads // cfg.kv_heads
+    kw = {}
+    if cfg.sliding_window is not None:
+        # Only passed when set, so window-less attn_fns (ring attention)
+        # keep working; a sliding-window cfg with an attn_fn that can't
+        # band-mask fails loudly (TypeError), never silently full-causal.
+        kw["window"] = cfg.sliding_window
     a = attn_fn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
-                causal=cfg.causal, mask=mask)
+                causal=cfg.causal, mask=mask, **kw)
     b, s = a.shape[:2]
     return nn.dense(bp["attn"]["wo"], a.reshape(b, s, -1), dtype=dtype)
 
@@ -310,12 +321,16 @@ def _block_decode(bp, h, cache_kv: Tuple[jnp.ndarray, jnp.ndarray],
         # Prefill is a full-sequence pass — the flash kernel's home turf.
         # Decode (below) keeps the XLA path: a 1-token query block can't
         # feed the MXU enough to win.
+        kw = ({"window": cfg.sliding_window}
+              if cfg.sliding_window is not None else {})
         a = default_attention()(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
-                                causal=True, mask=attn_mask)
+                                causal=True, mask=attn_mask, **kw)
     else:
         max_seq = ck.shape[1]
         kpos = jnp.arange(max_seq)[None, :]
         valid = (kpos <= pos) * jnp.ones((h.shape[0], 1), jnp.int32)
+        if cfg.sliding_window is not None:
+            valid = valid * (kpos > pos - cfg.sliding_window)
         if start is not None:
             # Left-padded batch: positions before each sample's first real
             # token are dead cache slots.
@@ -375,8 +390,10 @@ def _block_decode_rows(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
     ck = ck.at[rows, pos_vec].set(k[:, 0].astype(ck.dtype))
     cv = cv.at[rows, pos_vec].set(v[:, 0].astype(cv.dtype))
     kpos = jnp.arange(ck.shape[1])[None, :]
-    valid = ((kpos <= pos_vec[:, None]) & (kpos >= start_vec[:, None])
-             ).astype(jnp.int32)
+    valid = ((kpos <= pos_vec[:, None]) & (kpos >= start_vec[:, None]))
+    if cfg.sliding_window is not None:
+        valid = valid & (kpos > pos_vec[:, None] - cfg.sliding_window)
+    valid = valid.astype(jnp.int32)
     a = dot_product_attention(q, ck, cv, mask=valid)  # grouped, unexpanded
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
     h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
@@ -436,7 +453,10 @@ def _block_decode_window(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
     cv = cv.at[rows, cols].set(v.astype(cv.dtype))
     kpos = jnp.arange(ck.shape[1])[None, None, :]            # (1, 1, S)
     valid = ((kpos <= cols[:, :, None]) &
-             (kpos >= start_vec[:, None, None])).astype(jnp.int32)
+             (kpos >= start_vec[:, None, None]))
+    if cfg.sliding_window is not None:
+        valid = valid & (kpos > cols[:, :, None] - cfg.sliding_window)
+    valid = valid.astype(jnp.int32)
     a = dot_product_attention(q, ck, cv, mask=valid)  # grouped, unexpanded
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, w, -1), dtype=dtype)
     h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)

@@ -61,11 +61,12 @@ def _split_heads(x, n_heads: int):
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
-                          base_pos: int = 0):
+                          base_pos: int = 0, window: Optional[int] = None):
     """q: (B, Sq, H, D); k, v: (B, Sk, H_kv, D) with H_kv dividing H.
     Softmax in f32 (numerics), matmuls in the input dtype (MXU). `base_pos`
     offsets the query positions for causal masking when q is a suffix of the
-    kv sequence (decode).
+    kv sequence (decode). `window` (with causal) limits each query to the
+    last `window` key positions — sliding-window attention (Mistral).
 
     H_kv < H is grouped-query attention, computed by folding the group axis
     into the einsum against the UN-expanded K/V — never materializing an
@@ -85,7 +86,10 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         sk = k.shape[1]
         qpos = base_pos + jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
-        scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
+        keep = qpos >= kpos
+        if window is not None:
+            keep = keep & (qpos - kpos < window)
+        scores = jnp.where(keep, scores, -jnp.inf)
     if mask is not None:
         if mask.ndim == 3:
             # mask: (B, Sq, Sk) 1=valid — per-query-position masking (the

@@ -51,7 +51,8 @@ _NEG_INF = float("-inf")
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                   m_sc, l_sc, acc_sc, *,
                   block_q: int, block_k: int, scale: float,
-                  causal: bool, has_mask: bool):
+                  causal: bool, has_mask: bool,
+                  window=None):
     """One (head, q-block, k-block) grid step. Block shapes (leading 1 =
     head slot): q_ref/o_ref (1, block_q, D); k_ref/v_ref (1, block_k, D);
     mask_ref (1, 1, block_k) — the singleton middle axis satisfies Mosaic's
@@ -83,7 +84,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            keep = qpos >= kpos
+            if window is not None:
+                # Sliding-window band (Mistral): at most the last `window`
+                # key positions per query.
+                keep = keep & (qpos - kpos < window)
+            s = jnp.where(keep, s, _NEG_INF)
         if has_mask:
             mb = mask_ref[0, 0, :]
             s = jnp.where(mb[None, :] > 0, s, _NEG_INF)
@@ -102,8 +108,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     if causal:
         # Key blocks strictly past this q block's last row are all masked —
-        # skip their MXU work entirely.
-        @pl.when(j * block_k < (iq + 1) * block_q)
+        # skip their MXU work entirely; with a sliding window, blocks
+        # entirely BELOW the band skip too.
+        run = j * block_k < (iq + 1) * block_q
+        if window is not None:
+            run = run & ((j + 1) * block_k > iq * block_q - window + 1)
+
+        @pl.when(run)
         def _masked_sweep():
             fold_block()
     else:
@@ -132,12 +143,12 @@ def _pad_to(x, axis: int, size: int):
 def _flash_fwd_call(cfg, qh, kh, vh, mask):
     """Forward pallas_call over heads-layout operands. qh (BH, Sq_p, D);
     kh/vh (BH, Sk_p, D); mask (B, 1, Sk_p). Returns (out, lse)."""
-    causal, block_q, block_k, scale, has_mask, h, interpret = cfg
+    causal, block_q, block_k, scale, has_mask, h, interpret, window = cfg
     bh, sq_p, d = qh.shape
     sk_p = kh.shape[1]
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k,
-        scale=scale, causal=causal, has_mask=has_mask)
+        scale=scale, causal=causal, has_mask=has_mask, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, sq_p // block_q, sk_p // block_k),
@@ -169,7 +180,7 @@ def _flash_fwd_call(cfg, qh, kh, vh, mask):
 
 
 def _recompute_p(q, k, lse, mb, iq, j, *, block_q, block_k, scale,
-                 causal, has_mask):
+                 causal, has_mask, window=None):
     """Rebuild the probability tile p = exp(s - lse) exactly as the forward
     masked it (the flash-backward trick: O(block²) recompute instead of an
     (S, S) residual)."""
@@ -181,7 +192,10 @@ def _recompute_p(q, k, lse, mb, iq, j, *, block_q, block_k, scale,
             jnp.int32, (block_q, block_k), 0)
         kpos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        keep = qpos >= kpos
+        if window is not None:
+            keep = keep & (qpos - kpos < window)
+        s = jnp.where(keep, s, _NEG_INF)
     if has_mask:
         s = jnp.where(mb[None, :] > 0, s, _NEG_INF)
     # lse = -inf marks fully-masked rows: their p must be exactly 0.
@@ -192,7 +206,7 @@ def _recompute_p(q, k, lse, mb, iq, j, *, block_q, block_k, scale,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, dq_sc, *,
                    block_q: int, block_k: int, scale: float,
-                   causal: bool, has_mask: bool):
+                   causal: bool, has_mask: bool, window=None):
     """dq for one q block: sequential sweep over k blocks.
     dq = sum_j (p ∘ (do·vᵀ − Δ)) @ k · scale."""
     iq = pl.program_id(1)
@@ -210,7 +224,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         do = do_ref[0]
         p = _recompute_p(q, k, lse_ref[0], mask_ref[0, 0, :], iq, j,
                          block_q=block_q, block_k=block_k, scale=scale,
-                         causal=causal, has_mask=has_mask)
+                         causal=causal, has_mask=has_mask, window=window)
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, bk)
@@ -221,7 +235,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * scale
 
     if causal:
-        @pl.when(j * block_k < (iq + 1) * block_q)
+        run = j * block_k < (iq + 1) * block_q
+        if window is not None:
+            run = run & ((j + 1) * block_k > iq * block_q - window + 1)
+
+        @pl.when(run)
         def _masked():
             fold()
     else:
@@ -235,7 +253,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
                     block_q: int, block_k: int, scale: float,
-                    causal: bool, has_mask: bool):
+                    causal: bool, has_mask: bool, window=None):
     """dk/dv for one k block: sequential sweep over q blocks.
     dv = sum_i pᵀ @ do;  dk = sum_i (p ∘ (do·vᵀ − Δ))ᵀ @ q · scale."""
     j = pl.program_id(1)
@@ -254,7 +272,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         do = do_ref[0]
         p = _recompute_p(q, k, lse_ref[0], mask_ref[0, 0, :], iq, j,
                          block_q=block_q, block_k=block_k, scale=scale,
-                         causal=causal, has_mask=has_mask)
+                         causal=causal, has_mask=has_mask, window=window)
         pt = p.astype(do.dtype)
         dv_sc[...] += jax.lax.dot_general(
             pt, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -269,7 +287,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * scale
 
     if causal:
-        @pl.when((iq + 1) * block_q > j * block_k)
+        run = (iq + 1) * block_q > j * block_k
+        if window is not None:
+            run = run & ((j + 1) * block_k > iq * block_q - window + 1)
+
+        @pl.when(run)
         def _masked():
             fold()
     else:
@@ -282,7 +304,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_call(cfg, qh, kh, vh, mask, out, lse, do):
-    causal, block_q, block_k, scale, has_mask, h, interpret = cfg
+    causal, block_q, block_k, scale, has_mask, h, interpret, window = cfg
     bh, sq_p, d = qh.shape
     sk_p = kh.shape[1]
     # Δ_i = Σ_d do_i·o_i — tiny elementwise reduce; XLA fuses it.
@@ -298,7 +320,8 @@ def _flash_bwd_call(cfg, qh, kh, vh, mask, out, lse, do):
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal, has_mask=has_mask),
+                          scale=scale, causal=causal, has_mask=has_mask,
+                          window=window),
         grid=(bh, sq_p // block_q, sk_p // block_k),
         in_specs=[
             q_spec,
@@ -319,7 +342,8 @@ def _flash_bwd_call(cfg, qh, kh, vh, mask, out, lse, do):
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, iq: (bh, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, causal=causal, has_mask=has_mask),
+                          scale=scale, causal=causal, has_mask=has_mask,
+                          window=window),
         grid=(bh, sk_p // block_k, sq_p // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, j, iq: (bh, iq, 0)),
@@ -366,9 +390,9 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "block_q", "block_k", "interpret"))
+    "causal", "block_q", "block_k", "interpret", "window"))
 def _flash_call(q, k, v, mask, *, causal: bool, block_q: int, block_k: int,
-                interpret: bool):
+                interpret: bool, window=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
@@ -394,7 +418,7 @@ def _flash_call(q, k, v, mask, *, causal: bool, block_q: int, block_k: int,
 
     qh, kh, vh = to_heads(q, sq_p), to_heads(k, sk_p), to_heads(v, sk_p)
 
-    cfg = (causal, block_q, block_k, scale, has_mask, h, interpret)
+    cfg = (causal, block_q, block_k, scale, has_mask, h, interpret, window)
     out = _flash_core(cfg, qh, kh, vh, mask)
 
     out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)
@@ -403,7 +427,7 @@ def _flash_call(q, k, v, mask, *, causal: bool, block_q: int, block_k: int,
 
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     block_q: int = 512, block_k: int = 512,
-                    interpret=None):
+                    interpret=None, window=None):
     """Drop-in for `dot_product_attention` backed by the Pallas kernel.
 
     q: (B, Sq, H, D); k, v: (B, Sk, H, D); mask: optional (B, Sk) 1=valid.
@@ -428,5 +452,8 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     # "Mosaic failed … cannot statically prove that index in dimension 2
     # is a multiple of 128" at every prompt bucket < 128.)
     block_k = max(128, min(block_k, max(k.shape[1], 1)))
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal")
     return _flash_call(q, k, v, mask, causal=causal, block_q=block_q,
-                       block_k=block_k, interpret=bool(interpret))
+                       block_k=block_k, interpret=bool(interpret),
+                       window=None if window is None else int(window))

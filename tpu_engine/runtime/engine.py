@@ -170,6 +170,12 @@ class InferenceEngine:
                 raise ValueError(
                     f"reload rejected: leaf {i} shape {tuple(n.shape)} != "
                     f"served {tuple(o.shape)}")
+            if o.dtype != n.dtype:
+                # Compiled buckets are lowered for these avals; a dtype
+                # drift would poison every executable with no rollback.
+                raise ValueError(
+                    f"reload rejected: leaf {i} dtype {n.dtype} != "
+                    f"served {o.dtype}")
         if self._param_shardings is not None:
             params = jax.device_put(params, self._param_shardings)
         elif self._device is not None:

@@ -277,8 +277,11 @@ class WorkerNode:
         self._injected_fault: Optional[str] = None
         self._fault_listeners: list = []
         # Bumped by reload_weights: in-flight /infer results computed
-        # under an older generation must not enter the cleared cache.
+        # under an older generation must not enter the cleared cache. The
+        # lock makes check+put atomic against bump+clear — a bare compare
+        # would only narrow the race, not close it.
         self._weights_gen = 0
+        self._reload_lock = threading.Lock()
         # In-flight coalescing: concurrent identical misses share ONE
         # execution. The reference deliberately lacks this — simultaneous
         # identical requests all enter the batch because the cache is only
@@ -419,8 +422,9 @@ class WorkerNode:
                 self.generator.set_params(self.engine.params)
             else:
                 self.generator.params = self.engine.params
-        self._weights_gen += 1
-        self.cache.clear()  # cached /infer results came from old weights
+        with self._reload_lock:
+            self._weights_gen += 1
+            self.cache.clear()  # cached results came from old weights
         return {"ok": True, "node_id": self.node_id, "model_path": source}
 
     def inject_fault(self, reason: str = "injected") -> None:
@@ -500,9 +504,11 @@ class WorkerNode:
                 _BatchItem(request_id, input_data, shape))
             frag = json.dumps(result.output_data.tolist()).encode()
             # A hot reload between compute and put would otherwise re-seed
-            # the freshly cleared cache with an old-weight result forever.
-            if gen0 == self._weights_gen:
-                self.cache.put(key, frag)
+            # the freshly cleared cache with an old-weight result forever;
+            # check+put must be atomic against apply_weights' bump+clear.
+            with self._reload_lock:
+                if gen0 == self._weights_gen:
+                    self.cache.put(key, frag)
             entry.frag = frag
             entry.time_us = result.inference_time_us
         except BaseException as exc:
