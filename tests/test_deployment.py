@@ -299,3 +299,41 @@ def test_jax_distributed_two_process_rendezvous(tmp_path):
                   if line.startswith("{"))
              for _, out, _ in outs]
     assert {i["process_id"] for i in infos} == {0, 1}
+
+
+def test_sigterm_graceful_drain():
+    """SIGTERM drains the CLI server instead of killing mid-request: the
+    process exits 0 on its own after stopping the front and lanes."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    env = dict(os.environ, TPU_ENGINE_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+         "--model", "mlp", "--port", "18121"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = _time.time() + 120
+        up = False
+        while _time.time() < deadline:
+            try:
+                import http.client
+
+                c = http.client.HTTPConnection("127.0.0.1", 18121, timeout=2)
+                c.request("GET", "/health")
+                c.getresponse().read()
+                c.close()
+                up = True
+                break
+            except OSError:
+                _time.sleep(1.0)
+        assert up, "server never came up"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -73,6 +73,11 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
     H-wide copy of the cache (for the llama default, 32q/4kv, repeating the
     cached K/V would move 8× the bytes the cache actually holds on every
     decode step — exactly the bandwidth GQA exists to save)."""
+    if window is not None and not causal:
+        # Same contract as flash_attention — the band is defined relative
+        # to the causal diagonal; silently ignoring it here would make
+        # behavior diverge by backend (flash raises on TPU).
+        raise ValueError("window (sliding-window attention) requires causal")
     b, sq, h, d = q.shape
     h_kv = k.shape[2]
     if h_kv != h:

@@ -20,12 +20,35 @@ import sys
 import time
 
 
-def _run_forever():
+def _run_forever(stoppables=()):
+    """Block until SIGTERM/SIGINT, then DRAIN instead of dying mid-request:
+    the HTTP front stops accepting first, then each lane's batcher/decode
+    scheduler joins (in-flight work resolves its futures). The reference's
+    only shutdown is an abrupt kill (README.md:322 tests fault tolerance
+    by exactly that)."""
+    import signal
+    import threading
+
+    ev = threading.Event()
+
+    def _handle(_signum, _frame):
+        ev.set()
+
     try:
-        while True:
-            time.sleep(3600)
+        signal.signal(signal.SIGTERM, _handle)
+        signal.signal(signal.SIGINT, _handle)
+    except ValueError:
+        pass  # non-main thread (embedding); fall back to sleep loop
+    try:
+        while not ev.is_set():
+            ev.wait(3600)
     except KeyboardInterrupt:
         pass
+    for s in stoppables:
+        try:
+            s.stop()
+        except Exception:
+            pass
 
 
 def main(argv=None) -> int:
@@ -69,8 +92,8 @@ def main(argv=None) -> int:
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
                            model_path=model_path)
-        serve_worker(cfg, background=True)
-        _run_forever()
+        worker, server = serve_worker(cfg, background=True)
+        _run_forever([server, worker])
         return 0
 
     if cmd == "gateway":
@@ -87,11 +110,12 @@ def main(argv=None) -> int:
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (reference gateway.cpp:22)")
         args = parser.parse_args(rest)
-        serve_gateway(args.workers,
-                      GatewayConfig(port=args.port,
-                                    breaker_timeout_s=args.breaker_timeout),
-                      background=True)
-        _run_forever()
+        _gw, server = serve_gateway(
+            args.workers,
+            GatewayConfig(port=args.port,
+                          breaker_timeout_s=args.breaker_timeout),
+            background=True)
+        _run_forever([server])
         return 0
 
     if cmd == "serve":
@@ -177,10 +201,11 @@ def main(argv=None) -> int:
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)
-        serve_combined(model=args.model, lanes=args.lanes, port=args.port,
-                       warmup=args.warmup, worker_config=worker_config,
-                       gateway_config=gateway_config, mesh=args.mesh)
-        _run_forever()
+        _gw, workers, server = serve_combined(
+            model=args.model, lanes=args.lanes, port=args.port,
+            warmup=args.warmup, worker_config=worker_config,
+            gateway_config=gateway_config, mesh=args.mesh)
+        _run_forever([server, *workers])
         return 0
 
     if cmd == "import-weights":

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
@@ -37,6 +38,10 @@ class _TrackingServer(ThreadingHTTPServer):
         super().__init__(*args, **kwargs)
         self._conns = set()
         self._conns_lock = threading.Lock()
+        # Requests currently INSIDE a handler (excludes idle keep-alive
+        # connections): the graceful-drain wait in JsonHttpServer.stop.
+        self.active_requests = 0
+        self.active_lock = threading.Lock()
 
     def process_request(self, request, client_address):
         with self._conns_lock:
@@ -149,6 +154,8 @@ class JsonHttpServer:
                 if handler is None:
                     self._respond(404, {"error": f"no route {method} {self.path}"})
                     return
+                with self.server.active_lock:
+                    self.server.active_requests += 1
                 try:
                     body = None
                     if method == "POST":
@@ -177,6 +184,9 @@ class JsonHttpServer:
                         self._respond(500, {"error": str(exc)})
                     except Exception:
                         pass
+                finally:
+                    with self.server.active_lock:
+                        self.server.active_requests -= 1
 
             def do_POST(self):
                 self._dispatch("POST")
@@ -199,9 +209,19 @@ class JsonHttpServer:
         else:
             self._server.serve_forever()
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 10.0) -> None:
+        """Stop accepting, then DRAIN: wait up to `drain_s` for requests
+        already inside handlers to write their responses before severing
+        the remaining (idle keep-alive) connections — a SIGTERM must not
+        reset a client mid-/generate."""
         if self._server is not None:
-            self._server.shutdown()
+            self._server.shutdown()  # accept loop stops; handlers keep going
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                with self._server.active_lock:
+                    if self._server.active_requests == 0:
+                        break
+                time.sleep(0.05)
             self._server.close_open_connections()
             self._server.server_close()
             self._server = None
