@@ -319,6 +319,7 @@ def test_sigterm_graceful_drain():
         deadline = _time.time() + 120
         up = False
         while _time.time() < deadline:
+            assert proc.poll() is None, proc.stdout.read().decode()[-2000:]
             try:
                 import http.client
 

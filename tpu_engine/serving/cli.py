@@ -44,6 +44,13 @@ def _run_forever(stoppables=()):
             ev.wait(3600)
     except KeyboardInterrupt:
         pass
+    # Second signal = force quit: restore default handlers so an operator
+    # isn't locked out of Ctrl+C while a drain (or a hung lane) runs.
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGINT, signal.SIG_DFL)
+    except ValueError:
+        pass
     for s in stoppables:
         try:
             s.stop()

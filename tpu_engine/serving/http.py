@@ -42,6 +42,10 @@ class _TrackingServer(ThreadingHTTPServer):
         # connections): the graceful-drain wait in JsonHttpServer.stop.
         self.active_requests = 0
         self.active_lock = threading.Lock()
+        # Set by stop(): handlers finish their current request, then close
+        # the connection — live keep-alive pools converge to zero instead
+        # of feeding new requests forever and defeating the drain wait.
+        self.draining = False
 
     def process_request(self, request, client_address):
         with self._conns_lock:
@@ -187,6 +191,8 @@ class JsonHttpServer:
                 finally:
                     with self.server.active_lock:
                         self.server.active_requests -= 1
+                    if getattr(self.server, "draining", False):
+                        self.close_connection = True
 
             def do_POST(self):
                 self._dispatch("POST")
@@ -215,6 +221,7 @@ class JsonHttpServer:
         the remaining (idle keep-alive) connections — a SIGTERM must not
         reset a client mid-/generate."""
         if self._server is not None:
+            self._server.draining = True  # keep-alives close after reply
             self._server.shutdown()  # accept loop stops; handlers keep going
             deadline = time.monotonic() + drain_s
             while time.monotonic() < deadline:
