@@ -1,0 +1,137 @@
+"""Multi-model serving: one process, several models, routed by the
+"model" wire field over per-model sub-rings."""
+
+import http.client
+import json
+
+import pytest
+
+from tpu_engine.serving.app import serve_combined
+from tpu_engine.serving.gateway import Gateway, GatewayError
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import WorkerConfig
+
+
+@pytest.fixture(scope="module")
+def duo():
+    gateway, workers, server = serve_combined(
+        model="mlp,gpt2-small-test", lanes=2, port=0, background=True,
+        worker_config=WorkerConfig(dtype="float32"))
+    yield gateway, workers, server
+    server.stop()
+    for w in workers:
+        w.stop()
+
+
+def test_routes_by_model_field(duo):
+    gateway, workers, _ = duo
+    r1 = gateway.route_request({"request_id": "a", "model": "mlp",
+                                "input_data": [1.0, 2.0]})
+    r2 = gateway.route_request({"request_id": "a",
+                                "model": "gpt2-small-test",
+                                "input_data": [5.0, 9.0]})
+    # mlp and the LM have different output sizes — proof the right lane ran
+    assert len(r1["output_data"]) != len(r2["output_data"])
+    assert len(r2["output_data"]) == 256  # gpt2-small-test vocab
+
+
+def test_default_model_deterministic(duo):
+    gateway, _, _ = duo
+    # No "model": multi-model gateways route to the FIRST model (mlp).
+    r = gateway.route_request({"request_id": "b", "input_data": [1.0]})
+    assert len(r["output_data"]) == len(gateway.route_request(
+        {"request_id": "c", "model": "mlp", "input_data": [1.0]})
+        ["output_data"])
+
+
+def test_unknown_model_is_client_error(duo):
+    gateway, _, _ = duo
+    with pytest.raises(ValueError, match="unknown model"):
+        gateway.route_request({"request_id": "x", "model": "nope",
+                               "input_data": [1.0]})
+
+
+def test_generate_routes_to_lm(duo):
+    gateway, _, _ = duo
+    r = gateway.route_generate({"request_id": "g", "model": "gpt2-small-test",
+                                "prompt_tokens": [5, 9], "max_new_tokens": 4})
+    assert len(r["tokens"]) == 4
+
+
+def test_over_http_and_health(duo):
+    _, _, server = duo
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+    conn.request("POST", "/infer", body=json.dumps(
+        {"request_id": "h", "model": "gpt2-small-test",
+         "input_data": [5.0, 9.0]}),
+        headers={"Content-Type": "application/json"})
+    resp = json.loads(conn.getresponse().read())
+    assert len(resp["output_data"]) == 256
+    conn.request("GET", "/health")
+    h = json.loads(conn.getresponse().read())
+    models = {lane["model"] for lane in h["lanes"].values()}
+    assert models == {"mlp", "gpt2-small-test"}
+    conn.close()
+
+
+def test_misdirected_request_rejected():
+    w = WorkerNode(WorkerConfig(node_id="w_mm", model="mlp"))
+    try:
+        with pytest.raises(ValueError, match="serves model"):
+            w.handle_infer({"request_id": "m", "model": "gpt2",
+                            "input_data": [1.0]})
+    finally:
+        w.stop()
+
+
+def test_model_ring_failover_stays_within_model(duo):
+    gateway, workers, _ = duo
+    lm = next(w for w in workers
+              if w.engine.spec.name == "gpt2-small-test")
+    lm.inject_fault()
+    try:
+        # The only gpt2 lane is down; failover must NOT leak to the mlp
+        # lane (which would return wrong-model output).
+        with pytest.raises((GatewayError, ValueError)):
+            gateway.route_request({"request_id": "f",
+                                   "model": "gpt2-small-test",
+                                   "input_data": [1.0]})
+    finally:
+        lm.heal()
+
+
+def test_http_worker_gateway_passes_model_through():
+    """A gateway of URL workers has no model metadata: the 'model' field
+    routes on the global ring and the worker validates (code-review r4
+    finding — must not 400 'unknown model')."""
+    from tpu_engine.serving.app import serve_worker
+
+    cfg = WorkerConfig(port=0, node_id="http_mm", model="mlp")
+    w, server = serve_worker(cfg, background=True)
+    try:
+        gw = Gateway([f"127.0.0.1:{server.port}"])
+        r = gw.route_request({"request_id": "p", "model": "mlp",
+                              "input_data": [1.0, 2.0]})
+        assert "output_data" in r
+    finally:
+        server.stop()
+        w.stop()
+
+
+def test_all_lanes_of_model_removed_is_clean_error(duo):
+    gateway, workers, _ = duo
+    lm = next(w for w in workers if w.engine.spec.name == "gpt2-small-test")
+    gateway.remove_worker(lm.node_id)
+    try:
+        with pytest.raises(GatewayError, match="no workers available"):
+            gateway.route_request({"request_id": "r",
+                                   "model": "gpt2-small-test",
+                                   "input_data": [1.0]})
+    finally:
+        gateway.add_worker(lm)
+
+
+def test_lanes_fewer_than_models_rejected():
+    with pytest.raises(ValueError, match="cannot serve"):
+        serve_combined(model="mlp,gpt2-small-test", lanes=1, port=0,
+                       background=True)

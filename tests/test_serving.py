@@ -57,8 +57,11 @@ def test_cache_hit_second_request(worker):
 def test_health_schema(worker):
     worker.handle_infer({"request_id": "h", "input_data": [5.0]})
     h = worker.get_health()
-    assert set(h) == {"healthy", "node_id", "total_requests", "cache_hits",
-                      "cache_size", "cache_hit_rate", "batch_processor"}
+    # Reference fields exact; "model" is a documented additive field
+    # (multi-model serving) the reference's parsers ignore.
+    assert set(h) == {"healthy", "node_id", "model", "total_requests",
+                      "cache_hits", "cache_size", "cache_hit_rate",
+                      "batch_processor"}
     assert set(h["batch_processor"]) == {"total_batches", "avg_batch_size",
                                          "timeout_batches", "full_batches"}
     assert h["healthy"] is True

@@ -16,7 +16,6 @@ crashed (a Mosaic failure must surface, not hide behind a retry).
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys

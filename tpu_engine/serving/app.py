@@ -154,28 +154,45 @@ def serve_combined(
 
     devices = jax.devices()
     gateway_config = gateway_config or GatewayConfig(port=port)
+    # Multi-model serving: "a,b" assigns models to lanes round-robin;
+    # requests carry {"model": "..."} and the gateway routes on per-model
+    # sub-rings (Triton-style — the reference is one model per worker).
+    models = [m.strip() for m in str(model).split(",") if m.strip()]
+    if len(models) > 1 and worker_config is not None \
+            and worker_config.model_path:
+        raise ValueError("model_path is ambiguous with multiple models; "
+                         "serve them from separate processes or extend "
+                         "the config per model")
     # Real weights (HF/torch/orbax) are loaded once and shared by every lane
     # (each engine device_puts its own copy onto its chip).
     params = None
     if worker_config is not None and worker_config.model_path:
         from tpu_engine.serving.worker import _load_model_path
 
-        params = _load_model_path(model, worker_config.model_path)
+        params = _load_model_path(models[0], worker_config.model_path)
     workers = []
     if mesh is not None:
         if isinstance(mesh, str):
             mesh = parse_mesh_spec(mesh)
+        if len(models) > 1:
+            raise ValueError("mesh-sharded serving is single-model")
         cfg = worker_config or WorkerConfig()
         lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": "worker_1",
-                                   "model": model})
-        engine = _mesh_engine(model, lane_cfg, mesh, params=params)
+                                   "model": models[0]})
+        engine = _mesh_engine(models[0], lane_cfg, mesh, params=params)
         workers.append(WorkerNode(lane_cfg, engine=engine))
         n_lanes = 1
     else:
-        n_lanes = lanes or len(devices)
+        if lanes and lanes < len(models):
+            raise ValueError(
+                f"lanes={lanes} cannot serve {len(models)} models — "
+                f"later-listed models would silently get no lane")
+        n_lanes = lanes or max(len(devices), len(models))
         for i in range(n_lanes):
             cfg = worker_config or WorkerConfig()
-            lane_cfg = WorkerConfig(**{**cfg.__dict__, "node_id": f"worker_{i+1}", "model": model})
+            lane_cfg = WorkerConfig(**{**cfg.__dict__,
+                                       "node_id": f"worker_{i+1}",
+                                       "model": models[i % len(models)]})
             from tpu_engine.runtime.engine import InferenceEngine
 
             engine = InferenceEngine(
@@ -184,6 +201,7 @@ def serve_combined(
                 dtype=lane_cfg.dtype,
                 batch_buckets=lane_cfg.batch_buckets,
                 shape_buckets=lane_cfg.shape_buckets,
+                quantize=lane_cfg.quantize,
                 device=devices[i % len(devices)],
             )
             workers.append(WorkerNode(lane_cfg, engine=engine))

@@ -46,17 +46,27 @@ class Gateway:
         (local mode), or a mix."""
         self.config = config or GatewayConfig()
         self._ring = ConsistentHash(self.config.virtual_nodes)
+        # Multi-model serving: one sub-ring per model name so a request's
+        # "model" field restricts routing AND failover to lanes that
+        # actually serve it (Triton-style; the reference is one model per
+        # worker with no model awareness at the gateway).
+        self._model_rings: Dict[str, ConsistentHash] = {}
         self._clients: Dict[str, object] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._lock = threading.Lock()
         self._total_requests = 0
         self._failovers = 0
+        # Requests without a "model" field in multi-model mode route to
+        # the first-registered model (deterministic default) instead of
+        # whichever lane the global ring happens to own.
+        self.default_model: Optional[str] = None
         for w in workers or []:
             self.add_worker(w)
 
     # -- membership (elastic; reference ring was fixed at launch) ------------
 
     def add_worker(self, worker) -> str:
+        model_name = None
         if isinstance(worker, str):
             client = HttpWorkerClient(
                 worker,
@@ -68,10 +78,21 @@ class Gateway:
         else:
             client = LocalWorkerClient(worker)
             name = worker.node_id
+            spec = getattr(getattr(worker, "engine", None), "spec", None)
+            model_name = getattr(spec, "name", None)
         with self._lock:
             self._clients[name] = client
             self._breakers[name] = self._make_breaker()
         self._ring.add_node(name)
+        if model_name is not None:
+            with self._lock:
+                ring = self._model_rings.get(model_name)
+                if ring is None:
+                    ring = ConsistentHash(self.config.virtual_nodes)
+                    self._model_rings[model_name] = ring
+                if self.default_model is None:
+                    self.default_model = model_name
+            ring.add_node(name)
         return name
 
     def _make_breaker(self):
@@ -101,8 +122,11 @@ class Gateway:
     def remove_worker(self, name: str) -> None:
         self._ring.remove_node(name)
         with self._lock:
+            rings = list(self._model_rings.values())
             self._clients.pop(name, None)
             self._breakers.pop(name, None)
+        for ring in rings:
+            ring.remove_node(name)
 
     def worker_names(self) -> List[str]:
         return self._ring.get_all_nodes()
@@ -134,7 +158,31 @@ class Gateway:
         with self._lock:
             self._total_requests += 1
         request_id = str(payload.get("request_id", id(payload)))
-        primary = self._ring.get_node(request_id)
+        # "model" restricts routing AND failover to that model's sub-ring;
+        # without the field, multi-model gateways use the deterministic
+        # default model, single-model gateways the global ring.
+        mdl = payload.get("model")
+        with self._lock:
+            multi = len(self._model_rings) > 1
+            no_model_awareness = not self._model_rings
+            if mdl is None and multi:
+                mdl = self.default_model
+            if mdl is not None and not no_model_awareness:
+                ring = self._model_rings.get(str(mdl))
+            else:
+                # Either no "model" field, or a pure-HTTP-worker gateway
+                # (URL workers carry no model metadata): route on the
+                # global ring and let each worker's own _check_model
+                # reject a misdirect (reference deployment shape).
+                ring = self._ring
+        if ring is None:
+            raise ValueError(            # wire 400, not a lane failure
+                f"unknown model '{mdl}'; serving "
+                f"{sorted(self._model_rings)}")
+        try:
+            primary = ring.get_node(request_id)
+        except RuntimeError:  # every lane of this model was removed
+            raise GatewayError(f"no workers available for model '{mdl}'")
 
         result = self._try_node(primary, payload, op=op)
         if result is not None:
@@ -142,7 +190,7 @@ class Gateway:
         with self._lock:
             self._failovers += 1
         # Ring-order failover across every other lane (gateway.cpp:51-59).
-        for node in self._ring.get_all_nodes():
+        for node in ring.get_all_nodes():
             if node == primary:
                 continue
             result = self._try_node(node, payload, op=op)

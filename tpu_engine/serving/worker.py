@@ -396,6 +396,16 @@ class WorkerNode:
         except ValueError as exc:
             raise RuntimeError(f"speculative lane misconfigured: {exc}")
 
+    def _check_model(self, request: dict) -> None:
+        """A request addressed to a specific model must never be answered
+        by a lane serving a different one (multi-model routing sends it to
+        the right sub-ring; this guards misdirected/direct-port hits)."""
+        want = request.get("model")
+        have = getattr(self.engine.spec, "name", None)
+        if want is not None and have is not None and str(want) != have:
+            raise ValueError(
+                f"this lane serves model '{have}', not '{want}'")
+
     def reload_weights(self, model_path: str) -> dict:
         """Hot weight reload: load a checkpoint for the SERVED architecture
         and swap it into every lane (one-shot engine + generation
@@ -461,6 +471,7 @@ class WorkerNode:
         serialization dominated the whole request path."""
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
+        self._check_model(request)
         with self._counter_lock:
             self._total_requests += 1
         request_id = request["request_id"]
@@ -596,6 +607,7 @@ class WorkerNode:
             raise ValueError(f"model '{self.config.model}' does not support generation")
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
+        self._check_model(request)
         with self._counter_lock:
             self._total_requests += 1
         item = _GenItem(
@@ -671,6 +683,7 @@ class WorkerNode:
                 f"model '{self.config.model}' does not support generation")
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
+        self._check_model(request)
         # Parse/validate EVERY field EAGERLY — after the iterator is handed
         # back, the response is already committed to a 200 SSE stream, and a
         # bad request must be a 400 like the blocking endpoint's (on both
@@ -812,6 +825,7 @@ class WorkerNode:
         out = {
             "healthy": self._injected_fault is None,
             "node_id": self.node_id,
+            "model": getattr(self.engine.spec, "name", None),  # additive
             "total_requests": total,
             "cache_hits": hits,
             "cache_size": self.cache.size(),
