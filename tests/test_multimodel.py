@@ -119,11 +119,13 @@ def test_http_worker_gateway_passes_model_through():
 
 
 def test_all_lanes_of_model_removed_is_clean_error(duo):
+    """Removing a model's last lane prunes its sub-ring: the model becomes
+    unknown (clean 400), never a raw hash-ring RuntimeError."""
     gateway, workers, _ = duo
     lm = next(w for w in workers if w.engine.spec.name == "gpt2-small-test")
     gateway.remove_worker(lm.node_id)
     try:
-        with pytest.raises(GatewayError, match="no workers available"):
+        with pytest.raises(ValueError, match="unknown model"):
             gateway.route_request({"request_id": "r",
                                    "model": "gpt2-small-test",
                                    "input_data": [1.0]})
@@ -135,3 +137,52 @@ def test_lanes_fewer_than_models_rejected():
     with pytest.raises(ValueError, match="cannot serve"):
         serve_combined(model="mlp,gpt2-small-test", lanes=1, port=0,
                        background=True)
+
+
+def test_native_front_disabled_for_multimodel(duo):
+    """Multi-model must never use the C++ front (model-agnostic ring +
+    input-keyed cache could answer with the wrong model's cached output —
+    code-review r4 finding): the front must be the python server."""
+    from tpu_engine.serving.http import JsonHttpServer
+
+    _, _, server = duo
+    assert isinstance(server, JsonHttpServer)
+    with pytest.raises(RuntimeError, match="single-model"):
+        serve_combined(model="mlp,gpt2-small-test", lanes=2, port=0,
+                       background=True, native_front=True)
+
+
+def test_mixed_fleet_probes_untyped_workers():
+    """Local mlp lane + HTTP worker serving another model: a request for
+    the HTTP worker's model must reach it via probing, not 400."""
+    from tpu_engine.serving.app import serve_worker
+
+    cfg = WorkerConfig(port=0, node_id="http_lm", model="gpt2-small-test",
+                       dtype="float32")
+    w_http, server = serve_worker(cfg, background=True)
+    w_local = WorkerNode(WorkerConfig(node_id="local_mlp", model="mlp"))
+    try:
+        gw = Gateway([w_local, f"127.0.0.1:{server.port}"])
+        r = gw.route_request({"request_id": "mx",
+                              "model": "gpt2-small-test",
+                              "input_data": [5.0, 9.0]})
+        assert len(r["output_data"]) == 256  # the LM answered
+    finally:
+        server.stop()
+        w_http.stop()
+        w_local.stop()
+
+
+def test_remove_default_model_repoints(duo):
+    gateway, workers, _ = duo
+    mlp = next(w for w in workers if w.engine.spec.name == "mlp")
+    assert gateway.default_model == "mlp"
+    gateway.remove_worker(mlp.node_id)
+    try:
+        # No-field requests must now route to the surviving model.
+        r = gateway.route_request({"request_id": "d",
+                                   "input_data": [5.0, 9.0]})
+        assert len(r["output_data"]) == 256
+        assert gateway.default_model == "gpt2-small-test"
+    finally:
+        gateway.add_worker(mlp)

@@ -356,7 +356,20 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
     """Choose the serving edge: the C++ HttpFront (cache hits answered
     without the GIL; misses + misc routes fall back to Python) when the
     native lib and raw-mode lane caches are available, else the Python
-    ThreadingHTTPServer. native_front: None=auto, True=require, False=off."""
+    ThreadingHTTPServer. native_front: None=auto, True=require, False=off.
+
+    Multi-model deployments always use the Python front: the C++ hit path
+    rings request_ids over ALL lanes with input-bytes cache keys — no
+    model awareness — so it could answer a {"model": "gpt2"} request with
+    an mlp lane's cached fragment. Silent wrong-model output beats any
+    hit-path speedup; extend the C++ key schema before re-enabling."""
+    models = {getattr(w.engine.spec, "name", None) for w in workers}
+    if len(models) > 1:
+        if native_front is True:
+            raise RuntimeError(
+                "native front is single-model (its ring and cache keys "
+                "carry no model); serve multi-model with the python front")
+        native_front = False
     use_native = False
     if native_front is not False:
         try:

@@ -51,6 +51,11 @@ class Gateway:
         # actually serve it (Triton-style; the reference is one model per
         # worker with no model awareness at the gateway).
         self._model_rings: Dict[str, ConsistentHash] = {}
+        # Workers with UNKNOWN model (HTTP URLs carry no metadata): while
+        # any exist, an unmatched "model" falls back to the global ring
+        # with worker-side validation instead of a 400 — they might serve
+        # it.
+        self._untyped: set = set()
         self._clients: Dict[str, object] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._lock = threading.Lock()
@@ -83,16 +88,22 @@ class Gateway:
         with self._lock:
             self._clients[name] = client
             self._breakers[name] = self._make_breaker()
+            if model_name is None:
+                self._untyped.add(name)
         self._ring.add_node(name)
         if model_name is not None:
             with self._lock:
                 ring = self._model_rings.get(model_name)
                 if ring is None:
+                    # Populate BEFORE publishing: a concurrent _route must
+                    # never see an empty ring for a registered model.
                     ring = ConsistentHash(self.config.virtual_nodes)
+                    ring.add_node(name)
                     self._model_rings[model_name] = ring
+                else:
+                    ring.add_node(name)
                 if self.default_model is None:
                     self.default_model = model_name
-            ring.add_node(name)
         return name
 
     def _make_breaker(self):
@@ -122,11 +133,22 @@ class Gateway:
     def remove_worker(self, name: str) -> None:
         self._ring.remove_node(name)
         with self._lock:
-            rings = list(self._model_rings.values())
+            rings = dict(self._model_rings)
             self._clients.pop(name, None)
             self._breakers.pop(name, None)
-        for ring in rings:
+            self._untyped.discard(name)
+        for ring in rings.values():
             ring.remove_node(name)
+        with self._lock:
+            # Prune emptied sub-rings and re-point the no-field default —
+            # removing the default model's last lane must not strand every
+            # field-less request on a dead ring forever.
+            for mdl, ring in list(self._model_rings.items()):
+                if not ring.get_all_nodes():
+                    del self._model_rings[mdl]
+            if self.default_model not in self._model_rings:
+                self.default_model = (sorted(self._model_rings)[0]
+                                      if self._model_rings else None)
 
     def worker_names(self) -> List[str]:
         return self._ring.get_all_nodes()
@@ -162,18 +184,21 @@ class Gateway:
         # without the field, multi-model gateways use the deterministic
         # default model, single-model gateways the global ring.
         mdl = payload.get("model")
+        probing = False  # model unknown to the gateway; workers validate
         with self._lock:
             multi = len(self._model_rings) > 1
-            no_model_awareness = not self._model_rings
+            untyped = bool(self._untyped)
             if mdl is None and multi:
                 mdl = self.default_model
-            if mdl is not None and not no_model_awareness:
+            if mdl is not None:
                 ring = self._model_rings.get(str(mdl))
+                if ring is None and untyped:
+                    # Workers with unknown models (HTTP URLs carry no
+                    # metadata) might serve it: probe the global ring and
+                    # let each worker's _check_model decide — a mismatch
+                    # fails over instead of 400ing a servable request.
+                    ring, probing = self._ring, True
             else:
-                # Either no "model" field, or a pure-HTTP-worker gateway
-                # (URL workers carry no model metadata): route on the
-                # global ring and let each worker's own _check_model
-                # reject a misdirect (reference deployment shape).
                 ring = self._ring
         if ring is None:
             raise ValueError(            # wire 400, not a lane failure
@@ -184,7 +209,7 @@ class Gateway:
         except RuntimeError:  # every lane of this model was removed
             raise GatewayError(f"no workers available for model '{mdl}'")
 
-        result = self._try_node(primary, payload, op=op)
+        result = self._try_node(primary, payload, op=op, probing=probing)
         if result is not None:
             return result
         with self._lock:
@@ -193,14 +218,18 @@ class Gateway:
         for node in ring.get_all_nodes():
             if node == primary:
                 continue
-            result = self._try_node(node, payload, op=op)
+            result = self._try_node(node, payload, op=op, probing=probing)
             if result is not None:
                 return result
         raise GatewayError("All workers failed or unavailable")
 
-    def _try_node(self, node: str, payload: dict, op: str = "infer") -> Optional[dict]:
+    def _try_node(self, node: str, payload: dict, op: str = "infer",
+                  probing: bool = False) -> Optional[dict]:
         """Breaker-gated dispatch (reference tryNode, gateway.cpp:80-128).
-        Returns None on failure so the caller can fail over."""
+        Returns None on failure so the caller can fail over. `probing`:
+        the gateway couldn't resolve the request's model itself, so a
+        worker's model-mismatch rejection (a client-class 4xx/ValueError)
+        means "try the next lane" — no breaker penalty, no terminal 400."""
         with self._lock:
             client = self._clients.get(node)
             breaker = self._breakers.get(node)
@@ -215,6 +244,10 @@ class Gateway:
         except WorkerError:
             breaker.record_failure()
             return None
+        except ValueError:
+            if probing:
+                return None  # wrong-model lane; healthy — no penalty
+            raise
 
     # -- observability --------------------------------------------------------
 
