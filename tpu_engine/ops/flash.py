@@ -433,14 +433,16 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     q: (B, Sq, H, D); k, v: (B, Sk, H, D); mask: optional (B, Sk) 1=valid.
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere.
 
-    Measured on-chip (v5lite-1, causal bf16, amortized forced-sync timing,
-    this round, with the pre-streamed-K revision of this kernel — the
-    streamed-K restructure is interpreter-exact but awaits on-chip re-timing,
-    BENCH_r04_builder.json): parity with the XLA-fused path at S≤2048
-    (e.g. B4 S2048 H16 D64: 32.5 vs 33.5 ms), 1.18× faster at B1 S4096,
-    and it keeps scaling where XLA cannot compile at all — the fused XLA
-    path OOMs at S8192 (44 GB of S² score temps vs 15.75 GB HBM) while
-    this kernel runs it in 219 ms/iter with O(S·D) memory.
+    On-chip status (v5lite-1, this round): the STREAMED-K kernel compiles
+    and is exact vs the XLA path at every serving bucket S=16…512 — the
+    sub-128 Mosaic failure from BENCH_r03 is fixed and revalidated on
+    Mosaic, not just the interpreter. Timing provenance: the committed
+    numbers (BENCH_r04_builder.json) are from the pre-streamed-K revision
+    — parity with XLA-fused at S≤2048 (B4 S2048 H16 D64: 32.5 vs
+    33.5 ms), 1.18× at B1 S4096, and S8192 in 219 ms/iter where the fused
+    path cannot compile (44 GB of S² temps vs 15.75 GB HBM). Streamed-K
+    re-timing awaits a healthy device link (tools/onchip_campaign.py runs
+    it; the tunnel wedged for the rest of this session).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
