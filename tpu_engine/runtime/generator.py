@@ -263,6 +263,12 @@ class Generator:
         can't silently diverge between the fused and beam executables."""
         return 1 << (max_new - 1).bit_length() if max_new > 1 else 1
 
+    def _put(self, x):
+        """Device placement for host-built arrays — THE one placement rule
+        every path (batch/fused/beam/score assembly) shares."""
+        return (jax.device_put(x, self._device) if self._device is not None
+                else jnp.asarray(x))
+
     def _pooled_cache(self, bb: int):
         """Pop the bucket's KV buffer from the pool (alloc+place on miss).
         Stale contents are never read: prefill rewrites [0, pb) and decode
@@ -523,10 +529,7 @@ class Generator:
         max_new = max(1, min(int(max_new_tokens), self.max_seq - pb))
         cap = self._out_cap(max_new)
         tokens, attn_mask, pos_ids, start = left_pad_batch([prompt], 1, pb)
-        dev = self._device
-
-        def put(x):
-            return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+        put = self._put
 
         # Reuse the width-1 cache from the pool; the jit doesn't donate it
         # (the loop works on the bw-row tiled copy), so the buffer goes
@@ -547,6 +550,78 @@ class Generator:
             if norm > best_norm:
                 best, best_norm = row, norm
         return best
+
+    def _score_exe(self, bb: int, sb: int):
+        """Compiled scorer: one causal forward over prompt+completion,
+        gathering log P(token | prefix) at each completion position. No
+        KV cache, no decode loop — scoring is prefill-shaped work the MXU
+        likes (the evals/perplexity API; the reference has no analog)."""
+        key = ("score", bb, sb)
+        exe = self._prefill_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._lock:
+            if key in self._prefill_exe:
+                return self._prefill_exe[key]
+            cfg, dtype = self.cfg, self._dtype
+
+            def run(params, tokens, attn_mask):
+                from tpu_engine.models.transformer import transformer_apply
+
+                logits = transformer_apply(params, tokens, cfg,
+                                           mask=attn_mask, dtype=dtype)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                # log P(tokens[:, i] | tokens[:, :i]) lives at row i-1.
+                tgt = tokens[:, 1:, None]
+                return jnp.take_along_axis(logp[:, :-1], tgt, -1)[..., 0]
+
+            self._prefill_exe[key] = jax.jit(run)
+            return self._prefill_exe[key]
+
+    def score(self, prompts: Sequence[Sequence[int]],
+              completions: Sequence[Sequence[int]]) -> List[List[float]]:
+        """Per-token log-probabilities of each completion given its prompt
+        (teacher-forced, one forward pass — what perplexity evals and
+        lm-eval-harness loglikelihood requests need). Sequences RIGHT-pad
+        to a shared bucket; returns len(completion) floats per row."""
+        if len(prompts) != len(completions):
+            raise ValueError("prompts and completions length mismatch")
+        n = len(prompts)
+        if n == 0:
+            return []
+        out: List[List[float]] = []
+        max_bb = self._batch_buckets[-1]
+        for i in range(0, n, max_bb):
+            out.extend(self._score_batch(
+                [list(p) for p in prompts[i:i + max_bb]],
+                [list(c) for c in completions[i:i + max_bb]]))
+        return out
+
+    def _score_batch(self, prompts, completions) -> List[List[float]]:
+        n = len(prompts)
+        bb = self._bucket(self._batch_buckets, n)
+        seqs = [(p or [0]) + c for p, c in zip(prompts, completions)]
+        longest = min(max(len(s) for s in seqs), self.max_seq)
+        sb = self._bucket(self._prompt_buckets, longest)
+        tokens = np.zeros((bb, sb), np.int32)
+        attn = np.zeros((bb, sb), np.int32)
+        for r, s in enumerate(seqs):
+            if len(s) > sb:
+                raise ValueError(
+                    f"prompt+completion length {len(s)} exceeds the "
+                    f"largest sequence bucket {sb}")
+            tokens[r, :len(s)] = np.asarray(s, np.int32)
+            attn[r, :len(s)] = 1
+        put = self._put
+
+        lp = np.asarray(self._score_exe(bb, sb)(self.params, put(tokens),
+                                                put(attn)))
+        results = []
+        for r in range(n):
+            start = max(len(prompts[r]), 1)  # empty prompt consumes pad 0
+            end = start + len(completions[r])
+            results.append([float(x) for x in lp[r, start - 1:end - 1]])
+        return results
 
     # -- generation ------------------------------------------------------------
 
@@ -617,10 +692,7 @@ class Generator:
         tokens, attn_mask, pos_ids, start = left_pad_batch(prompts, bb, pb)
         alive = np.zeros((bb,), bool)
         alive[:n] = True
-        dev = self._device
-
-        def put(x):
-            return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+        put = self._put
 
         caches = self._pooled_cache(bb)
 
@@ -664,10 +736,7 @@ class Generator:
         max_new = max(1, min(max_new, self.max_seq - pb))
 
         tokens, attn_mask, pos_ids, start = left_pad_batch(prompts, bb, pb)
-        dev = self._device
-
-        def put(x):
-            return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+        put = self._put
 
         caches = self._pooled_cache(bb)
         logits, caches = self._prefill(bb, pb)(

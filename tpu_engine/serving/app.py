@@ -55,6 +55,8 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
         "text/plain; version=0.0.4"))
     server.route("POST", "/admin/reload", lambda body: (
         200, worker.reload_weights(body["model_path"])))
+    server.route("POST", "/score", lambda body: (
+        200, worker.handle_score(body)))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -332,6 +334,8 @@ def serve_combined(
         return (200 if ok else 500), {"ok": ok, "reloaded": outcomes}
 
     routes[("POST", "/admin/reload")] = _admin_reload
+    routes[("POST", "/score")] = (
+        lambda body: (200, gateway.route_score(body)))
 
     server = _make_front_server(port, routes, workers, gateway, native_front)
     kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"

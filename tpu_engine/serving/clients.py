@@ -58,6 +58,14 @@ class LocalWorkerClient:
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
 
+    def score(self, payload: dict) -> dict:
+        try:
+            return self.worker.handle_score(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def generate_stream(self, payload: dict):
         """SSE event-chunk iterator (in-process: the worker's iterator
         passes straight through — no proxy buffering)."""
@@ -175,6 +183,10 @@ class HttpWorkerClient:
 
     def generate(self, payload: dict) -> dict:
         return self._request("POST", "/generate", payload,
+                             timeout_s=self._gen_timeout)
+
+    def score(self, payload: dict) -> dict:
+        return self._request("POST", "/score", payload,
                              timeout_s=self._gen_timeout)
 
     def generate_stream(self, payload: dict):

@@ -163,6 +163,10 @@ class Gateway:
         reference re-parses and re-encodes the float array at every hop)."""
         return self._route(payload, op="infer_raw")
 
+    def route_score(self, payload: dict) -> dict:
+        """Route /score (teacher-forced logprobs) like /infer."""
+        return self._route(payload, op="score")
+
     def route_generate(self, payload: dict) -> dict:
         """Route a /generate request the same way as /infer: ring primary,
         breaker-gated, ring-order failover."""

@@ -88,6 +88,13 @@ class _GenResult:
     generate_time_us: int
 
 
+@dataclass
+class _ScoreItem:
+    request_id: str
+    prompt: list
+    completion: list
+
+
 def _load_model_path(model, model_path: Optional[str]):
     """Resolve the worker's model_path into a parameter pytree (or None for
     random init). HF checkpoint layouts (config.json / *.safetensors /
@@ -395,6 +402,87 @@ class WorkerNode:
                 device=getattr(self.engine, "_device", None))
         except ValueError as exc:
             raise RuntimeError(f"speculative lane misconfigured: {exc}")
+
+    def handle_score(self, request: dict) -> dict:
+        """Teacher-forced scoring: per-token log P(completion | prompt) in
+        one forward pass — the evals/perplexity API (lm-eval-harness
+        loglikelihood shape). Wire: {request_id, prompt_tokens,
+        completion_tokens} -> {request_id, logprobs, total_logprob,
+        node_id}. Works under every gen_scheduler (a dedicated scorer
+        shares the lane's params; first call compiles its bucket)."""
+        if self._injected_fault is not None:
+            raise RuntimeError(f"fault injected: {self._injected_fault}")
+        self._check_model(request)
+        from tpu_engine.models.transformer import TransformerConfig
+
+        cfg = getattr(self.engine.spec, "config", None)
+        if not isinstance(cfg, TransformerConfig) or not cfg.causal:
+            # Teacher-forced next-token logprobs are a decoder-LM notion;
+            # encoders (BERT dialect) reject with the scoring message, not
+            # a confusing generation error from deeper in the stack.
+            raise ValueError(
+                f"model '{self.config.model}' does not support scoring")
+        with self._counter_lock:
+            self._total_requests += 1
+        completion = [int(t) for t in request["completion_tokens"]]
+        if not completion:
+            raise ValueError("completion_tokens must be non-empty")
+        item = _ScoreItem(request["request_id"],
+                          [int(t) for t in request["prompt_tokens"]],
+                          completion)
+        t0 = time.perf_counter()
+        # Concurrent evals requests (the lm-eval-harness shape) batch into
+        # one bucketed forward instead of N sequential batch-1 forwards.
+        lps = self._score_processor().process(item)
+        return {
+            "request_id": item.request_id,
+            "logprobs": lps,
+            "total_logprob": float(sum(lps)),
+            "node_id": self.node_id,
+            "score_time_us": int((time.perf_counter() - t0) * 1e6),
+        }
+
+    def _get_scorer(self):
+        """The lane's scoring Generator: the batch scheduler's own
+        Generator when it has one (shared executable caches), else a lazy
+        dedicated instance sharing the lane's (possibly reloaded) params."""
+        from tpu_engine.runtime.generator import Generator
+
+        if isinstance(self.generator, Generator):
+            return self.generator
+        with self._counter_lock:
+            scorer = getattr(self, "_scorer", None)
+            if scorer is None:
+                scorer = Generator(
+                    self.engine.spec, params=self.engine.params,
+                    dtype=self.config.dtype,
+                    device=getattr(self.engine, "_device", None))
+                self._scorer = scorer
+        # Track hot reloads: params is a cheap reference swap.
+        scorer.params = self.engine.params
+        return scorer
+
+    def _score_processor(self):
+        proc = getattr(self, "_score_proc", None)
+        if proc is None:
+            with self._counter_lock:
+                proc = getattr(self, "_score_proc", None)
+                if proc is None:
+                    proc = BatchProcessor(
+                        self.config.max_batch_size,
+                        self.config.batch_timeout_ms,
+                        self._process_score_batch,
+                        name=f"{self.node_id}-score-batcher",
+                    )
+                    proc.start()
+                    self._score_proc = proc
+        return proc
+
+    def _process_score_batch(self, items):
+        scorer = self._get_scorer()
+        out = scorer.score([it.prompt for it in items],
+                           [it.completion for it in items])
+        return out
 
     def _check_model(self, request: dict) -> None:
         """A request addressed to a specific model must never be answered
@@ -843,6 +931,8 @@ class WorkerNode:
 
     def stop(self) -> None:
         self.batch_processor.stop()
+        if getattr(self, "_score_proc", None) is not None:
+            self._score_proc.stop()
         if self._gen_processor is not None:
             self._gen_processor.stop()
         if self._continuous and self.generator is not None:
