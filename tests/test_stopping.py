@@ -133,3 +133,42 @@ def test_speculative_rejects_penalty_trims_stops():
                         stop_tokens=[[stop], []])
     assert got[0] == plain[0][:plain[0].index(stop)]
     assert got[1] == plain[1]
+
+
+def test_min_p_filters_and_agrees(gen, sched):
+    """min_p keeps only tokens with prob >= min_p x max prob: at 1.0 the
+    stochastic stream collapses to the argmax family; both schedulers
+    agree for seeded requests; wire carries the field."""
+    # min_p=1.0 -> only max-prob tokens survive -> matches greedy when the
+    # argmax is unique.
+    greedy = gen.generate(PROMPTS, max_new_tokens=8)
+    tight = gen.generate(PROMPTS, max_new_tokens=8, temperature=0.7,
+                         seed=[1, 2], min_p=1.0)
+    assert tight == greedy
+    loose = gen.generate(PROMPTS, max_new_tokens=8, temperature=1.2,
+                         seed=[1, 2], min_p=0.05)
+    a = sched.generate(PROMPTS, max_new_tokens=8, temperature=1.2,
+                       seed=[1, 2], min_p=0.05)
+    assert a == loose
+    # fused path agrees too
+    f = gen.generate(PROMPTS, max_new_tokens=8, temperature=1.2,
+                     seed=[1, 2], min_p=0.05, fused=True)
+    assert f == loose
+
+
+def test_min_p_wire_and_validation():
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    w = WorkerNode(WorkerConfig(node_id="w_minp", model="gpt2-small-test",
+                                dtype="float32", gen_scheduler="batch"))
+    try:
+        r = w.handle_generate({"request_id": "m1", "prompt_tokens": [5, 9],
+                               "max_new_tokens": 4, "temperature": 0.8,
+                               "seed": 3, "min_p": 0.1})
+        assert len(r["tokens"]) == 4
+        with pytest.raises(ValueError):
+            w.handle_generate({"request_id": "m2", "prompt_tokens": [5],
+                               "max_new_tokens": 2, "min_p": 1.5})
+    finally:
+        w.stop()

@@ -123,13 +123,17 @@ def token_counts(rows: "Sequence[Sequence[int]]", n_rows: int,
     return out
 
 
-def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
+def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None,
+            min_p=None):
     """Per-row sampling: logits (B, V); seeds/positions/temperature/top_p/
-    top_k (B,).
+    top_k/min_p (B,).
 
     Greedy where temperature == 0, else categorical — optionally filtered
     to the nucleus (smallest token set with cumulative probability >=
-    top_p) and/or the top_k highest-logit tokens (0 = disabled) — with key
+    top_p), the top_k highest-logit tokens (0 = disabled), and/or min_p
+    (keep tokens whose probability >= min_p x the max probability; 0 =
+    disabled — in logit space that is simply lg >= max_lg + log(min_p),
+    applied after temperature like HF) — with key
     fold_in(PRNGKey(seed_r), position_r): deterministic per
     (seed, position) so co-batching and bucketing never change a request's
     tokens."""
@@ -138,10 +142,17 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
         top_p = jnp.ones(logits.shape[:1], jnp.float32)
     if top_k is None:
         top_k = jnp.zeros(logits.shape[:1], jnp.int32)
+    if min_p is None:
+        min_p = jnp.zeros(logits.shape[:1], jnp.float32)
 
-    def row(key_seed, pos, lg, t, p, k_limit):
+    def row(key_seed, pos, lg, t, p, k_limit, p_min):
         key = jax.random.fold_in(jax.random.PRNGKey(key_seed), pos)
         lg = lg / jnp.maximum(t, 1e-6)
+        min_thresh = jnp.where(p_min > 0,
+                               jnp.max(lg) + jnp.log(jnp.maximum(p_min,
+                                                                 1e-30)),
+                               -jnp.inf)
+        lg = jnp.where(lg >= min_thresh, lg, -jnp.inf)
         sorted_lg = jnp.sort(lg)[::-1]
         # Nucleus filter: keep the top tokens whose cumulative softmax mass
         # reaches p (always at least one). p >= 1 keeps everything.
@@ -161,12 +172,12 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None):
         return jax.random.categorical(key, lg)
 
     sampled = jax.vmap(row)(seeds, positions, logits, temperature,
-                            top_p, top_k).astype(jnp.int32)
+                            top_p, top_k, min_p).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
 def _decode_step_sampled(params, cfg, dtype, tok, caches, pos, start, done,
-                         seeds, temps, topps, topks, eos, controls,
+                         seeds, temps, topps, topks, minps, eos, controls,
                          counts, pens, stops):
     """One decode step + sampling + EOS/stop/counts bookkeeping — THE
     per-step semantics the chunked scan body and the fused while body
@@ -181,7 +192,8 @@ def _decode_step_sampled(params, cfg, dtype, tok, caches, pos, start, done,
         logits = apply_repetition_penalty(logits, counts, pens)
     # The sampled token sits at logical position pos+1-start in its own
     # sequence — fold that in so the stream is batch/bucket-independent.
-    nxt = _sample(logits, seeds, pos + 1 - start, temps, topps, topks)
+    nxt = _sample(logits, seeds, pos + 1 - start, temps, topps, topks,
+                  minps)
     nxt = jnp.where(done, eos, nxt)
     if controls:
         counts = counts.at[jnp.arange(nxt.shape[0]), nxt].add(
@@ -324,7 +336,7 @@ class Generator:
             cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
             def decode_chunk(params, caches, tok, pos0, start, done, seeds,
-                             temperature, top_p, top_k, eos_id,
+                             temperature, top_p, top_k, min_p, eos_id,
                              counts=None, rep_pen=None, stops=None):
                 """Scan `chunk` decode steps. tok: (B,) last emitted token;
                 seeds/temperature/top_p/top_k/rep_pen: per-row (B,)
@@ -339,8 +351,8 @@ class Generator:
                         counts = None
                     caches, nxt, done, counts = _decode_step_sampled(
                         params, cfg, dtype, tok, caches, pos0 + i, start,
-                        done, seeds, temperature, top_p, top_k, eos_id,
-                        controls, counts, rep_pen, stops)
+                        done, seeds, temperature, top_p, top_k, min_p,
+                        eos_id, controls, counts, rep_pen, stops)
                     if controls:
                         return (caches, nxt, done, counts), nxt
                     return (caches, nxt, done), nxt
@@ -356,7 +368,7 @@ class Generator:
 
             self._decode_exe[key] = jax.jit(
                 decode_chunk,
-                donate_argnums=(1, 11) if controls else (1,))
+                donate_argnums=(1, 12) if controls else (1,))
             return self._decode_exe[key]
 
     def _fused(self, bb: int, pb: int, cap: int, controls: bool):
@@ -380,8 +392,8 @@ class Generator:
             max_seq = self.max_seq
 
             def run(params, tokens, attn_mask, pos_ids, start, alive,
-                    caches, seeds, temps, topps, topks, max_new, eos_id,
-                    pens=None, stops=None, counts=None):
+                    caches, seeds, temps, topps, topks, minps, max_new,
+                    eos_id, pens=None, stops=None, counts=None):
                 rows = jnp.arange(bb)
                 logits, caches = transformer_prefill(
                     params, tokens, caches, cfg, dtype=dtype,
@@ -389,7 +401,7 @@ class Generator:
                 if controls:
                     logits = apply_repetition_penalty(logits, counts, pens)
                 first = _sample(logits, seeds, pb - start, temps, topps,
-                                topks)
+                                topks, minps)
                 out_buf = jnp.zeros((bb, cap), jnp.int32).at[:, 0].set(first)
                 n_out = jnp.ones((bb,), jnp.int32)
                 done = (~alive) | (first == eos_id) | (max_new <= 1)
@@ -412,8 +424,8 @@ class Generator:
                     done0 = done
                     caches, nxt, done, counts = _decode_step_sampled(
                         params, cfg, dtype, tok, caches, pos, start, done,
-                        seeds, temps, topps, topks, eos_id, controls,
-                        counts, pens, stops)
+                        seeds, temps, topps, topks, minps, eos_id,
+                        controls, counts, pens, stops)
                     write = (~done0) & (n_out < cap)
                     out_buf = out_buf.at[
                         rows, jnp.where(write, n_out, cap)
@@ -636,6 +648,7 @@ class Generator:
         top_k: Union[int, Sequence[int]] = 0,
         repetition_penalty: Union[float, Sequence[float]] = 1.0,
         stop_tokens=None,
+        min_p: Union[float, Sequence[float]] = 0.0,
         fused: bool = False,
     ) -> List[List[int]]:
         """Batched generation. Returns per-prompt generated token lists
@@ -660,8 +673,8 @@ class Generator:
         if not prompts:
             return []
         n = len(prompts)
-        temps, seeds, top_ps, top_ks = expand_sampling_params(
-            n, temperature, seed, top_p, top_k)
+        temps, seeds, top_ps, top_ks, min_ps = expand_sampling_params(
+            n, temperature, seed, top_p, top_k, min_p)
         pens, stops = expand_stopping_params(n, repetition_penalty,
                                              stop_tokens)
         out: List[List[int]] = []
@@ -673,14 +686,15 @@ class Generator:
                 max_new_tokens, eos_id, temps[i:i + max_bb],
                 seeds[i:i + max_bb], top_ps[i:i + max_bb],
                 top_ks[i:i + max_bb], pens[i:i + max_bb],
-                stops[i:i + max_bb]))
+                stops[i:i + max_bb], min_ps[i:i + max_bb]))
         return out
 
     def _generate_fused_batch(self, prompts: List[List[int]], max_new: int,
                               eos_id: int, temps: List[float],
                               seeds: List[int], top_ps: List[float],
                               top_ks: List[int], pens: List[float],
-                              stops: List[List[int]]) -> List[List[int]]:
+                              stops: List[List[int]],
+                              min_ps: List[float]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -700,14 +714,17 @@ class Generator:
         seeds_arr = np.zeros((bb,), np.int32)
         topp_arr = np.ones((bb,), np.float32)
         topk_arr = np.zeros((bb,), np.int32)
+        minp_arr = np.zeros((bb,), np.float32)
         temps_arr[:n] = temps
         seeds_arr[:n] = [int(s) & 0x7FFFFFFF for s in seeds]
         topp_arr[:n] = top_ps
         topk_arr[:n] = top_ks
+        minp_arr[:n] = min_ps
         args = [self.params, put(tokens), put(attn_mask), put(pos_ids),
                 put(start), put(alive), caches, put(seeds_arr),
                 put(temps_arr), put(topp_arr), put(topk_arr),
-                put(jnp.int32(max_new)), put(jnp.int32(eos_id))]
+                put(minp_arr), put(jnp.int32(max_new)),
+                put(jnp.int32(eos_id))]
         if controls:
             pens_arr = np.ones((bb,), np.float32)
             pens_arr[:n] = pens
@@ -728,7 +745,8 @@ class Generator:
                         eos_id: int, temps: List[float],
                         seeds: List[int], top_ps: List[float],
                         top_ks: List[int], pens: List[float],
-                        stops: List[List[int]]) -> List[List[int]]:
+                        stops: List[List[int]],
+                        min_ps: List[float]) -> List[List[int]]:
         n = len(prompts)
         bb = self._bucket(self._batch_buckets, n)
         longest = max(1, max(len(p) for p in prompts))
@@ -754,9 +772,12 @@ class Generator:
         # settings (documented seeded-reproducibility contract).
         seeds_arr[:n] = [int(s) & 0x7FFFFFFF for s in seeds]
         topp_arr[:n] = top_ps
+        minp_arr = np.zeros((bb,), np.float32)
+        minp_arr[:n] = min_ps
         controls = any(p != 1.0 for p in pens) or any(stops)
         temps_dev, seeds_dev = put(temps_arr), put(seeds_arr)
         topp_dev, topk_dev = put(topp_arr), put(topk_arr)
+        minp_dev = put(minp_arr)
         start_dev = put(start)
 
         # Bucket-padding rows start done: their outputs are discarded, and
@@ -776,7 +797,7 @@ class Generator:
                                               pens_dev)
         first = _sample(logits, seeds_dev, pb - jnp.asarray(start_dev),
                         jnp.asarray(temps_dev), jnp.asarray(topp_dev),
-                        jnp.asarray(topk_dev))
+                        jnp.asarray(topk_dev), jnp.asarray(minp_dev))
         done = pad_done | (first == eos_id)
         if controls:
             done = done | jnp.any(first[:, None] == stops_dev, axis=1)
@@ -797,12 +818,13 @@ class Generator:
             if controls:
                 caches, tok, done, counts, toks = decode(
                     self.params, caches, tok, pos, start_dev, done,
-                    seeds_dev, temps_dev, topp_dev, topk_dev, eos_dev,
-                    counts, pens_dev, stops_dev)
+                    seeds_dev, temps_dev, topp_dev, topk_dev, minp_dev,
+                    eos_dev, counts, pens_dev, stops_dev)
             else:
                 caches, tok, done, toks = decode(
                     self.params, caches, tok, pos, start_dev, done,
-                    seeds_dev, temps_dev, topp_dev, topk_dev, eos_dev)
+                    seeds_dev, temps_dev, topp_dev, topk_dev, minp_dev,
+                    eos_dev)
             start_host_copies(toks, done)
             pieces.append(np.asarray(toks))
             pos += self._step_chunk

@@ -74,6 +74,7 @@ class _Request:
     top_k: int
     rep_penalty: float = 1.0
     stop_tokens: List[int] = field(default_factory=list)
+    min_p: float = 0.0
     future: Future = field(default_factory=Future)
     # Streaming: freshly-visible tokens are pushed as lists between decode
     # chunks; None is the end-of-stream sentinel (the future then holds the
@@ -187,6 +188,7 @@ class ContinuousGenerator:
         self._temps = np.zeros((self.n_slots,), np.float32)
         self._topps = np.ones((self.n_slots,), np.float32)
         self._topks = np.zeros((self.n_slots,), np.int32)
+        self._minps = np.zeros((self.n_slots,), np.float32)
         self._pens = np.ones((self.n_slots,), np.float32)
         self._stops = np.full((self.n_slots, MAX_STOP_TOKENS), -1, np.int32)
         # Device-resident context-token counts (repetition-penalty state),
@@ -336,8 +338,9 @@ class ContinuousGenerator:
                 cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
 
                 def decode_chunk(params, caches, tok, pos, start, done,
-                                 seeds, temps, topps, topks, eos_vec,
-                                 counts=None, pens=None, stops=None):
+                                 seeds, temps, topps, topks, minps,
+                                 eos_vec, counts=None, pens=None,
+                                 stops=None):
                     rows = jnp.arange(tok.shape[0])
 
                     def body(carry, _):
@@ -353,7 +356,7 @@ class ContinuousGenerator:
                             logits = apply_repetition_penalty(
                                 logits, counts, pens)
                         nxt = _sample(logits, seeds, pos + 1 - start, temps,
-                                      topps, topks)
+                                      topps, topks, minps)
                         nxt = jnp.where(done, eos_vec, nxt)
                         if controls:
                             counts = counts.at[rows, nxt].add(
@@ -383,7 +386,7 @@ class ContinuousGenerator:
 
                 self._decode_exe[controls] = jax.jit(
                     decode_chunk,
-                    donate_argnums=(1, 11) if controls else (1,))
+                    donate_argnums=(1, 12) if controls else (1,))
             return self._decode_exe[controls]
 
     # -- public API ------------------------------------------------------------
@@ -392,7 +395,7 @@ class ContinuousGenerator:
                eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
                top_p: float = 1.0, top_k: int = 0,
                repetition_penalty: float = 1.0, stop_tokens=None,
-               stream=None) -> Future:
+               min_p: float = 0.0, stream=None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
@@ -404,24 +407,29 @@ class ContinuousGenerator:
         pens, stops = expand_stopping_params(1, repetition_penalty,
                                              [list(stop_tokens)]
                                              if stop_tokens else None)
+        if not 0.0 <= float(min_p) <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
                        float(temperature), int(seed), float(top_p),
                        clamp_top_k(top_k), rep_penalty=pens[0],
-                       stop_tokens=stops[0], stream=stream)
+                       stop_tokens=stops[0], min_p=float(min_p),
+                       stream=stream)
         self._queue.put(req)
         return req.future
 
     def generate(self, prompts, max_new_tokens: int = 32, eos_id: int = -1,
                  temperature=0.0, seed=0, top_p=1.0, top_k=0,
-                 repetition_penalty=1.0, stop_tokens=None) -> List[List[int]]:
+                 repetition_penalty=1.0, stop_tokens=None,
+                 min_p=0.0) -> List[List[int]]:
         """Blocking convenience over submit() (Generator-compatible)."""
         n = len(prompts)
-        temps, seeds, topps, topks = expand_sampling_params(
-            n, temperature, seed, top_p, top_k)
+        temps, seeds, topps, topks, minps = expand_sampling_params(
+            n, temperature, seed, top_p, top_k, min_p)
         pens, stops = expand_stopping_params(n, repetition_penalty,
                                              stop_tokens)
         futs = [self.submit(p, max_new_tokens, eos_id, temps[i], seeds[i],
-                            topps[i], topks[i], pens[i], stops[i])
+                            topps[i], topks[i], pens[i], stops[i],
+                            minps[i])
                 for i, p in enumerate(prompts)]
         return [f.result(timeout=600) for f in futs]
 
@@ -595,7 +603,8 @@ class ContinuousGenerator:
             jnp.asarray([L], jnp.int32),
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32))
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.min_p], jnp.float32))
         first_tok = int(first[0])
         if row_counts is not None:
             row_counts[0, first_tok] += 1  # first token joins the context
@@ -618,6 +627,7 @@ class ContinuousGenerator:
         self._temps[row] = req.temperature
         self._topps[row] = req.top_p
         self._topks[row] = req.top_k
+        self._minps[row] = req.min_p
         self._pens[row] = req.rep_penalty
         self._stops[row] = -1
         self._stops[row, :len(req.stop_tokens)] = req.stop_tokens
@@ -763,7 +773,8 @@ class ContinuousGenerator:
                         jnp.asarray(self._pos), jnp.asarray(self._start),
                         jnp.asarray(self._done), jnp.asarray(self._seeds),
                         jnp.asarray(self._temps), jnp.asarray(self._topps),
-                        jnp.asarray(self._topks), jnp.asarray(eos_vec),
+                        jnp.asarray(self._topks), jnp.asarray(self._minps),
+                        jnp.asarray(eos_vec),
                         self._ensure_counts(), jnp.asarray(self._pens),
                         jnp.asarray(self._stops))
                 else:
@@ -772,7 +783,8 @@ class ContinuousGenerator:
                         jnp.asarray(self._pos), jnp.asarray(self._start),
                         jnp.asarray(self._done), jnp.asarray(self._seeds),
                         jnp.asarray(self._temps), jnp.asarray(self._topps),
-                        jnp.asarray(self._topks), jnp.asarray(eos_vec))
+                        jnp.asarray(self._topks), jnp.asarray(self._minps),
+                        jnp.asarray(eos_vec))
                 start_host_copies(tok, pos, done, toks)
                 # np.array (copy): np.asarray of a jax.Array is read-only
                 # and the admit path mutates these vectors in place.

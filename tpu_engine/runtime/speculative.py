@@ -360,21 +360,22 @@ class SpeculativeGenerator:
         top_k: Union[int, Sequence[int]] = 0,
         repetition_penalty: Union[float, Sequence[float]] = 1.0,
         stop_tokens=None,
+        min_p: Union[float, Sequence[float]] = 0.0,
     ) -> List[List[int]]:
         n = len(prompts)
         if n == 0:
             return []
-        temps, seeds, top_ps, top_ks = expand_sampling_params(
-            n, temperature, seed, top_p, top_k)
+        temps, seeds, top_ps, top_ks, min_ps = expand_sampling_params(
+            n, temperature, seed, top_p, top_k, min_p)
         pens, stops = expand_stopping_params(n, repetition_penalty,
                                              stop_tokens)
         seeds = [s & 0x7FFFFFFF for s in seeds]
         if any(p < 1.0 for p in top_ps) or any(k > 0 for k in top_ks) \
-                or any(p != 1.0 for p in pens):
+                or any(p != 1.0 for p in pens) or any(m > 0 for m in min_ps):
             raise ValueError(
                 "speculative decoding supports temperature sampling only; "
-                "route top_p/top_k/repetition_penalty requests to the "
-                "plain schedulers")
+                "route top_p/top_k/min_p/repetition_penalty requests to "
+                "the plain schedulers")
         max_bb = self._batch_buckets[-1]
         if n > max_bb:
             out: List[List[int]] = []

@@ -72,6 +72,7 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     server.route("POST", "/generate/stream",
                  lambda body: (200, gateway.route_generate_stream(body)))
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
+    server.route("POST", "/score", lambda body: (200, gateway.route_score(body)))
     server.route("GET", "/metrics", lambda _body: (
         200, render_prometheus([], gateway.get_stats()),
         "text/plain; version=0.0.4"))

@@ -37,6 +37,7 @@ from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.serving.http import sse_event
 from tpu_engine.utils.config import WorkerConfig
 from tpu_engine.utils.sampling import clamp_top_k as _clamp_top_k
+from tpu_engine.utils.sampling import validate_min_p as _validate_min_p
 from tpu_engine.utils.sampling import expand_stopping_params
 from tpu_engine.utils.tracing import SpanRecorder
 
@@ -80,6 +81,7 @@ class _GenItem:
     stop_tokens: tuple = ()
     beam_width: int = 1
     length_penalty: float = 1.0
+    min_p: float = 0.0
 
 
 @dataclass
@@ -311,7 +313,8 @@ class WorkerNode:
 
     def _validate_beam(self, beam_width, temperature, top_p, top_k,
                        rep_penalty, stop_tokens,
-                       length_penalty: float = 1.0) -> None:
+                       length_penalty: float = 1.0,
+                       min_p: float = 0.0) -> None:
         if beam_width == 1:
             return  # non-beam paths never read length_penalty
         if not math.isfinite(length_penalty) or abs(length_penalty) > 10:
@@ -329,10 +332,11 @@ class WorkerNode:
         if self._continuous or self._speculative:
             raise ValueError("beam_width > 1 needs gen_scheduler=batch")
         if (temperature > 0 or top_p < 1.0 or top_k > 0
-                or rep_penalty != 1.0 or stop_tokens):
+                or rep_penalty != 1.0 or stop_tokens or min_p > 0):
             raise ValueError(
                 "beam_width is deterministic: temperature/top_p/top_k/"
-                "repetition_penalty/stop_tokens do not apply")
+                "min_p/repetition_penalty/stop_tokens do not apply")
+
 
     _AUTO_DRAFT = {"gpt2": "distilgpt2", "gpt2-small-test": "gpt2-small-test"}
 
@@ -430,6 +434,15 @@ class WorkerNode:
         item = _ScoreItem(request["request_id"],
                           [int(t) for t in request["prompt_tokens"]],
                           completion)
+        scorer = self._get_scorer()
+        total = max(len(item.prompt), 1) + len(completion)
+        largest = scorer._prompt_buckets[-1]
+        if total > largest:
+            # Validate BEFORE the item joins a shared batch: one over-long
+            # request must 400 alone, never poison its co-batched group.
+            raise ValueError(
+                f"prompt+completion length {total} exceeds the largest "
+                f"sequence bucket {largest}")
         t0 = time.perf_counter()
         # Concurrent evals requests (the lm-eval-harness shape) batch into
         # one bucketed forward instead of N sequential batch-1 forwards.
@@ -713,10 +726,12 @@ class WorkerNode:
                               for t in request.get("stop_tokens", ())),
             beam_width=int(request.get("beam_width", 1)),
             length_penalty=float(request.get("length_penalty", 1.0)),
+            min_p=_validate_min_p(request.get("min_p", 0.0)),
         )
         self._validate_beam(item.beam_width, item.temperature, item.top_p,
                             item.top_k, item.repetition_penalty,
-                            item.stop_tokens, item.length_penalty)
+                            item.stop_tokens, item.length_penalty,
+                            item.min_p)
         # Validate stopping params BEFORE the item can join a shared batch
         # — a malformed request must 400 alone, never poison its
         # co-batched group (the batch lane would otherwise surface
@@ -725,7 +740,8 @@ class WorkerNode:
                                [list(item.stop_tokens)]
                                if item.stop_tokens else None)
         if self._speculative and (item.top_p < 1.0 or item.top_k > 0
-                                  or item.repetition_penalty != 1.0):
+                                  or item.repetition_penalty != 1.0
+                                  or item.min_p > 0):
             # Reject BEFORE the item enters a shared batch: rejection
             # sampling is exact for the temperature distribution only, and
             # one filtered request must not poison its co-batched group.
@@ -740,7 +756,7 @@ class WorkerNode:
                 eos_id=item.eos_id, temperature=item.temperature,
                 seed=item.seed, top_p=item.top_p, top_k=item.top_k,
                 repetition_penalty=item.repetition_penalty,
-                stop_tokens=list(item.stop_tokens))
+                stop_tokens=list(item.stop_tokens), min_p=item.min_p)
             tokens = fut.result(timeout=600)
             elapsed_us = int((time.perf_counter() - t0) * 1e6)
             result = _GenResult(tokens, elapsed_us)
@@ -788,14 +804,15 @@ class WorkerNode:
         stop_toks = [int(t) for t in request.get("stop_tokens", ())]
         beam_width = int(request.get("beam_width", 1))
         length_penalty = float(request.get("length_penalty", 1.0))
+        min_p_val = _validate_min_p(request.get("min_p", 0.0))
         # Same eager validation as the blocking endpoint: a malformed
         # request must 400 before the 200 SSE stream is committed.
         expand_stopping_params(1, rep_pen,
                                [stop_toks] if stop_toks else None)
         self._validate_beam(beam_width, temperature, top_p, top_k,
-                            rep_pen, stop_toks, length_penalty)
+                            rep_pen, stop_toks, length_penalty, min_p_val)
         if self._speculative and (top_p < 1.0 or top_k > 0
-                                  or rep_pen != 1.0):
+                                  or rep_pen != 1.0 or min_p_val > 0):
             # Must fire HERE, before the iterator commits a 200 SSE stream
             # — same 400 the blocking endpoint gives this payload.
             raise ValueError(
@@ -809,7 +826,8 @@ class WorkerNode:
                       "repetition_penalty": rep_pen,
                       "stop_tokens": stop_toks,
                       "beam_width": beam_width,
-                      "length_penalty": length_penalty}
+                      "length_penalty": length_penalty,
+                      "min_p": min_p_val}
         if not self._continuous:
             def one_shot():
                 try:
@@ -828,7 +846,8 @@ class WorkerNode:
         fut = self.generator.submit(
             prompt, max_new_tokens=max_new, eos_id=eos_id,
             temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
-            repetition_penalty=rep_pen, stop_tokens=stop_toks, stream=q)
+            repetition_penalty=rep_pen, stop_tokens=stop_toks,
+            min_p=min_p_val, stream=q)
 
         def events():
             while True:
@@ -888,6 +907,7 @@ class WorkerNode:
                 repetition_penalty=[items[i].repetition_penalty
                                     for i in idxs],
                 stop_tokens=[list(items[i].stop_tokens) for i in idxs],
+                min_p=[items[i].min_p for i in idxs],
                 # The speculative generator is single-dispatch by design
                 # and takes no fused flag.
                 **({} if self._speculative

@@ -13,17 +13,28 @@ from __future__ import annotations
 import numpy as np
 
 
+def validate_min_p(m) -> float:
+    """min_p boundary rule (0 = off, 1 = only-max-prob tokens) — one
+    definition for every wire/API entry point."""
+    m = float(m)
+    if not 0.0 <= m <= 1.0:
+        raise ValueError(f"min_p must be in [0, 1], got {m}")
+    return m
+
+
 def clamp_top_k(k) -> int:
     """Clamp a wire top_k to int32 range (like seed's & 0x7FFFFFFF): an
     out-of-range value must not OverflowError inside a shared batch."""
     return max(0, min(int(k), 0x7FFFFFFF))
 
 
-def expand_sampling_params(n, temperature, seed, top_p, top_k):
+def expand_sampling_params(n, temperature, seed, top_p, top_k, min_p=0.0):
     """Normalize scalar-or-sequence sampling params to per-row lists of
     length n (scalar seed expands to seed+row so rows of one call still
     sample independently; top_k clamps to int32 range at the boundary).
-    Shared by both decode schedulers so the wire semantics can't drift."""
+    Shared by both decode schedulers so the wire semantics can't drift.
+    min_p (0 = off) keeps tokens with prob >= min_p x max prob (HF
+    semantics, applied after temperature)."""
     temps = ([float(temperature)] * n if np.isscalar(temperature)
              else [float(t) for t in temperature])
     seeds = ([int(seed) + r for r in range(n)] if np.isscalar(seed)
@@ -33,11 +44,15 @@ def expand_sampling_params(n, temperature, seed, top_p, top_k):
     top_ks = ([int(top_k)] * n if np.isscalar(top_k)
               else [int(k) for k in top_k])
     top_ks = [clamp_top_k(k) for k in top_ks]
+    min_ps = ([float(min_p)] * n if np.isscalar(min_p)
+              else [float(m) for m in min_p])
     if (len(temps) != n or len(seeds) != n or len(top_ps) != n
-            or len(top_ks) != n):
+            or len(top_ks) != n or len(min_ps) != n):
         raise ValueError(
-            "temperature/seed/top_p/top_k sequence length != n prompts")
-    return temps, seeds, top_ps, top_ks
+            "temperature/seed/top_p/top_k/min_p sequence length != n "
+            "prompts")
+    min_ps = [validate_min_p(m) for m in min_ps]
+    return temps, seeds, top_ps, top_ks, min_ps
 
 
 MAX_STOP_TOKENS = 8
