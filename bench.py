@@ -59,6 +59,26 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# Every completed sub-measurement lands here AND in BENCH_partial.json
+# immediately — so a tunnel wedge mid-run (the r2/r4 failure mode: the
+# driver kills the hung process and records only rc=1) still leaves every
+# number measured before the wedge, both on disk and attached to the
+# error JSON line main() prints. Mirrors tools/onchip_campaign.py's
+# save-after-every-stage discipline.
+_PARTIAL: dict = {}
+_PARTIAL_PATH = os.path.join(REPO, "BENCH_partial.json")
+
+
+def record_partial(name: str, data) -> None:
+    _PARTIAL[name] = data
+    _PARTIAL["ts"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    try:
+        with open(_PARTIAL_PATH, "w") as f:
+            json.dump(_PARTIAL, f, indent=2)
+    except OSError as exc:  # a read-only checkout must not kill the bench
+        log(f"partial artifact write failed: {exc}")
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -752,11 +772,19 @@ def main() -> int:
         return _main()
     except Exception as exc:  # ALWAYS leave the driver one JSON line
         log(f"bench failed: {exc!r}")
-        print(json.dumps({
+        line = {
             "metric": "bench_error", "value": 0.0, "unit": "error",
             "vs_baseline": 0.0, "scenario": _SCENARIO,
             "error": repr(exc)[:500],
-        }), flush=True)
+        }
+        # A wedge after N completed measurements must not zero them out:
+        # attach whatever landed before the failure (also on disk at
+        # BENCH_partial.json). Metadata-only partials (scenario/ts) are
+        # NOT attached — "partial" present must mean real numbers
+        # survived, or the driver would read an empty run as evidence.
+        if any(k not in ("scenario", "ts") for k in _PARTIAL):
+            line["partial"] = _PARTIAL
+        print(json.dumps(line), flush=True)
         return 1
 
 
@@ -794,6 +822,8 @@ def _main() -> int:
         jax.config.update("jax_platforms", platform)
     global _SCENARIO
     _SCENARIO = args.scenario
+    _PARTIAL.clear()  # never let a previous run's numbers masquerade
+    record_partial("scenario", args.scenario)
     # Preflight the device — except in --port mode, where a live server
     # already holds the (exclusive) chip and a second jax.devices() would
     # false-negative against a healthy deployment.
@@ -811,12 +841,16 @@ def _main() -> int:
         # In-process, no HTTP: pure device-compute evidence.
         compute = run_compute_bench(model=args.model
                                     if args.model != "gpt2" else "resnet50")
+        record_partial("compute", compute)
         decode = run_decode_compute()
+        record_partial("decode", decode)
         decode_f = run_decode_compute(fused=True)
+        record_partial("decode_fused", decode_f)
         # Named so the honest comparison is self-evident: the int8 arm is
         # fused, so its pair is decode_fused (NOT the chunked "decode" —
         # dividing by that would conflate the fusion win into int8's).
         decode_fq = run_decode_compute(quantize=True, fused=True)
+        record_partial("decode_fused_int8", decode_fq)
         log(json.dumps({"compute": compute, "decode": decode,
                         "decode_fused": decode_f,
                         "decode_fused_int8": decode_fq}, indent=2))
@@ -831,6 +865,7 @@ def _main() -> int:
 
     if args.scenario == "decode-ab":
         result = run_decode_ab(model=args.model)
+        record_partial("decode_ab", result)
         log(json.dumps(result, indent=2))
         print(json.dumps({
             "metric": "decode_continuous_speedup",
@@ -841,6 +876,7 @@ def _main() -> int:
 
     if args.scenario == "spec-ab":
         result = run_spec_ab(model=args.model)
+        record_partial("spec_ab", result)
         log(json.dumps(result, indent=2))
         print(json.dumps({
             "metric": "speculative_speedup_upper",
@@ -861,6 +897,7 @@ def _main() -> int:
 
         if args.scenario == "mixed":
             result = run_mixed_shape_bench(port)
+            record_partial("mixed", result)
             log(json.dumps(result, indent=2))
             result.update(scrape_stats(port))
             print(json.dumps({
@@ -872,6 +909,7 @@ def _main() -> int:
 
         if args.cache_test:
             result = run_cache_test(port)
+            record_partial("cache_test", result)
             log(json.dumps(result, indent=2))
             print(json.dumps({
                 "metric": "cache_speedup", "value": result["speedup"],
@@ -882,6 +920,7 @@ def _main() -> int:
 
         if args.scenario == "generate":
             result = run_generate_bench(port)
+            record_partial("generate", result)
             log(json.dumps(result, indent=2))
             print(json.dumps({
                 "metric": "decode_throughput", "value": result["tokens_per_s"],
@@ -900,6 +939,7 @@ def _main() -> int:
                       distinct_inputs=args.distinct)
         result = gen.run()
         result.update(scrape_stats(port))
+        record_partial("serving", result)
         log(json.dumps(result, indent=2))
 
         # Miss-heavy companion load (VERDICT r1 "bench workload hides the
@@ -917,6 +957,7 @@ def _main() -> int:
                 "p99_ms": miss["latency_ms"]["p99"],
                 "success_rate": round(miss["success_rate"], 4),
             }
+            record_partial("miss_path", miss)
             log(json.dumps({"miss_path": miss}, indent=2))
 
         # Free the chip before the in-process compute addendum.
@@ -932,10 +973,13 @@ def _main() -> int:
         if not args.no_compute:
             try:
                 compute = run_compute_bench()
+                record_partial("compute", compute)
                 log(json.dumps({"compute": compute}, indent=2))
                 decode = run_decode_compute()
+                record_partial("decode", decode)
                 log(json.dumps({"decode": decode}, indent=2))
                 decode_fused = run_decode_compute(fused=True)
+                record_partial("decode_fused", decode_fused)
                 log(json.dumps({"decode_fused": decode_fused}, indent=2))
             except Exception as exc:
                 log(f"compute addendum failed: {exc}")

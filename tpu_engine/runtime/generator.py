@@ -133,7 +133,8 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None,
     top_p), the top_k highest-logit tokens (0 = disabled), and/or min_p
     (keep tokens whose probability >= min_p x the max probability; 0 =
     disabled — in logit space that is simply lg >= max_lg + log(min_p),
-    applied after temperature like HF) — with key
+    applied after temperature and after the nucleus/top_k filters,
+    matching HF's warper order) — with key
     fold_in(PRNGKey(seed_r), position_r): deterministic per
     (seed, position) so co-batching and bucketing never change a request's
     tokens."""
@@ -148,11 +149,6 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None,
     def row(key_seed, pos, lg, t, p, k_limit, p_min):
         key = jax.random.fold_in(jax.random.PRNGKey(key_seed), pos)
         lg = lg / jnp.maximum(t, 1e-6)
-        min_thresh = jnp.where(p_min > 0,
-                               jnp.max(lg) + jnp.log(jnp.maximum(p_min,
-                                                                 1e-30)),
-                               -jnp.inf)
-        lg = jnp.where(lg >= min_thresh, lg, -jnp.inf)
         sorted_lg = jnp.sort(lg)[::-1]
         # Nucleus filter: keep the top tokens whose cumulative softmax mass
         # reaches p (always at least one). p >= 1 keeps everything.
@@ -169,6 +165,18 @@ def _sample(logits, seeds, positions, temperature, top_p=None, top_k=None,
         k = jnp.where(k_limit > 0, jnp.minimum(k, k_limit), k)
         thresh = sorted_lg[k - 1]
         lg = jnp.where(lg >= thresh, lg, -jnp.inf)
+        # min_p last, matching HF's warper order (temperature -> top_k ->
+        # top_p -> min_p): the threshold is relative to the max logit —
+        # always a survivor of the filters above, and renormalization
+        # preserves logit differences, so "p_tok >= min_p * p_max over the
+        # renormalized kept set" is exactly this mask. Applying it first
+        # instead would shrink the nucleus (the -inf'd tail re-weights
+        # cum above) and keep a slightly different set than HF.
+        min_thresh = jnp.where(p_min > 0,
+                               jnp.max(lg) + jnp.log(jnp.maximum(p_min,
+                                                                 1e-30)),
+                               -jnp.inf)
+        lg = jnp.where(lg >= min_thresh, lg, -jnp.inf)
         return jax.random.categorical(key, lg)
 
     sampled = jax.vmap(row)(seeds, positions, logits, temperature,

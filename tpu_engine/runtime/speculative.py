@@ -214,7 +214,11 @@ class SpeculativeGenerator:
             tail = jnp.concatenate(
                 [tokens[:, pb - (w - 1):].astype(jnp.int32), first[:, None]],
                 axis=1)
-            stats = jnp.zeros((2,), jnp.int32)  # (rounds, emitted-in-rounds)
+            # (rounds, emitted-in-rounds, live-row-rounds): slot 2 counts
+            # rows actually advancing each round, so the per-round
+            # acceptance stat is not diluted by rows that finished early
+            # but still sit in the batch for every remaining round.
+            stats = jnp.zeros((3,), jnp.int32)
 
             def cond(carry):
                 return jnp.any(~carry[6])
@@ -320,10 +324,12 @@ class SpeculativeGenerator:
                 new_tail = jnp.take_along_axis(
                     cat, adv[:, None] + slot, axis=1)
                 tail = jnp.where(done[:, None], tail, new_tail)
-                done = (done | eos_hit | (n_out >= max_new)
-                        | (pos + k + 1 > max_seq))
-                stats = stats + jnp.array([1, 0], jnp.int32)
+                live = jnp.sum((~done).astype(jnp.int32))  # entry-done: rows
+                done = (done | eos_hit | (n_out >= max_new)  # that ran this
+                        | (pos + k + 1 > max_seq))           # round
+                stats = stats + jnp.array([1, 0, 0], jnp.int32)
                 stats = stats.at[1].add(jnp.sum(adv))
+                stats = stats.at[2].add(live)
                 return (tcaches, dcaches, tail, pos, out_buf, n_out, done,
                         stats)
 
@@ -441,13 +447,17 @@ class SpeculativeGenerator:
         n_out = np.asarray(n_out)
         stats = np.asarray(stats)
         rounds, emitted = int(stats[0]), int(stats[1])
+        live_row_rounds = int(stats[2])
         self.last_stats = {
             "rounds": rounds,
             "tokens_in_rounds": emitted,
             # Mean stream advance per target verify pass, averaged over the
-            # LIVE rows (1.0 = no speculation win, k+1 = perfect draft).
-            "mean_tokens_per_round": (round(emitted / rounds / n, 3)
-                                      if rounds else None),
+            # rows actually LIVE in each round (1.0 = no speculation win,
+            # k+1 = perfect draft). Dividing by rounds*n instead would
+            # understate acceptance whenever early-EOS rows idle in the
+            # batch while others keep decoding.
+            "mean_tokens_per_round": (round(emitted / live_row_rounds, 3)
+                                      if live_row_rounds else None),
             "k": self.k,
         }
 

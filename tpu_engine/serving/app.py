@@ -307,11 +307,16 @@ def serve_combined(
         "text/plain; version=0.0.4")
 
     # Hot weight reload (no serving pause; the reference restarts worker
-    # processes to change weights). {"model_path": ..., "node": optional}
-    # — all lanes by default. The checkpoint loads from disk ONCE; each
-    # lane then swaps independently, and per-node outcomes are reported
-    # even on partial failure (an error mid-fleet must not hide which
-    # lanes already serve the new weights).
+    # processes to change weights). {"model_path": ..., "node": optional,
+    # "model": optional} — all lanes by default. The checkpoint loads from
+    # disk ONCE; each lane then swaps independently, and per-node outcomes
+    # are reported even on partial failure (an error mid-fleet must not
+    # hide which lanes already serve the new weights). In a multi-model
+    # deployment a bare reload is ambiguous — the checkpoint is loaded
+    # against ONE architecture, and two models that happen to share tree
+    # structure/shapes would silently accept each other's weights (swap
+    # validates only treedef/shape/dtype) — so the caller must name the
+    # target with "model" or "node" when more than one model is served.
     def _admin_reload(body):
         from tpu_engine.serving.worker import _load_model_path
 
@@ -320,6 +325,19 @@ def serve_combined(
                    if node in (None, "*") or w.node_id == node]
         if not targets:
             return 404, {"error": f"unknown node '{node}'"}
+        model = body.get("model")
+        if model is not None:
+            targets = [w for w in targets
+                       if getattr(w.engine.spec, "name", None) == model]
+            if not targets:
+                return 404, {"error": f"no lane serves model '{model}'"}
+        else:
+            served = {getattr(w.engine.spec, "name", None) for w in targets}
+            if len(served) > 1:
+                return 400, {"error":
+                             "multiple models served "
+                             f"({sorted(str(s) for s in served)}): "
+                             "pass 'model' or 'node' to pick the target"}
         path = body["model_path"]
         params = _load_model_path(targets[0].engine.spec, path)
         if params is None:
