@@ -1,0 +1,241 @@
+"""Generic ONNX serving of a TRANSFORMER graph (VERDICT r4 missing item 1).
+
+The reference serves any ONNX file by handing it to ``Ort::Session``
+(``/root/reference/src/inference_engine.cpp:31``); BASELINE configs 3 and
+5 name BERT- and GPT-class ONNX models. A mini-BERT encoder is emitted
+the way real exporters write one — embedding Gather, Slice'd position
+table, fused-QKV MatMul + Split, Equal/Unsqueeze/Where padding mask,
+erf-decomposed GELU, LayerNormalization, ReduceMean pooling, Cast'd
+float input ids — and golden-checked against the identical torch eager
+computation, then served end-to-end through the worker's ``.onnx`` path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+from tests import onnx_writer as ow
+from tpu_engine.models.onnx_graph import build_onnx_model, parse_onnx
+
+SEQ, HID, HEADS, VOCAB, CLASSES, LAYERS = 16, 32, 4, 50, 7, 2
+HEAD_DIM = HID // HEADS
+
+
+def _weights(rng: np.random.Generator) -> dict:
+    w = {"wte": rng.standard_normal((VOCAB, HID)) * 0.1,
+         "wpe": rng.standard_normal((SEQ * 2, HID)) * 0.1,
+         "wc": rng.standard_normal((CLASSES, HID)) * 0.1,
+         "bc": rng.standard_normal((CLASSES,)) * 0.1}
+    for l in range(LAYERS):
+        w.update({
+            f"wqkv{l}": rng.standard_normal((HID, 3 * HID)) * 0.1,
+            f"bqkv{l}": rng.standard_normal((3 * HID,)) * 0.1,
+            f"wo{l}": rng.standard_normal((HID, HID)) * 0.1,
+            f"bo{l}": rng.standard_normal((HID,)) * 0.1,
+            f"w1{l}": rng.standard_normal((HID, 4 * HID)) * 0.1,
+            f"bf1{l}": rng.standard_normal((4 * HID,)) * 0.1,
+            f"w2{l}": rng.standard_normal((4 * HID, HID)) * 0.1,
+            f"bf2{l}": rng.standard_normal((HID,)) * 0.1,
+            f"g1{l}": 1.0 + rng.standard_normal((HID,)) * 0.02,
+            f"be1{l}": rng.standard_normal((HID,)) * 0.02,
+            f"g2{l}": 1.0 + rng.standard_normal((HID,)) * 0.02,
+            f"be2{l}": rng.standard_normal((HID,)) * 0.02,
+        })
+    return {k: v.astype(np.float32) for k, v in w.items()}
+
+
+def torch_golden(w: dict, ids_f32: np.ndarray) -> np.ndarray:
+    """The graph's computation in torch eager, token-id floats in."""
+    t = {k: torch.from_numpy(v) for k, v in w.items()}
+    ids = torch.from_numpy(ids_f32).long()                  # Cast
+    pad = (ids == 0)                                        # Equal
+    bias = torch.where(pad[:, None, None, :],               # Where
+                       torch.tensor(-1e9), torch.tensor(0.0))
+    h = t["wte"][ids] + t["wpe"][:SEQ]                      # Gather + Slice
+    B = ids.shape[0]
+    for l in range(LAYERS):
+        qkv = h @ t[f"wqkv{l}"] + t[f"bqkv{l}"]
+        q, k, v = qkv.split(HID, dim=-1)                    # Split
+        q = q.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+        k = k.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+        v = v.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+        scores = (q @ k.transpose(-1, -2)) * (HEAD_DIM ** -0.5) + bias
+        ctx = torch.softmax(scores, dim=-1) @ v
+        ctx = ctx.permute(0, 2, 1, 3).reshape(B, SEQ, HID)
+        h = h + (ctx @ t[f"wo{l}"] + t[f"bo{l}"])
+        h = torch.nn.functional.layer_norm(
+            h, (HID,), t[f"g1{l}"], t[f"be1{l}"], 1e-5)
+        f = h @ t[f"w1{l}"] + t[f"bf1{l}"]
+        f = 0.5 * f * (1.0 + torch.erf(f / np.sqrt(2.0)))   # Erf GELU
+        h = h + (f @ t[f"w2{l}"] + t[f"bf2{l}"])
+        h = torch.nn.functional.layer_norm(
+            h, (HID,), t[f"g2{l}"], t[f"be2{l}"], 1e-5)
+    pooled = h.mean(dim=1)                                  # ReduceMean
+    return (pooled @ t["wc"].T + t["bc"]).numpy()           # Gemm transB
+
+
+def _export_minibert(w: dict, path: str) -> None:
+    inits = dict(w)
+    inits.update({
+        "pad0": np.asarray(0, np.int64),
+        "neg": np.asarray(-1e9, np.float32),
+        "zero": np.asarray(0.0, np.float32),
+        "scale": np.asarray(HEAD_DIM ** -0.5, np.float32),
+        "sqrt2": np.asarray(np.sqrt(2.0), np.float32),
+        "one": np.asarray(1.0, np.float32),
+        "half": np.asarray(0.5, np.float32),
+        "pos_start": np.asarray([0], np.int64),
+        "pos_end": np.asarray([SEQ], np.int64),
+        "pos_axis": np.asarray([0], np.int64),
+        "split_shape": np.asarray([0, 0, HEADS, HEAD_DIM], np.int64),
+        "merge_shape": np.asarray([0, 0, HID], np.int64),
+    })
+    nodes = [
+        ow.node("Cast", ["input"], ["ids"], [ow.attr_int("to", 7)]),
+        ow.node("Equal", ["ids", "pad0"], ["pad"]),
+        ow.node("Unsqueeze", ["pad"], ["pad4"],
+                [ow.attr_ints("axes", [1, 2])]),
+        ow.node("Where", ["pad4", "neg", "zero"], ["bias"]),
+        ow.node("Gather", ["wte", "ids"], ["emb"], [ow.attr_int("axis", 0)]),
+        ow.node("Slice", ["wpe", "pos_start", "pos_end", "pos_axis"],
+                ["pos"]),
+        ow.node("Add", ["emb", "pos"], ["h0"]),
+    ]
+    h = "h0"
+    for l in range(LAYERS):
+        p = f"l{l}_"
+        nodes += [
+            ow.node("MatMul", [h, f"wqkv{l}"], [p + "qkv0"]),
+            ow.node("Add", [p + "qkv0", f"bqkv{l}"], [p + "qkv"]),
+            ow.node("Split", [p + "qkv"], [p + "q", p + "k", p + "v"],
+                    [ow.attr_int("axis", -1),
+                     ow.attr_ints("split", [HID, HID, HID])]),
+        ]
+        for t in ("q", "k", "v"):
+            nodes += [
+                ow.node("Reshape", [p + t, "split_shape"], [p + t + "4"]),
+                ow.node("Transpose", [p + t + "4"], [p + t + "h"],
+                        [ow.attr_ints("perm", [0, 2, 1, 3])]),
+            ]
+        nodes += [
+            ow.node("Transpose", [p + "kh"], [p + "kt"],
+                    [ow.attr_ints("perm", [0, 1, 3, 2])]),
+            ow.node("MatMul", [p + "qh", p + "kt"], [p + "sc0"]),
+            ow.node("Mul", [p + "sc0", "scale"], [p + "sc1"]),
+            ow.node("Add", [p + "sc1", "bias"], [p + "sc"]),
+            ow.node("Softmax", [p + "sc"], [p + "pr"],
+                    [ow.attr_int("axis", -1)]),
+            ow.node("MatMul", [p + "pr", p + "vh"], [p + "ctx"]),
+            ow.node("Transpose", [p + "ctx"], [p + "ctx2"],
+                    [ow.attr_ints("perm", [0, 2, 1, 3])]),
+            ow.node("Reshape", [p + "ctx2", "merge_shape"], [p + "ctx3"]),
+            ow.node("MatMul", [p + "ctx3", f"wo{l}"], [p + "ao0"]),
+            ow.node("Add", [p + "ao0", f"bo{l}"], [p + "ao"]),
+            ow.node("Add", [h, p + "ao"], [p + "res1"]),
+            ow.node("LayerNormalization",
+                    [p + "res1", f"g1{l}", f"be1{l}"], [p + "ln1"],
+                    [ow.attr_int("axis", -1), ow.attr_float("epsilon", 1e-5)]),
+            ow.node("MatMul", [p + "ln1", f"w1{l}"], [p + "f0"]),
+            ow.node("Add", [p + "f0", f"bf1{l}"], [p + "f1"]),
+            # erf-decomposed exact GELU, the classic exporter pattern.
+            ow.node("Div", [p + "f1", "sqrt2"], [p + "gd"]),
+            ow.node("Erf", [p + "gd"], [p + "ge"]),
+            ow.node("Add", [p + "ge", "one"], [p + "g1p"]),
+            ow.node("Mul", [p + "f1", p + "g1p"], [p + "gm"]),
+            ow.node("Mul", [p + "gm", "half"], [p + "gel"]),
+            ow.node("MatMul", [p + "gel", f"w2{l}"], [p + "f2a"]),
+            ow.node("Add", [p + "f2a", f"bf2{l}"], [p + "f2"]),
+            ow.node("Add", [p + "ln1", p + "f2"], [p + "res2"]),
+            ow.node("LayerNormalization",
+                    [p + "res2", f"g2{l}", f"be2{l}"], [p + "h"],
+                    [ow.attr_int("axis", -1), ow.attr_float("epsilon", 1e-5)]),
+        ]
+        h = p + "h"
+    nodes += [
+        ow.node("ReduceMean", [h], ["pooled"],
+                [ow.attr_ints("axes", [1]), ow.attr_int("keepdims", 0)]),
+        ow.node("Gemm", ["pooled", "wc", "bc"], ["output"],
+                [ow.attr_int("transB", 1)]),
+    ]
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", SEQ]),
+                    ow.value_info("output", ["N", CLASSES]))
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+@pytest.fixture(scope="module")
+def bert_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("onnx_tr") / "mini_bert.onnx")
+    w = _weights(np.random.default_rng(11))
+    _export_minibert(w, path)
+    # Token ids in [1, VOCAB) with trailing PAD (=0) on some rows — the
+    # Where mask must actually change the answer for short rows.
+    rng = np.random.default_rng(12)
+    ids = rng.integers(1, VOCAB, (4, SEQ)).astype(np.float32)
+    ids[1, 10:] = 0.0
+    ids[3, 5:] = 0.0
+    golden = torch_golden(w, ids)
+    return path, w, ids, golden
+
+
+def test_parse_transformer_graph(bert_file):
+    path, _, _, _ = bert_file
+    g = parse_onnx(path)
+    assert g.input_shape == (0, SEQ)
+    ops = {n.op_type for n in g.nodes}
+    assert {"Cast", "Equal", "Unsqueeze", "Where", "Gather", "Slice",
+            "Split", "Erf", "LayerNormalization", "ReduceMean"} <= ops
+
+
+def test_minibert_matches_torch_golden(bert_file):
+    path, _, ids, golden = bert_file
+    spec, params = build_onnx_model(path)
+    assert spec.input_shape == (SEQ,)
+    assert spec.output_shape == (CLASSES,)
+    out = np.asarray(spec.apply(params, ids))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)
+
+
+def test_padding_mask_is_live(bert_file):
+    """Changing a PAD token's id must not change a fully-attended row, but
+    un-padding it must — i.e. the Equal/Where mask is functional, not
+    decorative."""
+    path, w, ids, _ = bert_file
+    spec, params = build_onnx_model(path)
+    base = np.asarray(spec.apply(params, ids))
+    toggled = ids.copy()
+    toggled[1, 12] = 9.0  # was PAD → now a real token
+    out = np.asarray(spec.apply(params, toggled))
+    assert not np.allclose(base[1], out[1], atol=1e-5)
+    assert np.allclose(base[0], out[0], atol=1e-6)  # other rows untouched
+    np.testing.assert_allclose(out[1], torch_golden(w, toggled)[1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_worker_serves_onnx_transformer_end_to_end(bert_file):
+    """``worker_node <port> <id> mini_bert.onnx`` semantics: an attention
+    graph through the generic path, batched on the engine's buckets."""
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    path, _, ids, golden = bert_file
+    w = WorkerNode(WorkerConfig(model="onnx", model_path=path,
+                                dtype="float32", batch_buckets=(1, 2, 4)))
+    try:
+        for r in range(2):
+            resp = w.handle_infer({"request_id": f"bert_{r}",
+                                   "input_data": ids[r].tolist()})
+            np.testing.assert_allclose(np.asarray(resp["output_data"]),
+                                       golden[r], rtol=1e-4, atol=1e-4)
+            assert resp["cached"] is False
+        # Short input zero-pads on device: zeros ARE the PAD id, so the
+        # graph's own mask covers the tail (reference predict :100-103).
+        short = w.handle_infer({"request_id": "bert_s",
+                                "input_data": ids[3, :5].tolist()})
+        np.testing.assert_allclose(np.asarray(short["output_data"]),
+                                   golden[3], rtol=1e-4, atol=1e-4)
+    finally:
+        w.batch_processor.stop()
