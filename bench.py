@@ -80,11 +80,9 @@ def record_partial(name: str, data) -> None:
 
 
 def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from tpu_engine.utils.net import free_port as _fp
+
+    return _fp()
 
 
 def wait_ready(port: int, timeout_s: float = 600.0) -> None:

@@ -29,11 +29,9 @@ REFERENCE_BENCH = "/root/reference/benchmark.py"
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from tpu_engine.utils.net import free_port
+
+    return free_port()
 
 
 def _child_env() -> dict:

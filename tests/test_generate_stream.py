@@ -18,11 +18,9 @@ import pytest
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from tpu_engine.utils.net import free_port
+
+    return free_port()
 
 
 def _post(port: int, path: str, payload: dict, timeout=120):

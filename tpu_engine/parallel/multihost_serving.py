@@ -1,0 +1,180 @@
+"""Multi-host mesh serving: one HTTP front, SPMD execution across hosts.
+
+The reference spans hosts at the REQUEST level — its gateway holds an
+``httplib::Client`` per worker process and re-serializes every float
+array as JSON twice on the way to the chip
+(``/root/reference/src/gateway.cpp:29-34``). The TPU-native equivalent
+keeps HTTP only at the client edge: the model itself spans hosts on one
+``jax.sharding.Mesh`` whose leading axis crosses DCN (see
+``parallel/distributed.hybrid_mesh``), and each inference is ONE jitted
+SPMD program — XLA inserts the DCN/ICI collectives; no JSON ever crosses
+the host boundary.
+
+Multi-controller JAX requires every process to enter every computation,
+so serving is a *lockstep* loop: process 0 owns the HTTP front and
+broadcasts a (command, batch) tick to all processes
+(``multihost_utils.broadcast_one_to_all`` — itself an XLA collective
+riding the same DCN); every process then executes the identical jitted
+forward on the global mesh. Followers block in the broadcast until the
+leader ticks — no polling traffic, no timeout races.
+
+Wire contract matches the single-host worker: ``POST /infer``
+{request_id, input_data} → {request_id, output_data, node_id, cached,
+inference_time_us} (reference ``worker_node.cpp:75-82`` schema).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CMD_IDLE, CMD_INFER, CMD_STOP = 0.0, 1.0, 2.0
+
+
+@dataclass
+class _Pending:
+    x: np.ndarray
+    n: int
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Optional[np.ndarray] = None
+
+
+class LockstepMeshServer:
+    """Serve a mesh-sharded model from N cooperating processes.
+
+    Every process constructs this with the SAME mesh/params and calls
+    ``run()``; the process-0 caller passes ``http_port`` to open the
+    front. ``run`` returns on ``POST /admin/stop`` (or ``stop()`` on the
+    leader). Batch capacity is the data-axis size — one row per data
+    shard; short batches zero-pad (device-side, like the engine)."""
+
+    def __init__(self, mesh: Mesh, apply_fn, params,
+                 sample_shape: Sequence[int], dtype=jnp.float32):
+        self.mesh = mesh
+        self.params = params
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+        self._data_axis = mesh.axis_names[0]
+        self.batch = int(mesh.shape[self._data_axis])
+        self._x_sharding = NamedSharding(
+            mesh, P(self._data_axis, *[None] * len(self.sample_shape)))
+        # Output fully replicated: addressable on every host, so the
+        # leader can answer without a second gather step.
+        self._fwd = jax.jit(
+            lambda p, x: apply_fn(p, x, dtype=dtype),
+            out_shardings=NamedSharding(mesh, P()))
+        self._payload = self.batch * int(np.prod(self.sample_shape))
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+
+    # -- leader-side HTTP handlers -------------------------------------------
+
+    def _handle_infer(self, body):
+        flat = np.asarray(body["input_data"], np.float32).ravel()
+        want = int(np.prod(self.sample_shape))
+        if flat.size > want:
+            flat = flat[:want]          # reference predict truncates long
+        elif flat.size < want:          # ... and zero-pads short (:100-103)
+            flat = np.pad(flat, (0, want - flat.size))
+        x = np.zeros((self.batch,) + self.sample_shape, np.float32)
+        x[0] = flat.reshape(self.sample_shape)
+        item = _Pending(x=x, n=1)
+        t0 = time.perf_counter()
+        self._q.put(item)
+        if not item.event.wait(timeout=300.0):
+            return 500, {"error": "lockstep tick timed out"}
+        if item.result is None:  # drained by shutdown before execution
+            return 503, {"error": "server stopping"}
+        return 200, {
+            "request_id": body.get("request_id", ""),
+            "output_data": item.result[0].ravel().tolist(),
+            "node_id": f"mesh_host_{jax.process_index()}",
+            "cached": False,
+            "inference_time_us": int((time.perf_counter() - t0) * 1e6),
+        }
+
+    def _handle_stop(self, _body):
+        self._stop.set()
+        return 200, {"ok": True}
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- the lockstep loop ----------------------------------------------------
+
+    def _payload_buf(self, item: Optional[_Pending]) -> np.ndarray:
+        buf = np.zeros((1 + self._payload,), np.float32)
+        if item is not None:
+            buf[0] = item.n
+            buf[1:] = item.x.ravel()
+        return buf
+
+    def run(self, http_port: Optional[int] = None,
+            poll_s: float = 0.02) -> None:
+        is_leader = jax.process_index() == 0
+        server = None
+        if is_leader and http_port is not None:
+            from tpu_engine.serving.http import JsonHttpServer
+
+            server = JsonHttpServer(http_port, host="127.0.0.1")
+            server.route("POST", "/infer", self._handle_infer)
+            server.route("POST", "/admin/stop", self._handle_stop)
+            server.route("GET", "/health", lambda _b: (200, {
+                "healthy": True, "node_id": "mesh_host_0",
+                "processes": jax.process_count(),
+                "mesh": dict(self.mesh.shape)}))
+            server.start(background=True)
+        try:
+            while True:
+                # Two-phase tick: a 1-float command word every poll, the
+                # batch payload ONLY on CMD_INFER — an idle server costs
+                # 4 bytes/tick of DCN, not the whole batch buffer.
+                item = None
+                if is_leader:
+                    if self._stop.is_set():
+                        cmd_buf = np.asarray([CMD_STOP], np.float32)
+                    else:
+                        try:
+                            item = self._q.get(timeout=poll_s)
+                            cmd_buf = np.asarray([CMD_INFER], np.float32)
+                        except queue.Empty:
+                            cmd_buf = np.asarray([CMD_IDLE], np.float32)
+                else:
+                    cmd_buf = np.zeros((1,), np.float32)
+                cmd = float(np.asarray(
+                    multihost_utils.broadcast_one_to_all(cmd_buf))[0])
+                if cmd == CMD_STOP:
+                    break
+                if cmd != CMD_INFER:
+                    continue
+                buf = np.asarray(multihost_utils.broadcast_one_to_all(
+                    self._payload_buf(item)))
+                n = int(buf[0])
+                x = buf[1:].reshape((self.batch,) + self.sample_shape)
+                xg = jax.make_array_from_callback(
+                    x.shape, self._x_sharding, lambda idx: x[idx])
+                out = np.asarray(self._fwd(self.params, xg))
+                if item is not None:  # only the leader holds the waiter
+                    item.result = out[:n]
+                    item.event.set()
+        finally:
+            # Requests that queued around the stop must fail fast, not
+            # sit in event.wait() until the HTTP drain severs them.
+            while True:
+                try:
+                    orphan = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                orphan.result = None
+                orphan.event.set()
+            if server is not None:
+                server.stop()

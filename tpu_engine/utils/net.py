@@ -1,0 +1,30 @@
+"""Small networking helpers shared by the bench harness, tests, and
+multi-process launch code."""
+
+from __future__ import annotations
+
+import socket
+from typing import List
+
+
+def free_ports(n: int = 1) -> List[int]:
+    """n distinct ephemeral ports. All probe sockets stay open until every
+    port is allocated — closing between probes lets the kernel hand the
+    same port back twice (the classic close-then-reuse TOCTOU). The
+    remaining race (another process grabbing a port after close) is
+    unavoidable without SO_REUSEPORT handoff; callers should bind
+    promptly."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def free_port() -> int:
+    return free_ports(1)[0]
