@@ -28,7 +28,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Optional, Sequence
 
 BASELINE_REQ_S = 522.64  # reference README.md:283 (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -623,7 +623,17 @@ def run_spec_ab(model: str = "gpt2", batch: int = 8, max_new: int = 64,
 
     results = {"model": model, "batch": batch, "max_new_tokens": max_new,
                "k": k, "plain_batch": plain_r}
+    from tpu_engine.ops.quant import quantize_params
+
+    # int8_self_draft is the deployable no-second-checkpoint draft: the
+    # TARGET's weights quantized int8 draft the bf16 target. The draft
+    # step reads half the weight HBM bytes (decode is weight-bound on
+    # chip) yet almost never flips the argmax, so acceptance stays near
+    # k+1 — a real speedup, unlike the same-cost self_draft upper bound
+    # or the random floor (VERDICT r4 weak item 3).
     drafts = [("self_draft", spec, params),
+              ("int8_self_draft", create_model(model),
+               quantize_params(params)),
               ("random_distilgpt2", create_model("distilgpt2"), None)
               if model == "gpt2" else
               ("random_same_arch", create_model(model), None)]
@@ -639,6 +649,127 @@ def run_spec_ab(model: str = "gpt2", batch: int = 8, max_new: int = 64,
             r["tokens_per_s"] / max(plain_r["tokens_per_s"], 1e-9), 3)
         results[name] = r
     return results
+
+
+def run_prefill_mfu(model: str = "gpt2", batch: int = 8, seq: int = 1024,
+                    iters: int = 10, dtype: str = "bfloat16") -> dict:
+    """Transformer-prefill MFU — the matmul-dense flagship (VERDICT r4
+    item 2's alternative): prefill is back-to-back (B*S, d) x (d, *)
+    matmuls, the shape the MXU was built for, where a CNN's small-channel
+    early convs are not. Pure device loop (inputs pre-staged, one hard
+    sync at the end), FLOPs from XLA's own cost analysis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.models.transformer import init_caches, transformer_prefill
+
+    _ensure_builtin_models_imported()
+    spec = create_model(model, max_seq=seq)
+    cfg = spec.config
+    dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype]
+    params = spec.init(jax.random.PRNGKey(0))
+
+    def prefill(p, tokens, caches):
+        return transformer_prefill(p, tokens, caches, cfg, dtype=dt)
+
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        1, cfg.vocab, (batch, seq)), jnp.int32)
+    caches = init_caches(cfg, batch, seq, dt)
+    t0 = time.perf_counter()
+    exe = jax.jit(prefill).lower(params, tokens, caches).compile()
+    compile_s = time.perf_counter() - t0
+    flops = None
+    try:
+        ca = exe.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception as exc:
+        log(f"cost_analysis unavailable: {exc}")
+
+    logits, _ = exe(params, tokens, caches)
+    _ = np.asarray(logits).ravel()[:1]  # hard sync (warm)
+    t0 = time.perf_counter()
+    for _k in range(iters):
+        logits, _ = exe(params, tokens, caches)
+    _ = np.asarray(logits).ravel()[:1]
+    step_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    kind, peak = chip_peak_flops()
+    achieved = flops / (step_ms / 1e3) if flops else None
+    return {
+        "model": model, "batch": batch, "seq": seq, "dtype": dtype,
+        "device_kind": kind,
+        "compile_s": round(compile_s, 2),
+        "device_step_ms": round(step_ms, 3),
+        "prefill_tokens_per_s": round(batch * seq / (step_ms / 1e3), 1),
+        "flops_per_step": flops,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+    }
+
+
+def run_longcontext_prefill(model: str = "gpt2",
+                            seqs: Sequence[int] = (4096, 8192),
+                            batch: int = 1, iters: int = 5,
+                            xla_arm_max_seq: int = 4096) -> dict:
+    """Long-context serving proof (VERDICT r4 item 7): gpt2 wired through
+    the GENERATOR's flash prefill at S4k-8k — the sequences whose S^2
+    score temps kill the unfused path. Measures prefill tok/s through the
+    real serving entry (Generator.generate, prompt-bucketed, two decode
+    steps so the path is the production one, prefill dominating). The XLA
+    arm (TPU_ENGINE_FLASH=0) runs only to `xla_arm_max_seq` — at S8192 it
+    cannot compile on a 16 GB chip (44 GB of S^2 temps, PERF.md)."""
+    import os
+
+    import numpy as np
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.generator import Generator
+
+    _ensure_builtin_models_imported()
+    max_seq = max(seqs)
+    rng = np.random.default_rng(3)
+    out: dict = {"model": model, "batch": batch}
+    prior_flash = os.environ.get("TPU_ENGINE_FLASH")  # restore, don't pop:
+    # clobbering a caller-forced mode would silently change attention
+    # selection for every stage that runs after this one.
+    for attn, label in (("auto", "flash"), ("0", "xla")):
+        os.environ["TPU_ENGINE_FLASH"] = attn
+        try:
+            # Fresh generator per arm: the attention choice is baked at
+            # trace time.
+            spec = create_model(model, max_seq=max_seq)
+            gen = Generator(spec, dtype="bfloat16", batch_buckets=(batch,),
+                            prompt_buckets=tuple(seqs), max_seq=max_seq)
+            for s in seqs:
+                if label == "xla" and s > xla_arm_max_seq:
+                    out[f"xla_S{s}"] = "skipped: S^2 temps exceed HBM"
+                    continue
+                plen = s - 2  # prompt bucket s, two decode steps inside it
+                prompts = [[int(t) for t in rng.integers(1, 1000, plen)]
+                           for _ in range(batch)]
+                t0 = time.perf_counter()
+                gen.generate(prompts, max_new_tokens=2)  # compile + warm
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _k in range(iters):
+                    gen.generate(prompts, max_new_tokens=2)
+                wall = (time.perf_counter() - t0) / iters
+                out[f"{label}_S{s}"] = {
+                    "prefill_tokens_per_s": round(batch * plen / wall, 1),
+                    "wall_s": round(wall, 3),
+                    "compile_s": round(compile_s, 2),
+                }
+        finally:
+            if prior_flash is None:
+                os.environ.pop("TPU_ENGINE_FLASH", None)
+            else:
+                os.environ["TPU_ENGINE_FLASH"] = prior_flash
+    return out
 
 
 def run_mixed_shape_bench(port: int, n_requests: int = 2000,

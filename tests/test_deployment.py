@@ -170,8 +170,10 @@ def test_two_process_worker_failover_and_recovery():
     """Gateway + 2 worker processes over real TCP; kill one worker, traffic
     keeps flowing through ring-order failover; restart it, the breaker
     half-opens and re-closes (reference README.md:322-349 scenario)."""
+    from tpu_engine.utils.net import free_ports
+
     env = _child_env()
-    p1, p2, pg = _free_port(), _free_port(), _free_port()
+    p1, p2, pg = free_ports(3)
     w1 = _spawn(["worker_node", str(p1), "w1", "mlp"], env)
     w2 = _spawn(["worker_node", str(p2), "w2", "mlp"], env)
     gw = None

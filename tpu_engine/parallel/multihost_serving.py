@@ -43,8 +43,7 @@ CMD_IDLE, CMD_INFER, CMD_STOP = 0.0, 1.0, 2.0
 
 @dataclass
 class _Pending:
-    x: np.ndarray
-    n: int
+    x: np.ndarray  # one sample, sample_shape
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[np.ndarray] = None
 
@@ -79,15 +78,15 @@ class LockstepMeshServer:
     # -- leader-side HTTP handlers -------------------------------------------
 
     def _handle_infer(self, body):
+        if self._stop.is_set():
+            return 503, {"error": "server stopping"}
         flat = np.asarray(body["input_data"], np.float32).ravel()
         want = int(np.prod(self.sample_shape))
         if flat.size > want:
             flat = flat[:want]          # reference predict truncates long
         elif flat.size < want:          # ... and zero-pads short (:100-103)
             flat = np.pad(flat, (0, want - flat.size))
-        x = np.zeros((self.batch,) + self.sample_shape, np.float32)
-        x[0] = flat.reshape(self.sample_shape)
-        item = _Pending(x=x, n=1)
+        item = _Pending(x=flat.reshape(self.sample_shape))
         t0 = time.perf_counter()
         self._q.put(item)
         if not item.event.wait(timeout=300.0):
@@ -96,7 +95,7 @@ class LockstepMeshServer:
             return 503, {"error": "server stopping"}
         return 200, {
             "request_id": body.get("request_id", ""),
-            "output_data": item.result[0].ravel().tolist(),
+            "output_data": item.result.ravel().tolist(),
             "node_id": f"mesh_host_{jax.process_index()}",
             "cached": False,
             "inference_time_us": int((time.perf_counter() - t0) * 1e6),
@@ -111,11 +110,14 @@ class LockstepMeshServer:
 
     # -- the lockstep loop ----------------------------------------------------
 
-    def _payload_buf(self, item: Optional[_Pending]) -> np.ndarray:
+    def _payload_buf(self, items) -> np.ndarray:
         buf = np.zeros((1 + self._payload,), np.float32)
-        if item is not None:
-            buf[0] = item.n
-            buf[1:] = item.x.ravel()
+        if items:
+            buf[0] = len(items)
+            x = np.zeros((self.batch,) + self.sample_shape, np.float32)
+            for i, it in enumerate(items):
+                x[i] = it.x
+            buf[1:] = x.ravel()
         return buf
 
     def run(self, http_port: Optional[int] = None,
@@ -138,16 +140,23 @@ class LockstepMeshServer:
                 # Two-phase tick: a 1-float command word every poll, the
                 # batch payload ONLY on CMD_INFER — an idle server costs
                 # 4 bytes/tick of DCN, not the whole batch buffer.
-                item = None
+                items = []
                 if is_leader:
                     if self._stop.is_set():
                         cmd_buf = np.asarray([CMD_STOP], np.float32)
                     else:
                         try:
-                            item = self._q.get(timeout=poll_s)
-                            cmd_buf = np.asarray([CMD_INFER], np.float32)
+                            items.append(self._q.get(timeout=poll_s))
+                            # Coalesce: each concurrent request takes a
+                            # data-shard row of the SAME tick — one DCN
+                            # broadcast + one SPMD dispatch for up to
+                            # `batch` requests, not one each.
+                            while len(items) < self.batch:
+                                items.append(self._q.get_nowait())
                         except queue.Empty:
-                            cmd_buf = np.asarray([CMD_IDLE], np.float32)
+                            pass
+                        cmd_buf = np.asarray(
+                            [CMD_INFER if items else CMD_IDLE], np.float32)
                 else:
                     cmd_buf = np.zeros((1,), np.float32)
                 cmd = float(np.asarray(
@@ -157,24 +166,33 @@ class LockstepMeshServer:
                 if cmd != CMD_INFER:
                     continue
                 buf = np.asarray(multihost_utils.broadcast_one_to_all(
-                    self._payload_buf(item)))
-                n = int(buf[0])
+                    self._payload_buf(items)))
                 x = buf[1:].reshape((self.batch,) + self.sample_shape)
                 xg = jax.make_array_from_callback(
                     x.shape, self._x_sharding, lambda idx: x[idx])
                 out = np.asarray(self._fwd(self.params, xg))
-                if item is not None:  # only the leader holds the waiter
-                    item.result = out[:n]
-                    item.event.set()
+                for i, it in enumerate(items):  # leader-only waiters
+                    it.result = out[i]
+                    it.event.set()
         finally:
-            # Requests that queued around the stop must fail fast, not
-            # sit in event.wait() until the HTTP drain severs them.
-            while True:
-                try:
-                    orphan = self._q.get_nowait()
-                except queue.Empty:
-                    break
-                orphan.result = None
-                orphan.event.set()
+            self._stop.set()  # handlers now 503 before enqueueing
+
+            def drain():
+                while True:
+                    try:
+                        orphan = self._q.get_nowait()
+                    except queue.Empty:
+                        return
+                    orphan.result = None
+                    orphan.event.set()
+
+            # Requests that raced the stop (enqueued before the 503 guard
+            # saw the flag) must fail fast, not sit in event.wait() until
+            # the HTTP drain severs them. Drain before server.stop() so
+            # in-flight handlers answer 503 over live connections, and
+            # again after — once the listener is down no producer remains,
+            # so the second drain is final.
+            drain()
             if server is not None:
                 server.stop()
+            drain()

@@ -144,6 +144,12 @@ def main(argv=None) -> int:
         parser.add_argument("--shape-buckets", default=None,
                             help="mixed-shape serving: comma-separated HxWxC "
                                  "list, e.g. 320x320x3,640x640x3")
+        parser.add_argument("--batch-buckets", default=None,
+                            help="comma-separated batch sizes to compile, "
+                                 "e.g. 1,8,32,128 (default 1..32; larger "
+                                 "buckets raise MFU on throughput-bound "
+                                 "fleets — batch 32 is the reference "
+                                 "batcher's cap, not the chip's)")
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
@@ -198,7 +204,14 @@ def main(argv=None) -> int:
             buckets = tuple(
                 tuple(int(d) for d in s.split("x"))
                 for s in args.shape_buckets.split(","))
-        worker_config = WorkerConfig(shape_buckets=buckets,
+        bb_kw = {}
+        if args.batch_buckets:
+            bb_kw["batch_buckets"] = tuple(
+                int(b) for b in args.batch_buckets.split(","))
+            # The batcher flushes at the largest bucket — otherwise a
+            # bigger compiled bucket could never fill.
+            bb_kw["max_batch_size"] = max(bb_kw["batch_buckets"])
+        worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,
                                      gen_draft_path=args.gen_draft_path,
