@@ -132,3 +132,48 @@ def test_lru_rejects_non_bytes_keys():
         c.put("str-key", 1)
     with pytest.raises(TypeError):
         c.get(123)
+
+
+def test_json_encode_f32_roundtrips():
+    """The native %.6g output encoder (miss-path response fragments): six
+    significant digits round-trip within 1e-5 relative — beyond bf16's own
+    noise — and non-finite values spell exactly what json.dumps emits, so
+    json.loads round-trips them."""
+    import json
+
+    import numpy as np
+
+    a = np.random.default_rng(1).standard_normal(257).astype(np.float32)
+    a *= np.float32(10.0) ** np.random.default_rng(2).integers(-8, 8, 257)
+    frag = native.json_encode_f32(a)
+    if frag is None:  # a pre-symbol libtpucore.so: rebuild to pick it up
+        pytest.skip("libtpucore.so predates tpu_json_encode_f32")
+    back = np.asarray(json.loads(frag), np.float32)
+    rel = np.max(np.abs(back - a) / (np.abs(a) + 1e-30))
+    assert rel < 1e-5, rel
+
+    weird = np.asarray([np.nan, np.inf, -np.inf, 0.0, -0.0, 1e-38, 3e38],
+                       np.float32)
+    got = json.loads(native.json_encode_f32(weird))
+    assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf
+    assert native.json_encode_f32(np.zeros(0, np.float32)) == b"[]"
+
+
+def test_encode_output_fallback_is_full_precision(monkeypatch):
+    """Without the native encoder the worker falls back to the plain
+    full-precision json.dumps — small magnitudes must NOT round to zero
+    (decimal-place rounding would), so fallback and native deployments
+    stay within %.6g of each other on the wire."""
+    import json
+
+    import numpy as np
+
+    from tpu_engine.core import native as core_native
+    from tpu_engine.serving import worker as worker_mod
+
+    # _encode_output imports tpu_engine.core.native at call time — patch
+    # the module attribute it will resolve.
+    monkeypatch.setattr(core_native, "json_encode_f32", lambda _a: None)
+    a = np.asarray([1e-9, -2.5e-30, 3.25, 0.0], np.float32)
+    back = np.asarray(json.loads(worker_mod._encode_output(a)), np.float32)
+    np.testing.assert_array_equal(back, a)

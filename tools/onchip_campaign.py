@@ -187,6 +187,16 @@ def main() -> int:
             batch=1, iters=2 if q else 5,
             xla_arm_max_seq=64 if q else 4096)
 
+    @stage(artifact, out, "miss_path_sweep")
+    def _miss_sweep():
+        # Launches server subprocesses: LAST, after every in-process stage,
+        # so a server holding the (exclusive) chip can't starve them.
+        return bench.run_miss_path_sweep(
+            model="mlp" if q else "resnet50",
+            depths=(4,) if q else (4, 8, 16),
+            n_requests=300 if q else 3000,
+            n_threads=8 if q else 50)
+
     @stage(artifact, out, "decode")
     def _decode():
         return bench.run_decode_compute(model=model, **dk)
@@ -215,7 +225,7 @@ def main() -> int:
     # keeps everything already saved.
     for fn in (_flash_exact, _compute, _decode, _decode_fused, _decode_int8,
                _flash, _spec, _prefill_mfu, _compute_sweep, _longctx,
-               _decode_ab):
+               _decode_ab, _miss_sweep):
         fn()
     print("[campaign] done", flush=True)
     return 0

@@ -110,6 +110,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.tpu_front_reply2.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                          ctypes.c_char_p, c_size,
                                          ctypes.c_char_p]
+    if hasattr(lib, "tpu_json_encode_f32"):  # older .so: python fallback
+        lib.tpu_json_encode_f32.restype = c_size
+        lib.tpu_json_encode_f32.argtypes = [
+            ctypes.c_void_p, c_size, ctypes.POINTER(ctypes.c_void_p)]
     return lib
 
 
@@ -164,6 +168,25 @@ def _take_bytes(lib, ptr: ctypes.c_void_p, length: int) -> bytes:
         return ctypes.string_at(ptr, length)
     finally:
         lib.tpu_free(ptr)
+
+
+def json_encode_f32(arr) -> Optional[bytes]:
+    """``[a,b,...]`` JSON fragment for a float array via the C encoder
+    (%.6g, ~10x faster than json.dumps and GIL-free for the duration).
+    None when the native core (or the symbol, in an older .so) is absent —
+    callers fall back to a Python encode."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "tpu_json_encode_f32"):
+        return None
+    import numpy as np
+
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    out = ctypes.c_void_p()
+    length = lib.tpu_json_encode_f32(
+        a.ctypes.data_as(ctypes.c_void_p), a.size, ctypes.byref(out))
+    if not out:
+        return None  # allocation failure: let the Python path serve
+    return _take_bytes(lib, out, length)
 
 
 class NativeLRUCache:

@@ -71,7 +71,6 @@ class LockstepMeshServer:
         self._fwd = jax.jit(
             lambda p, x: apply_fn(p, x, dtype=dtype),
             out_shardings=NamedSharding(mesh, P()))
-        self._payload = self.batch * int(np.prod(self.sample_shape))
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
 
@@ -89,9 +88,19 @@ class LockstepMeshServer:
         item = _Pending(x=flat.reshape(self.sample_shape))
         t0 = time.perf_counter()
         self._q.put(item)
-        if not item.event.wait(timeout=300.0):
-            return 500, {"error": "lockstep tick timed out"}
-        if item.result is None:  # drained by shutdown before execution
+        # Poll instead of one long wait: a request that slips in between
+        # the stop flag and the shutdown drains must resolve itself (503)
+        # rather than hold the HTTP server's drain hostage for 10 s.
+        deadline = time.monotonic() + 300.0
+        while not item.event.wait(timeout=0.1):
+            if self._stop.is_set():
+                # One grace wait: the loop may still be executing our tick
+                # (or the shutdown drain is about to set the event).
+                item.event.wait(timeout=1.0)
+                break
+            if time.monotonic() > deadline:
+                return 500, {"error": "lockstep tick timed out"}
+        if item.result is None:  # drained (or abandoned) by shutdown
             return 503, {"error": "server stopping"}
         return 200, {
             "request_id": body.get("request_id", ""),
@@ -111,14 +120,12 @@ class LockstepMeshServer:
     # -- the lockstep loop ----------------------------------------------------
 
     def _payload_buf(self, items) -> np.ndarray:
-        buf = np.zeros((1 + self._payload,), np.float32)
-        if items:
-            buf[0] = len(items)
-            x = np.zeros((self.batch,) + self.sample_shape, np.float32)
-            for i, it in enumerate(items):
-                x[i] = it.x
-            buf[1:] = x.ravel()
-        return buf
+        # Rows land directly in the flat buffer; the leader resolves
+        # results from its local `items` list, so no count crosses hosts.
+        buf = np.zeros((self.batch,) + self.sample_shape, np.float32)
+        for i, it in enumerate(items):
+            buf[i] = it.x
+        return buf.ravel()
 
     def run(self, http_port: Optional[int] = None,
             poll_s: float = 0.02) -> None:
@@ -167,7 +174,7 @@ class LockstepMeshServer:
                     continue
                 buf = np.asarray(multihost_utils.broadcast_one_to_all(
                     self._payload_buf(items)))
-                x = buf[1:].reshape((self.batch,) + self.sample_shape)
+                x = buf.reshape((self.batch,) + self.sample_shape)
                 xg = jax.make_array_from_callback(
                     x.shape, self._x_sharding, lambda idx: x[idx])
                 out = np.asarray(self._fwd(self.params, xg))

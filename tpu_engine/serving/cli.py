@@ -150,6 +150,11 @@ def main(argv=None) -> int:
                                  "buckets raise MFU on throughput-bound "
                                  "fleets — batch 32 is the reference "
                                  "batcher's cap, not the chip's)")
+        parser.add_argument("--pipeline-depth", type=int, default=None,
+                            help="submitted batches kept in flight on the "
+                                 "miss path (default 4); raise when the "
+                                 "dispatch round-trip dwarfs the device "
+                                 "step (high-latency links)")
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
@@ -211,6 +216,8 @@ def main(argv=None) -> int:
             # The batcher flushes at the largest bucket — otherwise a
             # bigger compiled bucket could never fill.
             bb_kw["max_batch_size"] = max(bb_kw["batch_buckets"])
+        if args.pipeline_depth is not None:
+            bb_kw["pipeline_depth"] = args.pipeline_depth
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,
