@@ -116,6 +116,35 @@ def main() -> int:
             res[key] = entry
         return res
 
+    @stage(artifact, out, "host_microbench")
+    def _host_micro():
+        # Host-side numbers PERF.md cites (no device involved; measured
+        # here so they live in a committed artifact, per DESIGN.md's
+        # honesty rules): response-fragment encode, native vs json.dumps.
+        import json as _json
+        import numpy as np
+
+        from tpu_engine.core import native
+
+        a = np.random.default_rng(0).standard_normal(1000).astype(np.float32)
+        res = {}
+
+        def best_us(fn, n=300):
+            fn()
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            return round((time.perf_counter() - t0) / n * 1e6, 1)
+
+        res["json_dumps_us_per_1000f"] = best_us(
+            lambda: _json.dumps(a.tolist()).encode())
+        if native.json_encode_f32(a) is not None:
+            res["native_encode_us_per_1000f"] = best_us(
+                lambda: native.json_encode_f32(a))
+            res["note"] = ("native runs with the GIL released; json.dumps "
+                           "holds it for the full duration")
+        return res
+
     @stage(artifact, out, "flash_exactness")
     def _flash_exact():
         # Streamed-K on-chip exactness at the long sequences that motivate
@@ -223,9 +252,9 @@ def main() -> int:
 
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
-    for fn in (_flash_exact, _compute, _decode, _decode_fused, _decode_int8,
-               _flash, _spec, _prefill_mfu, _compute_sweep, _longctx,
-               _decode_ab, _miss_sweep):
+    for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
+               _decode_int8, _flash, _spec, _prefill_mfu, _compute_sweep,
+               _longctx, _decode_ab, _miss_sweep):
         fn()
     print("[campaign] done", flush=True)
     return 0
