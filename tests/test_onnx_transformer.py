@@ -239,3 +239,128 @@ def test_worker_serves_onnx_transformer_end_to_end(bert_file):
                                    golden[3], rtol=1e-4, atol=1e-4)
     finally:
         w.batch_processor.stop()
+
+
+# -- GPT-class (causal decoder) graph -----------------------------------------
+# BASELINE config 5 names a GPT-2 ONNX model. HF's exporter materializes
+# the causal mask as a (1, 1, max_pos, max_pos) tril 'bias' initializer
+# that each layer Slices to the live sequence and feeds through Where —
+# exactly the pattern emitted here. One layer suffices to prove the op
+# path (the stack is the mini-BERT's, already covered above).
+
+def torch_gpt_golden(w: dict, ids_f32: np.ndarray) -> np.ndarray:
+    t = {k: torch.from_numpy(v) for k, v in w.items()}
+    ids = torch.from_numpy(ids_f32).long()
+    B = ids.shape[0]
+    h = t["wte"][ids] + t["wpe"][:SEQ]
+    ln = torch.nn.functional.layer_norm(h, (HID,), t["g10"], t["be10"], 1e-5)
+    qkv = ln @ t["wqkv0"] + t["bqkv0"]
+    q, k, v = qkv.split(HID, dim=-1)
+    q = q.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+    k = k.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+    v = v.reshape(B, SEQ, HEADS, HEAD_DIM).permute(0, 2, 1, 3)
+    scores = (q @ k.transpose(-1, -2)) * (HEAD_DIM ** -0.5)
+    causal = torch.tril(torch.ones(SEQ, SEQ, dtype=torch.bool))
+    scores = torch.where(causal[None, None], scores, torch.tensor(-1e9))
+    ctx = (torch.softmax(scores, dim=-1) @ v).permute(0, 2, 1, 3)
+    h = h + ctx.reshape(B, SEQ, HID) @ t["wo0"] + t["bo0"]
+    h = torch.nn.functional.layer_norm(h, (HID,), t["g20"], t["be20"], 1e-5)
+    return (h @ t["wte"].T).numpy()  # tied-embedding LM head, (B, S, V)
+
+
+def _export_minigpt(w: dict, path: str) -> None:
+    inits = {k: w[k] for k in
+             ("wte", "wpe", "wqkv0", "bqkv0", "wo0", "bo0",
+              "g10", "be10", "g20", "be20")}
+    inits.update({
+        # HF-style causal bias buffer: tril over the FULL max positions;
+        # layers slice the live (SEQ, SEQ) window out of it.
+        "bias": np.tril(np.ones((1, 1, 2 * SEQ, 2 * SEQ), np.float32)),
+        "b_start": np.asarray([0, 0], np.int64),
+        "b_end": np.asarray([SEQ, SEQ], np.int64),
+        "b_axes": np.asarray([2, 3], np.int64),
+        "one_f": np.asarray(1.0, np.float32),
+        "neg": np.asarray(-1e9, np.float32),
+        "scale": np.asarray(HEAD_DIM ** -0.5, np.float32),
+        "split_shape": np.asarray([0, 0, HEADS, HEAD_DIM], np.int64),
+        "merge_shape": np.asarray([0, 0, HID], np.int64),
+        "pos_start": np.asarray([0], np.int64),
+        "pos_end": np.asarray([SEQ], np.int64),
+        "pos_axis": np.asarray([0], np.int64),
+    })
+    nodes = [
+        ow.node("Cast", ["input"], ["ids"], [ow.attr_int("to", 7)]),
+        ow.node("Gather", ["wte", "ids"], ["emb"], [ow.attr_int("axis", 0)]),
+        ow.node("Slice", ["wpe", "pos_start", "pos_end", "pos_axis"],
+                ["pos"]),  # opset-10+ input form, like real exports
+        ow.node("Add", ["emb", "pos"], ["h0"]),
+        ow.node("LayerNormalization", ["h0", "g10", "be10"], ["ln1"],
+                [ow.attr_int("axis", -1), ow.attr_float("epsilon", 1e-5)]),
+        ow.node("MatMul", ["ln1", "wqkv0"], ["qkv0"]),
+        ow.node("Add", ["qkv0", "bqkv0"], ["qkv"]),
+        ow.node("Split", ["qkv"], ["q", "k", "v"],
+                [ow.attr_int("axis", -1),
+                 ow.attr_ints("split", [HID, HID, HID])]),
+    ]
+    for t in ("q", "k", "v"):
+        nodes += [
+            ow.node("Reshape", [t, "split_shape"], [t + "4"]),
+            ow.node("Transpose", [t + "4"], [t + "h"],
+                    [ow.attr_ints("perm", [0, 2, 1, 3])]),
+        ]
+    nodes += [
+        ow.node("Transpose", ["kh"], ["kt"],
+                [ow.attr_ints("perm", [0, 1, 3, 2])]),
+        ow.node("MatMul", ["qh", "kt"], ["sc0"]),
+        ow.node("Mul", ["sc0", "scale"], ["sc1"]),
+        # HF-exporter causal mask: Slice the tril bias buffer to the live
+        # window, compare against 1.0 -> bool, Where(-1e9).
+        ow.node("Slice", ["bias", "b_start", "b_end", "b_axes"], ["bwin"]),
+        ow.node("Equal", ["bwin", "one_f"], ["allow"]),
+        ow.node("Where", ["allow", "sc1", "neg"], ["sc"]),
+        ow.node("Softmax", ["sc"], ["pr"], [ow.attr_int("axis", -1)]),
+        ow.node("MatMul", ["pr", "vh"], ["ctx"]),
+        ow.node("Transpose", ["ctx"], ["ctx2"],
+                [ow.attr_ints("perm", [0, 2, 1, 3])]),
+        ow.node("Reshape", ["ctx2", "merge_shape"], ["ctx3"]),
+        ow.node("MatMul", ["ctx3", "wo0"], ["ao0"]),
+        ow.node("Add", ["ao0", "bo0"], ["ao"]),
+        ow.node("Add", ["h0", "ao"], ["res"]),
+        ow.node("LayerNormalization", ["res", "g20", "be20"], ["hf"],
+                [ow.attr_int("axis", -1), ow.attr_float("epsilon", 1e-5)]),
+        # Tied-embedding LM head: logits = h @ wte.T (Transpose + MatMul,
+        # the exporter's standard tie pattern).
+        ow.node("Transpose", ["wte"], ["wteT"],
+                [ow.attr_ints("perm", [1, 0])]),
+        ow.node("MatMul", ["hf", "wteT"], ["output"]),
+    ]
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", SEQ]),
+                    ow.value_info("output", ["N", SEQ, VOCAB]))
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def test_minigpt_causal_onnx_matches_torch(tmp_path):
+    """GPT-class causal decoder through the generic path (BASELINE config
+    5): full (B, S, V) logits match torch, and the Where-based causal
+    mask is live — changing a FUTURE token must not change earlier
+    positions' logits."""
+    w = _weights(np.random.default_rng(21))
+    path = str(tmp_path / "mini_gpt.onnx")
+    _export_minigpt(w, path)
+    spec, params = build_onnx_model(path)
+    assert spec.output_shape == (SEQ, VOCAB)
+    ids = np.random.default_rng(22).integers(1, VOCAB, (2, SEQ)
+                                             ).astype(np.float32)
+    golden = torch_gpt_golden(w, ids)
+    out = np.asarray(spec.apply(params, ids))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)
+
+    # Causality: perturb the LAST token; logits at positions < SEQ-1 must
+    # be bitwise-stable, the last position's must move.
+    toggled = ids.copy()
+    toggled[0, -1] = (toggled[0, -1] % (VOCAB - 1)) + 1
+    out2 = np.asarray(spec.apply(params, toggled))
+    np.testing.assert_array_equal(out[0, :-1], out2[0, :-1])
+    assert not np.allclose(out[0, -1], out2[0, -1], atol=1e-6)
