@@ -30,6 +30,29 @@ def _save(out_path: str, artifact: dict) -> None:
     print(f"[campaign] saved {out_path}", flush=True)
 
 
+def chain_time(attn, q, k, v, n=10, reps=2):
+    """ms/iteration of `attn` over an n-long jitted scan chain (forces
+    real sequential execution — a single call can hide in dispatch
+    latency), best of `reps` after a warmup. Shared by the flash timing
+    and tiling stages so their numbers stay methodology-comparable."""
+    import jax
+
+    @jax.jit
+    def run(q):
+        def body(c, _):
+            return attn(c, k, v, causal=True).astype(c.dtype), ()
+        out, _ = jax.lax.scan(body, q, None, length=n)
+        return out
+
+    jax.block_until_ready(run(q))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(q))
+        best = min(best, time.perf_counter() - t0)
+    return best / n * 1000
+
+
 def stage(artifact, out_path, name):
     def deco(fn):
         def run():
@@ -77,22 +100,6 @@ def main() -> int:
         from tpu_engine.ops.attention import dot_product_attention
         from tpu_engine.ops.flash import flash_attention
 
-        def chain_time(attn, q, k, v, n=10, reps=2):
-            @jax.jit
-            def run(q):
-                def body(c, _):
-                    o = attn(c, k, v, causal=True)
-                    return o.astype(c.dtype), ()
-                out, _ = jax.lax.scan(body, q, None, length=n)
-                return out
-            jax.block_until_ready(run(q))
-            best = 1e9
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                jax.block_until_ready(run(q))
-                best = min(best, time.perf_counter() - t0)
-            return best / n * 1000
-
         if args.quick:
             # Wiring smoke (CPU interpreter is ~1000x slower than Mosaic).
             shapes = [(1, 256, 2, 64)]
@@ -114,6 +121,31 @@ def main() -> int:
             except Exception as exc:
                 entry["xla_ms"] = f"FAIL {type(exc).__name__}"
             res[key] = entry
+        return res
+
+    @stage(artifact, out, "flash_tiling")
+    def _flash_tiling():
+        # (block_q, block_k) sweep at the long-context shape: the default
+        # 512x512 was chosen analytically (VMEM budget), never validated
+        # as the fastest tiling on the chip. One shape, four tilings.
+        import functools
+
+        from tpu_engine.ops.flash import flash_attention
+
+        b, s, h, d = (1, 256, 2, 64) if args.quick else (1, 4096, 16, 64)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+        res = {"shape": f"B{b}_S{s}_H{h}_D{d}"}
+        tilings = ([(256, 256)] if args.quick
+                   else [(256, 512), (512, 512), (512, 1024), (1024, 512)])
+        for bq, bk in tilings:
+            attn = functools.partial(flash_attention, block_q=bq, block_k=bk)
+            try:
+                res[f"bq{bq}_bk{bk}_ms"] = round(chain_time(attn, q, k, v), 2)
+            except Exception as exc:
+                res[f"bq{bq}_bk{bk}_ms"] = f"FAIL {type(exc).__name__}"
         return res
 
     @stage(artifact, out, "host_microbench")
@@ -253,8 +285,8 @@ def main() -> int:
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
-               _decode_int8, _flash, _spec, _prefill_mfu, _compute_sweep,
-               _longctx, _decode_ab, _miss_sweep):
+               _decode_int8, _flash, _flash_tiling, _spec, _prefill_mfu,
+               _compute_sweep, _longctx, _decode_ab, _miss_sweep):
         fn()
     print("[campaign] done", flush=True)
     return 0

@@ -343,10 +343,17 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         for p in procs:
             out, _ = p.communicate(timeout=timeout_s)
             outs.append(out)
+        losses = []
         for r, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
             for marker in (f"MESH-OK {r}", f"SERVE-OK {r}", f"TRAIN-OK {r}"):
                 assert marker in out, f"rank {r} missing {marker}:\n{out}"
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith(f"TRAIN-OK {r} "))
+            losses.append(line.split()[-1])  # "l1->l2" string
+        # SPMD means the replicated loss must be bit-identical across
+        # ranks; a divergence is a sharding bug even if both decrease.
+        assert losses[0] == losses[1], f"rank losses diverge: {losses}"
         if verbose:
             print("dryrun dcn (2 processes x 4 devices, data axis over "
                   "DCN): serve + 2 train steps OK")
