@@ -256,9 +256,12 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
     that rendezvous through ``jax.distributed``, build a hybrid mesh whose
     ``data`` axis crosses the process boundary, serve one ``/infer``
     through the lockstep mesh front (this parent is the HTTP client and
-    checks the logits against a locally-computed golden), and run two
-    dp2xtp4 train steps whose gradient psum rides the DCN axis. Returns a
-    summary dict; raises on any rank failure or golden mismatch."""
+    checks the logits against a locally-computed golden), run two
+    dp2xtp4 train steps whose gradient psum rides the DCN axis
+    (bit-identical losses asserted across ranks), and run ring attention
+    with the sequence axis spanning both processes — exact vs the
+    replicated full-sequence forward. Returns a summary dict; raises on
+    any rank failure or golden mismatch."""
     import json
     import os
     import subprocess
@@ -346,7 +349,8 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         losses = []
         for r, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
-            for marker in (f"MESH-OK {r}", f"SERVE-OK {r}", f"TRAIN-OK {r}"):
+            for marker in (f"MESH-OK {r}", f"SERVE-OK {r}", f"TRAIN-OK {r}",
+                           f"RING-DCN-OK {r}"):
                 assert marker in out, f"rank {r} missing {marker}:\n{out}"
             line = next(ln for ln in out.splitlines()
                         if ln.startswith(f"TRAIN-OK {r} "))
@@ -356,7 +360,8 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         assert losses[0] == losses[1], f"rank losses diverge: {losses}"
         if verbose:
             print("dryrun dcn (2 processes x 4 devices, data axis over "
-                  "DCN): serve + 2 train steps OK")
+                  "DCN): serve + 2 train steps + seq-spanning ring "
+                  "attention OK")
         return {"processes": 2, "mesh": health["mesh"],
                 "node_id": resp["node_id"]}
     finally:
