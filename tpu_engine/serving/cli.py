@@ -155,6 +155,12 @@ def main(argv=None) -> int:
                                  "miss path (default 4); raise when the "
                                  "dispatch round-trip dwarfs the device "
                                  "step (high-latency links)")
+        parser.add_argument("--cache-capacity", type=int, default=None,
+                            help="result-cache entries per lane (default "
+                                 "1000, reference worker_node.cpp:33)")
+        parser.add_argument("--batch-timeout-ms", type=float, default=None,
+                            help="dynamic batcher flush timeout (default "
+                                 "20, reference worker_node.cpp:36)")
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
@@ -218,6 +224,10 @@ def main(argv=None) -> int:
             bb_kw["max_batch_size"] = max(bb_kw["batch_buckets"])
         if args.pipeline_depth is not None:
             bb_kw["pipeline_depth"] = args.pipeline_depth
+        if args.cache_capacity is not None:
+            bb_kw["cache_capacity"] = args.cache_capacity
+        if args.batch_timeout_ms is not None:
+            bb_kw["batch_timeout_ms"] = args.batch_timeout_ms
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,
