@@ -1,0 +1,158 @@
+"""LockstepMeshServer logic in its single-process degenerate form.
+
+The 2-process DCN test (test_distributed.py) proves the cross-host
+collectives; these tests pin the queue/coalesce/shutdown semantics
+deterministically without spawning processes — process_count == 1 makes
+``broadcast_one_to_all`` an identity, so the lockstep loop runs the same
+code path with no rendezvous."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    create_model,
+)
+from tpu_engine.parallel.distributed import hybrid_mesh
+from tpu_engine.parallel.multihost_serving import LockstepMeshServer
+from tpu_engine.utils.net import free_port
+
+
+@pytest.fixture(scope="module")
+def served():
+    _ensure_builtin_models_imported()
+    spec = create_model("mlp", input_dim=8, hidden_dim=16, output_dim=8,
+                        num_layers=2)
+    params = spec.init(jax.random.PRNGKey(0))
+    mesh = hybrid_mesh((2, 4), ("data", "model"))
+    srv = LockstepMeshServer(mesh, spec.apply, params, sample_shape=(8,),
+                             dtype=jnp.float32)
+    port = free_port()
+    th = threading.Thread(target=srv.run, kwargs={"http_port": port},
+                          daemon=True)
+    th.start()
+    deadline = time.time() + 60
+    while True:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", "/health")
+            conn.getresponse().read()
+            break
+        except OSError:
+            if time.time() > deadline:
+                pytest.fail("lockstep server front never came up")
+            time.sleep(0.1)
+    yield spec, params, port, srv
+    srv.stop()
+    th.join(timeout=30)
+    assert not th.is_alive(), "lockstep loop failed to stop"
+
+
+def _post(port, path, body, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    return r.status, json.loads(r.read())
+
+
+def test_infer_matches_direct_apply(served):
+    spec, params, port, _ = served
+    x = np.linspace(-1, 1, 8, dtype=np.float32)
+    golden = np.asarray(spec.apply(params, x[None], dtype=jnp.float32))[0]
+    st, resp = _post(port, "/infer", {"request_id": "u1",
+                                      "input_data": x.tolist()})
+    assert st == 200
+    np.testing.assert_allclose(np.asarray(resp["output_data"], np.float32),
+                               golden, rtol=1e-5, atol=1e-6)
+    assert resp["node_id"] == "mesh_host_0"
+    assert resp["cached"] is False
+
+
+def test_short_input_zero_pads_and_long_truncates(served):
+    """Reference predict semantics (inference_engine.cpp:100-103)."""
+    spec, params, port, _ = served
+    short = [1.0, 2.0]
+    golden = np.asarray(spec.apply(
+        params, np.pad(np.asarray(short, np.float32), (0, 6))[None],
+        dtype=jnp.float32))[0]
+    st, resp = _post(port, "/infer", {"request_id": "u2",
+                                      "input_data": short})
+    assert st == 200
+    np.testing.assert_allclose(np.asarray(resp["output_data"], np.float32),
+                               golden, rtol=1e-5, atol=1e-6)
+    st, resp_long = _post(port, "/infer", {"request_id": "u3",
+                                           "input_data": [1.0] * 20})
+    assert st == 200 and len(resp_long["output_data"]) == 8
+
+
+def test_concurrent_requests_coalesce_and_all_answer(served):
+    """Concurrent posts ride data-shard rows of shared ticks; every caller
+    gets ITS OWN row's output (no cross-request smearing)."""
+    spec, params, port, _ = served
+    n = 6  # > batch (2): forces at least 3 ticks worth of coalescing
+    inputs = [np.full(8, 0.1 * (i + 1), np.float32) for i in range(n)]
+    results = [None] * n
+
+    def go(i):
+        st, resp = _post(port, "/infer", {"request_id": f"c{i}",
+                                          "input_data": inputs[i].tolist()})
+        results[i] = (st, resp)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (st, resp) in enumerate(results):
+        assert st == 200, resp
+        golden = np.asarray(spec.apply(params, inputs[i][None],
+                                       dtype=jnp.float32))[0]
+        np.testing.assert_allclose(
+            np.asarray(resp["output_data"], np.float32), golden,
+            rtol=1e-5, atol=1e-6, err_msg=f"request {i}")
+
+
+def test_stop_endpoint_returns_and_loop_exits():
+    """POST /admin/stop resolves in-flight handlers (200/503, never a
+    severed socket) and the run loop exits; a post-stop request is
+    refused with 503."""
+    _ensure_builtin_models_imported()
+    spec = create_model("mlp", input_dim=8, hidden_dim=16, output_dim=8,
+                        num_layers=2)
+    params = spec.init(jax.random.PRNGKey(1))
+    mesh = hybrid_mesh((2, 4), ("data", "model"))
+    srv = LockstepMeshServer(mesh, spec.apply, params, sample_shape=(8,),
+                             dtype=jnp.float32)
+    port = free_port()
+    th = threading.Thread(target=srv.run, kwargs={"http_port": port},
+                          daemon=True)
+    th.start()
+    deadline = time.time() + 60
+    while True:
+        try:
+            st, _ = _post(port, "/infer", {"request_id": "w",
+                                           "input_data": [0.0] * 8})
+            assert st == 200
+            break
+        except OSError:
+            if time.time() > deadline:
+                pytest.fail("lockstep server front never came up")
+            time.sleep(0.1)
+    st, resp = _post(port, "/admin/stop", {})
+    assert st == 200 and resp["ok"] is True
+    th.join(timeout=30)
+    assert not th.is_alive()
+    with pytest.raises(OSError):  # listener is down
+        _post(port, "/infer", {"request_id": "late",
+                               "input_data": [0.0] * 8}, timeout=3)

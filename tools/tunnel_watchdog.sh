@@ -38,6 +38,20 @@ while true; do
     echo "[watchdog] campaign exited rc=$rc ($attempt_out)"
     if [ "$rc" -eq 0 ]; then
       cp "$attempt_out" "$OUT"
+      # Bonus while the link is healthy: refresh the headline serving
+      # number (hit path) with this round's front changes. Best-effort —
+      # the campaign artifact above is the primary deliverable. Temp file
+      # + mv on success: a killed bench must not leave an empty artifact
+      # masquerading as evidence.
+      serving_out="${OUT%.json}_serving.json"
+      if timeout 1800 python bench.py --quick \
+          > "${serving_out}.tmp" 2>/tmp/bench_serving_refresh.log; then
+        mv "${serving_out}.tmp" "$serving_out"
+        echo "[watchdog] serving headline refreshed -> $serving_out"
+      else
+        rm -f "${serving_out}.tmp"
+        echo "[watchdog] serving refresh failed (/tmp/bench_serving_refresh.log)"
+      fi
       exit 0
     fi
     # A campaign that died mid-way (re-wedge) keeps its partial artifact;
