@@ -253,6 +253,17 @@ def scrape_stats(port: int) -> dict:
     return out
 
 
+def stop_server(proc: Optional[subprocess.Popen]) -> None:
+    """terminate -> bounded wait -> kill; shared by every launcher site."""
+    if proc is None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
 def launch_server(model: str, port: int, lanes: int,
                   mixed: bool = False,
                   pipeline_depth: Optional[int] = None,
@@ -302,11 +313,7 @@ def run_miss_path_sweep(model: str = "resnet50",
                 "success_rate": round(r["success_rate"], 4),
             }
         finally:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+            stop_server(proc)
     return out
 
 
@@ -1189,11 +1196,7 @@ def _main() -> int:
 
         # Free the chip before the in-process compute addendum.
         if proc is not None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+            stop_server(proc)
             proc = None
 
         compute = decode = decode_fused = None
@@ -1244,12 +1247,7 @@ def _main() -> int:
         print(json.dumps(line), flush=True)
         return 0 if result["success_rate"] > 0.99 else 1
     finally:
-        if proc is not None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        stop_server(proc)
 
 
 if __name__ == "__main__":
