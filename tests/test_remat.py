@@ -1,0 +1,78 @@
+"""Rematerialized training forward (`transformer_apply(remat=True)`).
+
+Long-sequence training is activation-memory-bound: the backward pass of
+an L-layer scan keeps every layer's intermediates resident. With
+`jax.checkpoint` over the scanned block, XLA stores one layer boundary
+per step and recomputes the block inside the backward — the standard
+FLOPs-for-HBM trade. Both claims are pinned here: gradients match the
+unrematerialized forward to float32 noise, and the compiled gradient
+executable's temp-buffer allocation (XLA's own memory analysis) shrinks
+several-fold.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.transformer import (
+    TransformerConfig,
+    transformer_apply,
+    transformer_init,
+)
+
+CFG = TransformerConfig(vocab=64, n_layers=8, d_model=64, n_heads=4,
+                        d_ff=256, max_seq=512, causal=True)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = transformer_init(jax.random.PRNGKey(0), CFG)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 512), 1, 64)
+    return params, toks
+
+
+def _loss(params, toks, remat):
+    logits = transformer_apply(params, toks, CFG, dtype=jnp.float32,
+                               remat=remat)
+    return jnp.mean(logits ** 2)
+
+
+def test_remat_gradients_match(setup):
+    params, toks = setup
+    g0 = jax.grad(functools.partial(_loss, toks=toks, remat=False))(params)
+    g1 = jax.grad(functools.partial(_loss, toks=toks, remat=True))(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_cuts_backward_activation_memory(setup):
+    params, toks = setup
+    temps = {}
+    for remat in (False, True):
+        exe = jax.jit(jax.grad(
+            functools.partial(_loss, toks=toks, remat=remat))
+        ).lower(params).compile()
+        ma = exe.memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("memory_analysis unavailable on this backend")
+        temps[remat] = ma.temp_size_in_bytes
+    # Measured on the CPU backend at these shapes: 313 MB -> 48 MB. Any
+    # regression that stops the checkpoint from taking effect (e.g. the
+    # scan body no longer wrapped) collapses the ratio toward 1.
+    assert temps[True] < temps[False] / 3, temps
+
+
+def test_remat_forward_unchanged(setup):
+    params, toks = setup
+    base = transformer_apply(params, toks, CFG, dtype=jnp.float32)
+    rem = transformer_apply(params, toks, CFG, dtype=jnp.float32,
+                            remat=True)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(rem),
+                               rtol=1e-6, atol=1e-6)

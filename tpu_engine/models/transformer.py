@@ -262,14 +262,19 @@ def _block_apply(bp, h, cfg: TransformerConfig, *, mask, dtype, attn_fn=None,
 
 def transformer_apply(params, tokens, cfg: TransformerConfig, *,
                       mask=None, dtype=jnp.bfloat16, attn_fn=None,
-                      token_type_ids=None):
+                      token_type_ids=None, remat=False):
     """Full-sequence forward. tokens: (B, S) int32 → logits (B, S, vocab).
 
     `attn_fn` swaps the attention implementation — e.g. a partial of
     parallel.ring.ring_attention for sequence-parallel long-context runs,
     or ops.flash.flash_attention for the fused Pallas kernel.
     `token_type_ids` (B, S) selects segment embeddings when the config has a
-    type vocabulary (BERT); defaults to all-zeros."""
+    type vocabulary (BERT); defaults to all-zeros.
+    `remat=True` checkpoints each block in the backward pass: activation
+    residency drops from O(L·B·S·d) to one layer recomputed at a time —
+    the standard FLOPs-for-HBM trade that long-sequence training needs
+    (gradients match the unrematerialized pass to float32 tolerance; see
+    tests/test_remat.py for the compiled-memory evidence)."""
     b, s = tokens.shape
     h = nn.embedding(params["tok_embed"], tokens)
     if cfg.pos == "learned":
@@ -287,6 +292,8 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
         return _block_apply(bp, carry, cfg, mask=mask, dtype=dtype,
                             attn_fn=attn_fn), None
 
+    if remat:
+        body = jax.checkpoint(body)
     h, _ = jax.lax.scan(body, h, params["blocks"])
     if not cfg.post_ln:
         h = _norm(params["ln_f"], h, cfg)
