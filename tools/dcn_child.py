@@ -16,7 +16,9 @@ layout ``parallel/distributed.hybrid_mesh`` prescribes for pods — then:
 3. run ring attention with the SEQUENCE axis spanning both processes —
    the long-context story: K/V shards rotate via ppermute across the
    host boundary, checked exact against the replicated full-sequence
-   forward.
+   forward, and
+4. run Ulysses all-to-all attention over the same cross-process seq
+   axis (the head-scattering SP mode), also checked exact.
 
 The reference needs nothing to span hosts because nothing is shared —
 each worker holds a whole model and the gateway re-POSTs JSON
@@ -114,36 +116,60 @@ def main() -> int:
     )
     from tpu_engine.parallel.ring import ring_attention
 
+    from tpu_engine.parallel.ring import ulysses_attention
+
     seq_mesh = hybrid_mesh((ndev,), ("seq",), dcn_shape=(2,))
     n_seq = 2 * ndev
-    cfg = TransformerConfig(vocab=64, n_layers=2, d_model=16, n_heads=4,
-                            d_ff=32, max_seq=8 * n_seq, causal=True)
-    tparams_host = transformer_init(jax.random.PRNGKey(1), cfg)
     rep = NamedSharding(seq_mesh, P())
-    tparams = jax.tree.map(lambda a: gput(np.asarray(a), rep), tparams_host)
     toks_host = np.asarray(
         np.random.default_rng(9).integers(0, 64, (1, 4 * n_seq)), np.int32)
     toks_sp = gput(toks_host, NamedSharding(seq_mesh, P(None, "seq")))
     toks_rep = gput(toks_host, rep)
-    ring = functools.partial(ring_attention, mesh=seq_mesh, axis_name="seq")
 
-    @functools.partial(jax.jit, out_shardings=rep)
-    def fwd_ring(p, t):
-        return transformer_apply(
-            p, t, cfg, dtype=jnp.float32,
-            attn_fn=lambda q, k, v, causal, mask: ring(
-                q, k, v, causal=causal, kv_mask=mask))
+    def check_sp_mode(marker, cfg_sp, key, attn):
+        """One SP arm: sharded-seq forward with `attn` must equal the
+        replicated full-sequence forward."""
+        p_rep = jax.tree.map(
+            lambda a: gput(np.asarray(a), rep),
+            transformer_init(jax.random.PRNGKey(key), cfg_sp))
 
-    @functools.partial(jax.jit, out_shardings=rep)
-    def fwd_plain(p, t):
-        return transformer_apply(p, t, cfg, dtype=jnp.float32)
+        @functools.partial(jax.jit, out_shardings=rep)
+        def fwd_sp(p, t):
+            return transformer_apply(
+                p, t, cfg_sp, dtype=jnp.float32,
+                attn_fn=lambda q, k, v, causal, mask: attn(
+                    q, k, v, causal=causal, kv_mask=mask))
 
-    lr = np.asarray(fwd_ring(tparams, toks_sp))
-    lp = np.asarray(fwd_plain(tparams, toks_rep))
-    assert np.isfinite(lr).all(), "non-finite ring-over-DCN logits"
-    np.testing.assert_allclose(lr, lp, rtol=2e-4, atol=2e-4)
-    print(f"RING-DCN-OK {rank} maxdiff={float(np.max(np.abs(lr - lp))):.2e}",
-          flush=True)
+        @functools.partial(jax.jit, out_shardings=rep)
+        def fwd_ref(p, t):
+            return transformer_apply(p, t, cfg_sp, dtype=jnp.float32)
+
+        ls = np.asarray(fwd_sp(p_rep, toks_sp))
+        lref = np.asarray(fwd_ref(p_rep, toks_rep))
+        assert np.isfinite(ls).all(), f"non-finite {marker} logits"
+        np.testing.assert_allclose(ls, lref, rtol=2e-4, atol=2e-4)
+        print(f"{marker} {rank} "
+              f"maxdiff={float(np.max(np.abs(ls - lref))):.2e}", flush=True)
+
+    check_sp_mode(
+        "RING-DCN-OK",
+        TransformerConfig(vocab=64, n_layers=2, d_model=16, n_heads=4,
+                          d_ff=32, max_seq=8 * n_seq, causal=True),
+        key=1,
+        attn=functools.partial(ring_attention, mesh=seq_mesh,
+                               axis_name="seq"))
+    # -- 4. Ulysses all-to-all over the same cross-process seq axis: the
+    # head-scattering SP mode (two all_to_all collectives instead of n-1
+    # ppermute hops). Needs n_heads % axis_size == 0, so its dims derive
+    # from n_seq — any DCN_CHILD_LOCAL_DEVICES value stays valid.
+    check_sp_mode(
+        "ULYSSES-DCN-OK",
+        TransformerConfig(vocab=64, n_layers=2, d_model=4 * n_seq,
+                          n_heads=n_seq, d_ff=8 * n_seq,
+                          max_seq=8 * n_seq, causal=True),
+        key=2,
+        attn=functools.partial(ulysses_attention, mesh=seq_mesh,
+                               axis_name="seq"))
     return 0
 
 

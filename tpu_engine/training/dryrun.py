@@ -350,7 +350,7 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         for r, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
             for marker in (f"MESH-OK {r}", f"SERVE-OK {r}", f"TRAIN-OK {r}",
-                           f"RING-DCN-OK {r}"):
+                           f"RING-DCN-OK {r}", f"ULYSSES-DCN-OK {r}"):
                 assert marker in out, f"rank {r} missing {marker}:\n{out}"
             line = next(ln for ln in out.splitlines()
                         if ln.startswith(f"TRAIN-OK {r} "))
@@ -360,8 +360,8 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         assert losses[0] == losses[1], f"rank losses diverge: {losses}"
         if verbose:
             print("dryrun dcn (2 processes x 4 devices, data axis over "
-                  "DCN): serve + 2 train steps + seq-spanning ring "
-                  "attention OK")
+                  "DCN): serve + 2 train steps + seq-spanning ring + "
+                  "ulysses attention OK")
         return {"processes": 2, "mesh": health["mesh"],
                 "node_id": resp["node_id"]}
     finally:
