@@ -35,12 +35,14 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_params(path: str, params: Any) -> str:
-    """Save a param pytree to `path` (created; must not already exist)."""
+def save_params(path: str, params: Any, overwrite: bool = False) -> str:
+    """Save a param pytree to `path` (created; must not already exist
+    unless `overwrite` — orbax replaces the old checkpoint atomically, so
+    a crash mid-save cannot lose both)."""
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     host = jax.tree.map(np.asarray, params)
-    ckptr.save(path, host)
+    ckptr.save(path, host, force=overwrite)
     ckptr.wait_until_finished()
     return path
 
@@ -61,8 +63,9 @@ def load_params(path: str, like: Optional[Any] = None) -> Any:
     return ckptr.restore(path)
 
 
-def save_train_state(path: str, state) -> str:
-    """Save a training.TrainState (params + opt_state + step)."""
+def save_train_state(path: str, state, overwrite: bool = False) -> str:
+    """Save a training.TrainState (params + opt_state + step).
+    `overwrite` replaces an existing checkpoint (atomic in orbax)."""
     from tpu_engine.training.train import TrainState
 
     assert isinstance(state, TrainState)
@@ -73,7 +76,7 @@ def save_train_state(path: str, state) -> str:
         "step": state.step,
     })
     ckptr = _checkpointer()
-    ckptr.save(path, host)
+    ckptr.save(path, host, force=overwrite)
     ckptr.wait_until_finished()
     return path
 
