@@ -12,9 +12,17 @@ set -u
 OUT="${1:-BENCH_r05_builder.json}"
 POLL_S="${POLL_S:-600}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-90}"
+# Stop launching new campaigns after this epoch: near the round's end the
+# DRIVER needs the (exclusive) chip for its own bench — a late-recovering
+# tunnel must not hand it to us instead. 0 disables the cutoff.
+STOP_AFTER_EPOCH="${STOP_AFTER_EPOCH:-0}"
 cd "$(dirname "$0")/.."
 
 while true; do
+  if [ "$STOP_AFTER_EPOCH" -gt 0 ] && [ "$(date +%s)" -ge "$STOP_AFTER_EPOCH" ]; then
+    echo "[watchdog] past cutoff ($(date -u)); leaving the chip to the driver"
+    exit 0
+  fi
   echo "[watchdog] $(date -u +%H:%M:%S) probing device (timeout ${PROBE_TIMEOUT}s)..."
   # bench.probe_device is the platform-aware probe (honors
   # TPU_ENGINE_PLATFORM, which the axon plugin requires — JAX_PLATFORMS is
