@@ -364,3 +364,53 @@ def test_minigpt_causal_onnx_matches_torch(tmp_path):
     out2 = np.asarray(spec.apply(params, toggled))
     np.testing.assert_array_equal(out[0, :-1], out2[0, :-1])
     assert not np.allclose(out[0, -1], out2[0, -1], atol=1e-6)
+
+
+def test_range_trilu_minmax_ops(tmp_path):
+    """Range (position-id generator), Trilu (causal-mask builder in
+    opset-14+ exports), and variadic Min/Max — golden vs torch."""
+    S = 6
+    w = np.random.default_rng(30).standard_normal((S, 8)).astype(np.float32)
+    nodes = [
+        # ids = Range(0, S, 1) -> Gather rows of w, input-independent
+        ow.node("Range", ["r_start", "r_limit", "r_delta"], ["rng"]),
+        ow.node("Gather", ["w", "rng"], ["rows"], [ow.attr_int("axis", 0)]),
+        # scores = input @ rows.T -> (N, S)
+        ow.node("Transpose", ["rows"], ["rowsT"],
+                [ow.attr_ints("perm", [1, 0])]),
+        ow.node("MatMul", ["input", "rowsT"], ["scores"],),  # (N, S)
+        ow.node("Min", ["scores", "cap_hi"], ["capped1"]),
+        ow.node("Max", ["capped1", "cap_lo"], ["capped"]),
+        ow.node("Unsqueeze", ["capped"], ["row3"],
+                [ow.attr_ints("axes", [1])]),                # (N, 1, S)
+        ow.node("Expand", ["row3", "sq_shape"], ["square"]),  # (N, S, S)
+        ow.node("Trilu", ["square"], ["tril"], [ow.attr_int("upper", 0)]),
+        ow.node("ReduceSum", ["tril"], ["output"],
+                [ow.attr_ints("axes", [1, 2]), ow.attr_int("keepdims", 0)]),
+    ]
+    inits = {
+        "w": w,
+        "r_start": np.asarray(0, np.int64),
+        "r_limit": np.asarray(S, np.int64),
+        "r_delta": np.asarray(1, np.int64),
+        "cap_hi": np.asarray(2.0, np.float32),
+        "cap_lo": np.asarray(-2.0, np.float32),
+        "sq_shape": np.asarray([1, S, S], np.int64),
+    }
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", 8]),
+                    ow.value_info("output", ["N"]))
+    path = str(tmp_path / "rangeops.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    spec, params = build_onnx_model(path)
+    x = np.random.default_rng(31).standard_normal((3, 8)).astype(np.float32)
+
+    tw = torch.from_numpy(w)
+    tx = torch.from_numpy(x)
+    scores = tx @ tw[torch.arange(S)].T
+    capped = torch.clamp(scores, -2.0, 2.0)
+    square = capped[:, None, :].expand(3, S, S)
+    golden = torch.tril(square).sum(dim=(1, 2)).numpy()
+    out = np.asarray(spec.apply(params, x))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)

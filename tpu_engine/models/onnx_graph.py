@@ -19,7 +19,8 @@ Div, Flatten, Reshape, Transpose, Concat, Softmax, Identity, Dropout
 (VERDICT r4 missing item 1: BERT-/GPT-class ONNX files, BASELINE configs
 3 and 5): Gather, Slice, Split, Erf, Gelu, ReduceMean, ReduceSum,
 LayerNormalization, Where, Cast, Shape, Unsqueeze, Squeeze, Expand,
-ConstantOfShape, Pow, Sqrt, Tanh, Neg, Exp, Log, Equal, Greater, Less.
+ConstantOfShape, Range, Trilu, Min, Max, Pow, Sqrt, Tanh, Neg, Exp, Log,
+Equal, Greater, Less.
 Tensors keep ONNX's NCHW semantics; XLA's layout assignment owns the
 physical tiling on TPU.
 
@@ -582,6 +583,37 @@ def _eval_node(env, node: OnnxNode, dtype, static) -> object:
             x, np.broadcast_shapes(tuple(x.shape), tuple(shape)))
     if op == "ConstantOfShape":
         return _op_constant_of_shape(env, node, static)
+    if op == "Range":
+        # Position-id generators in GPT-class exports. All three operands
+        # (start, limit, delta — the spec requires them) must be
+        # trace-time static (they derive from Shape in practice) and
+        # integer-typed: a float Range (diffusion timestep exports) would
+        # be silently truncated by the int coercion, so refuse it loudly.
+        vals = []
+        for name in node.inputs[:3]:
+            v = _static_value(name, env, static)
+            if v is None:
+                raise NotImplementedError(
+                    f"Range: operand '{name}' is data-dependent")
+            if not np.issubdtype(np.asarray(v).dtype, np.integer):
+                raise NotImplementedError(
+                    "Range: only integer start/limit/delta supported "
+                    f"(got dtype {np.asarray(v).dtype})")
+            vals.append(int(np.asarray(v).ravel()[0]))
+        start, limit, delta = vals
+        return np.arange(start, limit, delta, dtype=np.int64)
+    if op == "Trilu":
+        x = env[node.inputs[0]]
+        k = (_require_ints(node.inputs[1], env, static, "Trilu")[0]
+             if len(node.inputs) > 1 and node.inputs[1] else 0)
+        fn = jnp.triu if int(node.attrs.get("upper", 1)) else jnp.tril
+        return fn(x, k)
+    if op in ("Min", "Max"):
+        fn = jnp.minimum if op == "Min" else jnp.maximum
+        out = env[node.inputs[0]]
+        for name in node.inputs[1:]:  # ONNX Min/Max are variadic
+            out = fn(out, env[name])
+        return out
     raise NotImplementedError(
         f"ONNX op '{op}' is outside the supported subset (CNN ops: Conv/"
         "Gemm/MatMul/BN/Relu/Sigmoid/Clip/Pool/binops/Flatten/Reshape/"
