@@ -414,3 +414,99 @@ def test_range_trilu_minmax_ops(tmp_path):
     golden = torch.tril(square).sum(dim=(1, 2)).numpy()
     out = np.asarray(spec.apply(params, x))
     np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)
+
+
+def test_minivit_conv_plus_transformer(tmp_path):
+    """ViT-class graph: Conv patchify feeding a transformer encoder in
+    ONE generic-path executable — the CNN and transformer op subsets
+    composing, the way a real ViT export mixes them. Golden vs torch."""
+    IMG, PATCH, D, NH = 8, 4, 16, 2  # 2x2=4 patches, d_model 16
+    NP_ = (IMG // PATCH) ** 2
+    rng = np.random.default_rng(40)
+    w = {
+        "pw": rng.standard_normal((D, 3, PATCH, PATCH)).astype(np.float32) * 0.1,
+        "pb": rng.standard_normal((D,)).astype(np.float32) * 0.1,
+        "pos": rng.standard_normal((NP_, D)).astype(np.float32) * 0.1,
+        "wqkv": rng.standard_normal((D, 3 * D)).astype(np.float32) * 0.1,
+        "bqkv": rng.standard_normal((3 * D,)).astype(np.float32) * 0.1,
+        "wo": rng.standard_normal((D, D)).astype(np.float32) * 0.1,
+        "bo": rng.standard_normal((D,)).astype(np.float32) * 0.1,
+        "g": (1 + rng.standard_normal((D,)) * 0.02).astype(np.float32),
+        "be": (rng.standard_normal((D,)) * 0.02).astype(np.float32),
+        "wc": rng.standard_normal((5, D)).astype(np.float32) * 0.1,
+        "bc": rng.standard_normal((5,)).astype(np.float32) * 0.1,
+    }
+    hd = D // NH
+    nodes = [
+        # Patchify: Conv stride=patch -> (N, D, 2, 2) -> (N, D, 4) ->
+        # (N, 4, D) — the standard ViT embed export.
+        ow.node("Conv", ["input", "pw", "pb"], ["pe"],
+                [ow.attr_ints("strides", [PATCH, PATCH])]),
+        ow.node("Reshape", ["pe", "flat_shape"], ["pf"]),
+        ow.node("Transpose", ["pf"], ["tok0"],
+                [ow.attr_ints("perm", [0, 2, 1])]),
+        ow.node("Add", ["tok0", "pos"], ["h0"]),
+        # One pre-LN attention block.
+        ow.node("LayerNormalization", ["h0", "g", "be"], ["ln"],
+                [ow.attr_int("axis", -1), ow.attr_float("epsilon", 1e-5)]),
+        ow.node("MatMul", ["ln", "wqkv"], ["qkv0"]),
+        ow.node("Add", ["qkv0", "bqkv"], ["qkv"]),
+        ow.node("Split", ["qkv"], ["q", "k", "v"],
+                [ow.attr_int("axis", -1), ow.attr_ints("split", [D, D, D])]),
+    ]
+    for t in ("q", "k", "v"):
+        nodes += [
+            ow.node("Reshape", [t, "head_shape"], [t + "4"]),
+            ow.node("Transpose", [t + "4"], [t + "h"],
+                    [ow.attr_ints("perm", [0, 2, 1, 3])]),
+        ]
+    nodes += [
+        ow.node("Transpose", ["kh"], ["kt"],
+                [ow.attr_ints("perm", [0, 1, 3, 2])]),
+        ow.node("MatMul", ["qh", "kt"], ["sc0"]),
+        ow.node("Mul", ["sc0", "scale"], ["sc"]),
+        ow.node("Softmax", ["sc"], ["pr"], [ow.attr_int("axis", -1)]),
+        ow.node("MatMul", ["pr", "vh"], ["ctx"]),
+        ow.node("Transpose", ["ctx"], ["ctx2"],
+                [ow.attr_ints("perm", [0, 2, 1, 3])]),
+        ow.node("Reshape", ["ctx2", "merge_shape"], ["ctx3"]),
+        ow.node("MatMul", ["ctx3", "wo"], ["ao0"]),
+        ow.node("Add", ["ao0", "bo"], ["ao"]),
+        ow.node("Add", ["h0", "ao"], ["h1"]),
+        ow.node("ReduceMean", ["h1"], ["pooled"],
+                [ow.attr_ints("axes", [1]), ow.attr_int("keepdims", 0)]),
+        ow.node("Gemm", ["pooled", "wc", "bc"], ["output"],
+                [ow.attr_int("transB", 1)]),
+    ]
+    inits = dict(w)
+    inits.update({
+        "flat_shape": np.asarray([0, D, NP_], np.int64),
+        "head_shape": np.asarray([0, 0, NH, hd], np.int64),
+        "merge_shape": np.asarray([0, 0, D], np.int64),
+        "scale": np.asarray(hd ** -0.5, np.float32),
+    })
+    blob = ow.model(nodes, inits,
+                    ow.value_info("input", ["N", 3, IMG, IMG]),
+                    ow.value_info("output", ["N", 5]))
+    path = str(tmp_path / "mini_vit.onnx")
+    with open(path, "wb") as f:
+        f.write(blob)
+    spec, params = build_onnx_model(path)
+    x = rng.standard_normal((2, 3, IMG, IMG)).astype(np.float32)
+
+    t = {k: torch.from_numpy(v) for k, v in w.items()}
+    tx = torch.from_numpy(x)
+    pe = torch.nn.functional.conv2d(tx, t["pw"], t["pb"], stride=PATCH)
+    h0 = pe.reshape(2, D, NP_).permute(0, 2, 1) + t["pos"]
+    ln = torch.nn.functional.layer_norm(h0, (D,), t["g"], t["be"], 1e-5)
+    qkv = ln @ t["wqkv"] + t["bqkv"]
+    q, k, v = qkv.split(D, dim=-1)
+    q = q.reshape(2, NP_, NH, hd).permute(0, 2, 1, 3)
+    k = k.reshape(2, NP_, NH, hd).permute(0, 2, 1, 3)
+    v = v.reshape(2, NP_, NH, hd).permute(0, 2, 1, 3)
+    ctx = (torch.softmax((q @ k.transpose(-1, -2)) * hd ** -0.5, -1) @ v)
+    h1 = h0 + ctx.permute(0, 2, 1, 3).reshape(2, NP_, D) @ t["wo"] + t["bo"]
+    golden = (h1.mean(1) @ t["wc"].T + t["bc"]).numpy()
+
+    out = np.asarray(spec.apply(params, x))
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)

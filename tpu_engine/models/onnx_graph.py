@@ -620,7 +620,8 @@ def _eval_node(env, node: OnnxNode, dtype, static) -> object:
         "Transpose/Concat/Softmax/Identity/Dropout/Constant; transformer "
         "ops: Gather/Slice/Split/Erf/Gelu/ReduceMean/ReduceSum/"
         "LayerNormalization/Where/Cast/Shape/Unsqueeze/Squeeze/Expand/"
-        "ConstantOfShape/Pow/Sqrt/Tanh/unaries/comparisons)")
+        "ConstantOfShape/Range/Trilu/Min/Max/Pow/Sqrt/Tanh/unaries/"
+        "comparisons)")
 
 
 def execute_graph(graph: OnnxGraph, params: Dict[str, object], x,
