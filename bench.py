@@ -28,7 +28,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 BASELINE_REQ_S = 522.64  # reference README.md:283 (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -85,9 +85,17 @@ def free_port() -> int:
     return _fp()
 
 
-def wait_ready(port: int, timeout_s: float = 600.0) -> None:
+def wait_ready(port: int, timeout_s: float = 600.0, proc=None) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            # Server died before listening — most commonly the free_port()
+            # probe-then-close race (utils/net.py documents it: another
+            # process can bind the probed port first). Distinct error type
+            # so launch_ready retries with a FRESH port instead of
+            # polling a corpse for 10 minutes.
+            raise ChildProcessError(
+                f"server exited rc={proc.returncode} before ready")
         try:
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
             conn.request("GET", "/stats")
@@ -285,6 +293,31 @@ def launch_server(model: str, port: int, lanes: int,
                             stdout=sys.stderr, stderr=sys.stderr)
 
 
+def launch_ready(model: str, lanes: int, attempts: int = 3,
+                 **launch_kw) -> Tuple[int, subprocess.Popen]:
+    """Pick a free port, launch, wait ready — retrying the WHOLE pick+
+    launch on an early exit. free_port() can only probe: the kernel may
+    hand the same port to another process between the probe close and the
+    server's bind, so the consumer (here), not the prober, owns the
+    retry."""
+    last: Exception = RuntimeError("unreachable")
+    for attempt in range(attempts):
+        port = free_port()
+        proc = launch_server(model, port, lanes, **launch_kw)
+        try:
+            wait_ready(port, proc=proc)
+            return port, proc
+        except ChildProcessError as exc:
+            last = exc
+            log(f"launch attempt {attempt + 1}/{attempts} failed ({exc}); "
+                "retrying on a fresh port")
+        except BaseException:
+            stop_server(proc)
+            raise
+    raise RuntimeError(f"server failed to launch after {attempts} "
+                       f"attempts: {last}")
+
+
 def run_miss_path_sweep(model: str = "resnet50",
                         depths: Sequence[int] = (4, 8, 16),
                         n_requests: int = 3000, n_threads: int = 50) -> dict:
@@ -296,10 +329,8 @@ def run_miss_path_sweep(model: str = "resnet50",
     out: dict = {"model": model, "n_requests": n_requests,
                  "threads": n_threads}
     for depth in depths:
-        port = free_port()
-        proc = launch_server(model, port, 0, pipeline_depth=depth)
+        port, proc = launch_ready(model, 0, pipeline_depth=depth)
         try:
-            wait_ready(port)
             # Warm in a DISJOINT input range: warm vectors in the cache
             # would serve the measured run's first requests as hits.
             LoadGen(port, 200, 8, distinct_inputs=200,
@@ -1123,11 +1154,10 @@ def _main() -> int:
     port = args.port
     try:
         if port == 0:
-            port = free_port()
-            proc = launch_server(args.model, port, args.lanes,
-                                 mixed=args.scenario == "mixed")
+            port, proc = launch_ready(args.model, args.lanes,
+                                      mixed=args.scenario == "mixed")
         log(f"waiting for server on :{port} ...")
-        wait_ready(port)
+        wait_ready(port, proc=proc)
 
         if args.scenario == "mixed":
             result = run_mixed_shape_bench(port)

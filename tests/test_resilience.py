@@ -1,0 +1,574 @@
+"""Resilience layer: deadlines, retry budgets, backoff, hedging, shedding.
+
+Covers the SRE triad the breaker-only reference lacks (DESIGN.md "Request
+resilience"): deadline expiry at admission AND mid-generation, backoff
+jitter bounds, retry-budget exhaustion, hedge first-wins semantics, drain
+(lame-duck) mode, and the multihost lockstep abandoned-item regression.
+All knobs default off — the wire-compat guarantee is exercised too.
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_engine.runtime.batch_processor import BatchProcessor
+from tpu_engine.serving.gateway import Gateway, GatewayError
+from tpu_engine.serving.resilience import (
+    AdmissionController,
+    LatencyTracker,
+    RetryBudget,
+    backoff_delay,
+)
+from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+from tpu_engine.utils.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+)
+
+
+# -- policy units -------------------------------------------------------------
+
+def test_backoff_bounds_and_jitter():
+    # base 0 = reference's immediate failover.
+    assert backoff_delay(0, 0.0, 1000.0) == 0.0
+    assert backoff_delay(5, 0.0, 1000.0) == 0.0
+    # Exponential growth, symmetric jitter: attempt k in
+    # [base*2^k*(1-j), base*2^k*(1+j)] / 1000, capped at max_ms.
+    for attempt in range(5):
+        nominal = min(100.0 * (2 ** attempt), 800.0)
+        for _ in range(50):
+            d = backoff_delay(attempt, 100.0, 800.0, jitter=0.5)
+            assert nominal * 0.5 / 1000 <= d <= nominal * 1.5 / 1000
+    # jitter=0 is exact.
+    assert backoff_delay(2, 100.0, 10_000.0, jitter=0.0) == pytest.approx(0.4)
+
+
+def test_retry_budget_exhaustion_and_refill():
+    b = RetryBudget(ratio=0.5, min_retries=0, window_s=60.0)
+    for _ in range(10):
+        b.record_request()
+    # 0.5 * 10 = 5 retries allowed, the 6th is refused.
+    assert [b.try_acquire() for _ in range(6)] == [True] * 5 + [False]
+    # More traffic earns more budget: 14 requests -> 7 allowed, 5 spent.
+    for _ in range(4):
+        b.record_request()
+    assert [b.try_acquire() for _ in range(3)] == [True, True, False]
+
+
+def test_retry_budget_min_floor_and_disabled():
+    floor = RetryBudget(ratio=0.1, min_retries=2, window_s=60.0)
+    # Zero recent requests: the floor alone admits retries.
+    assert floor.try_acquire() and floor.try_acquire()
+    assert not floor.try_acquire()
+    unlimited = RetryBudget(ratio=None)
+    assert all(unlimited.try_acquire() for _ in range(1000))
+
+
+def test_latency_tracker_quantiles():
+    t = LatencyTracker(window=100)
+    assert t.quantile(0.99) is None
+    for v in range(1, 101):
+        t.record(v / 1000.0)
+    assert t.quantile(0.0) == pytest.approx(0.001)
+    assert t.quantile(1.0) == pytest.approx(0.100)
+    assert 0.090 <= t.quantile(0.95) <= 0.097
+    # Sliding: 100 more samples at a higher level displace the old ones.
+    for _ in range(100):
+        t.record(1.0)
+    assert t.quantile(0.5) == pytest.approx(1.0)
+
+
+def test_deadline_parsing_and_clamp():
+    assert Deadline.from_request({}) is None
+    d = Deadline.from_request({}, default_ms=50.0)
+    assert d is not None and 0 < d.remaining_ms() <= 50.0
+    assert Deadline.from_request({"deadline_ms": 0}).expired()
+    with pytest.raises(ValueError):
+        Deadline.from_request({"deadline_ms": -5})
+    with pytest.raises(ValueError):
+        Deadline.from_request({"deadline_ms": "bogus"})
+
+
+def test_admission_depth_drain_and_release():
+    a = AdmissionController(max_depth=2, node_id="t")
+    a.admit()
+    a.admit()
+    with pytest.raises(Overloaded):
+        a.admit()
+    a.release()
+    a.admit()  # slot freed
+    a.drain()
+    with pytest.raises(Overloaded):
+        a.admit()
+    # In-flight work finishes during drain; wait_idle observes it.
+    assert a.depth == 2
+    a.release()
+    a.release()
+    assert a.wait_idle(timeout_s=1.0)
+    a.undrain()
+    a.admit()
+    assert a.as_dict()["shed_overloaded"] == 1
+    assert a.as_dict()["shed_draining"] == 1
+
+
+# -- gateway ------------------------------------------------------------------
+
+class StubWorker:
+    """Scriptable lane: fail hard, or delay (slow-not-dead)."""
+
+    def __init__(self, node_id, delay_s=0.0):
+        self.node_id = node_id
+        self.fail = False
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def handle_infer(self, payload):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("device exploded")
+        return {"request_id": payload["request_id"], "output_data": [1.0],
+                "node_id": self.node_id, "cached": False,
+                "inference_time_us": 10}
+
+    def get_health(self):
+        return {"healthy": True, "node_id": self.node_id}
+
+
+def test_gateway_stats_schema_unchanged_at_defaults():
+    gw = Gateway([StubWorker(f"w{i}") for i in range(2)], GatewayConfig())
+    gw.route_request({"request_id": "r1", "input_data": [1.0]})
+    # Byte-compat guarantee: no resilience block until configured or used.
+    assert set(gw.get_stats()) == {"total_workers", "total_requests",
+                                   "failovers", "circuit_breakers"}
+
+
+def test_gateway_sheds_expired_deadline_at_admission():
+    gw = Gateway([StubWorker("w1")], GatewayConfig())
+    with pytest.raises(DeadlineExceeded):
+        gw.route_request({"request_id": "r", "input_data": [1.0],
+                          "deadline_ms": 0})
+    res = gw.get_stats()["resilience"]  # block appears once exercised
+    assert res["deadline_rejected"] == 1
+
+
+def test_gateway_default_deadline_applies():
+    gw = Gateway([StubWorker("w1", delay_s=0.3)],
+                 GatewayConfig(default_deadline_ms=0.0))
+    with pytest.raises(DeadlineExceeded):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+
+
+def test_gateway_retry_budget_stops_failover_storm():
+    ws = [StubWorker(f"w{i}") for i in range(3)]
+    for w in ws:
+        w.fail = True
+    gw = Gateway(ws, GatewayConfig(retry_budget_ratio=0.0,
+                                   retry_budget_min=1))
+    # First request: primary fails, ONE budgeted retry, then the budget
+    # refuses — the storm stops instead of marching the whole ring.
+    with pytest.raises(GatewayError, match="retry budget"):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+    res = gw.get_stats()["resilience"]
+    assert res["retries"] == 1
+    assert res["retry_budget_exhausted"] >= 1
+    assert sum(w.calls for w in ws) == 2  # primary + one retry, not 3
+
+
+def test_gateway_backoff_waits_between_failovers():
+    ws = [StubWorker(f"w{i}") for i in range(3)]
+    for w in ws:
+        w.fail = True
+    gw = Gateway(ws, GatewayConfig(retry_backoff_base_ms=40.0,
+                                   retry_jitter=0.0))
+    t0 = time.perf_counter()
+    with pytest.raises(GatewayError):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+    elapsed = time.perf_counter() - t0
+    # Two failover attempts: sleeps of 40 ms and 80 ms.
+    assert elapsed >= 0.11
+    assert gw.get_stats()["resilience"]["backoff_waits"] == 2
+
+
+def test_hedge_first_wins_and_loser_discarded():
+    cfg = GatewayConfig(hedge_enabled=True, hedge_min_ms=40.0,
+                        hedge_min_samples=10 ** 9)  # fixed 40 ms threshold
+    ws = [StubWorker(f"w{i}") for i in range(3)]
+    gw = Gateway(ws, cfg)
+    primary = gw.route_request({"request_id": "h", "input_data": [1.0]})["node_id"]
+    victim = next(w for w in ws if w.node_id == primary)
+    victim.delay_s = 0.5  # slow, NOT dead: breakers can't see this
+    t0 = time.perf_counter()
+    resp = gw.route_request({"request_id": "h", "input_data": [1.0]})
+    elapsed = time.perf_counter() - t0
+    assert resp["node_id"] != primary       # the hedge lane answered
+    assert elapsed < 0.4                    # did not wait out the slow lane
+    res = gw.get_stats()["resilience"]
+    assert res["hedges"] == 1 and res["hedge_wins"] == 1
+    # The loser was dispatched (then discarded), not cancelled pre-flight.
+    assert victim.calls == 2
+    # Breaker never tripped — the lane is healthy, just slow.
+    states = {e["node"]: e["state"]
+              for e in gw.get_stats()["circuit_breakers"]}
+    assert states[primary] == "CLOSED"
+
+
+def test_hedge_disabled_by_default():
+    ws = [StubWorker(f"w{i}") for i in range(2)]
+    gw = Gateway(ws, GatewayConfig())
+    primary = gw.route_request({"request_id": "h2", "input_data": [1.0]})["node_id"]
+    victim = next(w for w in ws if w.node_id == primary)
+    victim.delay_s = 0.15
+    t0 = time.perf_counter()
+    resp = gw.route_request({"request_id": "h2", "input_data": [1.0]})
+    assert resp["node_id"] == primary       # waited it out, like reference
+    assert time.perf_counter() - t0 >= 0.15
+
+
+def test_all_lanes_shedding_surfaces_as_503_not_500():
+    """Fleet-wide congestion must read as Overloaded (503 + Retry-After:
+    back off and retry), never the 500-class 'all workers failed'."""
+    from tpu_engine.serving.worker import WorkerNode
+
+    ws = [WorkerNode(WorkerConfig(node_id=f"s{i}", model="mlp",
+                                  dtype="float32", batch_buckets=(1, 2)))
+          for i in range(2)]
+    try:
+        gw = Gateway(ws, GatewayConfig())
+        for w in ws:
+            w.drain()
+        with pytest.raises(Overloaded):
+            gw.route_request({"request_id": "r", "input_data": [1.0]})
+        assert gw.get_stats()["resilience"]["shed_overloaded"] == 2
+        # Breakers untouched: shedding is a healthy-lane signal.
+        assert all(e["failures"] == 0
+                   for e in gw.get_stats()["circuit_breakers"])
+    finally:
+        for w in ws:
+            w.stop()
+
+
+def test_remove_worker_drain_marks_lane():
+    from tpu_engine.serving.worker import WorkerNode
+
+    w = WorkerNode(WorkerConfig(node_id="d1", model="mlp", dtype="float32",
+                                batch_buckets=(1, 2)))
+    try:
+        gw = Gateway([w], GatewayConfig())
+        gw.remove_worker("d1", drain=True)
+        assert w.draining
+        assert "d1" not in gw.worker_names()
+        with pytest.raises(Overloaded):
+            w.handle_infer({"request_id": "x", "input_data": [1.0]})
+        w.undrain()
+        assert w.handle_infer({"request_id": "x",
+                               "input_data": [1.0]})["node_id"] == "d1"
+    finally:
+        w.stop()
+
+
+def test_lane_suspect_deadline_feeds_breaker_but_clean_shed_does_not():
+    """A lane that HELD a request past its budget (hang signature) must
+    accrue breaker failures even though the request itself is a terminal
+    shed; a clean worker-side deadline 503 must not."""
+    gw = Gateway([StubWorker("w1")], GatewayConfig())
+
+    class SuspectClient:
+        def infer(self, payload):
+            exc = DeadlineExceeded("held past budget")
+            exc.lane_suspect = True
+            raise exc
+
+    gw._clients["w1"] = SuspectClient()
+    with pytest.raises(DeadlineExceeded):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+    assert gw.get_stats()["circuit_breakers"][0]["failures"] == 1
+
+    class CleanShedClient:
+        def infer(self, payload):
+            raise DeadlineExceeded("worker shed cleanly")
+
+    gw._clients["w1"] = CleanShedClient()
+    with pytest.raises(DeadlineExceeded):
+        gw.route_request({"request_id": "r", "input_data": [1.0]})
+    assert gw.get_stats()["circuit_breakers"][0]["failures"] == 1  # unchanged
+
+
+def test_predictive_shed_fails_over_instead_of_terminal_503():
+    """A lane PREDICTING it cannot meet a live deadline (EWMA > budget)
+    is a lane-local judgment: the gateway must fail over, not 503."""
+    from tpu_engine.serving.worker import WorkerNode
+
+    ws = [WorkerNode(WorkerConfig(node_id=f"p{i}", model="mlp",
+                                  dtype="float32", batch_buckets=(1, 2)))
+          for i in range(2)]
+    try:
+        gw = Gateway(ws, GatewayConfig())
+        payload = {"request_id": "pr1", "input_data": [4.0, 5.0],
+                   "deadline_ms": 500.0}
+        primary = gw.route_request(dict(payload, deadline_ms=60_000)) ["node_id"]
+        victim = next(w for w in ws if w.node_id == primary)
+        other = next(w for w in ws if w.node_id != primary)
+        victim._service_ewma_us = 10_000_000.0   # lane predicts 10 s misses
+        other.cache.clear(); victim.cache.clear()
+        resp = gw.route_request(dict(payload))
+        assert resp["node_id"] != primary        # failed over, served
+        assert all(e["failures"] == 0
+                   for e in gw.get_stats()["circuit_breakers"])
+    finally:
+        for w in ws:
+            w.stop()
+
+
+def test_coalesced_follower_recomputes_after_leader_deadline():
+    """A follower must not inherit the leader's DeadlineExceeded — the
+    leader's budget is not the follower's."""
+    from tpu_engine.serving.worker import WorkerNode, _Inflight
+
+    w = WorkerNode(WorkerConfig(node_id="co2", model="mlp", dtype="float32",
+                                batch_buckets=(1, 2)))
+    try:
+        key = w._cache_key([6.0, 7.0])
+        dead = _Inflight()
+        dead.error = DeadlineExceeded("leader budget expired")
+        dead.event.set()
+        w._inflight[key] = dead                  # simulate a dead leader
+        resp = w.handle_infer({"request_id": "f1",
+                               "input_data": [6.0, 7.0]})
+        assert resp["output_data"]               # recomputed, not 503
+    finally:
+        w.stop()
+
+
+def test_hedge_threshold_excludes_primary_lane():
+    """A degraded lane's own latency window must not raise ITS hedge
+    threshold — that feedback loop would self-disable hedging for
+    exactly the lane hedging exists to cover."""
+    gw = Gateway([StubWorker("w1"), StubWorker("w2")],
+                 GatewayConfig(hedge_enabled=True, hedge_min_ms=50.0,
+                               hedge_min_samples=8))
+    for _ in range(16):
+        gw._lane_tracker("w1").record(1.0)       # w1 degraded to 1 s
+        gw._lane_tracker("w2").record(0.002)     # w2 healthy
+    # Routing FOR w1: threshold comes from w2's window -> the 50 ms floor.
+    assert gw._hedge_threshold_s("w1") == pytest.approx(0.05)
+    # Routing FOR w2: w1's 1 s quantile is the only other lane -> 1 s.
+    assert gw._hedge_threshold_s("w2") == pytest.approx(1.0, rel=0.1)
+
+
+# -- batcher ------------------------------------------------------------------
+
+def test_batcher_drops_expired_items_at_batch_formation():
+    calls = []
+
+    def cb(items):
+        calls.append(list(items))
+        time.sleep(0.15)
+        return [i * 10 for i in items]
+
+    bp = BatchProcessor(4, 5.0, cb, name="dl-test")
+    bp.start()
+    try:
+        f1 = bp.submit(1)                                # occupies the lane
+        time.sleep(0.02)                                 # cb now sleeping
+        f2 = bp.submit(2, deadline=Deadline.after_ms(50))  # expires queued
+        f3 = bp.submit(3)                                # no deadline: runs
+        assert f1.result(timeout=5) == 10
+        with pytest.raises(DeadlineExceeded):
+            f2.result(timeout=5)
+        assert f3.result(timeout=5) == 30
+        assert bp.deadline_dropped == 1
+        assert all(2 not in batch for batch in calls)    # never dispatched
+    finally:
+        bp.stop()
+
+
+# -- continuous scheduler: mid-generation cancellation ------------------------
+
+@pytest.fixture(scope="module")
+def sched():
+    import jax
+
+    from tpu_engine.models.registry import (
+        _ensure_builtin_models_imported,
+        create_model,
+    )
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    _ensure_builtin_models_imported()
+    spec = create_model("gpt2-small-test")
+    s = ContinuousGenerator(spec, params=spec.init(jax.random.PRNGKey(0)),
+                            dtype="float32", n_slots=2, step_chunk=1)
+    yield s
+    s.stop()
+
+
+def test_scheduler_rejects_expired_before_prefill(sched):
+    fut = sched.submit([5, 9, 3], max_new_tokens=4,
+                       deadline=Deadline.after_ms(0))
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=30)
+    assert sched.stats().get("deadline_cancelled", 0) >= 1
+
+
+def test_scheduler_cancels_mid_generation_and_frees_row(sched):
+    import queue as queue_mod
+
+    q: "queue_mod.Queue" = queue_mod.Queue()
+    dl = Deadline.after_ms(60_000)
+    fut = sched.submit([5, 9, 3], max_new_tokens=50, deadline=dl, stream=q)
+    first = q.get(timeout=120)      # admitted: first token streamed
+    assert first
+    dl.at = 0.0                     # force expiry while decoding
+    with pytest.raises(DeadlineExceeded, match="mid-generation"):
+        fut.result(timeout=60)
+    # The row is FREED (not burning a lane) and the scheduler still serves.
+    deadline = time.monotonic() + 30
+    while sched.stats()["active"] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert sched.stats()["active"] == 0
+    assert sched.generate([[7, 2]], max_new_tokens=3)[0]  # alive after
+
+
+def test_worker_generate_deadline_at_admission():
+    from tpu_engine.serving.worker import WorkerNode
+
+    w = WorkerNode(WorkerConfig(node_id="g1", model="mlp", dtype="float32",
+                                batch_buckets=(1, 2)))
+    try:
+        # mlp has no generator, but admission (deadline/drain) fires first
+        # on /infer — the generate-path admission is the same controller.
+        with pytest.raises(DeadlineExceeded):
+            w.handle_infer({"request_id": "x", "input_data": [1.0],
+                            "deadline_ms": 0})
+        assert w.get_health()["admission"]["shed_deadline"] == 1
+    finally:
+        w.stop()
+
+
+# -- HTTP wire: 503 + Retry-After ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_worker():
+    from tpu_engine.serving.app import serve_worker
+
+    w, s = serve_worker(WorkerConfig(port=0, node_id="rw1", model="mlp",
+                                     dtype="float32",
+                                     batch_buckets=(1, 2, 4)))
+    yield w, s
+    s.stop()
+    w.stop()
+
+
+def _post(url, payload, timeout=15):
+    import json
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def test_http_deadline_shed_is_503_with_retry_after(http_worker):
+    w, s = http_worker
+    try:
+        _post(f"http://localhost:{s.port}/infer",
+              {"request_id": "r", "input_data": [1.0], "deadline_ms": 0})
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert e.headers["Retry-After"] is not None
+        import json
+
+        body = json.loads(e.read())
+        assert body["kind"] == "deadline_exceeded"
+
+
+def test_http_drain_endpoint_and_undrain(http_worker):
+    w, s = http_worker
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "drain"})
+    assert st == 200 and body["draining"] is True
+    try:
+        _post(f"http://localhost:{s.port}/infer",
+              {"request_id": "r", "input_data": [2.0]})
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        import json
+
+        assert json.loads(e.read())["kind"] == "overloaded"
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "undrain"})
+    assert st == 200 and body["draining"] is False
+    st, body, _ = _post(f"http://localhost:{s.port}/infer",
+                        {"request_id": "r", "input_data": [2.0]})
+    assert st == 200
+
+
+def test_http_client_maps_503_kinds(http_worker):
+    from tpu_engine.serving.clients import HttpWorkerClient
+
+    w, s = http_worker
+    client = HttpWorkerClient(f"localhost:{s.port}")
+    with pytest.raises(DeadlineExceeded):
+        client.infer({"request_id": "r", "input_data": [3.0],
+                      "deadline_ms": 0})
+    w.drain()
+    try:
+        with pytest.raises(Overloaded):
+            client.infer({"request_id": "r", "input_data": [3.0]})
+    finally:
+        w.undrain()
+
+
+# -- multihost lockstep: abandoned items --------------------------------------
+
+def test_lockstep_abandoned_item_never_burns_a_row():
+    """Regression for the multihost lockstep leak: a client that timed out
+    (or whose deadline expired) left its _Pending in the queue, and a
+    LATER tick burned a data-shard row computing for it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_engine.models.registry import (
+        _ensure_builtin_models_imported,
+        create_model,
+    )
+    from tpu_engine.parallel.distributed import hybrid_mesh
+    from tpu_engine.parallel.multihost_serving import (
+        LockstepMeshServer,
+        _Pending,
+    )
+
+    _ensure_builtin_models_imported()
+    spec = create_model("mlp", input_dim=8, hidden_dim=16, output_dim=8,
+                        num_layers=2)
+    params = spec.init(jax.random.PRNGKey(0))
+    mesh = hybrid_mesh((2, 4), ("data", "model"))
+    srv = LockstepMeshServer(mesh, spec.apply, params, sample_shape=(8,),
+                             dtype=jnp.float32)
+    # No run() loop: the handler's deadline expires, the item must be
+    # MARKED abandoned (the fix) and skipped at tick assembly.
+    status, body = srv._handle_infer({"request_id": "gone",
+                                      "input_data": [0.0] * 8,
+                                      "deadline_ms": 30})
+    assert status == 503 and body["kind"] == "deadline_exceeded"
+    live = _Pending(x=np.zeros((8,), np.float32))
+    srv._q.put(live)
+    items = srv._collect_items(0.01)
+    assert items == [live]          # abandoned item skipped, not computed
+    assert srv._q.empty()
+    # An expired deadline at admission never enqueues at all.
+    status, body = srv._handle_infer({"request_id": "dead",
+                                      "input_data": [0.0] * 8,
+                                      "deadline_ms": 0})
+    assert status == 503
+    assert srv._q.empty()

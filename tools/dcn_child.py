@@ -174,4 +174,16 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except OSError as exc:
+        import errno
+
+        if getattr(exc, "errno", None) == errno.EADDRINUSE:
+            # The parent probed these ports with free_ports() and another
+            # process bound one first. A distinct marker + exit code lets
+            # the launcher (training/dryrun.run_dcn_pair) classify this as
+            # a port race and relaunch on fresh ports.
+            print(f"BIND-FAIL {exc}", flush=True)
+            sys.exit(97)
+        raise

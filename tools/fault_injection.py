@@ -12,9 +12,20 @@ HALF_OPEN; 2 successes -> CLOSED, gateway.cpp:19-23 semantics):
   phase 3  heal the lane, wait breaker timeout     -> probe, breaker CLOSED
   phase 4  final load                              -> 100% success again
 
+``--slow-lane`` appends phase 5, the failure mode breakers CANNOT answer
+(the lane is slow, not dead — it keeps answering, so the breaker stays
+CLOSED): one lane gets per-request latency injected past the hedge
+threshold, and deadline-carrying load must stay fast — the resilience
+layer's hedged dispatch answers from a healthy lane, p99 stays bounded by
+the deadline, no successful response exceeds its deadline, and the
+``/stats`` hedge/shed/retry counters must be consistent with the fault.
+Phase 5 requires the server started with hedging on, e.g.:
+  python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
+      --port 8000 --breaker-timeout 2 --hedge --hedge-min-ms 100
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
-      [--requests-per-phase 60] [--breaker-timeout 2.0]
+      [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -84,6 +95,73 @@ def breaker_state(port: int, victim: str):
     return None, stats.get("failovers", 0)
 
 
+def slow_lane_phase(port: int, victim: str, victim_ids, n: int,
+                    checks: list, latency_s: float = 1.0,
+                    deadline_ms: float = 2000.0) -> dict:
+    """Phase 5: the victim lane is SLOW (not dead). Deadline-carrying load
+    on victim-routed ids must be answered fast by hedging — and every
+    success must land inside its deadline."""
+    before = _call(port, "GET", "/stats")[1].get("resilience", {})
+    _call(port, "POST", "/admin/fault",
+          {"node": victim, "action": "slow", "latency_s": latency_s})
+    lats_ms, ok, shed, fail = [], 0, 0, 0
+    nodes = {}
+    try:
+        for i, rid in enumerate(victim_ids[:n]):
+            t0 = time.perf_counter()
+            try:
+                # DISTINCT inputs: phase 0-4 warmed the result caches (and
+                # the native C++ front answers hits without touching the
+                # slowed Python lane at all) — only misses exercise the
+                # slow path hedging must rescue.
+                status, body = _call(port, "POST", "/infer", {
+                    "request_id": rid,
+                    "input_data": [5e6 + i, 5e6 + i + 0.25, 5e6 + i + 0.5],
+                    "deadline_ms": deadline_ms,
+                }, timeout=deadline_ms / 1000.0 + latency_s + 10)
+            except OSError:
+                fail += 1
+                continue
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            if status == 200:
+                ok += 1
+                lats_ms.append(lat_ms)
+                nodes[body["node_id"]] = nodes.get(body["node_id"], 0) + 1
+            elif status == 503:
+                shed += 1  # an honest shed beats a deadline-blown success
+            else:
+                fail += 1
+    finally:
+        _call(port, "POST", "/admin/fault",
+              {"node": victim, "action": "heal"})
+    after = _call(port, "GET", "/stats")[1].get("resilience", {})
+    lats_ms.sort()
+    p99 = lats_ms[int(0.99 * (len(lats_ms) - 1))] if lats_ms else None
+    hedges = after.get("hedges", 0) - before.get("hedges", 0)
+    wins = after.get("hedge_wins", 0) - before.get("hedge_wins", 0)
+    losses = after.get("hedge_losses", 0) - before.get("hedge_losses", 0)
+    report = {"ok": ok, "shed": shed, "fail": fail, "nodes": nodes,
+              "p99_ms": p99, "deadline_ms": deadline_ms,
+              "injected_latency_ms": latency_s * 1e3,
+              "hedges": hedges, "hedge_wins": wins,
+              "hedge_losses": losses, "resilience": after}
+    checks.append(("slow lane: no hard failures", fail == 0))
+    checks.append(("slow lane: requests answered", ok > 0))
+    checks.append(("slow lane: no success exceeded its deadline",
+                   all(l <= deadline_ms for l in lats_ms)))
+    checks.append(("slow lane: p99 bounded by the deadline",
+                   p99 is not None and p99 <= deadline_ms))
+    checks.append(("slow lane: hedges fired", hedges > 0))
+    checks.append(("slow lane: hedge wins recorded", wins > 0))
+    checks.append(("slow lane: hedge accounting consistent",
+                   wins >= 0 and losses >= 0 and wins + losses <= hedges))
+    # The breaker must NOT have opened — the lane answers, just slowly;
+    # this is exactly the gap the resilience layer closes.
+    state, _ = breaker_state(port, victim)
+    checks.append(("slow lane: breaker stayed CLOSED", state == "CLOSED"))
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=8000)
@@ -91,6 +169,13 @@ def main() -> int:
     ap.add_argument("--requests-per-phase", type=int, default=60)
     ap.add_argument("--breaker-timeout", type=float, default=30.0,
                     help="server's breaker_timeout_s (phase 3 waits this long)")
+    ap.add_argument("--slow-lane", action="store_true",
+                    help="append phase 5: slow (not dead) lane; requires "
+                         "the server started with --hedge")
+    ap.add_argument("--slow-latency", type=float, default=1.0,
+                    help="phase 5 injected per-request latency (seconds)")
+    ap.add_argument("--deadline-ms", type=float, default=2000.0,
+                    help="phase 5 per-request deadline budget")
     args = ap.parse_args()
     port, n = args.port, args.requests_per_phase
     checks = []
@@ -142,6 +227,12 @@ def main() -> int:
     ok, fail, nodes = load(port, all_ids[:n], "final")
     report["phases"]["final"] = {"ok": ok, "fail": fail, "nodes": nodes}
     checks.append(("final 100% success", fail == 0))
+
+    # Phase 5 (--slow-lane): slow-not-dead lane under deadline load.
+    if args.slow_lane:
+        report["phases"]["slow_lane"] = slow_lane_phase(
+            port, victim, victim_ids, n, checks,
+            latency_s=args.slow_latency, deadline_ms=args.deadline_ms)
 
     report["checks"] = {name: passed for name, passed in checks}
     report["passed"] = all(p for _, p in checks)

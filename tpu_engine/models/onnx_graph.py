@@ -357,15 +357,36 @@ def _require_ints(name: str, env, static, op: str) -> List[int]:
     return v
 
 
-def _op_gather(env, node, _dtype):
+def _op_gather(env, node, static):
     data = env[node.inputs[0]]
+    axis = int(node.attrs.get("axis", 0))
+    dim = int(data.shape[axis])
+    concrete = _static_value(node.inputs[1], env, static)
+    if concrete is not None:
+        # Trace-time-known indices (initializers / Constant / Shape-
+        # derived): enforce ONNX/ORT bounds semantics EXACTLY — an
+        # out-of-range id is a graph bug and must fail at load, never
+        # silently clamp (dim exclusive above, -dim inclusive below,
+        # negatives wrap).
+        ids = np.asarray(concrete, np.int64)
+        if ids.size and (ids.min() < -dim or ids.max() >= dim):
+            raise ValueError(
+                f"Gather: index out of bounds for axis {axis} with dim "
+                f"{dim}: indices span [{ids.min()}, {ids.max()}] "
+                "(ORT raises here; refusing at graph load)")
+        idx = jnp.asarray(ids.astype(np.int32))
+        return jnp.take(data, idx, axis=axis)
+    # Data-dependent indices (they arrive in the REQUEST, e.g. token ids
+    # feeding an embedding Gather): raising inside jit isn't possible, so
+    # clamp — deterministic and visible, never NaN-poison. This is a
+    # DOCUMENTED wire-visible deviation from ORT, which fails the request
+    # instead (MIGRATION.md "Known deviations"): out-of-range ids return
+    # the row at the clamped index rather than an error. jnp.take's
+    # "clip" clamps to [0, dim-1]; ONNX-legal negatives first wrap via
+    # `where` so [-dim, -1] still address from the end like ORT.
     idx = jnp.asarray(env[node.inputs[1]]).astype(jnp.int32)
-    # clip, not jnp.take's default NaN-fill: an out-of-range id from a
-    # client must not silently turn the whole response into NaNs (ORT
-    # raises here; raising data-dependently inside jit isn't possible,
-    # so clamp — deterministic and visible, never poison).
-    return jnp.take(data, idx, axis=int(node.attrs.get("axis", 0)),
-                    mode="clip")
+    idx = jnp.where(idx < 0, idx + dim, idx)
+    return jnp.take(data, idx, axis=axis, mode="clip")
 
 
 def _op_slice(env, node, static):
@@ -543,7 +564,7 @@ def _eval_node(env, node: OnnxNode, dtype, static) -> object:
         return jax.nn.gelu(env[node.inputs[0]].astype(jnp.float32),
                            approximate=approx == "tanh")
     if op == "Gather":
-        return _op_gather(env, node, dtype)
+        return _op_gather(env, node, static)
     if op == "Slice":
         return _op_slice(env, node, static)
     if op == "Split":

@@ -46,6 +46,11 @@ class _Pending:
     x: np.ndarray  # one sample, sample_shape
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[np.ndarray] = None
+    # Set by the HTTP handler when its client gave up (tick timeout or an
+    # expired request deadline): the lockstep loop must SKIP the item
+    # instead of burning a data-shard row of a later tick computing an
+    # answer nobody will read.
+    abandoned: bool = False
 
 
 class LockstepMeshServer:
@@ -79,6 +84,12 @@ class LockstepMeshServer:
     def _handle_infer(self, body):
         if self._stop.is_set():
             return 503, {"error": "server stopping"}
+        from tpu_engine.utils.deadline import Deadline
+
+        req_deadline = Deadline.from_request(body)  # optional deadline_ms
+        if req_deadline is not None and req_deadline.expired():
+            return 503, {"error": "deadline exceeded at admission",
+                         "kind": "deadline_exceeded"}
         flat = np.asarray(body["input_data"], np.float32).ravel()
         want = int(np.prod(self.sample_shape))
         if flat.size > want:
@@ -91,7 +102,9 @@ class LockstepMeshServer:
         # Poll instead of one long wait: a request that slips in between
         # the stop flag and the shutdown drains must resolve itself (503)
         # rather than hold the HTTP server's drain hostage for 10 s.
-        deadline = time.monotonic() + 300.0
+        deadline = time.monotonic() + (
+            300.0 if req_deadline is None
+            else min(300.0, max(0.0, req_deadline.remaining_s())))
         while not item.event.wait(timeout=0.1):
             if self._stop.is_set():
                 # One grace wait: the loop may still be executing our tick
@@ -99,6 +112,17 @@ class LockstepMeshServer:
                 item.event.wait(timeout=1.0)
                 break
             if time.monotonic() > deadline:
+                # The client is gone (tick timeout / expired deadline):
+                # MARK the queued item so a later tick skips it — before
+                # this flag, the loop would still burn a data-shard row
+                # computing for a caller that already got its error.
+                item.abandoned = True
+                if req_deadline is not None and req_deadline.expired():
+                    return 503, {"error": "deadline exceeded",
+                                 "kind": "deadline_exceeded"}
+                # The 300 s tick cap fired with client budget left: a
+                # retryable stall, not a spent deadline — keep the 500 so
+                # gateways fail over instead of giving up.
                 return 500, {"error": "lockstep tick timed out"}
         if item.result is None:  # drained (or abandoned) by shutdown
             return 503, {"error": "server stopping"}
@@ -118,6 +142,25 @@ class LockstepMeshServer:
         self._stop.set()
 
     # -- the lockstep loop ----------------------------------------------------
+
+    def _collect_items(self, poll_s: float) -> list:
+        """Leader-side tick assembly: drain up to `batch` LIVE items.
+        Abandoned items (client timed out / deadline expired and already
+        got its error response) are dropped here — before this check a
+        later tick would compute a data-shard row for nobody (the
+        multihost flavor of the burned-batch-row leak)."""
+        items: list = []
+        try:
+            while len(items) < self.batch:
+                it = (self._q.get(timeout=poll_s) if not items
+                      else self._q.get_nowait())
+                if it.abandoned:
+                    it.event.set()  # nothing waits; keep event invariants
+                    continue
+                items.append(it)
+        except queue.Empty:
+            pass
+        return items
 
     def _payload_buf(self, items) -> np.ndarray:
         # Rows land directly in the flat buffer; the leader resolves
@@ -152,16 +195,12 @@ class LockstepMeshServer:
                     if self._stop.is_set():
                         cmd_buf = np.asarray([CMD_STOP], np.float32)
                     else:
-                        try:
-                            items.append(self._q.get(timeout=poll_s))
-                            # Coalesce: each concurrent request takes a
-                            # data-shard row of the SAME tick — one DCN
-                            # broadcast + one SPMD dispatch for up to
-                            # `batch` requests, not one each.
-                            while len(items) < self.batch:
-                                items.append(self._q.get_nowait())
-                        except queue.Empty:
-                            pass
+                        # Coalesce: each concurrent request takes a
+                        # data-shard row of the SAME tick — one DCN
+                        # broadcast + one SPMD dispatch for up to
+                        # `batch` requests, not one each. Abandoned items
+                        # are skipped inside _collect_items.
+                        items = self._collect_items(poll_s)
                         cmd_buf = np.asarray(
                             [CMD_INFER if items else CMD_IDLE], np.float32)
                 else:

@@ -35,6 +35,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable, Generic, List, Optional, Sequence, Tuple, TypeVar
 
+from tpu_engine.utils.deadline import Deadline, DeadlineExceeded
+
 Request = TypeVar("Request")
 Response = TypeVar("Response")
 
@@ -115,7 +117,11 @@ class BatchProcessor(Generic[Request, Response]):
             self._ready_cb = _safe_ready
         self._depth = max(1, int(pipeline_depth)) if submit_callback else 1
         self._name = name
-        self._queue: List[Tuple[Request, Future]] = []
+        # Entries are (request, future, deadline-or-None). Expired entries
+        # are failed at batch-formation time instead of burning a batch
+        # row on a client that already gave up (resilience layer).
+        self._queue: List[Tuple[Request, Future, Optional[Deadline]]] = []
+        self.deadline_dropped = 0  # expired-in-queue count (observability)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._running = False
@@ -147,7 +153,7 @@ class BatchProcessor(Generic[Request, Response]):
         # implicitly by destructing promises; we fail them explicitly).
         with self._lock:
             pending, self._queue = self._queue, []
-        for _, fut in pending:
+        for _, fut, _dl in pending:
             if not fut.done():
                 fut.set_exception(RuntimeError("batch processor stopped"))
 
@@ -157,20 +163,24 @@ class BatchProcessor(Generic[Request, Response]):
 
     # -- request path --------------------------------------------------------
 
-    def process(self, request: Request, timeout: Optional[float] = None) -> Response:
+    def process(self, request: Request, timeout: Optional[float] = None,
+                deadline: Optional[Deadline] = None) -> Response:
         """Enqueue and block until the batch containing this request returns
         (reference ``batch_processor.h:91-103``)."""
-        fut = self.submit(request)
+        fut = self.submit(request, deadline=deadline)
         return fut.result(timeout=timeout)
 
-    def submit(self, request: Request) -> "Future":
+    def submit(self, request: Request,
+               deadline: Optional[Deadline] = None) -> "Future":
         """Non-blocking enqueue returning the future (enables async callers —
-        capability the reference's blocking-only API lacks)."""
+        capability the reference's blocking-only API lacks). An expired
+        ``deadline`` at batch-formation time fails the future with
+        ``DeadlineExceeded`` instead of occupying a batch row."""
         fut: Future = Future()
         with self._cv:
             if not self._running:
                 raise RuntimeError("batch processor is not running")
-            self._queue.append((request, fut))
+            self._queue.append((request, fut, deadline))
             self._cv.notify()
         with self._metrics_lock:
             self._metrics.total_requests += 1
@@ -235,11 +245,9 @@ class BatchProcessor(Generic[Request, Response]):
                             self._cv.wait(timeout=min(remaining, 0.002))
                         if not self._running:
                             break
-                        batch = self._queue[: self._max_batch_size]
-                        del self._queue[: len(batch)]
+                        batch = self._take_batch_locked()
                 else:
-                    batch = self._queue[: self._max_batch_size]
-                    del self._queue[: len(batch)]
+                    batch = self._take_batch_locked()
             if batch:
                 if self._submit_cb is None:
                     self._process_batch(batch, timed_out)
@@ -261,6 +269,30 @@ class BatchProcessor(Generic[Request, Response]):
                 self._collect(*inflight.pop(0))
         for entry in inflight:  # shutdown: drain what was already dispatched
             self._collect(*entry)
+
+    def _take_batch_locked(self) -> List[Tuple[Request, Future]]:
+        """Take up to max_batch_size live entries off the queue (caller
+        holds the lock). Entries whose deadline expired while queued are
+        failed with DeadlineExceeded and never enter a batch — the
+        resilience layer's 'don't burn a batch row for a client that gave
+        up'. One del at the end keeps extraction O(queue) — per-element
+        pop(0) would shift the whole backlog per item inside this critical
+        section, exactly when the queue is deepest."""
+        batch: List[Tuple[Request, Future]] = []
+        taken = 0
+        for req, fut, dl in self._queue:
+            taken += 1
+            if dl is not None and dl.expired():
+                self.deadline_dropped += 1
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        "deadline expired while queued for batching"))
+                continue
+            batch.append((req, fut))
+            if len(batch) >= self._max_batch_size:
+                break
+        del self._queue[:taken]
+        return batch
 
     def _submit(self, batch: List[Tuple[Request, Future]]):
         try:

@@ -118,6 +118,12 @@ class InferenceEngine:
         self._compile_times: Dict[int, float] = {}
         self._stats_lock = threading.Lock()
         self._execute_count = 0
+        # Wall-clock the host spends BLOCKED in batch_collect materializing
+        # device values. Near-zero = the submit/collect pipeline is hiding
+        # the device round-trip; large = the device (or link) is the
+        # bottleneck and admission control should bite sooner. Feeds
+        # /health via stats() for the resilience layer's observability.
+        self._collect_block_s = 0.0
         # Wire buckets: the host→device payload is only as wide as the bytes
         # the client actually sent, rounded up to one of these; the compiled
         # graph zero-pads to the model's input size ON DEVICE. The reference
@@ -419,18 +425,23 @@ class InferenceEngine:
         """Materialize phase: block on the handle's device values and split
         them per request (reference output split, ``:195-206``)."""
         kind, n, pending = handle
-        if kind == "shaped":
-            out: List[np.ndarray] = [None] * n  # type: ignore
-            for chunk, y in pending:
+        t0 = time.perf_counter()
+        try:
+            if kind == "shaped":
+                out: List[np.ndarray] = [None] * n  # type: ignore
+                for chunk, y in pending:
+                    y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
+                    for row, i in enumerate(chunk):
+                        out[i] = y_host[row]
+                return out
+            out = []
+            for n_real, y in pending:
                 y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
-                for row, i in enumerate(chunk):
-                    out[i] = y_host[row]
+                out.extend(y_host[i] for i in range(n_real))
             return out
-        out = []
-        for n_real, y in pending:
-            y_host = np.asarray(y, dtype=np.float32).reshape(y.shape[0], -1)
-            out.extend(y_host[i] for i in range(n_real))
-        return out
+        finally:
+            with self._stats_lock:
+                self._collect_block_s += time.perf_counter() - t0
 
     def _batch_submit_shaped(self, inputs: Sequence, shapes: Sequence):
         """Mixed-shape dispatch: group by shape bucket, dispatch every
@@ -480,6 +491,7 @@ class InferenceEngine:
             "compiled_buckets": sorted(self._executables, key=str),
             "compile_times_s": {str(k): round(v, 4) for k, v in self._compile_times.items()},
             "execute_count": self._execute_count,
+            "collect_block_s": round(self._collect_block_s, 4),
             "mesh": None if self._mesh is None else {
                 "axes": dict(self._mesh.shape),
                 "n_devices": self._mesh.size,

@@ -54,6 +54,7 @@ from tpu_engine.runtime.generator import (
     start_host_copies,
     token_counts,
 )
+from tpu_engine.utils.deadline import Deadline, DeadlineExceeded
 from tpu_engine.utils.sampling import (
     MAX_STOP_TOKENS,
     clamp_top_k,
@@ -81,6 +82,9 @@ class _Request:
     # final result or the error). `streamed` counts tokens already pushed.
     stream: Optional["queue.Queue"] = None
     streamed: int = 0
+    # Resilience: expired requests are refused before prefill and
+    # cancelled between decode chunks (the row frees for live work).
+    deadline: Optional[Deadline] = None
 
 
 class _PrefixCache:
@@ -217,6 +221,10 @@ class ContinuousGenerator:
         self._insert_exe = {}  # {with_counts flag: compiled insert}
         self._decode_exe = {}  # {controls flag: compiled chunk}
         self._stats = {"admitted": 0, "completed": 0, "chunks": 0}
+        # deadline_cancelled is bumped from BOTH the prefill and decode
+        # threads; a bare read-modify-write would drop counts under
+        # contention. Every other _stats key is decode-thread-only.
+        self._stats_lock = threading.Lock()
         self._prefix_cache = _PrefixCache(int(prefix_cache_mb) * (1 << 20))
         # Chunked prefill: prompts longer than this admit via a sequence
         # of window-decode dispatches instead of one monolithic prefill,
@@ -395,13 +403,16 @@ class ContinuousGenerator:
                eos_id: int = -1, temperature: float = 0.0, seed: int = 0,
                top_p: float = 1.0, top_k: int = 0,
                repetition_penalty: float = 1.0, stop_tokens=None,
-               min_p: float = 0.0, stream=None) -> Future:
+               min_p: float = 0.0, stream=None,
+               deadline: Optional[Deadline] = None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
         `repetition_penalty`/`stop_tokens` follow Generator.generate's
         semantics (HF-style penalty; <=8 stop ids ending the row like
-        EOS)."""
+        EOS). `deadline`: optional Deadline — the future resolves with
+        DeadlineExceeded if it expires before prefill or mid-decode (the
+        row is freed; already-streamed tokens stand)."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
         pens, stops = expand_stopping_params(1, repetition_penalty,
@@ -413,7 +424,7 @@ class ContinuousGenerator:
                        float(temperature), int(seed), float(top_p),
                        clamp_top_k(top_k), rep_penalty=pens[0],
                        stop_tokens=stops[0], min_p=float(min_p),
-                       stream=stream)
+                       stream=stream, deadline=deadline)
         self._queue.put(req)
         return req.future
 
@@ -468,6 +479,14 @@ class ContinuousGenerator:
     def _free_rows(self) -> List[int]:
         return [r for r in range(self.n_slots) if self._row_req[r] is None]
 
+    def _cancel_deadline(self, req: _Request, message: str) -> None:
+        """Fail one request with DeadlineExceeded and count it (lock: the
+        prefill and decode threads both cancel)."""
+        with self._stats_lock:
+            self._stats["deadline_cancelled"] = (
+                self._stats.get("deadline_cancelled", 0) + 1)
+        self._fail_request(req, DeadlineExceeded(message))
+
     @staticmethod
     def _fail_request(req: _Request, exc: BaseException) -> None:
         """Resolve a request with an error AND unblock its stream consumer
@@ -489,6 +508,11 @@ class ContinuousGenerator:
             req = self._queue.get()
             if req is None:
                 break
+            if req.deadline is not None and req.deadline.expired():
+                # The client's budget ran out while the request queued —
+                # skip the prefill forward entirely.
+                self._cancel_deadline(req, "deadline expired before prefill")
+                continue
             try:
                 item = self._run_prefill(req)
             except Exception as exc:
@@ -675,6 +699,22 @@ class ContinuousGenerator:
             self._done[row] = True
             self._stats["completed"] += 1
 
+    def _cancel_expired_rows(self) -> None:
+        """Mid-generation deadline enforcement: a row whose client budget
+        ran out is failed and freed BETWEEN chunks, so the next decode
+        chunk spends its lane on a live request instead. Tokens already
+        streamed stand; the future resolves with DeadlineExceeded."""
+        for r, req in enumerate(self._row_req):
+            if req is None or req.deadline is None:
+                continue
+            if req.deadline.expired():
+                self._cancel_deadline(
+                    req, "deadline exceeded mid-generation "
+                    f"({len(self._row_emitted[r])} tokens emitted)")
+                self._row_req[r] = None
+                self._row_emitted[r] = []
+                self._done[r] = True
+
     def _recover(self, exc: BaseException) -> None:
         """Device-step failure recovery. The prefill/decode executables
         donate ``self._caches``, so after a failed step the KV buffer may
@@ -740,6 +780,13 @@ class ContinuousGenerator:
                     break
                 if item is None:
                     return
+                req = item[0]
+                if req.deadline is not None and req.deadline.expired():
+                    # Prefilled but the budget ran out before a row freed:
+                    # drop the KV block instead of occupying a slot.
+                    self._cancel_deadline(
+                        req, "deadline expired before row admission")
+                    continue
                 try:
                     self._admit(item, free.pop(0))
                     admitted_any = True
@@ -749,6 +796,7 @@ class ContinuousGenerator:
                     self._fail_request(item[0], exc)
                     self._recover(exc)
                     break
+            self._cancel_expired_rows()
             if all(r is None for r in self._row_req):
                 continue
 

@@ -20,6 +20,7 @@ from tpu_engine.serving.gateway import Gateway
 from tpu_engine.serving.http import JsonHttpServer
 from tpu_engine.serving.worker import WorkerNode
 from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+from tpu_engine.utils.deadline import ShedError
 from tpu_engine.utils.metrics import render_prometheus
 
 
@@ -57,6 +58,22 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
         200, worker.reload_weights(body["model_path"])))
     server.route("POST", "/score", lambda body: (
         200, worker.handle_score(body)))
+
+    # Drain (lame-duck): refuse new admissions with 503 + Retry-After while
+    # in-flight work completes — the graceful half of removing a worker
+    # from a gateway's ring (the reference's only removal is SIGKILL).
+    def _admin_drain(body):
+        action = (body or {}).get("action", "drain")
+        if action == "drain":
+            worker.drain()
+        elif action == "undrain":
+            worker.undrain()
+        else:
+            return 400, {"error": "action must be drain|undrain"}
+        return 200, {"ok": True, "node_id": worker.node_id,
+                     "draining": worker.draining}
+
+    server.route("POST", "/admin/drain", _admin_drain)
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -269,7 +286,9 @@ def serve_combined(
     # Fault injection (BASELINE config 5). The reference injects faults by
     # killing worker processes (README.md:322-349); in-process lanes expose
     # an explicit admin hook instead: {"node": "worker_1", "action":
-    # "fail"|"heal"}.
+    # "fail"|"heal"|"slow"}. "slow" adds {"latency_s": X} of delay per
+    # request WITHOUT failing — the slow-lane fault breakers cannot see,
+    # which the resilience layer (deadlines/hedging) exists to answer.
     def _admin_fault(body):
         node = body.get("node")
         action = body.get("action", "fail")
@@ -277,11 +296,45 @@ def serve_combined(
         if not targets:
             return 404, {"error": f"unknown node '{node}'"}
         for w in targets:
-            w.inject_fault() if action == "fail" else w.heal()
+            if action == "fail":
+                w.inject_fault()
+            elif action == "slow":
+                w.inject_latency(float(body.get("latency_s", 1.0)))
+            else:
+                w.heal()
         return 200, {"ok": True, "nodes": [w.node_id for w in targets],
                      "action": action}
 
     routes[("POST", "/admin/fault")] = _admin_fault
+
+    # Drain (lame-duck) mode: {"node": "worker_1"|"*", "action":
+    # "drain"|"undrain", "remove": false}. "remove": true additionally
+    # takes the drained lane off the hash ring (graceful removal — the
+    # resilience-layer answer to the reference's kill-the-process).
+    def _admin_drain(body):
+        node = body.get("node")
+        action = body.get("action", "drain")
+        if action not in ("drain", "undrain"):
+            return 400, {"error": "action must be drain|undrain"}
+        targets = [w for w in workers
+                   if w.node_id == node or node in (None, "*")]
+        if not targets:
+            return 404, {"error": f"unknown node '{node}'"}
+        for w in targets:
+            if action == "drain":
+                w.drain()
+                if body.get("remove"):
+                    # Already drained above — plain ring removal (the
+                    # drain=True flavor would drain the same lane twice).
+                    gateway.remove_worker(w.node_id)
+            else:
+                w.undrain()
+        return 200, {"ok": True, "action": action,
+                     "nodes": [w.node_id for w in targets],
+                     "removed": bool(body.get("remove"))
+                     and action == "drain"}
+
+    routes[("POST", "/admin/drain")] = _admin_drain
 
     # Tracing (SURVEY.md §5: the reference has only per-request wall clocks).
     def _trace(_body):
@@ -442,6 +495,12 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
                     payload = b"".join(payload)
                 else:
                     payload = _json.dumps(payload).encode()
+        except ShedError as exc:
+            # Resilience refusal (deadline/overload/drain): 503 with the
+            # machine-readable kind. (The C++ reply path carries no extra
+            # headers, so Retry-After rides only the Python front.)
+            return 503, _json.dumps({"error": str(exc),
+                                     "kind": exc.kind}).encode()
         except (KeyError, ValueError, TypeError) as exc:
             return 400, _json.dumps({"error": str(exc)}).encode()
         except Exception as exc:

@@ -174,6 +174,37 @@ def main(argv=None) -> int:
         parser.add_argument("--breaker-timeout", type=float, default=None,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (default 30, reference gateway.cpp:22)")
+        # -- resilience layer (DESIGN.md "Request resilience"; every knob
+        # defaults off/permissive = reference-faithful behavior) ---------
+        parser.add_argument("--default-deadline-ms", type=float, default=None,
+                            help="deadline applied to requests without a "
+                                 "deadline_ms field; expired requests shed "
+                                 "503 + Retry-After instead of queueing "
+                                 "(default: no deadline)")
+        parser.add_argument("--retry-budget", type=float, default=None,
+                            help="global retry budget: failover retries "
+                                 "capped at this fraction of recent "
+                                 "requests, e.g. 0.1 (default: unlimited)")
+        parser.add_argument("--retry-backoff-ms", type=float, default=None,
+                            help="base exponential backoff between failover "
+                                 "attempts, with +/-50%% jitter (default 0 "
+                                 "= immediate ring-order march)")
+        parser.add_argument("--hedge", action="store_true",
+                            help="hedged dispatch for idempotent ops: when "
+                                 "the primary lane exceeds the hedge "
+                                 "latency quantile, fire the next lane and "
+                                 "take the first response")
+        parser.add_argument("--hedge-quantile", type=float, default=None,
+                            help="latency quantile that arms a hedge "
+                                 "(default 0.95)")
+        parser.add_argument("--hedge-min-ms", type=float, default=None,
+                            help="floor under the hedge threshold; also "
+                                 "the threshold until enough samples "
+                                 "(default 50)")
+        parser.add_argument("--max-queue-depth", type=int, default=None,
+                            help="per-lane admission cap: concurrent "
+                                 "requests beyond this shed 503 "
+                                 "(default 0 = unbounded)")
         parser.add_argument("--gen-scheduler",
                             choices=["batch", "continuous", "speculative"],
                             default="continuous",
@@ -212,12 +243,26 @@ def main(argv=None) -> int:
                                  "kernels stored int8 with per-channel "
                                  "scales (halves weight HBM traffic)")
         args = parser.parse_args(rest)
-        gateway_config = None
+        gw_kw = {}
         if args.breaker_timeout is not None:
+            gw_kw["breaker_timeout_s"] = args.breaker_timeout
+        if args.default_deadline_ms is not None:
+            gw_kw["default_deadline_ms"] = args.default_deadline_ms
+        if args.retry_budget is not None:
+            gw_kw["retry_budget_ratio"] = args.retry_budget
+        if args.retry_backoff_ms is not None:
+            gw_kw["retry_backoff_base_ms"] = args.retry_backoff_ms
+        if args.hedge:
+            gw_kw["hedge_enabled"] = True
+        if args.hedge_quantile is not None:
+            gw_kw["hedge_quantile"] = args.hedge_quantile
+        if args.hedge_min_ms is not None:
+            gw_kw["hedge_min_ms"] = args.hedge_min_ms
+        gateway_config = None
+        if gw_kw:
             from tpu_engine.utils.config import GatewayConfig
 
-            gateway_config = GatewayConfig(port=args.port,
-                                           breaker_timeout_s=args.breaker_timeout)
+            gateway_config = GatewayConfig(port=args.port, **gw_kw)
         from tpu_engine.utils.config import WorkerConfig
 
         buckets = None
@@ -238,6 +283,8 @@ def main(argv=None) -> int:
             bb_kw["cache_capacity"] = args.cache_capacity
         if args.batch_timeout_ms is not None:
             bb_kw["batch_timeout_ms"] = args.batch_timeout_ms
+        if args.max_queue_depth is not None:
+            bb_kw["max_queue_depth"] = args.max_queue_depth
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,

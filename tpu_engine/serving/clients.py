@@ -21,6 +21,8 @@ import socket
 import threading
 from typing import Optional, Tuple
 
+from tpu_engine.utils.deadline import DeadlineExceeded, Overloaded, ShedError
+
 
 class WorkerError(Exception):
     """Dispatch failure: connection error, timeout, non-200, device error."""
@@ -37,6 +39,11 @@ class LocalWorkerClient:
             # Malformed request — the worker would answer 500 over HTTP
             # (reference worker_node.cpp:180-186); treat equally here.
             raise
+        except ShedError:
+            # Policy refusal (deadline/overload/drain): the lane is healthy
+            # — the gateway fails over (Overloaded) or stops (expired
+            # deadline) WITHOUT a breaker penalty.
+            raise
         except Exception as exc:  # device/runtime failure → breaker signal
             raise WorkerError(str(exc)) from exc
 
@@ -47,6 +54,8 @@ class LocalWorkerClient:
             return self.worker.handle_infer_raw(payload)
         except (KeyError, TypeError, ValueError):
             raise
+        except ShedError:
+            raise
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
 
@@ -55,6 +64,8 @@ class LocalWorkerClient:
             return self.worker.handle_generate(payload)
         except (KeyError, TypeError, ValueError):
             raise
+        except ShedError:
+            raise
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
 
@@ -62,6 +73,8 @@ class LocalWorkerClient:
         try:
             return self.worker.handle_score(payload)
         except (KeyError, TypeError, ValueError):
+            raise
+        except ShedError:
             raise
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
@@ -73,8 +86,15 @@ class LocalWorkerClient:
             return self.worker.handle_generate_stream(payload)
         except (KeyError, TypeError, ValueError):
             raise
+        except ShedError:
+            raise
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
+
+    def drain(self) -> dict:
+        self.worker.drain()
+        return {"ok": True, "node_id": self.worker.node_id,
+                "draining": True}
 
     def health(self) -> dict:
         return self.worker.get_health()
@@ -142,6 +162,15 @@ class HttpWorkerClient:
         conn = self._acquire()
         try:
             t = timeout_s if timeout_s is not None else self._timeout
+            deadline_clamped = False
+            if isinstance(body, dict) and body.get("deadline_ms") is not None:
+                # Deadline propagation: never hold the socket meaningfully
+                # past the request's remaining budget (+250 ms so the
+                # worker's own 503 can arrive and be classified instead of
+                # a generic timeout).
+                budget = max(0.05, float(body["deadline_ms"]) / 1000.0 + 0.25)
+                if budget < t:
+                    t, deadline_clamped = budget, True
             conn.timeout = t
             if conn.sock is not None:
                 conn.sock.settimeout(t)
@@ -153,6 +182,23 @@ class HttpWorkerClient:
         except Exception as exc:
             conn.close()
             self._release(None)
+            if deadline_clamped and isinstance(exc, (socket.timeout,
+                                                     TimeoutError)):
+                # The socket timed out because the CLIENT's budget ran out
+                # — for THIS request that is terminal (DeadlineExceeded,
+                # no failover: the budget is spent). But the lane HELD the
+                # request past the budget without answering, which is also
+                # the signature of a hang: mark the exception lane_suspect
+                # so the gateway still feeds the breaker. Consecutive-
+                # failure breakers self-correct on any within-budget
+                # success (cache hits), so only a lane that NEVER answers
+                # inside client budgets accrues enough to OPEN — which is
+                # precisely a lane traffic should leave.
+                shed = DeadlineExceeded(
+                    f"worker {self.url}: deadline expired awaiting "
+                    "response")
+                shed.lane_suspect = True
+                raise shed from exc
             raise WorkerError(f"worker {self.url}: {exc}") from exc
         if 400 <= resp.status < 500:
             # Client error (bad payload, unsupported op): the request is at
@@ -166,6 +212,22 @@ class HttpWorkerClient:
             self._release(conn)
             raise ValueError(
                 f"worker {self.url} rejected request ({resp.status}): {detail}")
+        if resp.status == 503:
+            # Resilience shed: mirror the in-process exception types so the
+            # gateway treats a remote lane exactly like a local one (fail
+            # over on overload/drain, stop on an expired deadline — no
+            # breaker penalty either way). An unclassifiable 503 (a dying
+            # proxy, a non-resilience server) stays a WorkerError below.
+            kind = None
+            try:
+                kind = json.loads(data).get("kind")
+            except Exception:
+                pass
+            if kind in ("overloaded", "deadline_exceeded"):
+                self._release(conn)  # response fully read; conn healthy
+                exc_cls = (Overloaded if kind == "overloaded"
+                           else DeadlineExceeded)
+                raise exc_cls(f"worker {self.url} shed request ({kind})")
         if resp.status != 200:
             conn.close()
             self._release(None)
@@ -202,6 +264,9 @@ class HttpWorkerClient:
             yield sse_event({"tokens": result["tokens"]})
             yield sse_event({"done": True, **result})
         return events()
+
+    def drain(self) -> dict:
+        return self._request("POST", "/admin/drain", {"action": "drain"})
 
     def health(self) -> dict:
         return self._request("GET", "/health")

@@ -16,14 +16,24 @@ multi-process/multi-host deployment working unchanged (DCN between hosts).
 Improvements over the reference (documented, not silent):
 - elastic membership: ``add_worker``/``remove_worker`` at runtime (the
   reference's ring had removeNode but no caller — dead workers needed a
-  gateway restart, ``README.md:336-339``);
+  gateway restart, ``README.md:336-339``), with an optional drain
+  (lame-duck) mode for graceful removal;
 - routing falls back to a random key when ``request_id`` is absent instead
-  of raising.
+  of raising;
+- a resilience layer (``serving/resilience.py``, DESIGN.md "Request
+  resilience"): per-request deadlines threaded edge→lane, failover under
+  a global retry budget with exponential backoff + jitter, and hedged
+  dispatch for idempotent ops — the slow-lane/overload story the
+  breaker-only reference has no answer for. All knobs default
+  off/permissive; with defaults the routing behavior and wire schemas are
+  byte-identical to the reference parity described above.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
+import time
 from typing import Dict, List, Optional, Union
 
 from tpu_engine.core.circuit_breaker import CircuitBreaker
@@ -33,11 +43,39 @@ from tpu_engine.serving.clients import (
     LocalWorkerClient,
     WorkerError,
 )
+from tpu_engine.serving.resilience import (
+    LatencyTracker,
+    ResilienceCounters,
+    RetryBudget,
+    backoff_delay,
+)
 from tpu_engine.utils.config import GatewayConfig
+from tpu_engine.utils.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+)
 
 
 class GatewayError(Exception):
     pass
+
+
+# Ops safe to hedge: a duplicate dispatch returns the identical answer and
+# costs only compute (cache-first /infer, teacher-forced /score). /generate
+# is excluded — duplicating a whole decode loop is the one cost hedging
+# must never pay, and a stream cannot be "first response wins".
+_HEDGEABLE_OPS = frozenset({"infer", "infer_raw", "score"})
+
+# _try_node outcome for a lane that SHED the request (overloaded/draining):
+# failure for failover purposes, but distinguishable from a fault — if the
+# WHOLE ring sheds, the request must surface as 503 + Retry-After
+# (congestion), never the 500-class "all workers failed" (outage).
+_SHED = object()
+
+
+def _ok(result) -> bool:
+    return result is not None and result is not _SHED
 
 
 class Gateway:
@@ -61,6 +99,19 @@ class Gateway:
         self._lock = threading.Lock()
         self._total_requests = 0
         self._failovers = 0
+        # Resilience layer (all knobs default off/permissive — see
+        # GatewayConfig): deadline admission + budgeted, backed-off
+        # failover + hedged dispatch, every decision counted.
+        self.resilience = ResilienceCounters()
+        self._retry_budget = RetryBudget(self.config.retry_budget_ratio,
+                                         self.config.retry_budget_min,
+                                         self.config.retry_budget_window_s)
+        # PER-LANE latency windows: a global window would let a slow lane
+        # receiving >(1-q) of traffic drag the hedge quantile up to its
+        # own latency, self-disabling hedging for exactly the lane it
+        # exists to cover.
+        self._latency: Dict[str, LatencyTracker] = {}
+        self._hedge_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         # Requests without a "model" field in multi-model mode route to
         # the first-registered model (deterministic default) instead of
         # whichever lane the global ring happens to own.
@@ -130,12 +181,26 @@ class Gateway:
         with self._lock:
             return self._breakers.get(name)
 
-    def remove_worker(self, name: str) -> None:
+    def remove_worker(self, name: str, drain: bool = False) -> None:
+        """Remove a lane from every ring. ``drain=True`` = graceful
+        (lame-duck) removal: the lane refuses NEW admissions first — so a
+        request racing the ring update sheds with 503 instead of failing —
+        while in-flight work runs to completion off-ring. The default
+        stays the abrupt removal existing callers expect."""
+        if drain:
+            with self._lock:
+                client = self._clients.get(name)
+            if client is not None and hasattr(client, "drain"):
+                try:
+                    client.drain()
+                except Exception:
+                    pass  # unreachable lane: plain removal is all we have
         self._ring.remove_node(name)
         with self._lock:
             rings = dict(self._model_rings)
             self._clients.pop(name, None)
             self._breakers.pop(name, None)
+            self._latency.pop(name, None)  # stale window must not feed thresholds
             self._untyped.discard(name)
         for ring in rings.values():
             ring.remove_node(name)
@@ -183,6 +248,16 @@ class Gateway:
     def _route(self, payload: dict, op: str) -> dict:
         with self._lock:
             self._total_requests += 1
+        self._retry_budget.record_request()
+        # Deadline admission: an already-expired request sheds HERE — one
+        # cheap 503 + Retry-After instead of a doomed dispatch chain (and,
+        # downstream, a burned batch row).
+        deadline = Deadline.from_request(
+            payload, default_ms=self.config.default_deadline_ms)
+        if deadline is not None and deadline.expired():
+            self.resilience.bump("deadline_rejected")
+            raise self._shed(DeadlineExceeded(
+                "deadline exceeded at gateway admission"))
         request_id = str(payload.get("request_id", id(payload)))
         # "model" restricts routing AND failover to that model's sub-ring;
         # without the field, multi-model gateways use the deterministic
@@ -213,19 +288,268 @@ class Gateway:
         except RuntimeError:  # every lane of this model was removed
             raise GatewayError(f"no workers available for model '{mdl}'")
 
-        result = self._try_node(primary, payload, op=op, probing=probing)
-        if result is not None:
+        if self.config.hedge_enabled and op in _HEDGEABLE_OPS:
+            return self._route_hedged(ring, primary, payload, op,
+                                      probing, deadline)
+        result = self._try_node(primary,
+                                self._with_deadline(payload, deadline),
+                                op=op, probing=probing)
+        if not _ok(result):
+            with self._lock:
+                self._failovers += 1
+            result = self._failover(ring, primary, payload, op,
+                                    probing, deadline,
+                                    shed_seen=result is _SHED)
+        return result
+
+    def _shed(self, exc):
+        """Stamp a shed-class exception with the configured Retry-After."""
+        exc.retry_after_s = self.config.shed_retry_after_s
+        return exc
+
+    @staticmethod
+    def _with_deadline(payload: dict, deadline: Optional[Deadline]) -> dict:
+        """Deadline propagation: each dispatch carries the budget REMAINING
+        at dispatch time (recomputed per attempt, so retries after backoff
+        forward a smaller number). No deadline → payload untouched, wire
+        bytes identical to the pre-resilience gateway."""
+        if deadline is None:
+            return payload
+        return {**payload, "deadline_ms": max(0.0, deadline.remaining_ms())}
+
+    def _failover(self, ring, primary: str, payload: dict, op: str,
+                  probing: bool, deadline: Optional[Deadline],
+                  skip: tuple = (), shed_seen: bool = False) -> dict:
+        """Ring-order failover across every other lane (gateway.cpp:51-59)
+        — now deadline-bounded, budgeted, and backed off: each attempt
+        consumes the global retry budget (failover storms cannot amplify
+        an outage past `1 + ratio`), sleeps an exponential+jittered delay
+        (base 0 = reference's immediate march), and stops the moment the
+        client's budget is gone. A march where at least one lane SHED
+        (rather than failed) terminates as Overloaded (wire 503 +
+        Retry-After): fleet congestion must read as back-off-and-retry,
+        never as an outage."""
+        cfg = self.config
+        attempt = 0
+        for node in ring.get_all_nodes():
+            if node == primary or node in skip:
+                continue
+            if deadline is not None and deadline.expired():
+                self.resilience.bump("deadline_expired")
+                raise self._shed(DeadlineExceeded(
+                    "deadline exceeded during failover"))
+            if not self._retry_budget.try_acquire():
+                self.resilience.bump("retry_budget_exhausted")
+                raise GatewayError(
+                    "retry budget exhausted (retries capped at "
+                    f"{cfg.retry_budget_ratio:.0%} of recent requests)")
+            delay = backoff_delay(attempt, cfg.retry_backoff_base_ms,
+                                  cfg.retry_backoff_max_ms,
+                                  cfg.retry_jitter)
+            if delay > 0:
+                if deadline is not None:
+                    delay = min(delay, max(0.0, deadline.remaining_s()))
+                self.resilience.bump("backoff_waits")
+                time.sleep(delay)
+            self.resilience.bump("retries")
+            result = self._try_node(node,
+                                    self._with_deadline(payload, deadline),
+                                    op=op, probing=probing)
+            if _ok(result):
+                return result
+            shed_seen = shed_seen or result is _SHED
+            attempt += 1
+        if shed_seen:
+            raise self._shed(Overloaded(
+                "all lanes shed the request (overloaded or draining)"))
+        raise GatewayError("All workers failed or unavailable")
+
+    def _pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        # Generous cap: with hedging on, EVERY hedgeable dispatch rides
+        # this pool (1-2 threads per in-flight request), and the serving
+        # front is thread-per-request with no cap of its own — an
+        # undersized pool would throttle overall concurrency, not just
+        # hedges. 256 sits far above the stdlib front's practical
+        # concurrency; threads spawn on demand, so idle cost is zero.
+        with self._lock:
+            if self._hedge_pool is None:
+                self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=256, thread_name_prefix="gw-hedge")
+            return self._hedge_pool
+
+    def _lane_tracker(self, node: str) -> LatencyTracker:
+        with self._lock:
+            tracker = self._latency.get(node)
+            if tracker is None:
+                tracker = self._latency[node] = LatencyTracker()
+            return tracker
+
+    def _hedge_threshold_s(self, primary: Optional[str] = None) -> float:
+        """When to give up waiting on `primary`: the best OTHER lane's
+        latency quantile — "hedge once the primary exceeds what a healthy
+        alternative would take at p-q" — floored at hedge_min_ms (and
+        pure hedge_min_ms until some other lane has enough samples).
+        Excluding the primary's own window keeps a degraded lane from
+        raising its own threshold. primary=None (stats) uses all lanes."""
+        cfg = self.config
+        thr = cfg.hedge_min_ms / 1000.0
+        with self._lock:
+            trackers = [t for n, t in self._latency.items() if n != primary]
+        quantiles = [t.quantile(cfg.hedge_quantile) for t in trackers
+                     if len(t) >= cfg.hedge_min_samples]
+        quantiles = [q for q in quantiles if q is not None]
+        if quantiles:
+            thr = max(thr, min(quantiles))
+        return thr
+
+    def _route_hedged(self, ring, primary: str, payload: dict, op: str,
+                      probing: bool, deadline: Optional[Deadline]) -> dict:
+        """Hedged dispatch (idempotent ops only): wait `threshold` on the
+        primary; if it is merely SLOW — the failure mode breakers cannot
+        see — fire the next ring lane and take whichever answers first.
+        The loser's result is discarded ("cancelled" at the routing layer;
+        its lane simply finishes and the breaker records its outcome).
+        Hedges consume the retry budget, so a quantile collapse cannot
+        double fleet load."""
+        pool = self._pool()
+        p_started = threading.Event()
+        t_start: list = [None]
+
+        def _primary_task():
+            t_start[0] = time.perf_counter()
+            p_started.set()
+            return self._try_node(primary,
+                                  self._with_deadline(payload, deadline),
+                                  op, probing)
+
+        p_fut = pool.submit(_primary_task)
+
+        def _record_primary(fut):
+            # Feed the quantile EVERY primary completion (measured from its
+            # dispatch start), wherever the route ended up: recording only
+            # within-threshold successes would censor the sample at the
+            # threshold and pin it at hedge_min_ms forever; recording
+            # whole-route time would inflate it with backoff/failover
+            # exactly when lanes degrade.
+            try:
+                r = fut.result()
+            except BaseException:
+                return
+            if _ok(r) and t_start[0] is not None:
+                self._lane_tracker(primary).record(
+                    time.perf_counter() - t_start[0])
+
+        p_fut.add_done_callback(_record_primary)
+        # Arm the hedge timer only once the dispatch actually STARTED: a
+        # saturated pool queues tasks, and hedging a primary that never
+        # ran would amplify load against perfectly healthy lanes — the
+        # exact spiral hedging must not feed.
+        if not p_started.wait(timeout=None if deadline is None
+                              else max(0.0, deadline.remaining_s())):
+            self.resilience.bump("deadline_expired")
+            raise self._shed(DeadlineExceeded(
+                "deadline exceeded before primary dispatch started"))
+        thr = self._hedge_threshold_s(primary)
+        deadline_clamped = (deadline is not None
+                            and deadline.remaining_s() < thr)
+        if deadline_clamped:
+            thr = max(0.0, deadline.remaining_s())
+        try:
+            result = p_fut.result(timeout=thr)
+        except concurrent.futures.TimeoutError:
+            if deadline_clamped:
+                # The wait ended because the CLIENT's budget ran out, not
+                # because the lane exceeded the latency threshold: a hedge
+                # here would burn a shared retry-budget token dispatching
+                # a request the hedge lane must immediately shed. Ride out
+                # the remaining budget on the primary instead.
+                return self._await_primary(p_fut, ring, primary, payload,
+                                           op, probing, deadline)
+            result = None
+        else:
+            if _ok(result):
+                return result  # latency recorded by the done-callback
+            # Primary failed FAST (dead or shedding lane): plain budgeted
+            # failover.
+            with self._lock:
+                self._failovers += 1
+            return self._failover(ring, primary, payload, op, probing,
+                                  deadline, shed_seen=result is _SHED)
+
+        # Primary exceeded the hedge threshold. Pick the next lane whose
+        # breaker admits traffic; no budget, no lane → ride out the primary.
+        hedge_node = next(
+            (n for n in ring.get_all_nodes()
+             if n != primary and self._breaker_allows(n)), None)
+        if hedge_node is None or not self._retry_budget.try_acquire():
+            if hedge_node is not None:
+                self.resilience.bump("retry_budget_exhausted")
+            return self._await_primary(p_fut, ring, primary, payload, op,
+                                       probing, deadline)
+        self.resilience.bump("hedges")
+        h_fut = pool.submit(self._try_node, hedge_node,
+                            self._with_deadline(payload, deadline),
+                            op, probing)
+        pending = {p_fut: primary, h_fut: hedge_node}
+        first_error: Optional[BaseException] = None
+        shed_seen = False
+        while pending:
+            timeout = (None if deadline is None
+                       else max(0.0, deadline.remaining_s()))
+            done, _ = concurrent.futures.wait(
+                list(pending), timeout=timeout,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+            if not done:  # deadline ran out waiting on both lanes
+                self.resilience.bump("deadline_expired")
+                raise self._shed(DeadlineExceeded(
+                    "deadline exceeded awaiting hedged dispatch"))
+            for fut in done:
+                pending.pop(fut)
+                try:
+                    result = fut.result()
+                except BaseException as exc:
+                    first_error = first_error or exc
+                    continue
+                if _ok(result):
+                    self.resilience.bump("hedge_wins" if fut is h_fut
+                                         else "hedge_losses")
+                    return result
+                shed_seen = shed_seen or result is _SHED
+        # Both lanes failed/shed: budgeted failover over the remainder.
+        with self._lock:
+            self._failovers += 1
+        try:
+            return self._failover(ring, primary, payload, op, probing,
+                                  deadline, skip=(hedge_node,),
+                                  shed_seen=shed_seen)
+        except GatewayError:
+            if first_error is not None:
+                raise first_error
+            raise
+
+    def _await_primary(self, p_fut, ring, primary, payload, op, probing,
+                       deadline: Optional[Deadline]) -> dict:
+        """Hedge unavailable: block on the primary alone (deadline-bounded),
+        then fall back to plain failover if it ultimately failed."""
+        timeout = (None if deadline is None
+                   else max(0.0, deadline.remaining_s()))
+        try:
+            result = p_fut.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            self.resilience.bump("deadline_expired")
+            raise self._shed(DeadlineExceeded(
+                "deadline exceeded awaiting primary lane"))
+        if _ok(result):
             return result
         with self._lock:
             self._failovers += 1
-        # Ring-order failover across every other lane (gateway.cpp:51-59).
-        for node in ring.get_all_nodes():
-            if node == primary:
-                continue
-            result = self._try_node(node, payload, op=op, probing=probing)
-            if result is not None:
-                return result
-        raise GatewayError("All workers failed or unavailable")
+        return self._failover(ring, primary, payload, op, probing, deadline,
+                              shed_seen=result is _SHED)
+
+    def _breaker_allows(self, node: str) -> bool:
+        with self._lock:
+            breaker = self._breakers.get(node)
+        return breaker is not None and breaker.allow_request()
 
     def _try_node(self, node: str, payload: dict, op: str = "infer",
                   probing: bool = False) -> Optional[dict]:
@@ -248,6 +572,23 @@ class Gateway:
         except WorkerError:
             breaker.record_failure()
             return None
+        except Overloaded:
+            # The lane SHED the request (queue full / draining): healthy
+            # but busy — fail over without a breaker penalty (a breaker
+            # trip would amplify the overload into an outage).
+            self.resilience.bump("shed_overloaded")
+            return _SHED
+        except DeadlineExceeded as exc:
+            # The client's budget is gone; no other lane can help. A
+            # lane_suspect expiry (the lane HELD the request past its
+            # budget without answering — hang signature) still feeds the
+            # breaker so a dead lane loses its hash share; a clean worker
+            # 503 does not.
+            if getattr(exc, "lane_suspect", False):
+                breaker.record_failure()
+            self.resilience.bump("deadline_expired")
+            raise self._shed(DeadlineExceeded(
+                f"deadline exceeded at lane {node}"))
         except ValueError:
             if probing:
                 return None  # wrong-model lane; healthy — no penalty
@@ -255,12 +596,18 @@ class Gateway:
 
     # -- observability --------------------------------------------------------
 
+    def _resilience_configured(self) -> bool:
+        cfg = self.config
+        return (cfg.default_deadline_ms is not None or cfg.hedge_enabled
+                or cfg.retry_budget_ratio is not None
+                or cfg.retry_backoff_base_ms > 0)
+
     def get_stats(self) -> dict:
         """Exact /stats schema (``gateway.cpp:63-77``)."""
         with self._lock:
             items = list(self._breakers.items())
             total, failovers = self._total_requests, self._failovers
-        return {
+        out = {
             "total_workers": len(items),
             # Additive fields (reference /stats has only total_workers +
             # circuit_breakers; extra keys don't break its parsers).
@@ -276,3 +623,16 @@ class Gateway:
                 for node, br in items
             ],
         }
+        # Additive, and only once the resilience layer is configured or
+        # has made a decision (deadline-carrying request, shed, retry,
+        # hedge): a defaults-only deployment's /stats stays byte-identical
+        # to the breaker-only schema above.
+        if self._resilience_configured() or self.resilience.any_nonzero():
+            res = self.resilience.as_dict()
+            if self._retry_budget.enabled:
+                res["retry_budget"] = self._retry_budget.stats()
+            if self.config.hedge_enabled:
+                res["hedge_threshold_ms"] = round(
+                    self._hedge_threshold_s() * 1000.0, 3)
+            out["resilience"] = res
+        return out

@@ -17,6 +17,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
+from tpu_engine.utils.deadline import ShedError
+
 Handler = Callable[[Optional[dict]], Tuple[int, dict]]
 
 
@@ -101,7 +103,8 @@ class JsonHttpServer:
                 pass
 
             def _respond(self, status: int, payload,
-                         content_type: str = "application/json") -> None:
+                         content_type: str = "application/json",
+                         extra_headers: Optional[Dict[str, str]] = None) -> None:
                 # Handlers may return pre-serialized bytes (hot /infer
                 # path), a dict, or an ITERATOR of byte chunks (streaming
                 # SSE, e.g. /generate/stream) sent with chunked
@@ -117,6 +120,8 @@ class JsonHttpServer:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -174,6 +179,18 @@ class JsonHttpServer:
                                       content_type=result[2])
                     else:
                         self._respond(result[0], result[1])
+                except ShedError as exc:
+                    # Resilience layer refusal (expired deadline, overload,
+                    # drain): 503 + Retry-After so well-behaved clients back
+                    # off, and a machine-readable "kind" so upstream hops
+                    # classify without string matching.
+                    try:
+                        self._respond(
+                            503, {"error": str(exc), "kind": exc.kind},
+                            extra_headers={"Retry-After": str(max(
+                                1, int(exc.retry_after_s + 0.999)))})
+                    except Exception:
+                        pass
                 except (KeyError, ValueError, TypeError) as exc:
                     # Malformed/unsupported request → 400 so gateways can
                     # tell client errors from worker failures (the reference

@@ -1,0 +1,305 @@
+"""Resilience policies for the serving layer: retry budgets, backoff,
+hedging quantiles, admission control, and the counters that make every
+decision visible in ``/stats``.
+
+The reference system's whole fault story is the circuit breaker plus
+ring-order failover (``gateway.cpp:51-59``): correct for a DEAD lane,
+useless for a SLOW one or a traffic spike. This module adds the missing
+SRE-standard pieces:
+
+- ``RetryBudget`` — a global cap tying retries to recent request volume
+  (retries <= ratio * requests over a sliding window) so a failing fleet
+  sees at most ``1 + ratio`` x amplification instead of N x from every
+  request marching the whole ring.
+- ``backoff_delay`` — exponential backoff with symmetric jitter, so
+  retry waves decorrelate instead of synchronizing into thundering herds.
+- ``LatencyTracker`` — a sliding-window latency quantile estimator that
+  drives hedged dispatch ("fire a second lane when the primary exceeds
+  p95").
+- ``AdmissionController`` — worker-side bounded queue depth with
+  deadline-aware early rejection and a drain (lame-duck) mode.
+
+Every knob defaults to off/permissive (see ``GatewayConfig`` /
+``WorkerConfig``): with defaults, behavior and wire schemas are
+byte-identical to the breaker-only gateway.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import random
+import threading
+import time
+from typing import Deque, Optional
+
+from tpu_engine.utils.deadline import Deadline, DeadlineExceeded, Overloaded
+
+
+def backoff_delay(attempt: int, base_ms: float, max_ms: float,
+                  jitter: float = 0.5,
+                  rng: Optional[random.Random] = None) -> float:
+    """Delay in SECONDS before retry number ``attempt`` (0-based):
+    ``min(base * 2^attempt, max)`` spread symmetrically by ``jitter``
+    (0.5 -> uniform in [0.5x, 1.5x]). ``base_ms == 0`` (the default)
+    returns 0.0 — the reference's immediate ring-order failover."""
+    if base_ms <= 0:
+        return 0.0
+    d_ms = min(float(base_ms) * (2.0 ** max(0, int(attempt))), float(max_ms))
+    j = min(max(float(jitter), 0.0), 1.0)
+    if j > 0:
+        r = (rng or random).random()  # in [0, 1)
+        d_ms *= 1.0 - j + 2.0 * j * r
+    return d_ms / 1000.0
+
+
+class RetryBudget:
+    """Global retry budget: a retry is allowed while retries observed in
+    the sliding window stay under ``ratio * requests + min_retries``.
+
+    ``ratio=None`` disables the budget entirely (reference behavior:
+    unlimited failover). ``min_retries`` keeps low-traffic deployments
+    able to retry at all — a 10% budget of 3 requests rounds to zero.
+
+    Thread-safe; O(1) amortized via timestamp deques.
+    """
+
+    def __init__(self, ratio: Optional[float], min_retries: int = 10,
+                 window_s: float = 10.0):
+        self.ratio = None if ratio is None else max(0.0, float(ratio))
+        self.min_retries = max(0, int(min_retries))
+        self.window_s = float(window_s)
+        self._requests: Deque[float] = collections.deque()
+        self._retries: Deque[float] = collections.deque()
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.ratio is not None
+
+    def _gc(self, now: float) -> None:
+        horizon = now - self.window_s
+        for dq in (self._requests, self._retries):
+            while dq and dq[0] < horizon:
+                dq.popleft()
+
+    def record_request(self) -> None:
+        if self.ratio is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._gc(now)
+            self._requests.append(now)
+
+    def try_acquire(self) -> bool:
+        """True (and records the retry) if the budget permits one more
+        retry right now; False means the caller must NOT retry."""
+        if self.ratio is None:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            self._gc(now)
+            allowed = self.ratio * len(self._requests) + self.min_retries
+            if len(self._retries) + 1 > allowed:
+                return False
+            self._retries.append(now)
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"window_requests": len(self._requests),
+                    "window_retries": len(self._retries),
+                    "ratio": self.ratio}
+
+
+class LatencyTracker:
+    """Sliding-window latency quantiles over the last ``window`` samples.
+    Insertion keeps a sorted shadow list, so ``quantile`` is O(1) reads —
+    at the default window (512) the O(log n) insert + O(n) delete is
+    noise next to a single HTTP hop."""
+
+    def __init__(self, window: int = 512):
+        self.window = max(8, int(window))
+        self._ring: Deque[float] = collections.deque()
+        self._sorted: list = []
+        self._lock = threading.Lock()
+
+    def record(self, latency_s: float) -> None:
+        v = float(latency_s)
+        with self._lock:
+            self._ring.append(v)
+            bisect.insort(self._sorted, v)
+            if len(self._ring) > self.window:
+                old = self._ring.popleft()
+                del self._sorted[bisect.bisect_left(self._sorted, old)]
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The q-quantile of the window, or None with no samples."""
+        with self._lock:
+            if not self._sorted:
+                return None
+            idx = min(len(self._sorted) - 1,
+                      int(q * (len(self._sorted) - 1) + 0.5))
+            return self._sorted[idx]
+
+
+class ResilienceCounters:
+    """Every resilience decision, counted. ``as_dict`` feeds the
+    additive ``/stats`` ``resilience`` block and the Prometheus render;
+    ``any_nonzero`` gates the block so a defaults-only deployment keeps
+    its wire schema byte-identical to the breaker-only gateway."""
+
+    FIELDS = ("deadline_rejected", "deadline_expired", "retries",
+              "retry_budget_exhausted", "backoff_waits", "hedges",
+              "hedge_wins", "hedge_losses", "shed_overloaded")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {f: 0 for f in self.FIELDS}
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[field] += n
+
+    def get(self, field: str) -> int:
+        with self._lock:
+            return self._c[field]
+
+    def any_nonzero(self) -> bool:
+        with self._lock:
+            return any(v for v in self._c.values())
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return dict(self._c)
+
+
+class AdmissionController:
+    """Worker-side admission control: bounded in-flight depth,
+    deadline-aware early rejection, and a drain (lame-duck) mode.
+
+    ``max_depth=0`` (default) leaves depth unbounded — reference
+    behavior. ``drain()`` flips the lane to refusing new admissions while
+    in-flight work completes; ``/admin/drain`` and
+    ``Gateway.remove_worker(drain=True)`` drive it.
+
+    ``admit(deadline)`` raises ``Overloaded`` when draining or over depth
+    and ``DeadlineExceeded`` when the deadline already passed; callers
+    MUST pair a successful admit with ``release()``. ``check_deadline``
+    adds the estimate-aware early rejection for the miss path.
+    """
+
+    def __init__(self, max_depth: int = 0, node_id: str = "?"):
+        self.max_depth = max(0, int(max_depth))
+        self.node_id = node_id
+        self._depth = 0
+        self._draining = False
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self.shed_overloaded = 0
+        self.shed_deadline = 0
+        self.shed_draining = 0
+
+    # -- drain (lame-duck) ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        with self._lock:
+            self._draining = True
+
+    def undrain(self) -> None:
+        with self._lock:
+            self._draining = False
+
+    def wait_idle(self, timeout_s: float = 10.0) -> bool:
+        """Block until in-flight work reaches zero (True) or the timeout
+        passes (False) — the 'finishes in-flight work' half of drain."""
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self._depth > 0:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._idle.wait(timeout=rem)
+            return True
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, deadline: Optional[Deadline] = None) -> None:
+        with self._lock:
+            if self._draining:
+                self.shed_draining += 1
+                raise Overloaded(
+                    f"lane {self.node_id} is draining (lame-duck)")
+            if self.max_depth and self._depth >= self.max_depth:
+                self.shed_overloaded += 1
+                raise Overloaded(
+                    f"lane {self.node_id} at max queue depth "
+                    f"{self.max_depth}")
+            if deadline is not None and deadline.expired():
+                self.shed_deadline += 1
+                raise DeadlineExceeded("deadline exceeded at admission")
+            self._depth += 1
+
+    def check_deadline(self, deadline: Optional[Deadline],
+                       est_service_s: Optional[float] = None) -> None:
+        """Early rejection for work about to enter a batch/decode lane —
+        refusing doomed work here costs one cheap 503 instead of a batch
+        row. Called on the MISS path (after the cache lookup) so a
+        sub-millisecond cache hit is never shed against a miss-sized
+        estimate.
+
+        Two distinct refusals: an EXPIRED budget is DeadlineExceeded
+        (terminal — no lane can help); a live budget this lane merely
+        PREDICTS it cannot meet (remaining < service-time EWMA) is
+        Overloaded — a lane-local judgment, so the gateway fails over
+        (another lane may hold the result in ITS cache and answer in
+        microseconds)."""
+        if deadline is None:
+            return
+        rem = deadline.remaining_s()
+        if rem <= 0:
+            with self._lock:
+                self.shed_deadline += 1
+            raise DeadlineExceeded("deadline expired before dispatch")
+        if est_service_s is not None and rem < est_service_s:
+            with self._lock:
+                self.shed_deadline += 1
+            raise Overloaded(
+                f"lane {self.node_id} cannot meet the deadline "
+                f"(remaining {rem * 1e3:.0f} ms < estimated service "
+                f"{est_service_s * 1e3:.0f} ms)")
+
+    def release(self) -> None:
+        with self._idle:
+            self._depth = max(0, self._depth - 1)
+            if self._depth == 0:
+                self._idle.notify_all()
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def active(self) -> bool:
+        """Whether this controller has anything to report — gates the
+        additive /health block (schema untouched at defaults)."""
+        return bool(self.max_depth or self._draining or self.shed_overloaded
+                    or self.shed_deadline or self.shed_draining)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "draining": self._draining,
+                "queue_depth": self._depth,
+                "max_queue_depth": self.max_depth,
+                "shed_overloaded": self.shed_overloaded,
+                "shed_deadline": self.shed_deadline,
+                "shed_draining": self.shed_draining,
+            }

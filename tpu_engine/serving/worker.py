@@ -21,6 +21,7 @@ or in-process by the gateway (TPU-native shape: one process, lanes = chips).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import os
@@ -35,7 +36,13 @@ import numpy as np
 from tpu_engine.core.lru_cache import LRUCache
 from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.serving.http import sse_event
+from tpu_engine.serving.resilience import AdmissionController
 from tpu_engine.utils.config import WorkerConfig
+from tpu_engine.utils.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    clamp_timeout,
+)
 from tpu_engine.utils.sampling import clamp_top_k as _clamp_top_k
 from tpu_engine.utils.sampling import validate_min_p as _validate_min_p
 from tpu_engine.utils.sampling import expand_stopping_params
@@ -302,7 +309,20 @@ class WorkerNode:
         # need an explicit hook. While set, every request raises — the
         # gateway's breaker sees it exactly like a dead worker.
         self._injected_fault: Optional[str] = None
+        # Slow-lane fault (resilience scenarios): latency added to every
+        # request while set — the lane is SLOW, not dead, which the
+        # breaker alone cannot answer (hedging/deadlines do).
+        self._injected_latency_s: float = 0.0
         self._fault_listeners: list = []
+        # Resilience: bounded queue depth + drain (lame-duck) mode.
+        # max_queue_depth=0 keeps admission unbounded (reference behavior).
+        self._admission = AdmissionController(self.config.max_queue_depth,
+                                              self.node_id)
+        # EWMA of recent miss-path per-request service time (µs), feeding
+        # deadline-aware early rejection: a request whose remaining budget
+        # cannot cover the typical miss is shed before it occupies a
+        # batch row.
+        self._service_ewma_us: Optional[float] = None
         # Bumped by reload_weights: in-flight /infer results computed
         # under an older generation must not enter the cleared cache. The
         # lock makes check+put atomic against bump+clear — a bare compare
@@ -444,6 +464,12 @@ class WorkerNode:
             # a confusing generation error from deeper in the stack.
             raise ValueError(
                 f"model '{self.config.model}' does not support scoring")
+        deadline = Deadline.from_request(request)
+        with self._admitted(deadline):
+            return self._score_admitted(request, deadline)
+
+    def _score_admitted(self, request: dict,
+                        deadline: Optional[Deadline]) -> dict:
         with self._counter_lock:
             self._total_requests += 1
         completion = [int(t) for t in request["completion_tokens"]]
@@ -464,7 +490,7 @@ class WorkerNode:
         t0 = time.perf_counter()
         # Concurrent evals requests (the lm-eval-harness shape) batch into
         # one bucketed forward instead of N sequential batch-1 forwards.
-        lps = self._score_processor().process(item)
+        lps = self._score_processor().process(item, deadline=deadline)
         return {
             "request_id": item.request_id,
             "logprobs": lps,
@@ -561,10 +587,60 @@ class WorkerNode:
         for listener in self._fault_listeners:
             listener(False)
 
+    def inject_latency(self, seconds: float) -> None:
+        """Slow-lane fault: every request sleeps this long before serving.
+        The lane stays HEALTHY (no breaker trip from the fault itself) —
+        exactly the failure mode deadlines and hedging exist for."""
+        self._injected_latency_s = max(0.0, float(seconds))
+
     def heal(self) -> None:
         self._injected_fault = None
+        self._injected_latency_s = 0.0
         for listener in self._fault_listeners:
-            listener(True)
+            # A draining lane stays disabled at the native front even once
+            # healed — drain outranks health for new admissions.
+            listener(not self._admission.draining)
+
+    def _maybe_slow(self) -> None:
+        if self._injected_latency_s > 0:
+            time.sleep(self._injected_latency_s)
+
+    @contextlib.contextmanager
+    def _admitted(self, deadline):
+        """Admission scope shared by every blocking request path: admit
+        (drain/depth/expired-deadline can shed -> wire 503), apply the
+        slow-lane fault, and ALWAYS release. The streaming path manages
+        release by hand — its in-flight window is the iterator's life,
+        not this frame's."""
+        self._admission.admit(deadline)
+        try:
+            self._maybe_slow()
+            yield
+        finally:
+            self._admission.release()
+
+    # -- drain (lame-duck) -----------------------------------------------------
+
+    def drain(self) -> None:
+        """Refuse new admissions (503 + Retry-After) while in-flight work
+        completes — the lame-duck half of graceful removal. The gateway's
+        ``remove_worker(drain=True)`` and ``/admin/drain`` drive this.
+        Fault listeners fire too: the native C++ front must stop answering
+        a draining lane's cache hits (its hit path never enters Python, so
+        the admission check alone cannot reach it)."""
+        self._admission.drain()
+        for listener in self._fault_listeners:
+            listener(False)
+
+    def undrain(self) -> None:
+        self._admission.undrain()
+        if self._injected_fault is None:  # don't resurrect a faulted lane
+            for listener in self._fault_listeners:
+                listener(True)
+
+    @property
+    def draining(self) -> bool:
+        return self._admission.draining
 
     def on_fault_change(self, listener) -> None:
         """Register listener(healthy: bool) — the native HTTP front uses
@@ -591,8 +667,18 @@ class WorkerNode:
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
-        with self._counter_lock:
-            self._total_requests += 1
+        # Resilience: admission BEFORE the request counts — a shed request
+        # never skews the reference-exact /health counters, only its own
+        # (additive) admission block. Expired/overloaded/draining raise
+        # here and surface as 503 + Retry-After at the HTTP layer.
+        deadline = Deadline.from_request(request)
+        with self._admitted(deadline):
+            with self._counter_lock:
+                self._total_requests += 1
+            return self._infer_admitted(request, deadline)
+
+    def _infer_admitted(self, request: dict,
+                        deadline: Optional[Deadline]) -> Tuple[str, bytes, bool, int]:
         request_id = request["request_id"]
         input_data = request["input_data"]
         shape = request.get("shape")
@@ -609,16 +695,42 @@ class WorkerNode:
             # Reference reports a fixed fake latency on hits (:65).
             return request_id, frag, True, self.config.fake_cached_latency_us
 
-        with self._inflight_lock:
-            entry = self._inflight.get(key)
-            leader = entry is None
+        while True:
+            # Miss path: deadline-aware early rejection against the
+            # measured service-time EWMA — a doomed request sheds here for
+            # the cost of a 503 instead of occupying a batch row it cannot
+            # use. (Re-checked per coalescing round: this request's OWN
+            # budget governs.)
+            est = self._service_ewma_us
+            self._admission.check_deadline(
+                deadline, None if est is None else est / 1e6)
+
+            with self._inflight_lock:
+                entry = self._inflight.get(key)
+                leader = entry is None
+                if leader:
+                    entry = _Inflight()
+                    self._inflight[key] = entry
             if leader:
-                entry = _Inflight()
-                self._inflight[key] = entry
-        if not leader:
-            if not entry.event.wait(timeout=120.0):
+                break
+            if not entry.event.wait(
+                    timeout=clamp_timeout(deadline, 120.0)):
+                if deadline is not None and deadline.expired():
+                    raise DeadlineExceeded(
+                        "deadline expired waiting on coalesced result")
                 raise RuntimeError("coalesced request timed out")
             if entry.error is not None:
+                if isinstance(entry.error, DeadlineExceeded):
+                    # The LEADER's budget expired — a per-request fact,
+                    # not a property of the input. This follower's budget
+                    # may be fine: retire the dead entry (the leader's own
+                    # pop may not have run yet; leaving it would make this
+                    # loop spin on it) and recompute — next round it
+                    # either joins a live leader or leads itself.
+                    with self._inflight_lock:
+                        if self._inflight.get(key) is entry:
+                            self._inflight.pop(key)
+                    continue
                 # Re-raise the leader's exception unchanged so client-input
                 # error types (KeyError/TypeError/ValueError) keep their
                 # no-breaker-penalty classification in LocalWorkerClient —
@@ -631,7 +743,8 @@ class WorkerNode:
         try:
             gen0 = self._weights_gen  # stamp BEFORE the compute
             result = self.batch_processor.process(
-                _BatchItem(request_id, input_data, shape))
+                _BatchItem(request_id, input_data, shape),
+                deadline=deadline)
             frag = _encode_output(result.output_data)
             # A hot reload between compute and put would otherwise re-seed
             # the freshly cleared cache with an old-weight result forever;
@@ -641,6 +754,11 @@ class WorkerNode:
                     self.cache.put(key, frag)
             entry.frag = frag
             entry.time_us = result.inference_time_us
+            # EWMA (0.2 step) of the miss-path service time — feeds the
+            # early-rejection estimate above.
+            t = float(result.inference_time_us)
+            self._service_ewma_us = (t if self._service_ewma_us is None
+                                     else 0.8 * self._service_ewma_us + 0.2 * t)
         except BaseException as exc:
             entry.error = exc
             raise
@@ -727,6 +845,12 @@ class WorkerNode:
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
+        deadline = Deadline.from_request(request)
+        with self._admitted(deadline):
+            return self._generate_admitted(request, deadline)
+
+    def _generate_admitted(self, request: dict,
+                           deadline: Optional[Deadline]) -> dict:
         with self._counter_lock:
             self._total_requests += 1
         item = _GenItem(
@@ -774,12 +898,18 @@ class WorkerNode:
                 eos_id=item.eos_id, temperature=item.temperature,
                 seed=item.seed, top_p=item.top_p, top_k=item.top_k,
                 repetition_penalty=item.repetition_penalty,
-                stop_tokens=list(item.stop_tokens), min_p=item.min_p)
-            tokens = fut.result(timeout=600)
+                stop_tokens=list(item.stop_tokens), min_p=item.min_p,
+                deadline=deadline)
+            # The scheduler itself cancels expired rows between chunks
+            # (the future then raises DeadlineExceeded); the +5 s slack
+            # keeps this outer wait a backstop, never the arbiter.
+            tokens = fut.result(
+                timeout=600 if deadline is None
+                else max(5.0, deadline.remaining_s() + 5.0))
             elapsed_us = int((time.perf_counter() - t0) * 1e6)
             result = _GenResult(tokens, elapsed_us)
         else:
-            result = self._gen_processor.process(item)
+            result = self._gen_processor.process(item, deadline=deadline)
         self.tracer.record(item.request_id, "generate", self.node_id,
                            result.generate_time_us)
         return {
@@ -806,6 +936,9 @@ class WorkerNode:
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
+        # Deadline/admission EAGERLY too: an expired or shed request must
+        # 503 before the 200 SSE stream is committed.
+        deadline = Deadline.from_request(request)
         # Parse/validate EVERY field EAGERLY — after the iterator is handed
         # back, the response is already committed to a 200 SSE stream, and a
         # bad request must be a 400 like the blocking endpoint's (on both
@@ -846,9 +979,21 @@ class WorkerNode:
                       "beam_width": beam_width,
                       "length_penalty": length_penalty,
                       "min_p": min_p_val}
+        if deadline is not None:
+            # Forward the REMAINING budget (deadline propagation).
+            normalized["deadline_ms"] = max(0.0, deadline.remaining_ms())
         if not self._continuous:
+            # Eager shed check so drain/overload/expired 503s BEFORE the
+            # 200 SSE stream commits (same contract as the continuous
+            # path below); released immediately — handle_generate admits
+            # for real on first iteration, and a shed that slips into the
+            # gap still surfaces as the stream's terminal error event.
+            self._admission.admit(deadline)
+            self._admission.release()
+
             def one_shot():
                 try:
+                    # handle_generate admits (depth/drain/deadline) itself.
                     result = self.handle_generate(normalized)
                 except Exception as exc:  # terminal error event, stream ends
                     yield sse_event({"done": True, "error": str(exc)[:300]})
@@ -857,39 +1002,51 @@ class WorkerNode:
                 yield sse_event({"done": True, **result})
             return one_shot()
 
-        with self._counter_lock:
-            self._total_requests += 1
-        q: "queue.Queue" = queue.Queue()
-        t0 = time.perf_counter()
-        fut = self.generator.submit(
-            prompt, max_new_tokens=max_new, eos_id=eos_id,
-            temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
-            repetition_penalty=rep_pen, stop_tokens=stop_toks,
-            min_p=min_p_val, stream=q)
+        # Continuous path: admit before the stream commits; depth is held
+        # until the event iterator finishes (the stream IS the in-flight
+        # work). An expired deadline raises here -> wire 503, not a 200.
+        self._admission.admit(deadline)
+        try:
+            self._maybe_slow()
+            with self._counter_lock:
+                self._total_requests += 1
+            q: "queue.Queue" = queue.Queue()
+            t0 = time.perf_counter()
+            fut = self.generator.submit(
+                prompt, max_new_tokens=max_new, eos_id=eos_id,
+                temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
+                repetition_penalty=rep_pen, stop_tokens=stop_toks,
+                min_p=min_p_val, stream=q, deadline=deadline)
+        except BaseException:
+            self._admission.release()
+            raise
 
         def events():
-            while True:
-                try:
-                    item = q.get(timeout=600)
-                except queue.Empty:
-                    yield sse_event({"done": True,
-                                     "error": "generation stalled (no "
-                                              "tokens for 600s)"})
-                    return
-                if item is None:
-                    break
-                yield sse_event({"tokens": item})
-            elapsed_us = int((time.perf_counter() - t0) * 1e6)
             try:
-                tokens = fut.result(timeout=10)
-            except Exception as exc:
-                yield sse_event({"done": True, "error": str(exc)[:300]})
-                return
-            self.tracer.record(request_id, "generate_stream", self.node_id,
-                               elapsed_us)
-            yield sse_event({"done": True, "request_id": request_id,
-                             "tokens": tokens, "node_id": self.node_id,
-                             "generate_time_us": elapsed_us})
+                while True:
+                    try:
+                        item = q.get(timeout=600)
+                    except queue.Empty:
+                        yield sse_event({"done": True,
+                                         "error": "generation stalled (no "
+                                                  "tokens for 600s)"})
+                        return
+                    if item is None:
+                        break
+                    yield sse_event({"tokens": item})
+                elapsed_us = int((time.perf_counter() - t0) * 1e6)
+                try:
+                    tokens = fut.result(timeout=10)
+                except Exception as exc:
+                    yield sse_event({"done": True, "error": str(exc)[:300]})
+                    return
+                self.tracer.record(request_id, "generate_stream",
+                                   self.node_id, elapsed_us)
+                yield sse_event({"done": True, "request_id": request_id,
+                                 "tokens": tokens, "node_id": self.node_id,
+                                 "generate_time_us": elapsed_us})
+            finally:
+                self._admission.release()
         return events()
 
     def _process_gen_batch(self, items: List[_GenItem]) -> List[_GenResult]:
@@ -965,6 +1122,18 @@ class WorkerNode:
                 out["generator"] = self.generator.stats()
             except Exception:
                 pass
+        # Additive, and only once admission control has anything to say
+        # (a defaults-only lane keeps the reference-exact key set).
+        dropped = self.batch_processor.deadline_dropped
+        if self._gen_processor is not None:
+            dropped += self._gen_processor.deadline_dropped
+        score_proc = getattr(self, "_score_proc", None)
+        if score_proc is not None:
+            dropped += score_proc.deadline_dropped
+        if self._admission.active or dropped:
+            adm = self._admission.as_dict()
+            adm["deadline_dropped"] = dropped
+            out["admission"] = adm
         return out
 
     def stop(self) -> None:

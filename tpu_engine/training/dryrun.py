@@ -249,6 +249,15 @@ def _dryrun_pipeline(devices, verbose):
         print(f"dryrun pp ({n} stages x 2 layers) OK")
 
 
+class _PortRace(AssertionError):
+    """A dcn child died binding a probed port that another process stole
+    (the free_ports() TOCTOU utils/net.py documents)."""
+
+
+_BIND_MARKERS = ("BIND-FAIL", "Address already in use", "EADDRINUSE",
+                 "Errno 98")
+
+
 def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
     """REAL multi-process DCN execution (VERDICT r4 missing item 2).
 
@@ -261,7 +270,26 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
     (bit-identical losses asserted across ranks), and run ring attention
     with the sequence axis spanning both processes — exact vs the
     replicated full-sequence forward. Returns a summary dict; raises on
-    any rank failure or golden mismatch."""
+    any rank failure or golden mismatch.
+
+    ``free_ports`` can only PROBE for free ports — another process may
+    bind one between the probe close and the children's bind — so the
+    launch (the consumer) owns the retry: a child that died with a bind
+    error relaunches the pair on fresh ports instead of failing the run."""
+    last: Exception = AssertionError("unreachable")
+    for attempt in range(3):
+        try:
+            return _run_dcn_pair_once(timeout_s, verbose)
+        except _PortRace as exc:
+            last = exc
+            if verbose:
+                print(f"dcn pair hit a port bind race (attempt "
+                      f"{attempt + 1}/3); relaunching on fresh ports",
+                      flush=True)
+    raise AssertionError(f"dcn pair failed 3 port-race retries:\n{last}")
+
+
+def _run_dcn_pair_once(timeout_s: float, verbose: bool) -> dict:
     import json
     import os
     import subprocess
@@ -309,8 +337,11 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
                 out, _ = p.communicate(timeout=30)
                 tails.append(f"--- rank {r} (rc={p.returncode}) ---\n"
                              f"{out[-2000:]}")
-            raise AssertionError(
-                "mesh front never came up\n" + "\n".join(tails))
+            detail = "\n".join(tails)
+            if (any(m in detail for m in _BIND_MARKERS)
+                    or any(p.returncode == 97 for p in procs)):
+                raise _PortRace("port bind race\n" + detail)
+            raise AssertionError("mesh front never came up\n" + detail)
         assert health["processes"] == 2, health
         assert health["mesh"] == {"data": 2, "model": ndev}, health
 
@@ -324,9 +355,15 @@ def run_dcn_pair(timeout_s: float = 240.0, verbose: bool = True) -> dict:
         _ensure_builtin_models_imported()
         spec = create_model("mlp", input_dim=16, hidden_dim=4 * ndev,
                             output_dim=16, num_layers=2)
-        params = spec.init(jax.random.PRNGKey(0))
         x = np.linspace(-1.0, 1.0, 16, dtype=np.float32)
-        golden = np.asarray(spec.apply(params, x[None], dtype=jnp.float32))[0]
+        # CPU-pinned: the children are CPU-pinned, and a TPU-backed parent
+        # computing this forward on the MXU rounds differently enough to
+        # flake the 1e-5 rtol below — the golden must use the SAME backend
+        # arithmetic as the thing it checks.
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = spec.init(jax.random.PRNGKey(0))
+            golden = np.asarray(
+                spec.apply(params, x[None], dtype=jnp.float32))[0]
 
         req = urllib.request.Request(
             f"http://127.0.0.1:{http_port}/infer",

@@ -76,6 +76,10 @@ class WorkerConfig:
     # Worth enabling where dispatch latency is high; costs one compile per
     # (batch, prompt, output-capacity) bucket triple.
     gen_decode_fused: bool = False
+    # Admission control (resilience layer): maximum concurrently admitted
+    # requests on this lane; excess is shed with 503 + Retry-After instead
+    # of queueing unboundedly. 0 = unbounded (reference behavior).
+    max_queue_depth: int = 0
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
@@ -97,3 +101,35 @@ class GatewayConfig:
     worker_timeout_s: float = 5.0       # reference gateway.cpp:32-33
     gen_timeout_s: float = 120.0        # /generate: decode loop + compile
     default_worker_port: int = 8080     # reference parseUrl gateway.cpp:139,147
+
+    # -- resilience layer (serving/resilience.py). Defaults are all
+    # off/permissive: with them, routing behavior and wire schemas are
+    # byte-identical to the breaker-only gateway above. --------------------
+
+    # Deadline applied to requests that carry no "deadline_ms" field
+    # (None = no deadline, reference behavior). Expired requests are shed
+    # at admission with 503 + Retry-After; mid-route expiry stops the
+    # failover march.
+    default_deadline_ms: Optional[float] = None
+    # Suggested client Retry-After (seconds) on a shed (503) response.
+    shed_retry_after_s: float = 1.0
+    # Exponential backoff between failover attempts:
+    # min(base * 2^attempt, max) * jitter in [1-j, 1+j]. base 0 = the
+    # reference's immediate ring-order failover (no sleep).
+    retry_backoff_base_ms: float = 0.0
+    retry_backoff_max_ms: float = 1000.0
+    retry_jitter: float = 0.5
+    # Global retry budget: failover retries are allowed while retries <=
+    # ratio * requests (+ min) over the sliding window. None = unlimited
+    # (reference behavior); 0.1 = the SRE-standard "retries may add at
+    # most 10% load".
+    retry_budget_ratio: Optional[float] = None
+    retry_budget_min: int = 10
+    retry_budget_window_s: float = 10.0
+    # Hedged dispatch (idempotent ops: /infer, /score): when the primary
+    # lane exceeds the hedge latency quantile, fire the next ring lane and
+    # take whichever answers first. Off by default.
+    hedge_enabled: bool = False
+    hedge_quantile: float = 0.95        # threshold = quantile of recent latency
+    hedge_min_ms: float = 50.0          # floor under the quantile threshold
+    hedge_min_samples: int = 20         # before this, hedge_min_ms alone rules

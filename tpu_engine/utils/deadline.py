@@ -1,0 +1,102 @@
+"""Per-request deadlines and the shed-class exceptions they produce.
+
+The SRE-standard resilience triad (deadlines, bounded retries, load
+shedding) starts here: a request carries an absolute monotonic deadline
+from the HTTP edge through gateway -> worker client -> worker -> batcher
+or continuous-batching scheduler, so every layer can refuse or abandon
+work whose client already gave up instead of burning a batch row on it.
+
+Wire form: an optional ``"deadline_ms"`` request field — the REMAINING
+budget in milliseconds at the hop that wrote it (Google-style deadline
+propagation: each hop forwards what's left, so clock skew between hosts
+never matters). Absent field = no deadline, exactly the pre-resilience
+behavior.
+
+This module is utils-layer on purpose: ``runtime`` (batch processor,
+scheduler), ``serving`` and ``parallel`` all consume it and must not
+import each other for the privilege.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class ShedError(Exception):
+    """A request refused by policy, not failed by a fault: the correct
+    client action is to back off and retry later. HTTP layers render any
+    ShedError as 503 + a ``Retry-After`` header."""
+
+    retry_after_s: float = 1.0
+    kind: str = "shed"
+
+
+class DeadlineExceeded(ShedError):
+    """The request's deadline expired (at admission or mid-flight).
+    Retrying immediately cannot help — the budget is gone — so the
+    suggested Retry-After is short but non-zero."""
+
+    kind = "deadline_exceeded"
+
+
+class Overloaded(ShedError):
+    """Admission control refused the request: queue depth exceeded or the
+    lane is draining (lame-duck). The work itself was never attempted, so
+    the lane stays healthy — callers should fail over, not trip breakers."""
+
+    kind = "overloaded"
+
+
+class Deadline:
+    """Absolute monotonic deadline. ``None``-safe by construction: every
+    helper accepts ``deadline=None`` meaning "no deadline", so callers
+    thread an Optional[Deadline] without branching."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def after_ms(cls, budget_ms: float) -> "Deadline":
+        return cls(time.monotonic() + float(budget_ms) / 1000.0)
+
+    @classmethod
+    def from_request(cls, payload: dict,
+                     default_ms: Optional[float] = None) -> Optional["Deadline"]:
+        """Deadline from a request dict's ``deadline_ms`` (remaining budget
+        at this hop), else from ``default_ms``, else None. A malformed
+        value is a client error (ValueError -> wire 400), never a crash."""
+        raw = payload.get("deadline_ms")
+        if raw is None:
+            if default_ms is None:
+                return None
+            return cls.after_ms(default_ms)
+        budget = float(raw)
+        if budget != budget or budget < 0:  # NaN or negative
+            raise ValueError(f"deadline_ms must be >= 0, got {raw!r}")
+        return cls.after_ms(budget)
+
+    def remaining_s(self) -> float:
+        return self.at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def __repr__(self) -> str:  # debugging aid
+        return f"Deadline(in {self.remaining_ms():.1f} ms)"
+
+
+def clamp_timeout(deadline: Optional[Deadline],
+                  timeout_s: Optional[float]) -> Optional[float]:
+    """The tighter of a fixed timeout and the deadline's remaining budget
+    (floored at 0 so blocking waits fail fast instead of raising on a
+    negative timeout)."""
+    if deadline is None:
+        return timeout_s
+    rem = max(0.0, deadline.remaining_s())
+    return rem if timeout_s is None else min(timeout_s, rem)

@@ -69,6 +69,24 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None) -> byte
            [({**node(h), "model": g.get("model", g.get("target", "?"))}, 1)
             for h, g in gen])
 
+    # Resilience layer, lane side (the "admission" /health block appears
+    # only once admission control has made a decision).
+    adm = [(h, h.get("admission")) for h in healths if h.get("admission")]
+    metric("tpu_engine_lane_draining", "gauge",
+           "1 = lane refusing new admissions (lame-duck)",
+           [(node(h), int(bool(a.get("draining")))) for h, a in adm])
+    metric("tpu_engine_lane_queue_depth", "gauge",
+           "Concurrently admitted requests on the lane",
+           [(node(h), a.get("queue_depth")) for h, a in adm])
+    metric("tpu_engine_shed_total", "counter",
+           "Requests shed by lane admission control, by reason",
+           [({**node(h), "reason": r}, a.get(f"shed_{r}"))
+            for h, a in adm
+            for r in ("overloaded", "deadline", "draining")])
+    metric("tpu_engine_deadline_dropped_total", "counter",
+           "Queued requests dropped at batch formation (deadline expired)",
+           [(node(h), a.get("deadline_dropped")) for h, a in adm])
+
     if stats:
         metric("tpu_engine_gateway_requests_total", "counter",
                "Requests routed by the gateway",
@@ -90,4 +108,28 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None) -> byte
                "Successes recorded by the breaker",
                [({"node": w.get("node", "?")}, w.get("successes"))
                 for w in workers])
+        res = stats.get("resilience")
+        if res:
+            # Gateway-side resilience decisions (the /stats "resilience"
+            # block; present once configured or first exercised).
+            for key, help_text in (
+                    ("deadline_rejected",
+                     "Requests shed at gateway admission (expired deadline)"),
+                    ("deadline_expired",
+                     "Requests whose deadline expired mid-route"),
+                    ("retries", "Failover retry attempts dispatched"),
+                    ("retry_budget_exhausted",
+                     "Retries refused by the global retry budget"),
+                    ("backoff_waits", "Backoff sleeps before a retry"),
+                    ("hedges", "Hedged dispatches fired"),
+                    ("hedge_wins", "Hedged dispatches won by the hedge lane"),
+                    ("hedge_losses",
+                     "Hedged dispatches won by the primary lane"),
+                    ("shed_overloaded",
+                     "Dispatches shed by an overloaded/draining lane")):
+                metric(f"tpu_engine_{key}_total", "counter", help_text,
+                       [({}, res.get(key))])
+            metric("tpu_engine_hedge_threshold_ms", "gauge",
+                   "Current hedge latency threshold",
+                   [({}, res.get("hedge_threshold_ms"))])
     return ("\n".join(lines) + "\n").encode()

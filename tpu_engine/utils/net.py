@@ -13,7 +13,10 @@ def free_ports(n: int = 1) -> List[int]:
     same port back twice (the classic close-then-reuse TOCTOU). The
     remaining race (another process grabbing a port after close) is
     unavoidable without SO_REUSEPORT handoff; callers should bind
-    promptly."""
+    promptly AND own the retry: relaunch on a FRESH port when the bind
+    fails (bench.launch_ready and training/dryrun.run_dcn_pair do; a
+    plain JsonHttpServer caller should loop on EADDRINUSE the same
+    way)."""
     socks = []
     try:
         for _ in range(n):
