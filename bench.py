@@ -261,6 +261,51 @@ def scrape_stats(port: int) -> dict:
     return out
 
 
+def scrape_trace_stages(port: int) -> Optional[dict]:
+    """Per-stage latency attribution from the server's tracing layer
+    (GET /trace "stages"): where did the wall time go — queue wait,
+    batch formation, device compute, serialization? Emitted into the
+    BENCH json so the perf trajectory carries attributable numbers, not
+    just end-to-end req/s. Count-weighted means aggregate across lanes;
+    per-stage p99 reports the worst lane (cross-lane percentiles cannot
+    be merged from summaries)."""
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/trace")
+        resp = conn.getresponse()
+        trace = json.loads(resp.read())
+        conn.close()
+    except Exception as exc:  # tracing scrape is best-effort
+        log(f"trace scrape failed: {exc}")
+        return None
+    stages = trace.get("stages")
+    if not stages:
+        return None
+    agg: dict = {}
+    for lane_stages in stages.values():
+        for op, s in lane_stages.items():
+            a = agg.setdefault(op, {"count": 0, "_sum": 0.0, "p99_us": 0})
+            a["count"] += s["count"]
+            a["_sum"] += s["mean_us"] * s["count"]
+            a["p99_us"] = max(a["p99_us"], s["p99_us"])
+    out = {"stages": {}}
+    for op, a in sorted(agg.items()):
+        out["stages"][op] = {
+            "count": a["count"],
+            "mean_us": round(a["_sum"] / max(1, a["count"]), 1),
+            "p99_us": a["p99_us"],
+        }
+    qw = out["stages"].get("queue_wait")
+    dc = out["stages"].get("device_compute")
+    if qw and dc and dc["mean_us"] > 0:
+        # The headline attribution ratio: >1 means requests spend longer
+        # waiting for a batch slot than computing — batching policy, not
+        # the device, is the next thing to tune.
+        out["queue_wait_vs_device_compute"] = round(
+            qw["mean_us"] / dc["mean_us"], 3)
+    return out
+
+
 def stop_server(proc: Optional[subprocess.Popen]) -> None:
     """terminate -> bounded wait -> kill; shared by every launcher site."""
     if proc is None:
@@ -1224,6 +1269,13 @@ def _main() -> int:
             record_partial("miss_path", miss)
             log(json.dumps({"miss_path": miss}, indent=2))
 
+        # Per-stage latency attribution from the tracing layer (queue
+        # wait vs device compute etc.) — scraped before the server stops.
+        trace_stages = scrape_trace_stages(port)
+        if trace_stages is not None:
+            record_partial("trace_stages", trace_stages)
+            log(json.dumps({"trace_stages": trace_stages}, indent=2))
+
         # Free the chip before the in-process compute addendum.
         if proc is not None:
             stop_server(proc)
@@ -1261,6 +1313,8 @@ def _main() -> int:
         }
         if miss is not None:
             line["miss_path"] = miss
+        if trace_stages is not None:
+            line["trace_stages"] = trace_stages
         if compute is not None:
             line["compute"] = {k: compute[k] for k in
                                ("samples_per_s", "device_samples_per_s",

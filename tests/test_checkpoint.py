@@ -117,8 +117,15 @@ def test_worker_traces_requests():
     try:
         w.handle_infer({"request_id": "x1", "input_data": [1.0, 2.0, 3.0]})
         w.handle_infer({"request_id": "x1", "input_data": [1.0, 2.0, 3.0]})
-        spans = w.tracer.recent()
+        # The ring now also holds stage spans (admission, cache_lookup,
+        # queue_wait, device_compute, ...) — the request-level rows are
+        # the op=="infer" roots.
+        spans = [s for s in w.tracer.recent() if s["op"] == "infer"]
         assert [s["request_id"] for s in spans] == ["x1", "x1"]
         assert [s["cached"] for s in spans] == [False, True]
+        # Every span of the request carries the same derived trace id.
+        trace_ids = {s["trace_id"] for s in w.tracer.recent()
+                     if "trace_id" in s}
+        assert len(trace_ids) == 1
     finally:
         w.stop()

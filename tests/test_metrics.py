@@ -68,6 +68,88 @@ def test_metrics_over_http():
         w.stop()
 
 
+def test_latency_histogram_cumulative_counts():
+    from tpu_engine.utils.metrics import LatencyHistogram
+
+    h = LatencyHistogram(bounds=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.005, 0.05, 0.5):
+        h.observe(v)
+    snap = h.snapshot()
+    # Cumulative per-bucket counts; a value exactly ON a bound belongs in
+    # that bucket (Prometheus `le` semantics).
+    assert snap["cumulative"] == [2, 3, 4]
+    assert snap["inf"] == 5
+    assert snap["count"] == 5
+    assert abs(snap["sum"] - 0.5565) < 1e-12
+
+
+def test_histogram_exposition_validity():
+    from tpu_engine.utils.metrics import (
+        LatencyHistogram,
+        render_stage_histograms,
+    )
+
+    h = LatencyHistogram(bounds=(0.001, 0.01))
+    for v in (0.0002, 0.002, 2.0):
+        h.observe(v)
+
+    class _Rec:
+        def histograms(self):
+            return {"queue_wait": h}
+
+    text = "\n".join(render_stage_histograms({"w1": _Rec()}))
+    assert "# TYPE tpu_engine_stage_latency_seconds histogram" in text
+    assert ('tpu_engine_stage_latency_seconds_bucket'
+            '{node="w1",stage="queue_wait",le="0.001"} 1') in text
+    assert ('tpu_engine_stage_latency_seconds_bucket'
+            '{node="w1",stage="queue_wait",le="0.01"} 2') in text
+    assert ('tpu_engine_stage_latency_seconds_bucket'
+            '{node="w1",stage="queue_wait",le="+Inf"} 3') in text
+    assert ('tpu_engine_stage_latency_seconds_count'
+            '{node="w1",stage="queue_wait"} 3') in text
+    assert 'tpu_engine_stage_latency_seconds_sum' in text
+
+
+def test_stage_histograms_over_http():
+    """Acceptance: after a miss runs the batched path, /metrics exposes
+    queue_wait, batch_form, and device_compute histograms whose +Inf
+    bucket equals _count and whose buckets are monotone non-decreasing."""
+    import re
+
+    from tpu_engine.serving.app import serve_worker
+    from tpu_engine.utils.config import WorkerConfig
+
+    cfg = WorkerConfig(port=0, node_id="hist_w", model="mlp")
+    w, server = serve_worker(cfg, background=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/infer",
+                     body='{"request_id":"h1","input_data":[4.0,5.0]}',
+                     headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        conn.close()
+        for stage in ("queue_wait", "batch_form", "device_compute"):
+            pat = re.compile(
+                r'tpu_engine_stage_latency_seconds_bucket'
+                r'\{node="hist_w",stage="%s",le="([^"]+)"\} (\d+)' % stage)
+            buckets = pat.findall(body)
+            assert buckets, f"no histogram for stage {stage}"
+            counts = [int(c) for _, c in buckets]
+            assert counts == sorted(counts)  # cumulative => monotone
+            assert buckets[-1][0] == "+Inf"
+            count_m = re.search(
+                r'tpu_engine_stage_latency_seconds_count'
+                r'\{node="hist_w",stage="%s"\} (\d+)' % stage, body)
+            assert count_m and int(count_m.group(1)) == counts[-1]
+            assert counts[-1] >= 1  # the miss was observed
+    finally:
+        server.stop()
+        w.stop()
+
+
 def test_metrics_through_combined_front():
     """/metrics works through combined mode (native C++ front fallback
     path returns 3-tuples; regression for the 2-tuple unpack)."""

@@ -180,6 +180,32 @@ def test_gateway_retry_budget_stops_failover_storm():
     assert sum(w.calls for w in ws) == 2  # primary + one retry, not 3
 
 
+class SheddingWorker(StubWorker):
+    """Lane that refuses every request as overloaded (healthy, busy)."""
+
+    def handle_infer(self, payload):
+        self.calls += 1
+        raise Overloaded("lane full")
+
+
+def test_budget_exhaustion_after_shed_is_overloaded():
+    """A march that saw a SHED must end 503-class even when the retry
+    budget — not the ring — is what stops it: congestion reads as
+    back-off-and-retry, never as an outage."""
+    shedding, failing = SheddingWorker("w0"), StubWorker("w1")
+    failing.fail = True
+    gw = Gateway([shedding, failing],
+                 GatewayConfig(retry_budget_ratio=0.0, retry_budget_min=0))
+    # Deterministic primary: pick an id the ring assigns to the shedder.
+    rid = next(f"r{i}" for i in range(200)
+               if gw._ring.get_node(f"r{i}") == "w0")
+    with pytest.raises(Overloaded):
+        gw.route_request({"request_id": rid, "input_data": [1.0]})
+    res = gw.get_stats()["resilience"]
+    assert res["shed_overloaded"] == 1
+    assert res["retry_budget_exhausted"] >= 1
+
+
 def test_gateway_backoff_waits_between_failovers():
     ws = [StubWorker(f"w{i}") for i in range(3)]
     for w in ws:

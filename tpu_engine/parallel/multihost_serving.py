@@ -51,6 +51,11 @@ class _Pending:
     # instead of burning a data-shard row of a later tick computing an
     # answer nobody will read.
     abandoned: bool = False
+    # Tracing: worker-root span context + queue-entry timestamp so the
+    # lockstep loop can attribute enqueue→tick wait and SPMD compute.
+    request_id: str = ""
+    trace: Optional[object] = None
+    t_enq: float = 0.0
 
 
 class LockstepMeshServer:
@@ -78,6 +83,13 @@ class LockstepMeshServer:
             out_shardings=NamedSharding(mesh, P()))
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        # Tracing ring (leader-side): per-request `infer` roots with
+        # queue_wait / device_compute children — the lockstep flavor of
+        # the worker span taxonomy (exposed at /trace, /trace/export).
+        from tpu_engine.utils.tracing import SpanRecorder
+
+        self.tracer = SpanRecorder()
+        self._node = f"mesh_host_{jax.process_index()}"
 
     # -- leader-side HTTP handlers -------------------------------------------
 
@@ -85,6 +97,7 @@ class LockstepMeshServer:
         if self._stop.is_set():
             return 503, {"error": "server stopping"}
         from tpu_engine.utils.deadline import Deadline
+        from tpu_engine.utils.tracing import TraceContext
 
         req_deadline = Deadline.from_request(body)  # optional deadline_ms
         if req_deadline is not None and req_deadline.expired():
@@ -96,7 +109,14 @@ class LockstepMeshServer:
             flat = flat[:want]          # reference predict truncates long
         elif flat.size < want:          # ... and zero-pads short (:100-103)
             flat = np.pad(flat, (0, want - flat.size))
-        item = _Pending(x=flat.reshape(self.sample_shape))
+        request_id = str(body.get("request_id", ""))
+        parent = TraceContext.from_request(body)
+        tctx = (parent.child() if parent is not None
+                else TraceContext.root(request_id))
+        t_start_wall = time.time()
+        item = _Pending(x=flat.reshape(self.sample_shape),
+                        request_id=request_id, trace=tctx,
+                        t_enq=time.perf_counter())
         t0 = time.perf_counter()
         self._q.put(item)
         # Poll instead of one long wait: a request that slips in between
@@ -126,12 +146,18 @@ class LockstepMeshServer:
                 return 500, {"error": "lockstep tick timed out"}
         if item.result is None:  # drained (or abandoned) by shutdown
             return 503, {"error": "server stopping"}
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        self.tracer.record(
+            request_id, "infer", self._node, elapsed_us,
+            trace_id=tctx.trace_id, span_id=tctx.span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            start_ts=t_start_wall)
         return 200, {
             "request_id": body.get("request_id", ""),
             "output_data": item.result.ravel().tolist(),
-            "node_id": f"mesh_host_{jax.process_index()}",
+            "node_id": self._node,
             "cached": False,
-            "inference_time_us": int((time.perf_counter() - t0) * 1e6),
+            "inference_time_us": elapsed_us,
         }
 
     def _handle_stop(self, _body):
@@ -177,6 +203,8 @@ class LockstepMeshServer:
         if is_leader and http_port is not None:
             from tpu_engine.serving.http import JsonHttpServer
 
+            from tpu_engine.utils.tracing import export_chrome
+
             server = JsonHttpServer(http_port, host="127.0.0.1")
             server.route("POST", "/infer", self._handle_infer)
             server.route("POST", "/admin/stop", self._handle_stop)
@@ -184,6 +212,12 @@ class LockstepMeshServer:
                 "healthy": True, "node_id": "mesh_host_0",
                 "processes": jax.process_count(),
                 "mesh": dict(self.mesh.shape)}))
+            server.route("GET", "/trace", lambda _b: (200, {
+                "summary": {self._node: self.tracer.summary()},
+                "recent": self.tracer.recent(20),
+                "stages": {self._node: self.tracer.stage_summary()}}))
+            server.route("GET", "/trace/export", lambda _b: (
+                200, export_chrome({self._node: self.tracer})))
             server.start(background=True)
         try:
             while True:
@@ -211,13 +245,35 @@ class LockstepMeshServer:
                     break
                 if cmd != CMD_INFER:
                     continue
+                t_tick = time.perf_counter()
                 buf = np.asarray(multihost_utils.broadcast_one_to_all(
                     self._payload_buf(items)))
                 x = buf.reshape((self.batch,) + self.sample_shape)
                 xg = jax.make_array_from_callback(
                     x.shape, self._x_sharding, lambda idx: x[idx])
                 out = np.asarray(self._fwd(self.params, xg))
+                tick_us = (time.perf_counter() - t_tick) * 1e6
+                tick_start_wall = time.time() - tick_us / 1e6
                 for i, it in enumerate(items):  # leader-only waiters
+                    if it.trace is not None:
+                        # Stage children: enqueue→tick wait, then the
+                        # whole tick's DCN broadcast + SPMD dispatch as
+                        # the device leg (batch_size = rows this tick).
+                        wait_us = (t_tick - it.t_enq) * 1e6
+                        qw = it.trace.child()
+                        self.tracer.record(
+                            it.request_id, "queue_wait", self._node,
+                            wait_us, trace_id=qw.trace_id,
+                            span_id=qw.span_id,
+                            parent_id=it.trace.span_id,
+                            start_ts=tick_start_wall - wait_us / 1e6)
+                        dc = it.trace.child()
+                        self.tracer.record(
+                            it.request_id, "device_compute", self._node,
+                            tick_us, batch_size=len(items),
+                            trace_id=dc.trace_id, span_id=dc.span_id,
+                            parent_id=it.trace.span_id,
+                            start_ts=tick_start_wall)
                     it.result = out[i]
                     it.event.set()
         finally:

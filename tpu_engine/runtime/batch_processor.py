@@ -42,6 +42,22 @@ Response = TypeVar("Response")
 
 
 @dataclass
+class BatchTiming:
+    """Per-batch stage timing handed to the optional ``observer`` after a
+    successful batch (tracing layer): ``queue_wait_us[i]`` is request i's
+    submit→batch-formation wait; ``batch_form_us`` the window over which
+    the batch accumulated (formation time minus the oldest member's
+    enqueue); ``compute_us`` the device leg (callback wall for the
+    lockstep path, submit→collect residence for the pipelined path —
+    the same timing points ``inference_time_us`` divides by batch size)."""
+
+    queue_wait_us: List[float]
+    batch_form_us: float
+    compute_us: float = 0.0
+    timed_out: bool = False
+
+
+@dataclass
 class BatcherMetrics:
     total_requests: int = 0       # enqueued (reference counts at process(), :96)
     total_batches: int = 0
@@ -83,6 +99,7 @@ class BatchProcessor(Generic[Request, Response]):
         collect_callback: Optional[Callable[[Any], Sequence[Response]]] = None,
         ready_callback: Optional[Callable[[Any], bool]] = None,
         pipeline_depth: int = 1,
+        observer: Optional[Callable[[List[Request], BatchTiming], None]] = None,
     ):
         """`submit_callback`/`collect_callback` (both or neither) enable
         split-phase pipelining: the dispatch thread keeps up to
@@ -117,10 +134,15 @@ class BatchProcessor(Generic[Request, Response]):
             self._ready_cb = _safe_ready
         self._depth = max(1, int(pipeline_depth)) if submit_callback else 1
         self._name = name
-        # Entries are (request, future, deadline-or-None). Expired entries
-        # are failed at batch-formation time instead of burning a batch
-        # row on a client that already gave up (resilience layer).
-        self._queue: List[Tuple[Request, Future, Optional[Deadline]]] = []
+        # Tracing hook: called on the dispatch thread after each successful
+        # batch with (requests, BatchTiming). Guarded — a broken observer
+        # must never unwind the dispatch loop.
+        self._observer = observer
+        # Entries are (request, future, deadline-or-None, enqueue-perf-ts).
+        # Expired entries are failed at batch-formation time instead of
+        # burning a batch row on a client that already gave up (resilience
+        # layer); the timestamp feeds the queue_wait tracing span.
+        self._queue: List[Tuple[Request, Future, Optional[Deadline], float]] = []
         self.deadline_dropped = 0  # expired-in-queue count (observability)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -153,7 +175,7 @@ class BatchProcessor(Generic[Request, Response]):
         # implicitly by destructing promises; we fail them explicitly).
         with self._lock:
             pending, self._queue = self._queue, []
-        for _, fut, _dl in pending:
+        for _, fut, _dl, _t in pending:
             if not fut.done():
                 fut.set_exception(RuntimeError("batch processor stopped"))
 
@@ -180,7 +202,7 @@ class BatchProcessor(Generic[Request, Response]):
         with self._cv:
             if not self._running:
                 raise RuntimeError("batch processor is not running")
-            self._queue.append((request, fut, deadline))
+            self._queue.append((request, fut, deadline, time.perf_counter()))
             self._cv.notify()
         with self._metrics_lock:
             self._metrics.total_requests += 1
@@ -189,7 +211,8 @@ class BatchProcessor(Generic[Request, Response]):
     # -- dispatch loop -------------------------------------------------------
 
     def _processing_loop(self) -> None:
-        inflight: List[Tuple[List[Tuple[Request, Future]], Any, bool]] = []
+        # Entries: (batch, queue_waits_us, handle, timed_out, t_submit).
+        inflight: List[tuple] = []
         while True:
             with self._cv:
                 if self._queue or inflight:
@@ -236,7 +259,7 @@ class BatchProcessor(Generic[Request, Response]):
                         while (self._running
                                and len(self._queue) < self._max_batch_size):
                             if (self._ready_cb is not None
-                                    and self._ready_cb(inflight[0][1])):
+                                    and self._ready_cb(inflight[0][2])):
                                 break
                             remaining = deadline - time.monotonic()
                             if remaining <= 0:
@@ -245,23 +268,25 @@ class BatchProcessor(Generic[Request, Response]):
                             self._cv.wait(timeout=min(remaining, 0.002))
                         if not self._running:
                             break
-                        batch = self._take_batch_locked()
+                        batch, waits = self._take_batch_locked()
                 else:
-                    batch = self._take_batch_locked()
+                    batch, waits = self._take_batch_locked()
             if batch:
                 if self._submit_cb is None:
-                    self._process_batch(batch, timed_out)
+                    self._process_batch(batch, timed_out, waits)
                     continue
+                t_submit = time.perf_counter()
                 handle = self._submit(batch)
                 if handle is not None:
-                    inflight.append((batch, handle, timed_out))
+                    inflight.append((batch, waits, handle, timed_out,
+                                     t_submit))
             # Collect the oldest unless queued work can dispatch into spare
             # pipeline slots (the bounded linger above decides whether it
             # goes out partial or full). A completed oldest batch is always
             # collected first — it resolves callers without blocking.
             while inflight:
                 oldest_ready = (self._ready_cb is not None
-                                and self._ready_cb(inflight[0][1]))
+                                and self._ready_cb(inflight[0][2]))
                 with self._lock:
                     qlen = len(self._queue)
                 if qlen > 0 and len(inflight) < self._depth and not oldest_ready:
@@ -270,17 +295,22 @@ class BatchProcessor(Generic[Request, Response]):
         for entry in inflight:  # shutdown: drain what was already dispatched
             self._collect(*entry)
 
-    def _take_batch_locked(self) -> List[Tuple[Request, Future]]:
+    def _take_batch_locked(self) -> Tuple[List[Tuple[Request, Future]],
+                                          List[float]]:
         """Take up to max_batch_size live entries off the queue (caller
         holds the lock). Entries whose deadline expired while queued are
         failed with DeadlineExceeded and never enter a batch — the
         resilience layer's 'don't burn a batch row for a client that gave
         up'. One del at the end keeps extraction O(queue) — per-element
         pop(0) would shift the whole backlog per item inside this critical
-        section, exactly when the queue is deepest."""
+        section, exactly when the queue is deepest. Returns the batch and
+        each member's queue wait (µs, submit→now) for the tracing
+        observer."""
         batch: List[Tuple[Request, Future]] = []
+        waits: List[float] = []
+        now = time.perf_counter()
         taken = 0
-        for req, fut, dl in self._queue:
+        for req, fut, dl, t_enq in self._queue:
             taken += 1
             if dl is not None and dl.expired():
                 self.deadline_dropped += 1
@@ -289,10 +319,11 @@ class BatchProcessor(Generic[Request, Response]):
                         "deadline expired while queued for batching"))
                 continue
             batch.append((req, fut))
+            waits.append((now - t_enq) * 1e6)
             if len(batch) >= self._max_batch_size:
                 break
         del self._queue[:taken]
-        return batch
+        return batch, waits
 
     def _submit(self, batch: List[Tuple[Request, Future]]):
         try:
@@ -303,26 +334,34 @@ class BatchProcessor(Generic[Request, Response]):
                     fut.set_exception(exc)
             return None
 
-    def _collect(self, batch: List[Tuple[Request, Future]], handle,
-                 is_timeout: bool) -> None:
-        self._fan_out(batch, lambda: self._collect_cb(handle), is_timeout)
+    def _collect(self, batch: List[Tuple[Request, Future]],
+                 waits: List[float], handle, is_timeout: bool,
+                 t_submit: Optional[float] = None) -> None:
+        self._fan_out(batch, lambda: self._collect_cb(handle), is_timeout,
+                      waits, t0=t_submit)
 
     def _process_batch(
-        self, batch: List[Tuple[Request, Future]], is_timeout: bool
+        self, batch: List[Tuple[Request, Future]], is_timeout: bool,
+        waits: List[float],
     ) -> None:
         self._fan_out(batch, lambda: self._callback([r for r, _ in batch]),
-                      is_timeout)
+                      is_timeout, waits)
 
     def _fan_out(self, batch: List[Tuple[Request, Future]],
                  produce: Callable[[], Sequence[Response]],
-                 is_timeout: bool) -> None:
+                 is_timeout: bool, waits: List[float],
+                 t0: Optional[float] = None) -> None:
         """Resolve one batch's futures from `produce()`: one response per
         request, too-few responses fail the extras (reference
         ``batch_processor.h:148-155``), an exception fans out to every
         caller (``:171-180``) and updates no metrics (``:157-169`` sit
-        inside the reference's try block)."""
+        inside the reference's try block). ``t0``: dispatch start for the
+        pipelined path, so compute_us spans the batch's full device
+        residence (submit→collect), matching inference_time_us."""
+        t_start = t0 if t0 is not None else time.perf_counter()
         try:
             responses = produce()
+            compute_us = (time.perf_counter() - t_start) * 1e6
             for i, (_, fut) in enumerate(batch):
                 if i < len(responses):
                     fut.set_result(responses[i])
@@ -334,6 +373,16 @@ class BatchProcessor(Generic[Request, Response]):
                     fut.set_exception(exc)
             return
         self._record(len(batch), is_timeout)
+        if self._observer is not None:
+            try:
+                self._observer(
+                    [r for r, _ in batch],
+                    BatchTiming(queue_wait_us=waits,
+                                batch_form_us=max(waits) if waits else 0.0,
+                                compute_us=compute_us,
+                                timed_out=is_timeout))
+            except Exception:
+                pass  # telemetry must never unwind the dispatch thread
 
     def _record(self, batch_size: int, is_timeout: bool) -> None:
         with self._metrics_lock:

@@ -116,6 +116,11 @@ class InferenceEngine:
         self._executables: Dict[int, jax.stages.Compiled] = {}
         self._compile_lock = threading.Lock()
         self._compile_times: Dict[int, float] = {}
+        # Tracing hook (set by the owning WorkerNode): inline XLA compiles
+        # are the classic first-request mystery stall — recording them as
+        # ``xla_compile`` spans makes them attributable in /trace/export.
+        self.tracer = None
+        self.trace_node = "engine"
         self._stats_lock = threading.Lock()
         self._execute_count = 0
         # Wall-clock the host spends BLOCKED in batch_collect materializing
@@ -275,7 +280,16 @@ class InferenceEngine:
                 x0 = jax.device_put(x0, self._device)
             exe = jitted.lower(self.params, x0).compile()
             self._executables[key] = exe
-            self._compile_times[key] = time.monotonic() - start
+            elapsed = time.monotonic() - start
+            self._compile_times[key] = elapsed
+            if self.tracer is not None:
+                try:
+                    self.tracer.record(
+                        "-", "xla_compile", self.trace_node, elapsed * 1e6,
+                        start_ts=time.time() - elapsed,
+                        attrs={"bucket": str(key)})
+                except Exception:
+                    pass  # telemetry must never fail a compile
             return exe
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Union
@@ -85,6 +86,12 @@ class _Request:
     # Resilience: expired requests are refused before prefill and
     # cancelled between decode chunks (the row frees for live work).
     deadline: Optional[Deadline] = None
+    # Tracing (utils.tracing.TraceSink, optional): the scheduler records
+    # queue_wait (submit→prefill start), prefill, and decode stage spans
+    # against the request's worker-root span. None = zero overhead.
+    sink: Optional[object] = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
 
 
 class _PrefixCache:
@@ -404,7 +411,8 @@ class ContinuousGenerator:
                top_p: float = 1.0, top_k: int = 0,
                repetition_penalty: float = 1.0, stop_tokens=None,
                min_p: float = 0.0, stream=None,
-               deadline: Optional[Deadline] = None) -> Future:
+               deadline: Optional[Deadline] = None,
+               sink=None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
@@ -412,7 +420,9 @@ class ContinuousGenerator:
         semantics (HF-style penalty; <=8 stop ids ending the row like
         EOS). `deadline`: optional Deadline — the future resolves with
         DeadlineExceeded if it expires before prefill or mid-decode (the
-        row is freed; already-streamed tokens stand)."""
+        row is freed; already-streamed tokens stand). `sink`: optional
+        utils.tracing.TraceSink — the scheduler records queue_wait /
+        prefill / decode stage spans for this request against it."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
         pens, stops = expand_stopping_params(1, repetition_penalty,
@@ -424,7 +434,8 @@ class ContinuousGenerator:
                        float(temperature), int(seed), float(top_p),
                        clamp_top_k(top_k), rep_penalty=pens[0],
                        stop_tokens=stops[0], min_p=float(min_p),
-                       stream=stream, deadline=deadline)
+                       stream=stream, deadline=deadline, sink=sink,
+                       t_submit=time.perf_counter())
         self._queue.put(req)
         return req.future
 
@@ -513,11 +524,21 @@ class ContinuousGenerator:
                 # skip the prefill forward entirely.
                 self._cancel_deadline(req, "deadline expired before prefill")
                 continue
+            t0 = time.perf_counter()
+            if req.sink is not None:
+                wait_us = (t0 - req.t_submit) * 1e6
+                req.sink.stage("queue_wait", wait_us,
+                               start_ts=time.time() - wait_us / 1e6)
             try:
                 item = self._run_prefill(req)
             except Exception as exc:
                 self._fail_request(req, exc)
                 continue
+            if req.sink is not None:
+                dur_us = (time.perf_counter() - t0) * 1e6
+                req.sink.stage("prefill", dur_us,
+                               start_ts=time.time() - dur_us / 1e6,
+                               prompt_len=len(req.prompt))
             # Bounded put with a running check: if the decode loop already
             # exited, don't block forever on a full queue.
             placed = False
@@ -638,6 +659,7 @@ class ContinuousGenerator:
         """Decode-thread half of admission: splice the prefilled KV block
         into the shared cache and initialise the row's host-side state."""
         req, row_caches, first_tok, pb, L, row_counts = item
+        req.t_admit = time.perf_counter()
         if row_counts is not None:
             self._caches, self._counts = self._insert(True)(
                 self._caches, row_caches.k, row_caches.v, row,
@@ -691,6 +713,13 @@ class ContinuousGenerator:
         if hit_eos or budget or out_of_cache or self._done[row]:
             toks = self._visible_tokens(row, req)
             self._push_stream(row, req)
+            if req.sink is not None and req.t_admit:
+                # The row's whole decode residence (admission→completion):
+                # device chunks plus the idle lanes it rode along in.
+                dur_us = (time.perf_counter() - req.t_admit) * 1e6
+                req.sink.stage("decode", dur_us,
+                               start_ts=time.time() - dur_us / 1e6,
+                               tokens=len(toks))
             req.future.set_result(toks)
             if req.stream is not None:
                 req.stream.put(None)  # end of stream

@@ -22,6 +22,7 @@ from tpu_engine.serving.worker import WorkerNode
 from tpu_engine.utils.config import GatewayConfig, WorkerConfig
 from tpu_engine.utils.deadline import ShedError
 from tpu_engine.utils.metrics import render_prometheus
+from tpu_engine.utils.tracing import export_chrome
 
 
 def model_from_path(path_or_name: str) -> str:
@@ -52,8 +53,16 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
                  lambda body: (200, worker.handle_generate_stream(body)))
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
     server.route("GET", "/metrics", lambda _body: (
-        200, render_prometheus([worker.get_health()]),
+        200, render_prometheus([worker.get_health()],
+                               recorders={worker.node_id: worker.tracer}),
         "text/plain; version=0.0.4"))
+    server.route("GET", "/trace", lambda _body: (200, {
+        "summary": {worker.node_id: worker.tracer.summary()},
+        "recent": worker.tracer.recent(20),
+        "stages": {worker.node_id: worker.tracer.stage_summary()},
+    }))
+    server.route("GET", "/trace/export", lambda _body: (
+        200, export_chrome({worker.node_id: worker.tracer})))
     server.route("POST", "/admin/reload", lambda body: (
         200, worker.reload_weights(body["model_path"])))
     server.route("POST", "/score", lambda body: (
@@ -91,8 +100,16 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     server.route("GET", "/stats", lambda _body: (200, gateway.get_stats()))
     server.route("POST", "/score", lambda body: (200, gateway.route_score(body)))
     server.route("GET", "/metrics", lambda _body: (
-        200, render_prometheus([], gateway.get_stats()),
+        200, render_prometheus([], gateway.get_stats(),
+                               recorders={"gateway": gateway.tracer}),
         "text/plain; version=0.0.4"))
+    server.route("GET", "/trace", lambda _body: (200, {
+        "summary": {"gateway": gateway.tracer.summary()},
+        "recent": gateway.tracer.recent(20),
+        "stages": {"gateway": gateway.tracer.stage_summary()},
+    }))
+    server.route("GET", "/trace/export", lambda _body: (
+        200, export_chrome({"gateway": gateway.tracer})))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
     print("Circuit breakers enabled")
@@ -336,12 +353,23 @@ def serve_combined(
 
     routes[("POST", "/admin/drain")] = _admin_drain
 
-    # Tracing (SURVEY.md §5: the reference has only per-request wall clocks).
+    # Tracing (SURVEY.md §5: the reference has only per-request wall
+    # clocks). "summary"/"recent" keep the original schema; "gateway" and
+    # "stages" (per-stage queue_wait / batch_form / device_compute
+    # breakdown, scraped by bench.py) are additive.
     def _trace(_body):
         return 200, {
             "summary": {w.node_id: w.tracer.summary() for w in workers},
             "recent": [s for w in workers for s in w.tracer.recent(20)],
+            "gateway": gateway.tracer.summary(),
+            "stages": {w.node_id: w.tracer.stage_summary()
+                       for w in workers},
         }
+
+    def _trace_export(_body):
+        recs = {w.node_id: w.tracer for w in workers}
+        recs["gateway"] = gateway.tracer
+        return 200, export_chrome(recs)
 
     def _admin_profile(body):
         from tpu_engine.utils import tracing
@@ -353,10 +381,14 @@ def serve_combined(
         return 400, {"error": "action must be start|stop"}
 
     routes[("GET", "/trace")] = _trace
+    routes[("GET", "/trace/export")] = _trace_export
     routes[("POST", "/admin/profile")] = _admin_profile
     routes[("GET", "/metrics")] = lambda _b: (
         200, render_prometheus([w.get_health() for w in workers],
-                               gateway.get_stats()),
+                               gateway.get_stats(),
+                               recorders={**{w.node_id: w.tracer
+                                             for w in workers},
+                                          "gateway": gateway.tracer}),
         "text/plain; version=0.0.4")
 
     # Hot weight reload (no serving pause; the reference restarts worker

@@ -176,6 +176,13 @@ class HttpWorkerClient:
                 conn.sock.settimeout(t)
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
+            if isinstance(body, dict) and isinstance(
+                    body.get("traceparent"), str):
+                # W3C trace propagation: mirror the payload's context as
+                # the standard `traceparent` HTTP header so intermediaries
+                # (proxies, meshes, non-tpu_engine collectors) see the
+                # trace without parsing the JSON body.
+                headers["traceparent"] = body["traceparent"]
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             data = resp.read()

@@ -34,6 +34,7 @@ from __future__ import annotations
 import concurrent.futures
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Union
 
 from tpu_engine.core.circuit_breaker import CircuitBreaker
@@ -54,7 +55,9 @@ from tpu_engine.utils.deadline import (
     Deadline,
     DeadlineExceeded,
     Overloaded,
+    ShedError,
 )
+from tpu_engine.utils.tracing import SpanRecorder, TraceContext
 
 
 class GatewayError(Exception):
@@ -76,6 +79,28 @@ _SHED = object()
 
 def _ok(result) -> bool:
     return result is not None and result is not _SHED
+
+
+class _RouteTrace:
+    """Per-request trace state threaded through the routing layers: the
+    route span's context (every attempt / resilience-decision span parents
+    here) and whether the CLIENT supplied a traceparent — only then is the
+    context re-forwarded to workers, so traceless requests keep their wire
+    bytes identical to the pre-tracing protocol (anonymous correlation
+    rides the request_id-derived trace id instead)."""
+
+    __slots__ = ("request_id", "parent", "ctx", "outcome")
+
+    def __init__(self, request_id: str, parent: Optional[TraceContext]):
+        self.request_id = request_id
+        self.parent = parent
+        self.ctx = (parent.child() if parent is not None
+                    else TraceContext.root(request_id))
+        self.outcome = "error"
+
+    @property
+    def traced(self) -> bool:
+        return self.parent is not None
 
 
 class Gateway:
@@ -116,6 +141,12 @@ class Gateway:
         # the first-registered model (deterministic default) instead of
         # whichever lane the global ring happens to own.
         self.default_model: Optional[str] = None
+        # Tracing: the gateway's own span ring — one ``route`` span per
+        # request with ``attempt`` children (primary / retry / hedge as
+        # siblings) and zero-duration ``resilience`` decision markers, so
+        # every shed/retry/hedge the counters report is explainable
+        # per-request in /trace/export.
+        self.tracer = SpanRecorder(self.config.trace_capacity)
         for w in workers or []:
             self.add_worker(w)
 
@@ -249,16 +280,64 @@ class Gateway:
         with self._lock:
             self._total_requests += 1
         self._retry_budget.record_request()
+        # Anonymous requests get a stable server-side request_id (minted
+        # once, forwarded to the lane, echoed in the response) instead of
+        # the old route-on-a-random-key: the id doubles as the trace root,
+        # so even an id-less request is correlatable end to end.
+        rid = payload.get("request_id")
+        if rid is None:
+            rid = uuid.uuid4().hex
+            payload = {**payload, "request_id": rid}
+        request_id = str(rid)
+        trace = _RouteTrace(request_id, TraceContext.from_request(payload))
+        t0 = time.perf_counter()
+        start = time.time()
+        try:
+            result = self._route_inner(payload, op, request_id, trace)
+            trace.outcome = "ok"
+            return result
+        except ShedError as exc:
+            trace.outcome = exc.kind
+            raise
+        except Exception:
+            trace.outcome = "error"
+            raise
+        finally:
+            self.tracer.record(
+                request_id, "route", "gateway",
+                (time.perf_counter() - t0) * 1e6,
+                trace_id=trace.ctx.trace_id, span_id=trace.ctx.span_id,
+                parent_id=(trace.parent.span_id if trace.parent is not None
+                           else None),
+                start_ts=start, attrs={"op": op, "outcome": trace.outcome})
+
+    def _count(self, trace: Optional[_RouteTrace], decision: str) -> None:
+        """Bump a resilience counter AND drop a zero-duration marker span
+        under the request's route span — the counters say how often, the
+        markers say for WHICH requests (tools/fault_injection.py asserts
+        the two agree)."""
+        self.resilience.bump(decision)
+        if trace is not None:
+            child = trace.ctx.child()
+            self.tracer.record(
+                trace.request_id, "resilience", "gateway", 0,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=trace.ctx.span_id, start_ts=time.time(),
+                attrs={"decision": decision})
+
+    def _route_inner(self, payload: dict, op: str, request_id: str,
+                     trace: _RouteTrace) -> dict:
         # Deadline admission: an already-expired request sheds HERE — one
         # cheap 503 + Retry-After instead of a doomed dispatch chain (and,
         # downstream, a burned batch row).
         deadline = Deadline.from_request(
             payload, default_ms=self.config.default_deadline_ms)
         if deadline is not None and deadline.expired():
-            self.resilience.bump("deadline_rejected")
-            raise self._shed(DeadlineExceeded(
+            self._count(trace, "deadline_rejected")
+            exc = self._shed(DeadlineExceeded(
                 "deadline exceeded at gateway admission"))
-        request_id = str(payload.get("request_id", id(payload)))
+            exc.stage = "gateway_admission"
+            raise exc
         # "model" restricts routing AND failover to that model's sub-ring;
         # without the field, multi-model gateways use the deterministic
         # default model, single-model gateways the global ring.
@@ -290,16 +369,16 @@ class Gateway:
 
         if self.config.hedge_enabled and op in _HEDGEABLE_OPS:
             return self._route_hedged(ring, primary, payload, op,
-                                      probing, deadline)
+                                      probing, deadline, trace)
         result = self._try_node(primary,
                                 self._with_deadline(payload, deadline),
-                                op=op, probing=probing)
+                                op=op, probing=probing, trace=trace)
         if not _ok(result):
             with self._lock:
                 self._failovers += 1
             result = self._failover(ring, primary, payload, op,
                                     probing, deadline,
-                                    shed_seen=result is _SHED)
+                                    shed_seen=result is _SHED, trace=trace)
         return result
 
     def _shed(self, exc):
@@ -319,7 +398,8 @@ class Gateway:
 
     def _failover(self, ring, primary: str, payload: dict, op: str,
                   probing: bool, deadline: Optional[Deadline],
-                  skip: tuple = (), shed_seen: bool = False) -> dict:
+                  skip: tuple = (), shed_seen: bool = False,
+                  trace: Optional[_RouteTrace] = None) -> dict:
         """Ring-order failover across every other lane (gateway.cpp:51-59)
         — now deadline-bounded, budgeted, and backed off: each attempt
         consumes the global retry budget (failover storms cannot amplify
@@ -335,11 +415,23 @@ class Gateway:
             if node == primary or node in skip:
                 continue
             if deadline is not None and deadline.expired():
-                self.resilience.bump("deadline_expired")
-                raise self._shed(DeadlineExceeded(
+                self._count(trace, "deadline_expired")
+                exc = self._shed(DeadlineExceeded(
                     "deadline exceeded during failover"))
+                exc.stage = "failover"
+                raise exc
             if not self._retry_budget.try_acquire():
-                self.resilience.bump("retry_budget_exhausted")
+                self._count(trace, "retry_budget_exhausted")
+                if shed_seen:
+                    # A lane SHED this request before the budget ran out:
+                    # the march is ending under congestion, and congestion
+                    # must surface as 503 + Retry-After (back off and
+                    # retry), never the 500-class outage below.
+                    exc = self._shed(Overloaded(
+                        "retry budget exhausted after a lane shed the "
+                        "request (overloaded, not failed)"))
+                    exc.stage = "failover"
+                    raise exc
                 raise GatewayError(
                     "retry budget exhausted (retries capped at "
                     f"{cfg.retry_budget_ratio:.0%} of recent requests)")
@@ -349,19 +441,22 @@ class Gateway:
             if delay > 0:
                 if deadline is not None:
                     delay = min(delay, max(0.0, deadline.remaining_s()))
-                self.resilience.bump("backoff_waits")
+                self._count(trace, "backoff_waits")
                 time.sleep(delay)
-            self.resilience.bump("retries")
+            self._count(trace, "retries")
             result = self._try_node(node,
                                     self._with_deadline(payload, deadline),
-                                    op=op, probing=probing)
+                                    op=op, probing=probing, trace=trace,
+                                    kind="retry")
             if _ok(result):
                 return result
             shed_seen = shed_seen or result is _SHED
             attempt += 1
         if shed_seen:
-            raise self._shed(Overloaded(
+            exc = self._shed(Overloaded(
                 "all lanes shed the request (overloaded or draining)"))
+            exc.stage = "failover"
+            raise exc
         raise GatewayError("All workers failed or unavailable")
 
     def _pool(self) -> concurrent.futures.ThreadPoolExecutor:
@@ -403,14 +498,17 @@ class Gateway:
         return thr
 
     def _route_hedged(self, ring, primary: str, payload: dict, op: str,
-                      probing: bool, deadline: Optional[Deadline]) -> dict:
+                      probing: bool, deadline: Optional[Deadline],
+                      trace: Optional[_RouteTrace] = None) -> dict:
         """Hedged dispatch (idempotent ops only): wait `threshold` on the
         primary; if it is merely SLOW — the failure mode breakers cannot
         see — fire the next ring lane and take whichever answers first.
         The loser's result is discarded ("cancelled" at the routing layer;
         its lane simply finishes and the breaker records its outcome).
         Hedges consume the retry budget, so a quantile collapse cannot
-        double fleet load."""
+        double fleet load. Tracing: primary and hedge dispatches record
+        sibling ``attempt`` spans (same trace_id, distinct span_ids) under
+        the route span."""
         pool = self._pool()
         p_started = threading.Event()
         t_start: list = [None]
@@ -420,7 +518,7 @@ class Gateway:
             p_started.set()
             return self._try_node(primary,
                                   self._with_deadline(payload, deadline),
-                                  op, probing)
+                                  op, probing, trace=trace, kind="primary")
 
         p_fut = pool.submit(_primary_task)
 
@@ -446,7 +544,12 @@ class Gateway:
         # exact spiral hedging must not feed.
         if not p_started.wait(timeout=None if deadline is None
                               else max(0.0, deadline.remaining_s())):
-            self.resilience.bump("deadline_expired")
+            # The task never started (saturated pool): cancel it so the
+            # queued thunk doesn't later dispatch a request nobody will
+            # read — abandoned dispatches against an already-saturated
+            # fleet are the amplification spiral this wait guards.
+            p_fut.cancel()
+            self._count(trace, "deadline_expired")
             raise self._shed(DeadlineExceeded(
                 "deadline exceeded before primary dispatch started"))
         thr = self._hedge_threshold_s(primary)
@@ -464,7 +567,7 @@ class Gateway:
                 # a request the hedge lane must immediately shed. Ride out
                 # the remaining budget on the primary instead.
                 return self._await_primary(p_fut, ring, primary, payload,
-                                           op, probing, deadline)
+                                           op, probing, deadline, trace)
             result = None
         else:
             if _ok(result):
@@ -474,7 +577,8 @@ class Gateway:
             with self._lock:
                 self._failovers += 1
             return self._failover(ring, primary, payload, op, probing,
-                                  deadline, shed_seen=result is _SHED)
+                                  deadline, shed_seen=result is _SHED,
+                                  trace=trace)
 
         # Primary exceeded the hedge threshold. Pick the next lane whose
         # breaker admits traffic; no budget, no lane → ride out the primary.
@@ -483,13 +587,13 @@ class Gateway:
              if n != primary and self._breaker_allows(n)), None)
         if hedge_node is None or not self._retry_budget.try_acquire():
             if hedge_node is not None:
-                self.resilience.bump("retry_budget_exhausted")
+                self._count(trace, "retry_budget_exhausted")
             return self._await_primary(p_fut, ring, primary, payload, op,
-                                       probing, deadline)
-        self.resilience.bump("hedges")
+                                       probing, deadline, trace)
+        self._count(trace, "hedges")
         h_fut = pool.submit(self._try_node, hedge_node,
                             self._with_deadline(payload, deadline),
-                            op, probing)
+                            op, probing, trace, "hedge")
         pending = {p_fut: primary, h_fut: hedge_node}
         first_error: Optional[BaseException] = None
         shed_seen = False
@@ -500,7 +604,7 @@ class Gateway:
                 list(pending), timeout=timeout,
                 return_when=concurrent.futures.FIRST_COMPLETED)
             if not done:  # deadline ran out waiting on both lanes
-                self.resilience.bump("deadline_expired")
+                self._count(trace, "deadline_expired")
                 raise self._shed(DeadlineExceeded(
                     "deadline exceeded awaiting hedged dispatch"))
             for fut in done:
@@ -511,8 +615,8 @@ class Gateway:
                     first_error = first_error or exc
                     continue
                 if _ok(result):
-                    self.resilience.bump("hedge_wins" if fut is h_fut
-                                         else "hedge_losses")
+                    self._count(trace, "hedge_wins" if fut is h_fut
+                                else "hedge_losses")
                     return result
                 shed_seen = shed_seen or result is _SHED
         # Both lanes failed/shed: budgeted failover over the remainder.
@@ -521,14 +625,15 @@ class Gateway:
         try:
             return self._failover(ring, primary, payload, op, probing,
                                   deadline, skip=(hedge_node,),
-                                  shed_seen=shed_seen)
+                                  shed_seen=shed_seen, trace=trace)
         except GatewayError:
             if first_error is not None:
                 raise first_error
             raise
 
     def _await_primary(self, p_fut, ring, primary, payload, op, probing,
-                       deadline: Optional[Deadline]) -> dict:
+                       deadline: Optional[Deadline],
+                       trace: Optional[_RouteTrace] = None) -> dict:
         """Hedge unavailable: block on the primary alone (deadline-bounded),
         then fall back to plain failover if it ultimately failed."""
         timeout = (None if deadline is None
@@ -536,7 +641,7 @@ class Gateway:
         try:
             result = p_fut.result(timeout=timeout)
         except concurrent.futures.TimeoutError:
-            self.resilience.bump("deadline_expired")
+            self._count(trace, "deadline_expired")
             raise self._shed(DeadlineExceeded(
                 "deadline exceeded awaiting primary lane"))
         if _ok(result):
@@ -544,7 +649,7 @@ class Gateway:
         with self._lock:
             self._failovers += 1
         return self._failover(ring, primary, payload, op, probing, deadline,
-                              shed_seen=result is _SHED)
+                              shed_seen=result is _SHED, trace=trace)
 
     def _breaker_allows(self, node: str) -> bool:
         with self._lock:
@@ -552,12 +657,21 @@ class Gateway:
         return breaker is not None and breaker.allow_request()
 
     def _try_node(self, node: str, payload: dict, op: str = "infer",
-                  probing: bool = False) -> Optional[dict]:
+                  probing: bool = False,
+                  trace: Optional[_RouteTrace] = None,
+                  kind: str = "primary") -> Optional[dict]:
         """Breaker-gated dispatch (reference tryNode, gateway.cpp:80-128).
         Returns None on failure so the caller can fail over. `probing`:
         the gateway couldn't resolve the request's model itself, so a
         worker's model-mismatch rejection (a client-class 4xx/ValueError)
-        means "try the next lane" — no breaker penalty, no terminal 400."""
+        means "try the next lane" — no breaker penalty, no terminal 400.
+
+        Tracing: each dispatch records an ``attempt`` span (child of the
+        route span; ``kind`` = primary | retry | hedge, sibling attempts
+        share the trace_id with distinct span_ids). When the CLIENT
+        supplied a traceparent, the attempt's own context is re-forwarded
+        in the payload — worker-side spans then parent under this exact
+        attempt; traceless payloads are forwarded untouched."""
         with self._lock:
             client = self._clients.get(node)
             breaker = self._breakers.get(node)
@@ -565,18 +679,39 @@ class Gateway:
             return None
         if not breaker.allow_request():
             return None
+        ctx = None
+        if trace is not None:
+            ctx = trace.ctx.child()
+            if trace.traced:
+                payload = {**payload, "traceparent": ctx.to_traceparent()}
+        t0 = time.perf_counter()
+        start = time.time()
+        outcome = "error"
+
+        def _span():
+            if trace is not None:
+                self.tracer.record(
+                    trace.request_id, "attempt", "gateway",
+                    (time.perf_counter() - t0) * 1e6,
+                    trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    parent_id=trace.ctx.span_id, start_ts=start,
+                    attrs={"lane": node, "kind": kind, "outcome": outcome})
+
         try:
             response = getattr(client, op)(payload)
             breaker.record_success()
+            outcome = "ok"
             return response
         except WorkerError:
             breaker.record_failure()
+            outcome = "failed"
             return None
         except Overloaded:
             # The lane SHED the request (queue full / draining): healthy
             # but busy — fail over without a breaker penalty (a breaker
             # trip would amplify the overload into an outage).
-            self.resilience.bump("shed_overloaded")
+            self._count(trace, "shed_overloaded")
+            outcome = "shed"
             return _SHED
         except DeadlineExceeded as exc:
             # The client's budget is gone; no other lane can help. A
@@ -586,13 +721,19 @@ class Gateway:
             # 503 does not.
             if getattr(exc, "lane_suspect", False):
                 breaker.record_failure()
-            self.resilience.bump("deadline_expired")
-            raise self._shed(DeadlineExceeded(
+            self._count(trace, "deadline_expired")
+            outcome = "deadline"
+            shed = self._shed(DeadlineExceeded(
                 f"deadline exceeded at lane {node}"))
+            shed.stage = "lane"
+            raise shed
         except ValueError:
             if probing:
+                outcome = "wrong_model"
                 return None  # wrong-model lane; healthy — no penalty
             raise
+        finally:
+            _span()
 
     # -- observability --------------------------------------------------------
 

@@ -171,6 +171,16 @@ class JsonHttpServer:
                         length = int(self.headers.get("Content-Length", 0))
                         raw = self.rfile.read(length) if length else b"{}"
                         body = json.loads(raw)
+                        # W3C trace propagation: a `traceparent` HTTP
+                        # header (the standard carrier external clients
+                        # and meshes emit) joins the payload-field form —
+                        # body field wins when both are present, so a
+                        # tpu_engine upstream's re-parented context is
+                        # never clobbered by a stale edge header.
+                        tp = self.headers.get("traceparent")
+                        if tp and isinstance(body, dict) \
+                                and "traceparent" not in body:
+                            body["traceparent"] = tp
                     result = handler(body)
                     # (status, payload) or (status, payload, content_type)
                     # — e.g. /metrics returns Prometheus text exposition.

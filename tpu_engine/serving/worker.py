@@ -41,12 +41,13 @@ from tpu_engine.utils.config import WorkerConfig
 from tpu_engine.utils.deadline import (
     Deadline,
     DeadlineExceeded,
+    ShedError,
     clamp_timeout,
 )
 from tpu_engine.utils.sampling import clamp_top_k as _clamp_top_k
 from tpu_engine.utils.sampling import validate_min_p as _validate_min_p
 from tpu_engine.utils.sampling import expand_stopping_params
-from tpu_engine.utils.tracing import SpanRecorder
+from tpu_engine.utils.tracing import SpanRecorder, TraceContext, TraceSink
 
 
 @dataclass
@@ -54,12 +55,29 @@ class _BatchItem:
     request_id: str
     input_data: Sequence[float]
     shape: Optional[tuple] = None  # mixed-shape serving (BASELINE config 4)
+    # The request's worker-root span context: queue_wait / batch_form /
+    # device_compute stage spans parent here (utils.tracing).
+    trace: Optional[TraceContext] = None
 
 
 @dataclass
 class _BatchResult:
     output_data: np.ndarray
     inference_time_us: int
+
+
+class _RootSpan:
+    """Mutable state of one worker-root span while its request runs: the
+    span's context (stage children parent here), plus the cached flag and
+    attrs the request path fills in before the scope records."""
+
+    __slots__ = ("ctx", "request_id", "attrs", "cached")
+
+    def __init__(self, ctx: TraceContext, request_id: str):
+        self.ctx = ctx
+        self.request_id = request_id
+        self.attrs = {"outcome": "error"}
+        self.cached = False
 
 
 class _Inflight:
@@ -89,6 +107,7 @@ class _GenItem:
     beam_width: int = 1
     length_penalty: float = 1.0
     min_p: float = 0.0
+    trace: Optional[TraceContext] = None  # worker-root ctx (stage spans)
 
 
 @dataclass
@@ -228,6 +247,17 @@ class WorkerNode:
                     quantize=self.config.quantize,
                 )
         self.engine = engine
+        # Tracing: one span ring per lane (request roots + stage children
+        # + per-stage histograms). Created before the batchers so their
+        # observer hook has a live recorder from the first batch on; the
+        # engine reports its XLA compile events into the same ring so
+        # first-request compile stalls are attributable in /trace/export.
+        self.tracer = SpanRecorder(self.config.trace_capacity)
+        try:
+            self.engine.tracer = self.tracer
+            self.engine.trace_node = self.node_id
+        except AttributeError:
+            pass  # test fakes with __slots__: engine tracing is optional
         self.cache = _make_cache(self.config.cache_capacity)
         self.batch_processor: BatchProcessor[_BatchItem, _BatchResult] = BatchProcessor(
             self.config.max_batch_size,
@@ -245,6 +275,7 @@ class WorkerNode:
             ready_callback=((lambda s: self.engine.handle_ready(s[0]))
                             if hasattr(self.engine, "handle_ready") else None),
             pipeline_depth=self.config.pipeline_depth,
+            observer=self._batch_observer,
         )
         self.batch_processor.start()
         # Autoregressive generation lane (transformer models only): its own
@@ -266,6 +297,7 @@ class WorkerNode:
                         self.config.batch_timeout_ms,
                         self._process_gen_batch,
                         name=f"{self.node_id}-gen-batcher",
+                        observer=self._batch_observer,
                     )
                     self._gen_processor.start()
                 elif self._continuous:
@@ -295,6 +327,7 @@ class WorkerNode:
                         self.config.batch_timeout_ms,
                         self._process_gen_batch,
                         name=f"{self.node_id}-gen-batcher",
+                        observer=self._batch_observer,
                     )
                     self._gen_processor.start()
             except ValueError:
@@ -465,8 +498,10 @@ class WorkerNode:
             raise ValueError(
                 f"model '{self.config.model}' does not support scoring")
         deadline = Deadline.from_request(request)
-        with self._admitted(deadline):
-            return self._score_admitted(request, deadline)
+        with self._traced_request(request, "score") as span:
+            with self._admitted(deadline, trace=(span.ctx,
+                                                 span.request_id)):
+                return self._score_admitted(request, deadline)
 
     def _score_admitted(self, request: dict,
                         deadline: Optional[Deadline]) -> dict:
@@ -606,15 +641,72 @@ class WorkerNode:
             time.sleep(self._injected_latency_s)
 
     @contextlib.contextmanager
-    def _admitted(self, deadline):
+    def _traced_request(self, request: dict, op: str):
+        """Worker-root span scope shared by the blocking request paths
+        (/infer, /generate, /score): parse the caller's traceparent (or
+        derive a root from request_id), yield a `_RootSpan` whose ``ctx``
+        parents every stage child, and record the root — wall time,
+        outcome (ok / shed kind / error), plus whatever attrs the body
+        added — however the body exits."""
+        parent = TraceContext.from_request(request)
+        request_id = str(request.get("request_id", ""))
+        ctx = (parent.child() if parent is not None
+               else TraceContext.root(request_id))
+        span = _RootSpan(ctx, request_id)
+        t0 = time.perf_counter()
+        start = time.time()
+        try:
+            yield span
+            span.attrs["outcome"] = "ok"
+        except ShedError as exc:
+            span.attrs["outcome"] = exc.kind
+            raise
+        finally:
+            self.tracer.record(
+                request_id, op, self.node_id,
+                (time.perf_counter() - t0) * 1e6,
+                cached=span.cached, trace_id=ctx.trace_id,
+                span_id=ctx.span_id,
+                parent_id=parent.span_id if parent is not None else None,
+                start_ts=start, attrs=span.attrs)
+
+    @contextlib.contextmanager
+    def _admitted(self, deadline, trace=None):
         """Admission scope shared by every blocking request path: admit
         (drain/depth/expired-deadline can shed -> wire 503), apply the
         slow-lane fault, and ALWAYS release. The streaming path manages
         release by hand — its in-flight window is the iterator's life,
-        not this frame's."""
-        self._admission.admit(deadline)
+        not this frame's.
+
+        ``trace``: optional (TraceContext, request_id) — records an
+        ``admission`` stage span (child of the worker root) whose duration
+        covers the admit decision AND any injected slow-lane latency, so a
+        slowed lane's traces show WHERE the time went. A shed records the
+        span with the refusal kind before re-raising."""
+        t0 = time.perf_counter()
+        start = time.time()
+
+        def _span(outcome):
+            if trace is None:
+                return
+            ctx, request_id = trace
+            child = ctx.child()
+            self.tracer.record(
+                request_id, "admission", self.node_id,
+                (time.perf_counter() - t0) * 1e6,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=ctx.span_id, start_ts=start,
+                attrs={"outcome": outcome})
+
+        try:
+            self._admission.admit(deadline)
+        except ShedError as exc:
+            exc.stage = exc.stage or "worker_admission"
+            _span(exc.kind)
+            raise
         try:
             self._maybe_slow()
+            _span("admitted")
             yield
         finally:
             self._admission.release()
@@ -663,22 +755,35 @@ class WorkerNode:
         The fragment is cached alongside the array: serializing ~1000
         floats costs ~670 µs in json.dumps but 1 µs to splice pre-encoded —
         on a ~99% hit-rate workload (the reference's own benchmark) that
-        serialization dominated the whole request path."""
+        serialization dominated the whole request path.
+
+        Tracing: the worker-side root span (op ``infer``) covers the full
+        worker wall time — admission through response fragment ready —
+        with per-stage children (admission, cache_lookup, queue_wait,
+        batch_form, device_compute, serialize). Its parent is the
+        caller's ``traceparent`` span when supplied; otherwise the root
+        derives its trace_id from request_id, so gateway and worker
+        correlate with zero wire change."""
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
-        # Resilience: admission BEFORE the request counts — a shed request
-        # never skews the reference-exact /health counters, only its own
-        # (additive) admission block. Expired/overloaded/draining raise
-        # here and surface as 503 + Retry-After at the HTTP layer.
         deadline = Deadline.from_request(request)
-        with self._admitted(deadline):
-            with self._counter_lock:
-                self._total_requests += 1
-            return self._infer_admitted(request, deadline)
+        with self._traced_request(request, "infer") as span:
+            # Resilience: admission BEFORE the request counts — a shed
+            # request never skews the reference-exact /health counters,
+            # only its own (additive) admission block. Expired/overloaded/
+            # draining raise here and surface as 503 + Retry-After.
+            with self._admitted(deadline, trace=(span.ctx,
+                                                 span.request_id)):
+                with self._counter_lock:
+                    self._total_requests += 1
+                out = self._infer_admitted(request, deadline, span.ctx)
+                span.cached = out[2]
+                span.attrs["inference_time_us"] = out[3]
+                return out
 
-    def _infer_admitted(self, request: dict,
-                        deadline: Optional[Deadline]) -> Tuple[str, bytes, bool, int]:
+    def _infer_admitted(self, request: dict, deadline: Optional[Deadline],
+                        tctx: TraceContext) -> Tuple[str, bytes, bool, int]:
         request_id = request["request_id"]
         input_data = request["input_data"]
         shape = request.get("shape")
@@ -686,12 +791,19 @@ class WorkerNode:
             shape = tuple(int(d) for d in shape)
 
         key = self._cache_key(input_data, shape)
+        cl0 = time.perf_counter()
+        cl_start = time.time()
         frag = self.cache.get(key)
+        child = tctx.child()
+        self.tracer.record(
+            request_id, "cache_lookup", self.node_id,
+            (time.perf_counter() - cl0) * 1e6,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=tctx.span_id, start_ts=cl_start,
+            attrs={"hit": frag is not None})
         if frag is not None:
             with self._counter_lock:
                 self._cache_hits += 1
-            self.tracer.record(request_id, "infer", self.node_id,
-                               self.config.fake_cached_latency_us, cached=True)
             # Reference reports a fixed fake latency on hits (:65).
             return request_id, frag, True, self.config.fake_cached_latency_us
 
@@ -713,6 +825,8 @@ class WorkerNode:
                     self._inflight[key] = entry
             if leader:
                 break
+            w0 = time.perf_counter()
+            w_start = time.time()
             if not entry.event.wait(
                     timeout=clamp_timeout(deadline, 120.0)):
                 if deadline is not None and deadline.expired():
@@ -736,16 +850,29 @@ class WorkerNode:
                 # no-breaker-penalty classification in LocalWorkerClient —
                 # a coalesced bad input must not count as a lane failure.
                 raise entry.error
-            self.tracer.record(request_id, "infer", self.node_id,
-                               entry.time_us, batch_size=0)  # coalesced
+            child = tctx.child()
+            self.tracer.record(
+                request_id, "coalesced_wait", self.node_id,
+                (time.perf_counter() - w0) * 1e6,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=tctx.span_id, start_ts=w_start,
+                attrs={"leader_time_us": entry.time_us})
             return request_id, entry.frag, False, entry.time_us
 
         try:
             gen0 = self._weights_gen  # stamp BEFORE the compute
             result = self.batch_processor.process(
-                _BatchItem(request_id, input_data, shape),
+                _BatchItem(request_id, input_data, shape, trace=tctx),
                 deadline=deadline)
+            s0 = time.perf_counter()
+            s_start = time.time()
             frag = _encode_output(result.output_data)
+            child = tctx.child()
+            self.tracer.record(
+                request_id, "serialize", self.node_id,
+                (time.perf_counter() - s0) * 1e6,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=tctx.span_id, start_ts=s_start)
             # A hot reload between compute and put would otherwise re-seed
             # the freshly cleared cache with an old-weight result forever;
             # check+put must be atomic against apply_weights' bump+clear.
@@ -766,8 +893,6 @@ class WorkerNode:
             entry.event.set()
             with self._inflight_lock:
                 self._inflight.pop(key, None)
-        self.tracer.record(request_id, "infer", self.node_id,
-                           result.inference_time_us)
         return request_id, frag, False, result.inference_time_us
 
     def handle_infer(self, request: dict) -> dict:
@@ -793,6 +918,50 @@ class WorkerNode:
                 + b', "cached": ' + (b"true" if cached else b"false")
                 + b', "inference_time_us": ' + str(time_us).encode() + b"}")
 
+    def _batch_observer(self, items, timing) -> None:
+        """BatchProcessor tracing hook (dispatch thread): per-request
+        ``queue_wait`` spans plus one shared ``batch_form`` span per
+        member — the in-queue portion of latency the flat recorder could
+        never attribute. Runs after the batch's futures resolve; span
+        wall-clock is reconstructed from the observer call time."""
+        end_wall = time.time()
+        formed_at = end_wall - timing.compute_us / 1e6
+        for it, wait_us in zip(items, timing.queue_wait_us):
+            ctx = getattr(it, "trace", None)
+            if ctx is None:
+                continue
+            qw = ctx.child()
+            self.tracer.record(
+                it.request_id, "queue_wait", self.node_id, wait_us,
+                trace_id=qw.trace_id, span_id=qw.span_id,
+                parent_id=ctx.span_id, start_ts=formed_at - wait_us / 1e6)
+            bf = ctx.child()
+            self.tracer.record(
+                it.request_id, "batch_form", self.node_id,
+                timing.batch_form_us, batch_size=len(items),
+                trace_id=bf.trace_id, span_id=bf.span_id,
+                parent_id=ctx.span_id,
+                start_ts=formed_at - timing.batch_form_us / 1e6,
+                attrs={"timed_out": timing.timed_out})
+
+    def _record_device_spans(self, items, elapsed_us: float,
+                             op: str = "device_compute") -> None:
+        """One ``device_compute`` child span per traced batch member —
+        duration is the whole batch's device leg (the exact measurement
+        ``inference_time_us`` divides by batch size), batch_size carries
+        the divisor."""
+        start_wall = time.time() - elapsed_us / 1e6
+        n = len(items)
+        for it in items:
+            ctx = getattr(it, "trace", None)
+            if ctx is None:
+                continue
+            child = ctx.child()
+            self.tracer.record(
+                it.request_id, op, self.node_id, elapsed_us, batch_size=n,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=ctx.span_id, start_ts=start_wall)
+
     def _process_batch(self, items: List[_BatchItem]) -> List[_BatchResult]:
         """Lockstep path — runs only when the engine lacks batch_submit
         (plain/fake engines); pipelined engines use _submit/_collect below."""
@@ -803,6 +972,7 @@ class WorkerNode:
             [it.input_data for it in items], shapes=shapes)
         elapsed_us = (time.perf_counter() - start) * 1e6
         per_request_us = int(elapsed_us / max(1, len(items)))
+        self._record_device_spans(items, elapsed_us)
         return [_BatchResult(out, per_request_us) for out in outputs]
 
     def _submit_batch(self, items: List[_BatchItem]):
@@ -814,7 +984,7 @@ class WorkerNode:
                   if any(it.shape is not None for it in items) else None)
         handle = self.engine.batch_submit(
             [it.input_data for it in items], shapes=shapes)
-        return handle, start, len(items)
+        return handle, start, items
 
     def _collect_batch(self, submitted) -> List[_BatchResult]:
         """Blocking half. `inference_time_us` semantics differ deliberately
@@ -824,10 +994,11 @@ class WorkerNode:
         overlap window behind up to pipeline_depth-1 older batches. That is
         the latency a caller actually experienced for the device leg; the
         execute-only number would undercount on a link-dominated setup."""
-        handle, start, n = submitted
+        handle, start, items = submitted
         outputs = self.engine.batch_collect(handle)
         elapsed_us = (time.perf_counter() - start) * 1e6
-        per_request_us = int(elapsed_us / max(1, n))  # cf. worker_node.cpp:123
+        per_request_us = int(elapsed_us / max(1, len(items)))  # cf. worker_node.cpp:123
+        self._record_device_spans(items, elapsed_us)
         return [_BatchResult(out, per_request_us) for out in outputs]
 
     # -- generation path -------------------------------------------------------
@@ -846,11 +1017,15 @@ class WorkerNode:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
         deadline = Deadline.from_request(request)
-        with self._admitted(deadline):
-            return self._generate_admitted(request, deadline)
+        with self._traced_request(request, "generate") as span:
+            with self._admitted(deadline, trace=(span.ctx,
+                                                 span.request_id)):
+                return self._generate_admitted(request, deadline,
+                                               span.ctx)
 
     def _generate_admitted(self, request: dict,
-                           deadline: Optional[Deadline]) -> dict:
+                           deadline: Optional[Deadline],
+                           tctx: TraceContext) -> dict:
         with self._counter_lock:
             self._total_requests += 1
         item = _GenItem(
@@ -869,6 +1044,7 @@ class WorkerNode:
             beam_width=int(request.get("beam_width", 1)),
             length_penalty=float(request.get("length_penalty", 1.0)),
             min_p=_validate_min_p(request.get("min_p", 0.0)),
+            trace=tctx,
         )
         self._validate_beam(item.beam_width, item.temperature, item.top_p,
                             item.top_k, item.repetition_penalty,
@@ -899,7 +1075,9 @@ class WorkerNode:
                 seed=item.seed, top_p=item.top_p, top_k=item.top_k,
                 repetition_penalty=item.repetition_penalty,
                 stop_tokens=list(item.stop_tokens), min_p=item.min_p,
-                deadline=deadline)
+                deadline=deadline,
+                sink=TraceSink(self.tracer, self.node_id,
+                               item.request_id, tctx))
             # The scheduler itself cancels expired rows between chunks
             # (the future then raises DeadlineExceeded); the +5 s slack
             # keeps this outer wait a backstop, never the arbiter.
@@ -910,8 +1088,6 @@ class WorkerNode:
             result = _GenResult(tokens, elapsed_us)
         else:
             result = self._gen_processor.process(item, deadline=deadline)
-        self.tracer.record(item.request_id, "generate", self.node_id,
-                           result.generate_time_us)
         return {
             "request_id": item.request_id,
             "tokens": result.tokens,
@@ -1005,6 +1181,10 @@ class WorkerNode:
         # Continuous path: admit before the stream commits; depth is held
         # until the event iterator finishes (the stream IS the in-flight
         # work). An expired deadline raises here -> wire 503, not a 200.
+        parent = TraceContext.from_request(request)
+        tctx = (parent.child() if parent is not None
+                else TraceContext.root(request_id))
+        t_start_wall = time.time()
         self._admission.admit(deadline)
         try:
             self._maybe_slow()
@@ -1016,7 +1196,8 @@ class WorkerNode:
                 prompt, max_new_tokens=max_new, eos_id=eos_id,
                 temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
                 repetition_penalty=rep_pen, stop_tokens=stop_toks,
-                min_p=min_p_val, stream=q, deadline=deadline)
+                min_p=min_p_val, stream=q, deadline=deadline,
+                sink=TraceSink(self.tracer, self.node_id, request_id, tctx))
         except BaseException:
             self._admission.release()
             raise
@@ -1040,8 +1221,13 @@ class WorkerNode:
                 except Exception as exc:
                     yield sse_event({"done": True, "error": str(exc)[:300]})
                     return
-                self.tracer.record(request_id, "generate_stream",
-                                   self.node_id, elapsed_us)
+                self.tracer.record(
+                    request_id, "generate_stream", self.node_id,
+                    elapsed_us, trace_id=tctx.trace_id,
+                    span_id=tctx.span_id,
+                    parent_id=(parent.span_id if parent is not None
+                               else None),
+                    start_ts=t_start_wall)
                 yield sse_event({"done": True, "request_id": request_id,
                                  "tokens": tokens, "node_id": self.node_id,
                                  "generate_time_us": elapsed_us})
@@ -1087,9 +1273,12 @@ class WorkerNode:
                 # and takes no fused flag.
                 **({} if self._speculative
                    else {"fused": self.config.gen_decode_fused}))
+            group_elapsed_us = (time.perf_counter() - t0) * 1e6
+            self._record_device_spans([items[i] for i in idxs],
+                                      group_elapsed_us)
             # Reference semantic: per-request time = batch_duration /
             # batch_size, per group (worker_node.cpp:123).
-            elapsed_us = int((time.perf_counter() - t0) * 1e6 / max(1, len(idxs)))
+            elapsed_us = int(group_elapsed_us / max(1, len(idxs)))
             for i, row in zip(idxs, toks):
                 results[i] = _GenResult(row[: items[i].max_new_tokens], elapsed_us)
         return results

@@ -80,6 +80,10 @@ class WorkerConfig:
     # requests on this lane; excess is shed with 503 + Retry-After instead
     # of queueing unboundedly. 0 = unbounded (reference behavior).
     max_queue_depth: int = 0
+    # Tracing ring-buffer capacity (spans kept per lane, utils.tracing).
+    # On by default — recording is lock-guarded ring writes, ~1 µs/span.
+    # 0 disables span recording AND the /metrics stage histograms.
+    trace_capacity: int = 2048
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
@@ -133,3 +137,7 @@ class GatewayConfig:
     hedge_quantile: float = 0.95        # threshold = quantile of recent latency
     hedge_min_ms: float = 50.0          # floor under the quantile threshold
     hedge_min_samples: int = 20         # before this, hedge_min_ms alone rules
+
+    # Tracing ring-buffer capacity for the gateway's own spans (route +
+    # per-attempt children + resilience decision markers). 0 disables.
+    trace_capacity: int = 2048

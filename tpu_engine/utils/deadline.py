@@ -26,10 +26,16 @@ from typing import Optional
 class ShedError(Exception):
     """A request refused by policy, not failed by a fault: the correct
     client action is to back off and retry later. HTTP layers render any
-    ShedError as 503 + a ``Retry-After`` header."""
+    ShedError as 503 + a ``Retry-After`` header.
+
+    ``stage``: where in the pipeline the shed fired (``gateway_admission``,
+    ``worker_admission``, ``failover``, ``queue``, ...) — raise sites set
+    it so the tracing layer can attribute the decision to a span without
+    string-matching messages."""
 
     retry_after_s: float = 1.0
     kind: str = "shed"
+    stage: Optional[str] = None
 
 
 class DeadlineExceeded(ShedError):

@@ -6,22 +6,112 @@ benchmark scrapes. Those JSON schemas stay reference-exact; `/metrics`
 additionally renders the same counters in the Prometheus exposition format
 (version 0.0.4) so standard scrapers/alerting work against a worker or the
 combined front without an adapter.
+
+Histograms: `LatencyHistogram` is the cumulative-bucket accumulator the
+tracing layer (``utils.tracing.SpanRecorder``) feeds per stage
+(``queue_wait``, ``batch_form``, ``device_compute``, ...); `/metrics`
+renders them as ``tpu_engine_stage_latency_seconds`` with the standard
+``_bucket``/``_sum``/``_count`` series so p50/p95/p99 are scrapeable,
+not just in-process.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence
 
 _BREAKER_STATE_IDS = {"CLOSED": 0, "OPEN": 1, "HALF_OPEN": 2}
+
+# Serving latencies span ~10 µs (cache hit bookkeeping) to seconds (cold
+# compiles, decode loops): log-ish spacing, ~5 buckets per decade. Chosen
+# once for every stage so lane-to-lane and stage-to-stage quantiles are
+# comparable; DESIGN.md "Tracing" documents the choice.
+DEFAULT_LATENCY_BUCKETS_S = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class LatencyHistogram:
+    """Prometheus-style histogram: fixed upper bounds, per-bucket counts,
+    running sum. `observe` is one bisect + two adds under a lock — cheap
+    enough for the per-request tracing hot path. Rendering cumulates."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S):
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        idx = bisect.bisect_left(self.bounds, seconds)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += seconds
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative bucket counts keyed by `le` (upper bound), plus sum
+        and count — the exact numbers the exposition format wants."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, acc = [], 0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        return {"le": self.bounds, "cumulative": cum[:-1],
+                "inf": cum[-1], "sum": s, "count": total}
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus-conventional bound label: no exponent notation."""
+    s = f"{bound:.10f}".rstrip("0").rstrip(".")
+    return s if s else "0"
+
+
+def render_stage_histograms(recorders: Dict[str, "object"]) -> List[str]:
+    """Exposition lines for every (node, stage) latency histogram.
+    `recorders`: node name -> SpanRecorder (duck-typed: anything with
+    ``histograms() -> {stage: LatencyHistogram}``)."""
+    lines: List[str] = []
+    series = []
+    for node in sorted(recorders):
+        hists = recorders[node].histograms()
+        for stage in sorted(hists):
+            series.append((node, stage, hists[stage].snapshot()))
+    if not series:
+        return lines
+    name = "tpu_engine_stage_latency_seconds"
+    lines.append(f"# HELP {name} Per-stage serving latency "
+                 "(tracing span durations)")
+    lines.append(f"# TYPE {name} histogram")
+    for node, stage, snap in series:
+        lbl = f'node="{_esc(node)}",stage="{_esc(stage)}"'
+        for bound, cum in zip(snap["le"], snap["cumulative"]):
+            lines.append(f'{name}_bucket{{{lbl},le="{_fmt_le(bound)}"}} '
+                         f"{cum}")
+        lines.append(f'{name}_bucket{{{lbl},le="+Inf"}} {snap["inf"]}')
+        lines.append(f"{name}_sum{{{lbl}}} {snap['sum']:.9f}")
+        lines.append(f"{name}_count{{{lbl}}} {snap['count']}")
+    return lines
 
 
 def _esc(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
 
 
-def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None) -> bytes:
+def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
+                      recorders: Optional[Dict[str, object]] = None) -> bytes:
     """healths: per-lane WorkerNode.get_health() dicts; stats: optional
-    Gateway.get_stats(). Returns the exposition body (text/plain 0.0.4)."""
+    Gateway.get_stats(); recorders: optional node -> SpanRecorder map for
+    the per-stage latency histograms. Returns the exposition body
+    (text/plain 0.0.4)."""
     lines: List[str] = []
 
     def metric(name, mtype, help_text, samples):
@@ -132,4 +222,6 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None) -> byte
             metric("tpu_engine_hedge_threshold_ms", "gauge",
                    "Current hedge latency threshold",
                    [({}, res.get("hedge_threshold_ms"))])
+    if recorders:
+        lines.extend(render_stage_histograms(recorders))
     return ("\n".join(lines) + "\n").encode()

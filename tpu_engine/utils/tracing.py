@@ -1,14 +1,34 @@
-"""Tracing / profiling — replaces the reference's ad-hoc wall-clock timing.
+"""Distributed tracing — span trees, trace propagation, and the profiler.
 
-The reference's only tracing is a per-batch stopwatch divided by batch size
-(``/root/reference/src/worker_node.cpp:108-123``) surfaced as
+The reference's only observability is a per-batch stopwatch divided by
+batch size (``/root/reference/src/worker_node.cpp:108-123``) surfaced as
 ``inference_time_us``; no spans, no trace ids, no profiler (SURVEY.md §5).
-Here:
+The first cut here kept exactly that shape: one flat ``infer`` span per
+request. This module now carries a real tracing subsystem:
 
-- `SpanRecorder` — a lock-guarded ring buffer of recent request spans
-  (request_id, op, node, duration, cached, batch size). Zero-allocation
-  steady state, O(capacity) memory, exposed at ``GET /trace`` so the
-  `inference_time_us` wire field finally has a server-side counterpart.
+- `TraceContext` — a W3C-traceparent-style (trace_id, span_id) pair.
+  Wire form is one optional ``"traceparent"`` request field
+  (``00-<32 hex>-<16 hex>-01``), carried next to ``deadline_ms`` and
+  re-forwarded (re-parented) at each hop: edge → gateway → worker client
+  → worker → batcher/continuous scheduler. Requests WITHOUT the field get
+  a trace root **derived deterministically from request_id** at every hop
+  (same id → same trace_id, no wire change), so anonymous requests stay
+  correlatable while their wire bytes stay byte-identical to the
+  pre-tracing protocol.
+- `SpanRecorder` — a lock-guarded ring buffer of spans, now hierarchical:
+  each span may carry (trace_id, span_id, parent_id, start_ts) plus free
+  attrs. Request-level spans (the old flat ``infer``/``generate`` rows)
+  and stage spans (``queue_wait``, ``batch_form``, ``device_compute``,
+  ``cache_lookup``, ``serialize``, ``admission``, ...) share the ring;
+  ``summary()`` keeps its original schema over request spans only, and
+  every span also feeds a per-stage `LatencyHistogram` for Prometheus
+  exposition (``utils.metrics``). Bounded memory: O(capacity) spans +
+  a fixed histogram per stage; ``capacity=0`` disables recording.
+- `export_chrome` — Chrome trace-event / Perfetto-loadable JSON of the
+  ring contents (``GET /trace/export``), parent/child linkage in args.
+- `TraceSink` — (recorder, node, request_id, parent ctx) bundled so
+  runtime components (continuous scheduler) can record stage spans
+  without importing the serving layer.
 - `profiler_start` / `profiler_stop` — ``jax.profiler`` session wrappers
   (XLA device traces viewable in TensorBoard / Perfetto), driven by
   ``POST /admin/profile`` on the combined server.
@@ -16,19 +36,102 @@ Here:
 
 from __future__ import annotations
 
+import hashlib
+import math
+import re
 import threading
 import time
+import uuid
 from collections import deque
-from typing import Optional
+from typing import Dict, List, Optional
+
+from tpu_engine.utils.metrics import LatencyHistogram
+
+# Request-level ops: one span per request, the rows the original flat
+# recorder kept. summary() aggregates these ONLY, so its numbers keep
+# meaning "per-request latency" now that stage spans share the ring.
+_REQUEST_OPS = frozenset({"infer", "generate", "generate_stream", "score",
+                          "route"})
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def derive_trace_id(request_id: str) -> str:
+    """Deterministic trace id for requests that carry no traceparent:
+    every hop derives the SAME id from the request_id, so gateway and
+    worker spans correlate without adding a byte to the wire."""
+    return hashlib.md5(b"tpu-trace:"
+                       + str(request_id).encode()).hexdigest()
+
+
+class TraceContext:
+    """One (trace_id, span_id) position in a trace tree. ``span_id`` is
+    the CURRENT span — ``from_request`` yields the caller's span (this
+    hop's parent); ``child()`` mints this hop's own."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    @classmethod
+    def from_request(cls, payload) -> Optional["TraceContext"]:
+        """Parse the request's ``traceparent`` field. W3C semantics for a
+        malformed value: ignore it (trace as if absent), never fail the
+        request over telemetry."""
+        tp = payload.get("traceparent") if isinstance(payload, dict) else None
+        if not isinstance(tp, str):
+            return None
+        m = _TRACEPARENT_RE.match(tp.strip().lower())
+        if m is None:
+            return None
+        return cls(m.group(1), m.group(2))
+
+    @classmethod
+    def root(cls, request_id=None) -> "TraceContext":
+        tid = (derive_trace_id(request_id) if request_id is not None
+               else uuid.uuid4().hex)
+        return cls(tid, new_span_id())
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, new_span_id())
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_traceparent()})"
 
 
 class SpanRecorder:
-    def __init__(self, capacity: int = 512):
-        self._spans = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+    """Lock-guarded ring buffer of spans + per-stage latency histograms.
 
-    def record(self, request_id: str, op: str, node: str, duration_us: int,
-               *, cached: bool = False, batch_size: int = 1) -> None:
+    ``record`` keeps its original positional signature (request_id, op,
+    node, duration_us) — additive keyword fields carry the tree structure.
+    ``capacity=0`` disables span recording entirely (histograms included).
+    """
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = int(capacity)
+        self._spans = deque(maxlen=max(0, self.capacity))
+        self._lock = threading.Lock()
+        self._hists: Dict[str, LatencyHistogram] = {}
+
+    def record(self, request_id: str, op: str, node: str, duration_us,
+               *, cached: bool = False, batch_size: int = 1,
+               trace_id: Optional[str] = None,
+               span_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               start_ts: Optional[float] = None,
+               attrs: Optional[dict] = None) -> None:
+        if self.capacity <= 0:
+            return
         span = {
             "request_id": request_id,
             "op": op,
@@ -38,30 +141,137 @@ class SpanRecorder:
             "batch_size": batch_size,
             "ts": time.time(),
         }
+        if trace_id is not None:
+            span["trace_id"] = trace_id
+        if span_id is not None:
+            span["span_id"] = span_id
+        if parent_id is not None:
+            span["parent_id"] = parent_id
+        if start_ts is not None:
+            span["start_ts"] = start_ts
+        if attrs:
+            span["attrs"] = attrs
+        hist = self._hists.get(op)
         with self._lock:
             self._spans.append(span)
+            if hist is None:
+                hist = self._hists.setdefault(op, LatencyHistogram())
+        hist.observe(float(duration_us) / 1e6)
 
-    def recent(self, n: int = 100):
+    def recent(self, n: int = 100) -> List[dict]:
         with self._lock:
             items = list(self._spans)
         return items[-n:]
 
-    def summary(self) -> dict:
+    def snapshot(self) -> List[dict]:
+        """Every span currently in the ring (export path)."""
         with self._lock:
-            items = list(self._spans)
+            return list(self._spans)
+
+    def summary(self) -> dict:
+        """The original ``/trace`` summary schema, aggregated over
+        request-level spans only (stage spans would double-count)."""
+        items = [s for s in self.snapshot() if s["op"] in _REQUEST_OPS]
         if not items:
             return {"spans": 0}
         durs = sorted(s["duration_us"] for s in items)
-
-        def pct(p):
-            return durs[min(len(durs) - 1, int(p / 100 * len(durs)))]
-
         return {
             "spans": len(items),
             "cached": sum(1 for s in items if s["cached"]),
-            "duration_us": {"p50": pct(50), "p90": pct(90), "p99": pct(99),
+            "duration_us": {"p50": percentile(durs, 50),
+                            "p90": percentile(durs, 90),
+                            "p99": percentile(durs, 99),
                             "max": durs[-1]},
         }
+
+    def stage_summary(self) -> dict:
+        """Per-op latency summary over EVERY span in the ring — the
+        queue-wait vs device-compute breakdown ``bench.py`` scrapes.
+        Additive endpoint data; the original summary() is untouched."""
+        by_op: Dict[str, List[int]] = {}
+        for s in self.snapshot():
+            by_op.setdefault(s["op"], []).append(s["duration_us"])
+        out = {}
+        for op, durs in sorted(by_op.items()):
+            durs.sort()
+            out[op] = {
+                "count": len(durs),
+                "mean_us": round(sum(durs) / len(durs), 1),
+                "p50_us": percentile(durs, 50),
+                "p90_us": percentile(durs, 90),
+                "p99_us": percentile(durs, 99),
+                "max_us": durs[-1],
+            }
+        return out
+
+    def histograms(self) -> Dict[str, LatencyHistogram]:
+        """Live per-stage histogram objects (rendered by utils.metrics)."""
+        with self._lock:
+            return dict(self._hists)
+
+
+class TraceSink:
+    """Recorder + identity bundle handed into runtime components (the
+    continuous scheduler) so they can record stage spans for a request
+    without importing the serving layer. ``None``-safe at every call
+    site: runtime code threads an Optional[TraceSink]."""
+
+    __slots__ = ("recorder", "node", "request_id", "ctx")
+
+    def __init__(self, recorder: SpanRecorder, node: str, request_id: str,
+                 ctx: TraceContext):
+        self.recorder = recorder
+        self.node = node
+        self.request_id = request_id
+        self.ctx = ctx
+
+    def stage(self, op: str, duration_us: float,
+              start_ts: Optional[float] = None, **attrs) -> None:
+        child = self.ctx.child()
+        self.recorder.record(
+            self.request_id, op, self.node, duration_us,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=self.ctx.span_id, start_ts=start_ts,
+            attrs=attrs or None)
+
+
+def percentile(sorted_vals: List, p: float):
+    """Nearest-rank (ceil) percentile of a pre-sorted list: the smallest
+    value with at least p% of samples ≤ it. The previous
+    ``int(p/100*len)`` truncation indexed one past the nearest rank
+    (over-reporting mid percentiles) and could swing either way on small
+    samples; nearest-rank is the standard, monotonic definition."""
+    if not sorted_vals:
+        return None
+    rank = math.ceil(p / 100.0 * len(sorted_vals))  # 1-based
+    return sorted_vals[min(len(sorted_vals) - 1, max(0, rank - 1))]
+
+
+def export_chrome(recorders: Dict[str, SpanRecorder]) -> dict:
+    """Chrome trace-event JSON of every recorder's ring — loadable in
+    Perfetto / chrome://tracing. One tid per node (named via metadata
+    events); complete ("X") events carry trace_id/span_id/parent_id in
+    ``args`` so tooling can rebuild the exact span tree."""
+    events: List[dict] = []
+    for tid, (node, rec) in enumerate(sorted(recorders.items()), start=1):
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": node}})
+        for s in rec.snapshot():
+            start = s.get("start_ts")
+            if start is None:  # legacy rows stamp completion time only
+                start = s["ts"] - s["duration_us"] / 1e6
+            args = {"request_id": s["request_id"]}
+            for k in ("trace_id", "span_id", "parent_id", "cached",
+                      "batch_size"):
+                if k in s:
+                    args[k] = s[k]
+            args.update(s.get("attrs") or {})
+            events.append({
+                "name": s["op"], "cat": "serving", "ph": "X",
+                "ts": start * 1e6, "dur": max(0, int(s["duration_us"])),
+                "pid": 1, "tid": tid, "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 _profile_lock = threading.Lock()
