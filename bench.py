@@ -964,6 +964,154 @@ def run_mixed_shape_bench(port: int, n_requests: int = 2000,
     }
 
 
+def run_paged_ab(model: str = "gpt2-small-test", n_requests: int = 16,
+                 max_new: int = 96, shared_max_new: int = 16,
+                 prompt_len: int = 8, shared_prefix_len: int = 64,
+                 mean_gap_ms: float = 15.0, dtype: str = "float32",
+                 block_size: int = 16, dense_slots: int = 2,
+                 max_seq: int = 512) -> dict:
+    """Dense vs paged KV cache at EQUAL KV memory budget (the tentpole
+    A/B). Two arms:
+
+    - **capacity**: a burst of short prompts against (a) the dense
+      scheduler (`dense_slots` rows of max_seq each) and (b) the paged
+      scheduler given exactly the same KV bytes as a block pool
+      (`dense_slots * ceil(max_seq/bs)` blocks), with its slot count
+      sized to what those blocks can hold concurrently at this
+      workload's row footprint. Reports the peak concurrently-admitted
+      rows each sustained — paged rows reserve blocks for the tokens
+      they actually hold, so the same HBM admits several times more
+      short rows.
+    - **shared-prefix**: Poisson arrivals of prompts sharing one
+      system-prompt prefix, paged with radix sharing on vs off. Reports
+      prefill-token savings (prefix_hit_tokens vs prefilled_tokens) and
+      tokens/s.
+
+    Runs on the CPU mesh (tiny default model, max_seq overridden on the
+    spec: the capacity and sharing ratios are layout/workload
+    properties, not model-size properties); the on-chip campaign re-runs
+    it against gpt2 on the device."""
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    _ensure_builtin_models_imported()
+    spec = create_model(model, max_seq=max_seq)
+    params = spec.init(jax.random.PRNGKey(0))
+    step_chunk = 8
+    width = -(-max_seq // block_size)
+    kv_blocks = dense_slots * width + 1  # == dense KV bytes (+ null block)
+    # Worst-case blocks one capacity-arm row pins (prompt + generation +
+    # one chunk of headroom): the pool admits this many rows at once.
+    per_row_blocks = -(-(prompt_len + max_new + step_chunk) // block_size)
+    paged_slots = max(1, (kv_blocks - 1) // per_row_blocks)
+    rnd = random.Random(42)
+
+    def run_burst(gen, prompts, new_tokens, gaps=None):
+        peak = [0]
+        stop_flag = threading.Event()
+
+        def sampler():
+            while not stop_flag.is_set():
+                peak[0] = max(peak[0], gen.stats()["active"])
+                time.sleep(0.002)
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        futs = []
+        for i, p in enumerate(prompts):
+            if gaps:
+                time.sleep(gaps[i])
+            futs.append(gen.submit(p, max_new_tokens=new_tokens))
+        outs = [f.result(600) for f in futs]
+        wall = time.perf_counter() - t0
+        stop_flag.set()
+        th.join(timeout=1)
+        toks = sum(len(o) for o in outs)
+        short = sum(1 for o in outs if len(o) < new_tokens)
+        return {"requests": len(prompts), "wall_s": round(wall, 3),
+                "tokens": toks, "truncated_rows": short,
+                "tokens_per_s": round(toks / wall, 2) if wall else 0.0,
+                "peak_concurrent_rows": peak[0]}
+
+    results = {"model": model, "max_seq": max_seq,
+               "block_size": block_size, "dense_slots": dense_slots,
+               "paged_slots_equal_budget": paged_slots,
+               "kv_blocks_equal_budget": kv_blocks}
+    # A few distinct prompts cycled (the reference benchmark's own
+    # workload shape): admission cost is then prefix-cache/radix-cheap on
+    # both arms, so the burst measures RESIDENCY capacity, not the CPU
+    # mesh's serial prefill throughput.
+    distinct = [[rnd.randrange(1, 200) for _ in range(prompt_len)]
+                for _ in range(4)]
+    prompts = [distinct[i % len(distinct)] for i in range(n_requests)]
+
+    dense = ContinuousGenerator(spec, params=params, dtype=dtype,
+                                n_slots=dense_slots, step_chunk=step_chunk,
+                                max_seq=max_seq)
+    try:
+        dense.generate(distinct, max_new_tokens=2)  # warm compiles+cache
+        results["dense"] = run_burst(dense, prompts, max_new)
+    finally:
+        dense.stop()
+    record_partial("paged_ab_dense", results["dense"])
+    paged = ContinuousGenerator(spec, params=params, dtype=dtype,
+                                n_slots=paged_slots, step_chunk=step_chunk,
+                                max_seq=max_seq, kv_block_size=block_size,
+                                kv_blocks=kv_blocks)
+    try:
+        paged.generate(distinct, max_new_tokens=2)
+        results["paged"] = run_burst(paged, prompts, max_new)
+        results["paged"]["kv_pool"] = {
+            k: paged.stats()["kv_pool"][k]
+            for k in ("blocks_total", "blocks_free", "evictions")}
+    finally:
+        paged.stop()
+    results["capacity_gain"] = round(
+        results["paged"]["peak_concurrent_rows"]
+        / max(1, results["dense"]["peak_concurrent_rows"]), 2)
+    record_partial("paged_ab_capacity", {
+        k: results[k] for k in ("dense", "paged", "capacity_gain")})
+
+    # Shared-prefix Poisson arm: radix sharing on vs off, same arrivals.
+    shared = [rnd.randrange(1, 200) for _ in range(shared_prefix_len)]
+    sp = [shared + [rnd.randrange(1, 200) for _ in range(6)]
+          for _ in range(n_requests)]
+    gaps = [rnd.expovariate(1000.0 / mean_gap_ms) / 1000.0
+            for _ in range(n_requests)]
+    for label, sharing in (("paged_shared_prefix", True),
+                           ("paged_no_sharing", False)):
+        g = ContinuousGenerator(spec, params=params, dtype=dtype,
+                                n_slots=paged_slots, step_chunk=step_chunk,
+                                max_seq=max_seq, kv_block_size=block_size,
+                                kv_blocks=kv_blocks,
+                                prefix_sharing=sharing)
+        try:
+            # Warm the full prefill path AND (sharing arm) the resumed
+            # mid-prompt window widths, so the timed burst measures the
+            # steady state, not one-time XLA compiles.
+            g.generate([sp[0]], max_new_tokens=2)
+            g.generate([shared + [1, 2, 3]], max_new_tokens=2)
+            r = run_burst(g, sp, shared_max_new, gaps=gaps)
+            pool = g.stats()["kv_pool"]
+            r["kv_pool"] = {k: pool[k] for k in
+                            ("prefix_hit_tokens", "prefilled_tokens",
+                             "prefix_savings_frac", "blocks_shared",
+                             "radix_nodes", "evictions")}
+            results[label] = r
+        finally:
+            g.stop()
+        record_partial(label, results[label])
+    results["prefill_token_savings_frac"] = \
+        results["paged_shared_prefix"]["kv_pool"]["prefix_savings_frac"]
+    return results
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -1022,6 +1170,37 @@ def probe_device(timeout_s: float = 240.0, attempts: int = 3,
 
 
 _SCENARIO = "infer"  # set by _main after arg parsing; read by the handler
+_DEVICE_NOTE = None  # "unavailable" after a device-probe fallback
+
+
+def emit(line: dict) -> None:
+    """Print the driver's one JSON line, stamped with the device state —
+    a CPU-fallback round must say so (``"device": "unavailable"``), so
+    its numbers can never masquerade as on-chip evidence."""
+    if _DEVICE_NOTE is not None:
+        line.setdefault("device", _DEVICE_NOTE)
+    print(json.dumps(line), flush=True)
+
+
+def device_fallback(exc: BaseException) -> str:
+    """Device probe failed (hung tunnel, dead chip, contention that never
+    cleared): fall back to the CPU backend instead of dying with a
+    zero-information error artifact (round-5 VERDICT ask). Every
+    subsequent measurement — in-process scenarios via the jax config,
+    server subprocesses via TPU_ENGINE_PLATFORM — runs host-side, the
+    partial artifact records ``device: "unavailable"``, and the final
+    JSON line carries the same stamp."""
+    log(f"device probe failed ({exc!r}); falling back to CPU-backend "
+        "scenarios — artifact will carry device=unavailable")
+    record_partial("device", "unavailable")
+    os.environ["TPU_ENGINE_PLATFORM"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return "unavailable"
 
 
 def main() -> int:
@@ -1034,6 +1213,8 @@ def main() -> int:
             "vs_baseline": 0.0, "scenario": _SCENARIO,
             "error": repr(exc)[:500],
         }
+        if _DEVICE_NOTE is not None:
+            line["device"] = _DEVICE_NOTE
         # A wedge after N completed measurements must not zero them out:
         # attach whatever landed before the failure (also on disk at
         # BENCH_partial.json). Metadata-only partials (scenario/ts) are
@@ -1068,7 +1249,7 @@ def _main() -> int:
     ap.add_argument("--scenario",
                     choices=["infer", "generate", "compute", "decode-ab",
                              "spec-ab", "mixed", "prefill-mfu", "longctx",
-                             "miss-sweep"],
+                             "miss-sweep", "paged-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -1084,9 +1265,17 @@ def _main() -> int:
     record_partial("scenario", args.scenario)
     # Preflight the device — except in --port mode, where a live server
     # already holds the (exclusive) chip and a second jax.devices() would
-    # false-negative against a healthy deployment.
+    # false-negative against a healthy deployment. A failed probe no
+    # longer kills the round: scenarios fall back to the CPU backend and
+    # the artifact says device="unavailable" (host-side numbers beat a
+    # zero-information error line).
+    global _DEVICE_NOTE
     if args.port == 0:
-        probe_device()
+        try:
+            probe_device()
+        except Exception as exc:
+            _DEVICE_NOTE = device_fallback(exc)
+            args.quick = True  # CPU-budget sizes for every scenario
     if args.quick:
         args.requests, args.threads = 1000, 20
     if (args.scenario in ("generate", "decode-ab", "spec-ab")
@@ -1094,53 +1283,62 @@ def _main() -> int:
         args.model = "gpt2"
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
+    if args.scenario == "paged-ab" and args.model == "resnet50":
+        args.model = "gpt2-small-test"
+    if _DEVICE_NOTE is not None:
+        # Host-side runs also downshift the model: a 124M-param decode
+        # loop on CPU would wedge the very round the fallback rescues.
+        args.model = {"gpt2": "gpt2-small-test",
+                      "resnet50": "mlp"}.get(args.model, args.model)
 
     if args.scenario == "compute":
-        # In-process, no HTTP: pure device-compute evidence.
+        # In-process, no HTTP: pure device-compute evidence. A CPU
+        # fallback round shrinks the decode model too.
+        dm = "gpt2-small-test" if _DEVICE_NOTE is not None else "gpt2"
         compute = run_compute_bench(model=args.model
                                     if args.model != "gpt2" else "resnet50")
         record_partial("compute", compute)
-        decode = run_decode_compute()
+        decode = run_decode_compute(model=dm)
         record_partial("decode", decode)
-        decode_f = run_decode_compute(fused=True)
+        decode_f = run_decode_compute(model=dm, fused=True)
         record_partial("decode_fused", decode_f)
         # Named so the honest comparison is self-evident: the int8 arm is
         # fused, so its pair is decode_fused (NOT the chunked "decode" —
         # dividing by that would conflate the fusion win into int8's).
-        decode_fq = run_decode_compute(quantize=True, fused=True)
+        decode_fq = run_decode_compute(model=dm, quantize=True, fused=True)
         record_partial("decode_fused_int8", decode_fq)
         log(json.dumps({"compute": compute, "decode": decode,
                         "decode_fused": decode_f,
                         "decode_fused_int8": decode_fq}, indent=2))
-        print(json.dumps({
+        emit({
             "metric": "device_compute", "value": compute["samples_per_s"],
             "unit": "samples/s", "vs_baseline": None,
             "mfu": compute["mfu"], "decode_tokens_per_s": decode["tokens_per_s"],
             "compute": compute, "decode": decode, "decode_fused": decode_f,
             "decode_fused_int8": decode_fq,
-        }), flush=True)
+        })
         return 0
 
     if args.scenario == "decode-ab":
         result = run_decode_ab(model=args.model)
         record_partial("decode_ab", result)
         log(json.dumps(result, indent=2))
-        print(json.dumps({
+        emit({
             "metric": "decode_continuous_speedup",
             "value": result["continuous_speedup"], "unit": "x",
             "vs_baseline": None, "model": args.model, **result,
-        }), flush=True)
+        })
         return 0
 
     if args.scenario == "spec-ab":
         result = run_spec_ab(model=args.model)
         record_partial("spec_ab", result)
         log(json.dumps(result, indent=2))
-        print(json.dumps({
+        emit({
             "metric": "speculative_speedup_upper",
             "value": result["self_draft"]["speedup_vs_plain"], "unit": "x",
             "vs_baseline": None, "model": args.model, **result,
-        }), flush=True)
+        })
         return 0
 
     if args.scenario == "prefill-mfu":
@@ -1156,10 +1354,10 @@ def _main() -> int:
         value, unit = result["mfu"], "fraction_of_peak"
         if value is None:
             value, unit = result["prefill_tokens_per_s"], "tokens/s"
-        print(json.dumps({
+        emit({
             "metric": "prefill_mfu", "value": value,
             "unit": unit, "vs_baseline": None, **result,
-        }), flush=True)
+        })
         return 0
 
     if args.scenario == "longctx":
@@ -1171,11 +1369,11 @@ def _main() -> int:
         log(json.dumps(result, indent=2))
         top = max(int(k.split("_S")[1]) for k in result
                   if k.startswith("flash_S"))
-        print(json.dumps({
+        emit({
             "metric": "longcontext_prefill_tokens_per_s",
             "value": result[f"flash_S{top}"]["prefill_tokens_per_s"],
             "unit": "tokens/s", "vs_baseline": None, **result,
-        }), flush=True)
+        })
         return 0
 
     if args.scenario == "miss-sweep":
@@ -1188,11 +1386,27 @@ def _main() -> int:
         log(json.dumps(result, indent=2))
         best = max((v["throughput_req_s"], k) for k, v in result.items()
                    if k.startswith("depth"))
-        print(json.dumps({
+        emit({
             "metric": "miss_path_throughput",
             "value": best[0], "unit": "req/s", "best_depth": best[1],
             "vs_baseline": round(best[0] / BASELINE_REQ_S, 3), **result,
-        }), flush=True)
+        })
+        return 0
+
+    if args.scenario == "paged-ab":
+        result = run_paged_ab(
+            model=args.model,
+            n_requests=8 if args.quick else 16,
+            max_new=48 if args.quick else 96)
+        record_partial("paged_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "paged_kv_capacity_gain",
+            "value": result["capacity_gain"], "unit": "x",
+            "vs_baseline": None, "model": args.model,
+            "prefill_token_savings_frac":
+                result["prefill_token_savings_frac"], **result,
+        })
         return 0
 
     proc = None
@@ -1209,33 +1423,33 @@ def _main() -> int:
             record_partial("mixed", result)
             log(json.dumps(result, indent=2))
             result.update(scrape_stats(port))
-            print(json.dumps({
+            emit({
                 "metric": "mixed_shape_throughput",
                 "value": result["throughput_req_s"], "unit": "req/s",
                 "vs_baseline": None, "model": args.model, **result,
-            }), flush=True)
+            })
             return 0 if result["failed"] == 0 else 1
 
         if args.cache_test:
             result = run_cache_test(port)
             record_partial("cache_test", result)
             log(json.dumps(result, indent=2))
-            print(json.dumps({
+            emit({
                 "metric": "cache_speedup", "value": result["speedup"],
                 "unit": "x", "vs_baseline": None, "model": args.model,
                 **result,
-            }), flush=True)
+            })
             return 0
 
         if args.scenario == "generate":
             result = run_generate_bench(port)
             record_partial("generate", result)
             log(json.dumps(result, indent=2))
-            print(json.dumps({
+            emit({
                 "metric": "decode_throughput", "value": result["tokens_per_s"],
                 "unit": "tokens/s", "vs_baseline": None, "model": args.model,
                 **result,
-            }), flush=True)
+            })
             return 0 if result["failed"] == 0 else 1
 
         log("server ready; warmup pass (misses populate the cache) ...")
@@ -1328,7 +1542,7 @@ def _main() -> int:
             line["decode_fused"] = {
                 k: decode_fused[k] for k in ("tokens_per_s", "decode_mfu")
                 if k in decode_fused}
-        print(json.dumps(line), flush=True)
+        emit(line)
         return 0 if result["success_rate"] > 0.99 else 1
     finally:
         stop_server(proc)

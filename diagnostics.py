@@ -23,11 +23,12 @@ import sys
 
 OK, FAIL = "✓", "✗"
 _results = []
+_TOTAL = 6  # --kernel-parity appends a 7th step
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
     mark = OK if ok else FAIL
-    print(f"[{n}/6] {title}: {mark} {detail}".rstrip())
+    print(f"[{n}/{_TOTAL}] {title}: {mark} {detail}".rstrip())
     _results.append(ok)
 
 
@@ -60,10 +61,18 @@ def _strip(url: str, default_port: int = 8000) -> str:
 
 
 def main() -> int:
+    global _TOTAL
     ap = argparse.ArgumentParser()
     ap.add_argument("--gateway", default="http://localhost:8000")
     ap.add_argument("--workers", nargs="*", default=[])
+    ap.add_argument("--kernel-parity", action="store_true",
+                    help="step 7: paged-attention kernel vs XLA reference "
+                         "parity on this host's backend (in-process, no "
+                         "server; compiles a small kernel — seconds on "
+                         "CPU, validates Mosaic on a TPU host)")
     args = ap.parse_args()
+    if args.kernel_parity:
+        _TOTAL = 7
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -136,6 +145,24 @@ def main() -> int:
              f"(node {body.get('node_id')}, {body.get('inference_time_us')} us)")
     except OSError as exc:
         step(6, "gateway end-to-end infer", False, f"({exc})")
+
+    # 7 (--kernel-parity): paged-attention Pallas kernel vs XLA reference
+    # — the decode read path behind --kv-block-size serving; run on a TPU
+    # host this validates the Mosaic compile, elsewhere the interpreter.
+    if args.kernel_parity:
+        try:
+            import jax.numpy as jnp
+
+            from tpu_engine.ops.paged_attention import parity_check
+
+            diff = max(parity_check(),
+                       parity_check(n_heads=8, n_kv_heads=2, d_head=16))
+            bf16 = parity_check(dtype=jnp.bfloat16)
+            step(7, "paged-attention kernel parity",
+                 diff < 2e-5 and bf16 < 2e-2,
+                 f"(max|Δ| f32 {diff:.2e}, bf16 {bf16:.2e})")
+        except Exception as exc:
+            step(7, "paged-attention kernel parity", False, f"({exc})")
 
     n_ok = sum(_results)
     print(f"\n{n_ok}/{len(_results)} checks passed")

@@ -29,3 +29,17 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU mesh"
 assert len(jax.devices()) == 8, "xla_force_host_platform_device_count=8 not applied"
+
+
+def serve_worker_retry(cfg_factory):
+    """Shared test launcher: serve_worker on a freshly probed free port,
+    retrying the probe-close→bind race on a fresh port
+    (utils.net.launch_with_retry owns the pattern; bench.launch_ready is
+    the subprocess-shaped twin). ``cfg_factory(port) -> WorkerConfig``.
+    Returns (port, worker, server) — caller stops both."""
+    from tpu_engine.serving.app import serve_worker
+    from tpu_engine.utils.net import launch_with_retry
+
+    port, pair = launch_with_retry(
+        lambda p: serve_worker(cfg_factory(p), background=True))
+    return (port, *pair)

@@ -57,6 +57,34 @@ def test_error_line_carries_partials(monkeypatch):
     assert line["partial"]["compute"]["mfu"] == 0.24
 
 
+def test_device_fallback_records_unavailable(monkeypatch):
+    """A wedged device probe must not kill the round: the fallback flips
+    the backend to CPU, stamps the partial artifact with
+    device=unavailable, and emit() carries the stamp onto the one JSON
+    line (round-5 VERDICT: never a zero-information error artifact)."""
+    monkeypatch.delenv("TPU_ENGINE_PLATFORM", raising=False)
+    note = bench.device_fallback(
+        RuntimeError("device probe hung >240s (tunnel wedged?)"))
+    assert note == "unavailable"
+    assert os.environ["TPU_ENGINE_PLATFORM"] == "cpu"  # server subprocs
+    on_disk = json.load(open(bench._PARTIAL_PATH))
+    assert on_disk["device"] == "unavailable"
+    monkeypatch.setattr(bench, "_DEVICE_NOTE", note)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.emit({"metric": "serving_throughput", "value": 1.0})
+    line = json.loads(buf.getvalue())
+    assert line["device"] == "unavailable"
+
+
+def test_emit_without_fallback_stays_clean(monkeypatch):
+    monkeypatch.setattr(bench, "_DEVICE_NOTE", None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.emit({"metric": "m", "value": 2.0})
+    assert "device" not in json.loads(buf.getvalue())
+
+
 def test_error_line_without_partials_stays_clean(monkeypatch):
     # Metadata-only partials (the scenario stamp _main writes before any
     # measurement) must not masquerade as surviving numbers.

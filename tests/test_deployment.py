@@ -49,6 +49,38 @@ def _spawn(args, env):
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
+def _launch_cli(make_args, env, ready_path="/stats", attempts=3):
+    """Spawn one CLI server on a freshly probed port, retrying the whole
+    pick+spawn when the child loses the probe-close→bind race and exits
+    before ready (utils.net.launch_with_retry; bench.launch_ready is the
+    same pattern). ``make_args(port) -> cli argv``. Returns (port, proc)."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    def launch(port):
+        proc = _spawn(make_args(port), env)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChildProcessError(
+                    f"server exited rc={proc.returncode} before ready")
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=2)
+                conn.request("GET", ready_path)
+                resp = conn.getresponse()
+                resp.read()
+                conn.close()
+                if resp.status == 200:
+                    return proc
+            except OSError:
+                pass
+            time.sleep(0.3)
+        _terminate(proc)
+        raise TimeoutError(f"port {port}{ready_path} never became ready")
+
+    return launch_with_retry(launch, attempts=attempts)
+
+
 def _wait_http(port: int, path: str, timeout_s: float = 90.0) -> None:
     deadline = time.monotonic() + timeout_s
     last = None
@@ -105,11 +137,10 @@ def _terminate(*procs):
 def test_reference_benchmark_runs_unmodified():
     """The reference's own load generator + stats scraper must work against
     the combined server byte-for-byte (wire-contract proof)."""
-    port = _free_port()
-    server = _spawn(["serve", "--model", "mlp", "--port", str(port),
-                     "--lanes", "2"], _child_env())
+    port, server = _launch_cli(
+        lambda p: ["serve", "--model", "mlp", "--port", str(p),
+                   "--lanes", "2"], _child_env())
     try:
-        _wait_http(port, "/stats")
         out = subprocess.run(
             [sys.executable, REFERENCE_BENCH,
              "--gateway", f"http://127.0.0.1:{port}",
@@ -130,11 +161,10 @@ def test_reference_benchmark_runs_unmodified():
 def test_diagnostics_six_steps_pass_against_live_server():
     """diagnostics.py (the reference diagnostics.sh's 6 checks ported) must
     pass 6/6 against a live combined server and exit 0."""
-    port = _free_port()
-    server = _spawn(["serve", "--model", "mlp", "--port", str(port)],
-                    _child_env())
+    port, server = _launch_cli(
+        lambda p: ["serve", "--model", "mlp", "--port", str(p)],
+        _child_env())
     try:
-        _wait_http(port, "/stats")
         out = subprocess.run(
             [sys.executable, "diagnostics.py",
              "--gateway", f"http://127.0.0.1:{port}",

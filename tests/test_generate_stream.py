@@ -44,13 +44,12 @@ def _parse_sse(data: bytes):
 
 @pytest.fixture(scope="module")
 def worker_server():
-    from tpu_engine.serving.app import serve_worker
+    from conftest import serve_worker_retry
     from tpu_engine.utils.config import WorkerConfig
 
-    port = _free_port()
-    worker, server = serve_worker(
-        WorkerConfig(port=port, node_id="w_stream", model="gpt2-small-test",
-                     dtype="float32"), background=True)
+    port, worker, server = serve_worker_retry(
+        lambda p: WorkerConfig(port=p, node_id="w_stream",
+                               model="gpt2-small-test", dtype="float32"))
     time.sleep(0.2)
     yield port
     worker.stop()

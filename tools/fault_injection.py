@@ -42,8 +42,57 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import os
+import subprocess
 import sys
 import time
+
+# --launch imports tpu_engine.utils.net; the harness itself must stay
+# runnable from anywhere (its target-a-live-server mode is stdlib-only).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def launch_combined(model: str = "mlp", lanes: int = 3,
+                    breaker_timeout: float = 2.0, hedge: bool = False,
+                    attempts: int = 3):
+    """Spawn the combined server for a self-contained harness run
+    (``--launch``), bind-race-proofed: utils.net.launch_with_retry picks
+    a fresh port and relaunches when the child loses the probe-close→
+    bind race and exits before ready (the same consumer-owns-the-retry
+    rule bench.launch_ready applies). Returns (port, Popen)."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(port: int):
+        cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+               "--model", model, "--lanes", str(lanes),
+               "--port", str(port),
+               "--breaker-timeout", str(breaker_timeout)]
+        if hedge:
+            cmd += ["--hedge", "--hedge-min-ms", "100"]
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=sys.stderr, stderr=sys.stderr)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                # Early exit = most likely the bind race: the distinct
+                # error type tells launch_with_retry to repick the port.
+                raise ChildProcessError(
+                    f"server exited rc={proc.returncode} before ready")
+            try:
+                status, _ = _call(port, "GET", "/stats", timeout=2.0)
+                if status == 200:
+                    return proc
+            except OSError:
+                pass
+            time.sleep(0.5)
+        proc.terminate()
+        raise TimeoutError("server never became ready")
+
+    return launch_with_retry(spawn, attempts=attempts)
 
 
 def _call(port: int, method: str, path: str, body=None, timeout=30.0):
@@ -227,73 +276,94 @@ def main() -> int:
                     help="phase 5 injected per-request latency (seconds)")
     ap.add_argument("--deadline-ms", type=float, default=2000.0,
                     help="phase 5 per-request deadline budget")
+    ap.add_argument("--launch", metavar="MODEL", default=None,
+                    help="spawn the combined server myself (3 lanes, "
+                         "breaker timeout from --breaker-timeout, hedging "
+                         "on with --slow-lane) instead of targeting an "
+                         "already-running one; the launch retries on the "
+                         "free-port bind race")
     args = ap.parse_args()
-    port, n = args.port, args.requests_per_phase
-    checks = []
+    proc = None
+    if args.launch:
+        args.breaker_timeout = min(args.breaker_timeout, 2.0)
+        port, proc = launch_combined(model=args.launch,
+                                     breaker_timeout=args.breaker_timeout,
+                                     hedge=args.slow_lane)
+        args.port = port
+    try:
+        port, n = args.port, args.requests_per_phase
+        checks = []
 
-    # Phase 0: routing pre-pass — collect ids per lane, pick the victim.
-    pools = route_map(port, max(4 * n, 100))
-    victim = (args.victim
-              if len(pools.get(args.victim, [])) >= 5
-              else max(pools, key=lambda k: len(pools[k])))
-    victim_ids = pools[victim]
-    all_ids = [rid for p in pools.values() for rid in p]
-    report = {"victim": victim,
-              "routing": {k: len(v) for k, v in pools.items()},
-              "phases": {}}
-    checks.append(("victim owns enough keys to trip the breaker",
-                   len(victim_ids) >= 5))
+        # Phase 0: routing pre-pass — collect ids per lane, pick the victim.
+        pools = route_map(port, max(4 * n, 100))
+        victim = (args.victim
+                  if len(pools.get(args.victim, [])) >= 5
+                  else max(pools, key=lambda k: len(pools[k])))
+        victim_ids = pools[victim]
+        all_ids = [rid for p in pools.values() for rid in p]
+        report = {"victim": victim,
+                  "routing": {k: len(v) for k, v in pools.items()},
+                  "phases": {}}
+        checks.append(("victim owns enough keys to trip the breaker",
+                       len(victim_ids) >= 5))
 
-    # Phase 1: healthy baseline over every lane's keys. The pre-pass
-    # populated the LRU caches; reuse of the same ids exercises hits too.
-    ok, fail, nodes = load(port, all_ids[:n], "base")
-    state, _ = breaker_state(port, victim)
-    report["phases"]["baseline"] = {"ok": ok, "fail": fail, "nodes": nodes,
-                                    "breaker": state}
-    checks.append(("baseline 100% success", fail == 0))
+        # Phase 1: healthy baseline over every lane's keys. The pre-pass
+        # populated the LRU caches; reuse of the same ids exercises hits too.
+        ok, fail, nodes = load(port, all_ids[:n], "base")
+        state, _ = breaker_state(port, victim)
+        report["phases"]["baseline"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                        "breaker": state}
+        checks.append(("baseline 100% success", fail == 0))
 
-    # Phase 2: inject fault; drive ids that route PRIMARY to the victim so
-    # its breaker sees consecutive failures while failover answers them.
-    _call(port, "POST", "/admin/fault", {"node": victim, "action": "fail"})
-    ok, fail, nodes = load(port, victim_ids[:n], "fault")
-    state, failovers = breaker_state(port, victim)
-    report["phases"]["faulted"] = {"ok": ok, "fail": fail, "nodes": nodes,
-                                   "breaker": state, "failovers": failovers}
-    checks.append(("failover keeps success at 100%", fail == 0))
-    checks.append(("victim took no faulted traffic", victim not in nodes))
-    checks.append(("breaker OPEN after consecutive failures", state == "OPEN"))
-    checks.append(("failovers counted", failovers > 0))
+        # Phase 2: inject fault; drive ids that route PRIMARY to the victim so
+        # its breaker sees consecutive failures while failover answers them.
+        _call(port, "POST", "/admin/fault", {"node": victim, "action": "fail"})
+        ok, fail, nodes = load(port, victim_ids[:n], "fault")
+        state, failovers = breaker_state(port, victim)
+        report["phases"]["faulted"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                       "breaker": state, "failovers": failovers}
+        checks.append(("failover keeps success at 100%", fail == 0))
+        checks.append(("victim took no faulted traffic", victim not in nodes))
+        checks.append(("breaker OPEN after consecutive failures", state == "OPEN"))
+        checks.append(("failovers counted", failovers > 0))
 
-    # Phase 3: heal, wait out the breaker timeout, probe traffic re-closes it.
-    _call(port, "POST", "/admin/fault", {"node": victim, "action": "heal"})
-    time.sleep(args.breaker_timeout + 0.5)
-    ok, fail, nodes = load(port, victim_ids[:n], "heal")
-    state, _ = breaker_state(port, victim)
-    report["phases"]["healed"] = {"ok": ok, "fail": fail, "nodes": nodes,
-                                  "breaker": state}
-    checks.append(("breaker CLOSED after recovery", state == "CLOSED"))
-    checks.append(("victim serving again", nodes.get(victim, 0) > 0))
+        # Phase 3: heal, wait out the breaker timeout, probe traffic re-closes it.
+        _call(port, "POST", "/admin/fault", {"node": victim, "action": "heal"})
+        time.sleep(args.breaker_timeout + 0.5)
+        ok, fail, nodes = load(port, victim_ids[:n], "heal")
+        state, _ = breaker_state(port, victim)
+        report["phases"]["healed"] = {"ok": ok, "fail": fail, "nodes": nodes,
+                                      "breaker": state}
+        checks.append(("breaker CLOSED after recovery", state == "CLOSED"))
+        checks.append(("victim serving again", nodes.get(victim, 0) > 0))
 
-    # Phase 4: steady state across all lanes.
-    ok, fail, nodes = load(port, all_ids[:n], "final")
-    report["phases"]["final"] = {"ok": ok, "fail": fail, "nodes": nodes}
-    checks.append(("final 100% success", fail == 0))
+        # Phase 4: steady state across all lanes.
+        ok, fail, nodes = load(port, all_ids[:n], "final")
+        report["phases"]["final"] = {"ok": ok, "fail": fail, "nodes": nodes}
+        checks.append(("final 100% success", fail == 0))
 
-    # Phase 5 (--slow-lane): slow-not-dead lane under deadline load.
-    if args.slow_lane:
-        report["phases"]["slow_lane"] = slow_lane_phase(
-            port, victim, victim_ids, n, checks,
-            latency_s=args.slow_latency, deadline_ms=args.deadline_ms)
+        # Phase 5 (--slow-lane): slow-not-dead lane under deadline load.
+        if args.slow_lane:
+            report["phases"]["slow_lane"] = slow_lane_phase(
+                port, victim, victim_ids, n, checks,
+                latency_s=args.slow_latency, deadline_ms=args.deadline_ms)
 
-    # Final: the tracing layer must explain every resilience decision the
-    # counters recorded (shed / retry / hedge fire & win — PR 1's failure
-    # paths, now provably span-covered).
-    report["trace_coverage"] = trace_coverage(port, checks)
+        # Final: the tracing layer must explain every resilience decision the
+        # counters recorded (shed / retry / hedge fire & win — PR 1's failure
+        # paths, now provably span-covered).
+        report["trace_coverage"] = trace_coverage(port, checks)
 
-    report["checks"] = {name: passed for name, passed in checks}
-    report["passed"] = all(p for _, p in checks)
-    print(json.dumps(report, indent=2))
-    return 0 if report["passed"] else 1
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
 
 if __name__ == "__main__":

@@ -282,11 +282,37 @@ def main() -> int:
                                    n_requests=6 if q else 24,
                                    max_new=8 if q else 32)
 
+    @stage(artifact, out, "paged")
+    def _paged():
+        # Paged KV cache on-chip: (a) Mosaic compile + exactness of the
+        # paged-attention kernel vs the XLA gather reference (the CPU
+        # rounds only ever ran the interpreter), (b) the dense-vs-paged
+        # capacity + shared-prefix A/B against the real chip.
+        import jax.numpy as jnp
+
+        from tpu_engine.ops.paged_attention import parity_check
+
+        res = {"kernel_parity": {
+            "f32_max_abs_diff": parity_check(
+                block_size=16, n_blocks=33, table_len=8, d_head=64),
+            "bf16_max_abs_diff": parity_check(
+                dtype=jnp.bfloat16, block_size=16, n_blocks=33,
+                table_len=8, d_head=64),
+            "gqa_max_abs_diff": parity_check(
+                n_heads=8, n_kv_heads=2, d_head=64, block_size=16,
+                n_blocks=33, table_len=8),
+        }}
+        res["ab"] = bench.run_paged_ab(
+            model=model, n_requests=8 if q else 16,
+            max_new=48 if q else 96, dtype="bfloat16")
+        return res
+
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
-               _decode_int8, _flash, _flash_tiling, _spec, _prefill_mfu,
-               _compute_sweep, _longctx, _decode_ab, _miss_sweep):
+               _decode_int8, _flash, _flash_tiling, _paged, _spec,
+               _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
+               _miss_sweep):
         fn()
     print("[campaign] done", flush=True)
     return 0

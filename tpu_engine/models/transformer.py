@@ -437,6 +437,75 @@ def transformer_decode_rows(params, token_t, caches: KVCache, pos_vec,
     return logits[:, 0], KVCache(k_new, v_new)
 
 
+def _block_decode_rows_paged(bp, h, cache_kv, tables, pos_vec,
+                             cfg: TransformerConfig, *, dtype, attn_fn):
+    """One decode step against the PAGED pool: cache_kv arrays are
+    (NB, bs, H_kv, D) block pools shared by every row; ``tables`` (B, nb)
+    maps row b's logical column c to pool block ``tables[b, c // bs]``,
+    offset ``c % bs``. Paged rows are 0-aligned (token i at logical
+    column i — the alignment radix sharing needs), so pos_vec IS the
+    logical position. The new token's K/V is scattered into its block
+    BEFORE the attention read (write-before-attend, like every other
+    decode path)."""
+    ck, cv = cache_kv
+    bs = ck.shape[1]
+    b = h.shape[0]
+    x = _norm(bp["ln1"], h, cfg)
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype,
+                           positions=pos_vec[:, None])
+    rows = jnp.arange(b)
+    blk = tables[rows, pos_vec // bs]
+    off = pos_vec % bs
+    ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
+    a = attn_fn(q, ck, cv, tables, pos_vec)  # grouped, unexpanded
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
+    return h.astype(dtype), (ck, cv)
+
+
+def transformer_decode_rows_paged(params, token_t, caches: KVCache, tables,
+                                  pos_vec, cfg: TransformerConfig, *,
+                                  dtype=jnp.bfloat16, attn_fn=None):
+    """`transformer_decode_rows` over a block pool instead of per-row
+    cache stripes. caches: (L, NB, bs, H_kv, D) pool pair; tables:
+    (B, nb) int32 per-row block tables (0 = the reserved null block —
+    masked by pos); pos_vec: (B,) logical write positions (0-aligned
+    rows: no start_vec). ``attn_fn`` defaults to
+    `ops.paged_attention.default_paged_attention()` — the Pallas kernel
+    on TPU, the XLA gather reference elsewhere. Returns
+    (logits (B, vocab), caches)."""
+    if attn_fn is None:
+        from tpu_engine.ops.paged_attention import default_paged_attention
+
+        attn_fn = default_paged_attention()
+    if cfg.sliding_window is not None:
+        # Band masking is not plumbed through the paged read path yet;
+        # failing loudly beats silently attending the full context.
+        raise NotImplementedError(
+            "sliding_window models are not supported by the paged KV "
+            "cache (use the dense scheduler)")
+    h = nn.embedding(params["tok_embed"], token_t[:, None])
+    if cfg.pos == "learned":
+        logical = jnp.clip(pos_vec, 0,
+                           params["pos_embed"]["table"].shape[0] - 1)
+        h = h + params["pos_embed"]["table"][logical][:, None, :]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_decode_rows_paged(
+            bp, carry, (ck, cv), tables, pos_vec, cfg, dtype=dtype,
+            attn_fn=attn_fn)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h,
+                                     (params["blocks"], caches.k, caches.v))
+    h = _norm(params["ln_f"], h, cfg)
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    return logits[:, 0], KVCache(k_new, v_new)
+
+
 def _block_decode_window(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
                          dtype, start_vec):
     """Width-W decode with PER-ROW cache positions — the speculative-decode

@@ -45,6 +45,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_engine.utils.jax_compat import CompilerParams as _CompilerParams
+
 _NEG_INF = float("-inf")
 
 
@@ -172,7 +174,7 @@ def _flash_fwd_call(cfg, qh, kh, vh, mask):
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qh, kh, vh, mask)
@@ -314,7 +316,7 @@ def _flash_bwd_call(cfg, qh, kh, vh, mask, out, lse, do):
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, a, 0))
     qrow = pl.BlockSpec((1, block_q), lambda bh, a, b_: (bh, a))
     common = dict(
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )

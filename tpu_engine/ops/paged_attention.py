@@ -1,0 +1,234 @@
+"""Paged-attention decode: single-token queries over block-pooled KV.
+
+The paged KV cache (runtime.kv_blocks) stores every row's keys/values in
+fixed-size blocks of a shared pool instead of one dense per-row stripe;
+a per-row **block table** maps logical column `c` to pool block
+`table[c // bs]`, offset `c % bs`. This module is the attention read
+side of that layout — two interchangeable implementations behind one
+contract:
+
+- `paged_attention_reference` — XLA `take`: gather the row's blocks into
+  a dense (B, S, H_kv, D) view and run the exact
+  `ops.attention.dot_product_attention` math (grouped, un-expanded,
+  masked `kpos <= pos`). This is the correctness anchor and the CPU-mesh
+  serving path: the gathered view puts every logical column at the same
+  index the dense scheduler would, so reductions see identical operand
+  layouts and seeded token streams match the dense path.
+- `paged_attention` — a Pallas TPU kernel streamed like `ops.flash`:
+  grid (B, H_kv, n_blocks) with the block axis sequential; each step
+  DMAs ONE (bs, D) K/V block, chosen by the block table via scalar
+  prefetch (the index map reads `tables[b, j]` — the gather never
+  materializes), and folds it into running flash accumulators (f32
+  max / denominator / weighted sum in VMEM scratch). Blocks entirely
+  past the row's length are skipped with `pl.when`, so a short row in a
+  long-table batch costs only its own blocks — the ragged-batch win the
+  TPU paged-attention kernel exists for (PAPERS.md "Ragged Paged
+  Attention").
+
+Grouped queries ride the sublane axis: q is laid out (B, H_kv, G, D)
+with G = n_heads/kv_heads, so one grid step computes all G group queries
+against its KV head's block — the (G, bs) score tile feeds the MXU once
+per block instead of G times.
+
+On-chip status: interpreter-validated only (this round's tunnel state);
+the `paged` stage of tools/onchip_campaign.py runs the Mosaic compile +
+parity + the dense-vs-paged A/B when the device link recovers. Selection
+mirrors
+`models.transformer.default_attention`: `TPU_ENGINE_PAGED` "1" forces the
+kernel (interpreter off-TPU), "0" forces the XLA reference, unset/"auto"
+picks the kernel on TPU only.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_engine.ops.attention import dot_product_attention
+from tpu_engine.utils.jax_compat import CompilerParams as _CompilerParams
+
+_NEG_INF = float("-inf")
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, pos_vec):
+    """XLA gather path. q: (B, 1, H, D); k_pool/v_pool: (NB, bs, H_kv, D);
+    tables: (B, nb) int32 block ids (0 = the reserved null block — its
+    columns must be masked by `pos_vec`); pos_vec: (B,) last valid
+    logical column per row (columns kpos <= pos are attended). Returns
+    (B, 1, H, D)."""
+    bs = k_pool.shape[1]
+    kk = k_pool[tables]                    # (B, nb, bs, H_kv, D)
+    vv = v_pool[tables]
+    b, nb = tables.shape
+    kk = kk.reshape(b, nb * bs, kk.shape[3], kk.shape[4])
+    vv = vv.reshape(b, nb * bs, vv.shape[3], vv.shape[4])
+    kpos = jnp.arange(nb * bs)[None, :]
+    valid = (kpos <= pos_vec[:, None]).astype(jnp.int32)
+    return dot_product_attention(q, kk, vv, mask=valid)
+
+
+def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *, block_size: int, scale: float):
+    """One (row, kv-head, block) grid step. q_ref/o_ref (1, 1, G, D);
+    k_ref/v_ref (1, bs, 1, D) — the physical block the index map picked
+    from the table. Scratch (m/l: (G,), acc: (G, D), f32) carries the
+    online softmax across the sequential block axis."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    length = lengths_ref[b]
+
+    def fold():
+        q = q_ref[0, 0]                    # (G, D)
+        k = k_ref[0, :, 0, :]              # (bs, D)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (G, bs)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m = m_sc[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=-1)
+        acc_sc[...] = acc_sc[...] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = m_new
+
+    # Blocks wholly past the row's valid length do no work at all — the
+    # ragged skip that makes a short row cost only its own blocks.
+    @pl.when(j * block_size < length)
+    def _live_block():
+        fold()
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_call(q, k_pool, v_pool, tables, lengths, *, interpret: bool):
+    b, _, h, d = q.shape
+    nb_pool, bs, h_kv, _ = k_pool.shape
+    nb = tables.shape[1]
+    g = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    # (B, 1, H, D) -> (B, H_kv, G, D): group queries share their KV head's
+    # grid step (head order matches dot_product_attention's grouping).
+    qh = q[:, 0].reshape(b, h_kv, g, d)
+    kernel = functools.partial(_paged_kernel, block_size=bs, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,        # tables, lengths
+            grid=(b, h_kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b, h, j, tables, lengths: (b, h, 0, 0)),
+                # The block table IS the index map: step (b, h, j) DMAs
+                # physical block tables[b, j] — no gathered copy exists.
+                pl.BlockSpec((1, bs, 1, d),
+                             lambda b, h, j, tables, lengths:
+                             (tables[b, j], 0, h, 0)),
+                pl.BlockSpec((1, bs, 1, d),
+                             lambda b, h, j, tables, lengths:
+                             (tables[b, j], 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, d),
+                lambda b, h, j, tables, lengths: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g,), jnp.float32),
+                pltpu.VMEM((g,), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, g, d), v_pool.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, lengths, qh, k_pool, v_pool)
+    return out.reshape(b, 1, h, d)
+
+
+def paged_attention(q, k_pool, v_pool, tables, pos_vec, *, interpret=None):
+    """Pallas-kernel drop-in for `paged_attention_reference` (same
+    signature/contract). `interpret=None` auto-selects: compiled on TPU,
+    interpreter elsewhere."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lengths = jnp.asarray(pos_vec, jnp.int32) + 1
+    return _paged_call(q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
+                       lengths, interpret=bool(interpret))
+
+
+_PAGED_CACHE = {}
+
+
+def default_paged_attention():
+    """Serving-path paged-attention selection, one rule with
+    `models.transformer.default_attention`: `TPU_ENGINE_PAGED` "1" forces
+    the Pallas kernel (interpreter off-TPU — slow, for parity tests),
+    "0" forces the XLA gather reference, unset/"auto" kernel on TPU."""
+    import os
+
+    mode = os.environ.get("TPU_ENGINE_PAGED", "auto")
+    fn = _PAGED_CACHE.get(mode)
+    if fn is None:
+        if mode == "1" or (mode == "auto"
+                           and jax.default_backend() == "tpu"):
+            fn = paged_attention
+        else:
+            fn = paged_attention_reference
+        _PAGED_CACHE[mode] = fn
+    return fn
+
+
+def parity_check(batch: int = 2, n_heads: int = 4, n_kv_heads: int = 2,
+                 d_head: int = 8, block_size: int = 16, n_blocks: int = 9,
+                 table_len: int = 4, dtype=jnp.float32,
+                 seed: int = 0) -> float:
+    """Max |kernel - reference| over a random pool/table/length workload —
+    shared by tests/test_paged_kv.py, diagnostics.py --kernel-parity, and
+    the on-chip campaign's `paged` stage. Rows get distinct shuffled
+    tables and ragged lengths so the skip/mask paths are exercised."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (batch, 1, n_heads, d_head), dtype)
+    k_pool = jax.random.normal(
+        keys[1], (n_blocks, block_size, n_kv_heads, d_head), dtype)
+    v_pool = jax.random.normal(
+        keys[2], (n_blocks, block_size, n_kv_heads, d_head), dtype)
+    tables = np.zeros((batch, table_len), np.int32)
+    pos = np.zeros((batch,), np.int32)
+    for r in range(batch):
+        ids = 1 + rng.permutation(n_blocks - 1)[:table_len]
+        tables[r] = ids
+        pos[r] = int(rng.integers(0, table_len * block_size))
+    tables = jnp.asarray(tables)
+    pos = jnp.asarray(pos)
+    ours = paged_attention(q, k_pool, v_pool, tables, pos)
+    ref = paged_attention_reference(q, k_pool, v_pool, tables, pos)
+    return float(jnp.max(jnp.abs(ours.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))

@@ -97,6 +97,20 @@ def left_pad_batch(prompts: Sequence[Sequence[int]], bb: int, pb: int,
     return tokens, attn_mask, pos_ids, start
 
 
+def right_pad_prompt(prompt: Sequence[int], pb: int) -> np.ndarray:
+    """(1, pb) RIGHT-padded token row — the paged scheduler's 0-aligned
+    batch-assembly step (`left_pad_batch`'s counterpart): token i sits at
+    column i, so a shared prefix lands at identical logical columns
+    whatever bucket each prompt picked — the alignment block-level radix
+    sharing keys on. Over-long prompts truncate from the left, same rule
+    as every other decode path."""
+    tokens = np.zeros((1, pb), np.int32)
+    p = list(prompt)[-pb:]
+    if p:
+        tokens[0, :len(p)] = np.asarray(p, np.int32)
+    return tokens
+
+
 def apply_repetition_penalty(logits, counts, penalty):
     """HF-style repetition penalty. logits (B, V) f32; counts (B, V) int32
     occurrence counts of every token already in the row's context (prompt

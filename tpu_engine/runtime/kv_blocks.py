@@ -1,0 +1,350 @@
+"""Paged KV cache: a static block pool, page tables, and a radix tree of
+shared prompt prefixes.
+
+The continuous scheduler's dense cache reserves `max_seq` columns of HBM
+per slot the moment a row is admitted — a 24-token chat request pins the
+same memory as a 1024-token one, and KV can only be reused on an exact
+whole-prompt repeat (`_PrefixCache`). This module replaces that with the
+vLLM-style layout, kept TPU-native:
+
+- **One static device tensor** per K/V of shape
+  ``(L, num_blocks, block_size, H_kv, D)`` — allocated once, donated
+  through every decode chunk exactly like the dense cache, so the layout
+  stays compiler-visible and nothing retraces as rows come and go
+  (PAPERS.md "Compiler-First … Portable O(1) Autoregressive Caching").
+  Block 0 is the reserved **null block**: unallocated page-table entries
+  point at it, padding scatters dump into it, and it is never attended
+  (the position mask ends at each row's `pos`).
+- **Host-side bookkeeping** (free list, per-block refcounts, the radix
+  tree) under one lock. The lock ALSO serializes device dispatches that
+  touch the pool: decode chunks donate the pool buffers, and the prefill
+  thread's prefix gathers read them — dispatch order under the lock is
+  what keeps a gather from racing a donation (same-device programs
+  execute in dispatch order).
+- **Radix tree over token blocks**: each node is one FULL block of
+  ``block_size`` prompt tokens, keyed by those tokens, holding a
+  refcount on its pool block. A new prompt walks the tree and maps every
+  matched full block straight into its page table (refcount++, zero
+  prefill compute); prefill resumes mid-prompt after the match. Nodes
+  are inserted at admission for each full prompt block, so ANY shared
+  prefix — not just exact repeats — is shared, across requests and
+  buckets (paged rows are 0-aligned: token `i` always lives at logical
+  column `i`).
+- **Refcounts + copy-on-write**: a block is freed only at refcount 0
+  (row released AND no tree node). Rows only ever append into blocks
+  they exclusively own — full shared blocks are read-only by
+  construction — but ``ensure_writable`` enforces it mechanically:
+  writing into a block with refcount > 1 first copies it (one jitted
+  dynamic-slice copy) and swaps the writer's reference.
+- **Eviction**: when allocation runs dry, LRU radix LEAVES whose blocks
+  have refcount 1 (tree-only) are evicted until enough blocks free. A
+  block referenced by any live row is structurally unevictable — its
+  refcount is ≥ 2 while a tree node points at it.
+
+`runtime.scheduler.ContinuousGenerator(kv_block_size=...)` drives this;
+`ops.paged_attention` is the matching attention read path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_engine.models.transformer import TransformerConfig
+from tpu_engine.ops.attention import KVCache
+
+
+class PoolExhausted(RuntimeError):
+    """Raised when an allocation cannot be satisfied even after evicting
+    every evictable radix leaf — callers back off (defer the admission)
+    or complete the starved row early; they must never treat this as a
+    device failure."""
+
+
+class _RadixNode:
+    __slots__ = ("children", "parent", "key", "block_id", "last_used")
+
+    def __init__(self, parent: Optional["_RadixNode"], key, block_id: int):
+        self.children: Dict[tuple, _RadixNode] = {}
+        self.parent = parent
+        self.key = key            # the block's token tuple (len block_size)
+        self.block_id = block_id  # -1 on the root only
+        self.last_used = 0
+
+
+class RadixTree:
+    """Prefix index over FULL token blocks. One node per (path, block of
+    tokens); the node's pool block holds exactly those tokens' KV at
+    logical columns [depth*bs, (depth+1)*bs). All methods assume the
+    owning pool's lock is held."""
+
+    def __init__(self, pool: "BlockPool"):
+        self._pool = pool
+        self.root = _RadixNode(None, None, -1)
+        self.nodes = 0
+        self._clock = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _full_blocks(self, tokens: Sequence[int]) -> List[tuple]:
+        bs = self._pool.block_size
+        return [tuple(tokens[i:i + bs])
+                for i in range(0, (len(tokens) // bs) * bs, bs)]
+
+    def lookup(self, tokens: Sequence[int]) -> List[int]:
+        """Longest-prefix match over full blocks. Returns the matched
+        block ids IN ORDER, each retained once on behalf of the caller
+        (release them when the row frees — or immediately on a discarded
+        admission)."""
+        ids: List[int] = []
+        node = self.root
+        stamp = self._tick()
+        for key in self._full_blocks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = stamp
+            self._pool.retain(child.block_id)
+            ids.append(child.block_id)
+            node = child
+        return ids
+
+    def insert(self, tokens: Sequence[int], block_ids: Sequence[int]) -> int:
+        """Index a row's full prompt blocks. ``block_ids[j]`` is the pool
+        block holding prompt block j (the row's page-table prefix). New
+        nodes retain their block (the tree's own reference); existing
+        nodes are left pointing at their original block — the newcomer's
+        duplicate block simply stays row-private. Returns nodes added."""
+        added = 0
+        node = self.root
+        stamp = self._tick()
+        for j, key in enumerate(self._full_blocks(tokens)):
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(node, key, int(block_ids[j]))
+                node.children[key] = child
+                self._pool.retain(child.block_id)
+                self.nodes += 1
+                added += 1
+            child.last_used = stamp
+            node = child
+        return added
+
+    def _evictable(self) -> List[_RadixNode]:
+        out, stack = [], [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                if c.children:
+                    stack.append(c)
+                elif self._pool.refcount(c.block_id) == 1:
+                    out.append(c)  # leaf, tree-only reference
+        return out
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` pool blocks by dropping LRU leaves
+        whose blocks nothing but the tree references. Never touches a
+        block a live row holds (refcount ≥ 2). Returns blocks freed."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable()
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.last_used)
+            for leaf in leaves:
+                if freed >= n_blocks:
+                    break
+                del leaf.parent.children[leaf.key]
+                self._pool.release(leaf.block_id)
+                self.nodes -= 1
+                self._pool.evictions += 1
+                freed += 1
+        return freed
+
+    def clear(self) -> None:
+        """Drop every node (weight reload: cached KV is stale). Blocks
+        still referenced by live rows survive until those rows free."""
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                stack.append(c)
+                self._pool.release(c.block_id)
+        self.root = _RadixNode(None, None, -1)
+        self.nodes = 0
+
+
+class BlockPool:
+    """Device block pool + host bookkeeping for the paged KV cache."""
+
+    def __init__(self, cfg: TransformerConfig, num_blocks: int,
+                 block_size: int, dtype=jnp.bfloat16, device=None):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._dtype = dtype
+        self._device = device
+        # One lock for bookkeeping AND pool-touching dispatch ordering
+        # (module docstring). RLock: eviction runs inside alloc.
+        self.lock = threading.RLock()
+        # Bumped by reset(): pins taken against an older generation are
+        # void (the refcount table was rebuilt wholesale) — holders must
+        # compare generations instead of releasing stale ids.
+        self.generation = 0
+        self.caches = self._init_device()
+        self._ref = np.zeros((self.num_blocks,), np.int32)
+        self._ref[0] = 1  # null block: permanently pinned, never allocated
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self.radix = RadixTree(self)
+        self._copy_exe = None
+        # Counters for /stats, /metrics, and the paged-ab bench.
+        self.prefix_hit_tokens = 0
+        self.prefilled_tokens = 0
+        self.evictions = 0
+        self.cow_copies = 0
+
+    def _init_device(self) -> KVCache:
+        shape = (self.cfg.n_layers, self.num_blocks, self.block_size,
+                 self.cfg.kv_heads, self.cfg.d_head)
+        caches = KVCache(jnp.zeros(shape, self._dtype),
+                         jnp.zeros(shape, self._dtype))
+        if self._device is not None:
+            caches = jax.device_put(caches, self._device)
+        return caches
+
+    # -- bookkeeping (hold self.lock) -----------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def refcount(self, block_id: int) -> int:
+        return int(self._ref[block_id])
+
+    def evictable_blocks(self) -> int:
+        return len(self.radix._evictable())
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free) + self.evictable_blocks()
+
+    def alloc(self, n: int) -> List[int]:
+        """n fresh blocks (refcount 1 each), evicting radix leaves LRU
+        when the free list runs short. Raises PoolExhausted (state
+        unchanged) when even eviction cannot cover the request."""
+        if n > len(self._free):
+            self.radix.evict(n - len(self._free))
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free and nothing "
+                f"evictable ({self.num_blocks} total)")
+        ids = [self._free.pop() for _ in range(n)]
+        for i in ids:
+            self._ref[i] = 1
+        return ids
+
+    def retain(self, block_id: int) -> None:
+        assert self._ref[block_id] > 0, "retain of a free block"
+        self._ref[block_id] += 1
+
+    def release(self, block_id: int) -> None:
+        if block_id == 0:
+            return  # null block: permanent
+        self._ref[block_id] -= 1
+        assert self._ref[block_id] >= 0, "double free"
+        if self._ref[block_id] == 0:
+            self._free.append(block_id)
+
+    def release_many(self, block_ids: Sequence[int]) -> None:
+        for i in block_ids:
+            self.release(i)
+
+    def ensure_writable(self, block_id: int) -> Tuple[int, bool]:
+        """Copy-on-write: a caller about to APPEND into ``block_id``
+        gets a private copy when anything else (tree node, other row)
+        also references it. Returns (writable id, copied?). The caller
+        swaps its page-table entry and drops its old reference; the
+        scheduler's append path never actually shares (only full blocks
+        enter the tree), so this is the mechanical guard for the
+        invariant, exercised directly by tests."""
+        if self._ref[block_id] <= 1:
+            return block_id, False
+        if self._copy_exe is None:
+            def copy_block(caches, src, dst):
+                k = jax.lax.dynamic_slice_in_dim(caches.k, src, 1, axis=1)
+                v = jax.lax.dynamic_slice_in_dim(caches.v, src, 1, axis=1)
+                return KVCache(
+                    jax.lax.dynamic_update_slice_in_dim(caches.k, k, dst,
+                                                        axis=1),
+                    jax.lax.dynamic_update_slice_in_dim(caches.v, v, dst,
+                                                        axis=1))
+
+            self._copy_exe = jax.jit(copy_block, donate_argnums=(0,))
+        new_id = self.alloc(1)[0]
+        self.caches = self._copy_exe(self.caches,
+                                     jnp.int32(block_id), jnp.int32(new_id))
+        self.release(block_id)
+        self.cow_copies += 1
+        return new_id, True
+
+    def reset(self) -> None:
+        """Post-device-failure recovery: the donated pool buffers may be
+        invalid — rebuild everything (mirrors the dense scheduler's
+        `_recover`)."""
+        self.generation += 1
+        self.caches = self._init_device()
+        self._ref[:] = 0
+        self._ref[0] = 1
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self.radix = RadixTree(self)
+
+    def stats(self) -> dict:
+        with self.lock:
+            shared = int(np.sum(self._ref[1:] > 1))
+            hit, filled = self.prefix_hit_tokens, self.prefilled_tokens
+            return {
+                "blocks_total": self.num_blocks - 1,  # null excluded
+                "block_size": self.block_size,
+                "blocks_free": len(self._free),
+                "blocks_shared": shared,
+                "radix_nodes": self.radix.nodes,
+                "evictions": self.evictions,
+                "cow_copies": self.cow_copies,
+                "prefix_hit_tokens": hit,
+                "prefilled_tokens": filled,
+                "prefix_savings_frac": round(hit / (hit + filled), 4)
+                if hit + filled else 0.0,
+            }
+
+
+# -- device-side block movement (jitted by the scheduler per bucket) ----------
+
+def gather_blocks(pool_k, pool_v, ids):
+    """(L, NB, bs, H, D) pools + (nb,) block ids -> one row-cache KVCache
+    (L, 1, nb*bs, H, D): logical column j*bs+o reads pool[ids[j], o].
+    Padding entries point at the null block; their columns carry garbage
+    the position mask must exclude."""
+    L, _, bs, h, d = pool_k.shape
+    nb = ids.shape[0]
+    k = pool_k[:, ids].reshape(L, 1, nb * bs, h, d)
+    v = pool_v[:, ids].reshape(L, 1, nb * bs, h, d)
+    return KVCache(k, v)
+
+
+def scatter_blocks(caches, row_k, row_v, ids):
+    """Write a prefilled (L, 1, nb*bs, H, D) row cache into pool blocks
+    ``ids`` (the admission half of paging). Entries mapped to 0 dump
+    into the null block — the scheduler points radix-matched prefix
+    blocks there so shared blocks are never rewritten. Donate `caches`."""
+    L, nb = caches.k.shape[0], ids.shape[0]
+    bs, h, d = caches.k.shape[2], caches.k.shape[3], caches.k.shape[4]
+    rk = row_k.reshape(L, nb, bs, h, d).astype(caches.k.dtype)
+    rv = row_v.reshape(L, nb, bs, h, d).astype(caches.v.dtype)
+    return KVCache(caches.k.at[:, ids].set(rk), caches.v.at[:, ids].set(rv))

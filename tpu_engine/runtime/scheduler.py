@@ -29,6 +29,7 @@ Generator.generate.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -45,6 +46,7 @@ from tpu_engine.models.transformer import (
     TransformerConfig,
     init_caches,
     transformer_decode_rows,
+    transformer_decode_rows_paged,
     transformer_decode_window,
     transformer_prefill,
 )
@@ -52,8 +54,15 @@ from tpu_engine.runtime.generator import (
     _DTYPES,
     _sample,
     apply_repetition_penalty,
+    right_pad_prompt,
     start_host_copies,
     token_counts,
+)
+from tpu_engine.runtime.kv_blocks import (
+    BlockPool,
+    PoolExhausted,
+    gather_blocks,
+    scatter_blocks,
 )
 from tpu_engine.utils.deadline import Deadline, DeadlineExceeded
 from tpu_engine.utils.sampling import (
@@ -92,6 +101,12 @@ class _Request:
     sink: Optional[object] = None
     t_submit: float = 0.0
     t_admit: float = 0.0
+
+
+class _StaleAdmission(RuntimeError):
+    """A prefilled item's pool pins/gather predate a pool rebuild
+    (device recovery): the single request fails, the scheduler keeps
+    serving (no second recovery)."""
 
 
 class _PrefixCache:
@@ -161,7 +176,19 @@ class ContinuousGenerator:
         device=None,
         prefix_cache_mb: int = 64,
         prefill_chunk: int = 256,
+        kv_block_size: int = 0,
+        kv_blocks: int = 0,
+        prefix_sharing: bool = True,
     ):
+        """`kv_block_size` > 0 switches the KV cache from one dense
+        (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
+        pool (runtime.kv_blocks) of `kv_blocks` blocks of that many
+        columns each (0 = auto: the dense layout's capacity), per-row
+        block tables, and — with `prefix_sharing` — a radix tree that
+        maps any shared prompt prefix onto already-filled blocks and
+        resumes prefill mid-prompt. 0 (default) keeps the dense cache:
+        behavior, compiled executables, and streams are exactly the
+        pre-paging scheduler's."""
         if isinstance(model, str):
             _ensure_builtin_models_imported()
             model = create_model(model)
@@ -187,11 +214,44 @@ class ContinuousGenerator:
         if device is not None:
             self.params = jax.device_put(self.params, device)
 
-        # Device state: one persistent KV cache + per-row vectors.
-        self._caches = init_caches(self.cfg, self.n_slots, self.max_seq,
-                                   self._dtype)
-        if device is not None:
-            self._caches = jax.device_put(self._caches, device)
+        # Device state: one persistent KV cache + per-row vectors. Paged
+        # mode replaces the dense per-slot cache with a block pool +
+        # per-row block tables (runtime.kv_blocks); everything else —
+        # row vectors, sampling, admission — is layout-independent.
+        self._paged = int(kv_block_size) > 0
+        self._caches = None
+        self._pool: Optional[BlockPool] = None
+        if self._paged:
+            bs = int(kv_block_size)
+            if self.cfg.sliding_window is not None:
+                raise ValueError("paged KV cache does not support "
+                                 "sliding_window models yet")
+            bad = [b for b in self._prompt_buckets if b % bs]
+            if bad:
+                raise ValueError(
+                    f"kv_block_size={bs} must divide every prompt bucket "
+                    f"(violates {bad}); pick a power of two <= "
+                    f"{self._prompt_buckets[0]}")
+            width = -(-self.max_seq // bs)  # blocks per full-length row
+            nb = int(kv_blocks) if kv_blocks else self.n_slots * width + 1
+            if nb < width + 1:
+                raise ValueError(
+                    f"kv_blocks={nb} cannot hold even one max_seq row "
+                    f"({width} blocks + the null block)")
+            self._pool = BlockPool(self.cfg, nb, bs, self._dtype, device)
+            self._tables = np.zeros((self.n_slots, width), np.int32)
+            self._row_blocks: List[List[int]] = [[] for _ in
+                                                 range(self.n_slots)]
+            self._prefix_sharing = bool(prefix_sharing)
+            # Admissions deferred on pool pressure, retried as rows free.
+            self._pending: "collections.deque" = collections.deque()
+            self._gather_exe = {}   # {n_blocks: compiled prefix gather}
+            self._scatter_exe = {}  # {n_blocks: compiled block scatter}
+        else:
+            self._caches = init_caches(self.cfg, self.n_slots, self.max_seq,
+                                       self._dtype)
+            if device is not None:
+                self._caches = jax.device_put(self._caches, device)
         self._pos = np.zeros((self.n_slots,), np.int32)      # next write col
         self._start = np.zeros((self.n_slots,), np.int32)    # first valid col
         self._tok = np.zeros((self.n_slots,), np.int32)      # last emitted
@@ -404,6 +464,100 @@ class ContinuousGenerator:
                     donate_argnums=(1, 12) if controls else (1,))
             return self._decode_exe[controls]
 
+    # -- paged compiled stages -------------------------------------------------
+
+    def _gather(self, nb: int):
+        """Prefix gather for one bucket width: (pool, nb block ids) ->
+        the row's (L, 1, nb*bs, H, D) cache view. Read-only on the pool
+        — dispatched by the prefill thread under the pool lock so it
+        orders before the decode thread's donating chunk."""
+        exe = self._gather_exe.get(nb)
+        if exe is None:
+            with self._exe_lock:
+                exe = self._gather_exe.setdefault(
+                    nb, jax.jit(gather_blocks))
+        return exe
+
+    def _scatter(self, nb: int):
+        """Admission scatter for one bucket width: write a prefilled row
+        cache into its allocated pool blocks (null-block entries absorb
+        radix-matched positions). Donates the pool — decode-thread only,
+        under the pool lock."""
+        exe = self._scatter_exe.get(nb)
+        if exe is None:
+            with self._exe_lock:
+                exe = self._scatter_exe.setdefault(
+                    nb, jax.jit(scatter_blocks, donate_argnums=(0,)))
+        return exe
+
+    def _decode_paged(self, controls: bool):
+        """Compiled decode chunk over the block pool — `_decode` with the
+        per-row cache stripe swapped for (pool, block tables). Paged rows
+        are 0-aligned (no start vector): pos IS the logical position, so
+        the sampling fold positions and rotary phases match the dense
+        path token for token (seeded streams are identical — tested)."""
+        exe = self._decode_exe.get(("paged", controls))
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if ("paged", controls) not in self._decode_exe:
+                from tpu_engine.ops.paged_attention import (
+                    default_paged_attention,
+                )
+
+                cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
+                attn_fn = default_paged_attention()
+                max_col = self.max_seq - 1
+
+                def decode_chunk(params, caches, tables, tok, pos, done,
+                                 seeds, temps, topps, topks, minps,
+                                 eos_vec, counts=None, pens=None,
+                                 stops=None):
+                    rows = jnp.arange(tok.shape[0])
+
+                    def body(carry, _):
+                        if controls:
+                            caches, tok, pos, done, counts = carry
+                        else:
+                            caches, tok, pos, done = carry
+                            counts = None
+                        logits, caches = transformer_decode_rows_paged(
+                            params, tok, caches, tables, pos, cfg,
+                            dtype=dtype, attn_fn=attn_fn)
+                        if controls:
+                            logits = apply_repetition_penalty(
+                                logits, counts, pens)
+                        nxt = _sample(logits, seeds, pos + 1, temps,
+                                      topps, topks, minps)
+                        nxt = jnp.where(done, eos_vec, nxt)
+                        if controls:
+                            counts = counts.at[rows, nxt].add(
+                                (~done).astype(jnp.int32))
+                        done = done | (nxt == eos_vec)
+                        if controls:
+                            done = done | jnp.any(nxt[:, None] == stops,
+                                                  axis=1)
+                        pos = jnp.where(done, pos,
+                                        jnp.minimum(pos + 1, max_col))
+                        if controls:
+                            return (caches, nxt, pos, done, counts), nxt
+                        return (caches, nxt, pos, done), nxt
+
+                    if controls:
+                        (caches, tok, pos, done, counts), toks = \
+                            jax.lax.scan(body,
+                                         (caches, tok, pos, done, counts),
+                                         None, length=chunk)
+                        return caches, tok, pos, done, counts, toks.T
+                    (caches, tok, pos, done), toks = jax.lax.scan(
+                        body, (caches, tok, pos, done), None, length=chunk)
+                    return caches, tok, pos, done, toks.T
+
+                self._decode_exe[("paged", controls)] = jax.jit(
+                    decode_chunk,
+                    donate_argnums=(1, 12) if controls else (1,))
+            return self._decode_exe[("paged", controls)]
+
     # -- public API ------------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -458,17 +612,26 @@ class ContinuousGenerator:
     def set_params(self, params) -> None:
         """Hot weight swap. The prefix cache holds (logits, KV) computed
         under the OLD weights — serving them against new weights would mix
-        models mid-stream, so it empties with the swap. In-flight rows
-        finish their current chunk on whichever params reference the chunk
-        captured; subsequent chunks use the new weights (acceptable for a
-        reload; stop the scheduler first for a hard cut)."""
+        models mid-stream, so it empties with the swap (paged mode: the
+        radix tree clears the same way; blocks still pinned by in-flight
+        rows free as those rows finish). In-flight rows finish their
+        current chunk on whichever params reference the chunk captured;
+        subsequent chunks use the new weights (acceptable for a reload;
+        stop the scheduler first for a hard cut)."""
         self.params = params
         self._prefix_cache = _PrefixCache(self._prefix_cache.budget)
+        if self._paged:
+            with self._pool.lock:
+                self._pool.radix.clear()
 
     def stats(self) -> dict:
-        return dict(self._stats, n_slots=self.n_slots,
-                    active=int(sum(r is not None for r in self._row_req)),
-                    prefix_cache=self._prefix_cache.stats())
+        out = dict(self._stats, n_slots=self.n_slots,
+                   active=int(sum(r is not None for r in self._row_req)),
+                   prefix_cache=self._prefix_cache.stats())
+        if self._paged:
+            out["kv_pool"] = self._pool.stats()
+            out["kv_pool"]["pending_admissions"] = len(self._pending)
+        return out
 
     def stop(self) -> None:
         self._running = False
@@ -483,6 +646,7 @@ class ContinuousGenerator:
             except queue.Empty:
                 break
             if item is not None:
+                self._discard_item(item)
                 self._fail_request(item[0], RuntimeError("scheduler stopped"))
 
     # -- scheduler loop --------------------------------------------------------
@@ -565,7 +729,117 @@ class ContinuousGenerator:
         except queue.Full:
             pass
 
+    def _first_token(self, req: _Request, logits, prompt, L: int):
+        """Sample the request's first token from its prefill logits at
+        logical position L — the one sampling rule both cache layouts
+        share (fold_in(seed, position): batch- and layout-independent).
+        Returns (first_tok, row_counts or None)."""
+        seed = int(req.seed) & 0x7FFFFFFF
+        row_counts = None
+        first_logits = jnp.asarray(logits)[None, :]
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            row_counts = token_counts([prompt], 1, self.cfg.vocab)
+            if req.rep_penalty != 1.0:
+                first_logits = apply_repetition_penalty(
+                    first_logits, jnp.asarray(row_counts),
+                    jnp.asarray([req.rep_penalty], jnp.float32))
+        first = _sample(
+            first_logits,
+            jnp.asarray([seed], jnp.int32),
+            jnp.asarray([L], jnp.int32),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.min_p], jnp.float32))
+        first_tok = int(first[0])
+        if row_counts is not None:
+            row_counts[0, first_tok] += 1  # first token joins the context
+        return first_tok, row_counts
+
+    def _run_prefill_paged(self, req: _Request):
+        """Paged admission prefill: 0-aligned (RIGHT-padded) row cache,
+        radix longest-prefix match, prefill resumed mid-prompt past the
+        matched blocks. Runs on the prefill thread; the only shared-state
+        touches are the radix lookup and the prefix gather, both under
+        the pool lock (the lock also orders the gather's dispatch before
+        any decode chunk's pool donation)."""
+        pool = self._pool
+        bs = pool.block_size
+        pb = next((b for b in self._prompt_buckets if b >= len(req.prompt)),
+                  self._prompt_buckets[-1])
+        prompt = req.prompt[-pb:]
+        L = len(prompt)
+        Leff = max(L, 1)  # empty prompts sample from the zero-token column
+        tokens = right_pad_prompt(prompt, pb)
+
+        matched: List[int] = []
+        t0 = time.perf_counter()
+        with pool.lock:
+            gen = pool.generation
+            if self._prefix_sharing:
+                matched = pool.radix.lookup(prompt)  # pins for this row
+        m_tok = len(matched) * bs
+        try:
+            if matched:
+                # The gather IS the row cache init on a hit: matched
+                # columns carry the shared prefix, the rest null-block
+                # garbage the windows overwrite / the position mask hides.
+                ids = np.zeros((pb // bs,), np.int32)
+                ids[:len(matched)] = matched
+                with pool.lock:  # dispatch-order fence vs pool donation
+                    row_caches = self._gather(pb // bs)(
+                        pool.caches.k, pool.caches.v, jnp.asarray(ids))
+            else:
+                row_caches = init_caches(self.cfg, 1, pb, self._dtype)
+                if self._device is not None:
+                    row_caches = jax.device_put(row_caches, self._device)
+            if req.sink is not None:
+                dur_us = (time.perf_counter() - t0) * 1e6
+                req.sink.stage("radix_lookup", dur_us,
+                               start_ts=time.time() - dur_us / 1e6,
+                               matched_tokens=m_tok)
+            # Resume prefill at the BLOCK boundary at/below the match —
+            # the matched tokens' compute is skipped entirely (the whole
+            # point of sharing), and window starts stay block-aligned so
+            # the compiled-width set is bounded (multiples of block_size
+            # up to the prefill chunk, materialized lazily). Always runs
+            # at least the window holding position L-1, whose logits seed
+            # the first sample — an exact whole-prompt match recomputes
+            # that one block so sampling params stay OUT of the radix
+            # key (logits are never cached, seeds stay per-request).
+            w = self._prefill_chunk
+            if not 0 < w < pb:
+                w = pb
+            win_exe = self._window()
+            p0 = (min(m_tok, Leff - 1) // bs) * bs
+            logits = None
+            w0 = p0
+            while w0 <= Leff - 1:
+                width = min(w, pb - w0)
+                head = "all" if w0 <= Leff - 1 < w0 + width else "none"
+                wlog, row_caches = win_exe(
+                    self.params, jnp.asarray(tokens[:, w0:w0 + width]),
+                    row_caches, jnp.asarray([w0], jnp.int32),
+                    jnp.asarray([0], jnp.int32), head)
+                if head == "all":
+                    logits = wlog[0, Leff - 1 - w0]
+                w0 += width
+            with pool.lock:
+                pool.prefix_hit_tokens += p0
+                pool.prefilled_tokens += Leff - p0
+            first_tok, row_counts = self._first_token(req, logits, prompt, L)
+        except BaseException:
+            if matched:
+                with pool.lock:
+                    if pool.generation == gen:  # void after a pool reset
+                        pool.release_many(matched)
+            raise
+        return (req, row_caches, first_tok, pb, L, row_counts, matched,
+                prompt, gen)
+
     def _run_prefill(self, req: _Request):
+        if self._paged:
+            return self._run_prefill_paged(req)
         pb = next((b for b in self._prompt_buckets if b >= len(req.prompt)),
                   self._prompt_buckets[-1])
         prompt = req.prompt[-pb:]
@@ -577,7 +851,6 @@ class ContinuousGenerator:
         attn[0, pb - L:] = 1
         pos_ids[0, pb - L:] = np.arange(L)
 
-        seed = int(req.seed) & 0x7FFFFFFF
         # Prefix cache: an exact repeat of a (bucket, prompt) skips the
         # prompt forward entirely; the cached KV block is read-only (row
         # insertion copies it into the shared cache, never donates it), so
@@ -631,44 +904,94 @@ class ContinuousGenerator:
         # First token from the prefill logits at logical position L (same
         # fold_in(seed, position) scheme as decode — batch-independent),
         # penalized by the PROMPT's token counts like every later step.
-        # Count bookkeeping exists only for requests that need it
-        # (penalty != 1 or stop tokens — the latter ride the same
-        # controls decode variant, which carries the counts buffer).
-        row_counts = None
-        first_logits = jnp.asarray(logits)[None, :]
-        if req.rep_penalty != 1.0 or req.stop_tokens:
-            row_counts = token_counts([prompt], 1, self.cfg.vocab)
-            if req.rep_penalty != 1.0:
-                first_logits = apply_repetition_penalty(
-                    first_logits, jnp.asarray(row_counts),
-                    jnp.asarray([req.rep_penalty], jnp.float32))
-        first = _sample(
-            first_logits,
-            jnp.asarray([seed], jnp.int32),
-            jnp.asarray([L], jnp.int32),
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_p], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.min_p], jnp.float32))
-        first_tok = int(first[0])
-        if row_counts is not None:
-            row_counts[0, first_tok] += 1  # first token joins the context
+        first_tok, row_counts = self._first_token(req, logits, prompt, L)
         return req, row_caches, first_tok, pb, L, row_counts
 
-    def _admit(self, item, row: int) -> None:
-        """Decode-thread half of admission: splice the prefilled KV block
-        into the shared cache and initialise the row's host-side state."""
-        req, row_caches, first_tok, pb, L, row_counts = item
-        req.t_admit = time.perf_counter()
+    def _admit_paged(self, item, row: int) -> None:
+        """Decode-thread half of paged admission: allocate the bucket's
+        fresh blocks (radix-matched prefix blocks are already pinned and
+        simply enter the table), scatter the prefilled row cache into
+        them, and index the prompt's full blocks in the radix tree.
+        Raises PoolExhausted (nothing consumed) when even eviction can't
+        cover the allocation — the caller defers the admission."""
+        (req, row_caches, first_tok, pb, L, row_counts, matched, prompt,
+         gen) = item
+        pool = self._pool
+        bs = pool.block_size
+        nb_bucket = pb // bs
+        m = len(matched)
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        first_col = min(L, self.max_seq - 1)  # first decode write column
+        with pool.lock:
+            if gen != pool.generation:
+                # The pool was rebuilt (device recovery) while this item
+                # sat prefilled: its gathered KV and pins are void.
+                raise _StaleAdmission(
+                    "kv pool was rebuilt during this request's admission")
+            # Cover the bucket AND the first decode chunk's columns so
+            # the chunk never writes through an unallocated table entry.
+            cols = min(first_col + self._step_chunk + 1, self.max_seq)
+            need = max(nb_bucket, (cols - 1) // bs + 1)
+            fresh = pool.alloc(need - m)  # PoolExhausted -> defer
+            ids = np.zeros((nb_bucket,), np.int32)
+            ids[m:] = fresh[:nb_bucket - m]  # matched slots -> null block
+            table = list(matched) + fresh
+            # Tail block the row will append into must be private — full
+            # shared blocks make this structurally true; COW is the
+            # mechanical backstop (kv_blocks.ensure_writable). A deferral
+            # raised past this point must hand the fresh blocks back, or
+            # every retry would leak an allocation.
+            try:
+                wid, copied = pool.ensure_writable(table[first_col // bs])
+            except PoolExhausted:
+                pool.release_many(fresh)
+                raise
+            if copied:
+                table[first_col // bs] = wid
+            pool.caches = self._scatter(nb_bucket)(
+                pool.caches, row_caches.k, row_caches.v, jnp.asarray(ids))
+            if self._prefix_sharing:
+                pool.radix.insert(prompt, table)
+        self._tables[row, :] = 0
+        self._tables[row, :len(table)] = table
+        self._row_blocks[row] = table
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("kv_alloc", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           blocks=len(table), shared_blocks=m)
         if row_counts is not None:
-            self._caches, self._counts = self._insert(True)(
-                self._caches, row_caches.k, row_caches.v, row,
-                self._ensure_counts(), jnp.asarray(row_counts[0]))
-        else:
-            self._caches = self._insert(False)(
-                self._caches, row_caches.k, row_caches.v, row)
-        self._start[row] = pb - L
-        self._pos[row] = pb
+            # Counts splice is an eager scatter here (the KV went through
+            # the pool scatter above; no fused insert executable needed).
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._init_row(req, row, first_tok, pos=first_col, start=0)
+
+    def _release_row_blocks(self, row: int) -> None:
+        """Return a freed row's block references to the pool (blocks the
+        radix tree also references survive at refcount >= 1)."""
+        if not self._paged or not self._row_blocks[row]:
+            return
+        with self._pool.lock:
+            self._pool.release_many(self._row_blocks[row])
+        self._row_blocks[row] = []
+        self._tables[row, :] = 0
+
+    def _discard_item(self, item) -> None:
+        """Release a prefilled-but-never-admitted item's radix pins
+        (deadline drop, shutdown drain). Safe on dense items; pins taken
+        against a reset-away pool generation are void, not released."""
+        if self._paged and item is not None and len(item) >= 9 and item[6]:
+            with self._pool.lock:
+                if item[8] == self._pool.generation:
+                    self._pool.release_many(item[6])
+
+    def _init_row(self, req: _Request, row: int, first_tok: int, *,
+                  pos: int, start: int) -> None:
+        """Host-side row state shared by both admission paths."""
+        self._start[row] = start
+        self._pos[row] = pos
         self._seeds[row] = int(req.seed) & 0x7FFFFFFF
         self._temps[row] = req.temperature
         self._topps[row] = req.top_p
@@ -685,6 +1008,23 @@ class ContinuousGenerator:
         self._stats["admitted"] += 1
         self._push_stream(row, req)  # first token flushes at admission
         self._maybe_complete(row)
+
+    def _admit(self, item, row: int) -> None:
+        """Decode-thread half of admission: splice the prefilled KV block
+        into the shared cache and initialise the row's host-side state."""
+        if self._paged:
+            self._admit_paged(item, row)
+            return
+        req, row_caches, first_tok, pb, L, row_counts = item
+        req.t_admit = time.perf_counter()
+        if row_counts is not None:
+            self._caches, self._counts = self._insert(True)(
+                self._caches, row_caches.k, row_caches.v, row,
+                self._ensure_counts(), jnp.asarray(row_counts[0]))
+        else:
+            self._caches = self._insert(False)(
+                self._caches, row_caches.k, row_caches.v, row)
+        self._init_row(req, row, first_tok, pos=pb, start=pb - L)
 
     def _visible_tokens(self, row: int, req: _Request) -> List[int]:
         """The request's client-visible tokens so far: budget-capped and
@@ -726,6 +1066,7 @@ class ContinuousGenerator:
             self._row_req[row] = None
             self._row_emitted[row] = []
             self._done[row] = True
+            self._release_row_blocks(row)
             self._stats["completed"] += 1
 
     def _cancel_expired_rows(self) -> None:
@@ -743,6 +1084,7 @@ class ContinuousGenerator:
                 self._row_req[r] = None
                 self._row_emitted[r] = []
                 self._done[r] = True
+                self._release_row_blocks(r)
 
     def _recover(self, exc: BaseException) -> None:
         """Device-step failure recovery. The prefill/decode executables
@@ -762,11 +1104,20 @@ class ContinuousGenerator:
         self._tok[:] = 0
         self._done[:] = True
         self._stats["failures"] = self._stats.get("failures", 0) + 1
-        caches = init_caches(self.cfg, self.n_slots, self.max_seq,
-                             self._dtype)
-        if self._device is not None:
-            caches = jax.device_put(caches, self._device)
-        self._caches = caches
+        if self._paged:
+            # The donated pool buffers may be invalid: rebuild the pool,
+            # dropping the radix tree (its blocks died with the pool).
+            with self._pool.lock:
+                self._pool.reset()
+            self._tables[:, :] = 0
+            for r in range(self.n_slots):
+                self._row_blocks[r] = []
+        else:
+            caches = init_caches(self.cfg, self.n_slots, self.max_seq,
+                                 self._dtype)
+            if self._device is not None:
+                caches = jax.device_put(caches, self._device)
+            self._caches = caches
         self._counts = None  # donated alongside — realloc lazily if needed
 
     def _loop(self) -> None:
@@ -786,42 +1137,139 @@ class ContinuousGenerator:
                     self._fail_request(req, exc)
                     self._row_req[r] = None
                     self._row_emitted[r] = []
+                self._release_row_blocks(r)
+            if self._paged:
+                while self._pending:
+                    item = self._pending.popleft()
+                    self._discard_item(item)
+                    self._fail_request(item[0], exc)
             while True:
                 try:
                     item = self._ready.get_nowait()
                 except queue.Empty:
                     break
                 if item is not None:
+                    self._discard_item(item)
                     self._fail_request(item[0], exc)
+
+    def _ensure_capacity_paged(self) -> None:
+        """Pre-chunk block growth: every live row must own blocks through
+        the columns the next chunk can write (a write through an
+        unallocated table entry would land in the null block and the row
+        would attend garbage). A row the pool cannot grow — even after
+        radix eviction — completes early with the tokens it has (counted
+        in stats as pool_starved) rather than corrupting; admissions are
+        deferred behind live-row growth, so this is the last resort."""
+        pool = self._pool
+        bs = pool.block_size
+        for r, req in enumerate(self._row_req):
+            if req is None or self._done[r]:
+                continue  # done rows rewrite their own (allocated) column
+            last_col = min(int(self._pos[r]) + self._step_chunk,
+                           self.max_seq - 1)
+            need = last_col // bs + 1
+            have = len(self._row_blocks[r])
+            if need <= have:
+                continue
+            try:
+                with pool.lock:
+                    fresh = pool.alloc(need - have)
+            except PoolExhausted:
+                self._stats["pool_starved"] = (
+                    self._stats.get("pool_starved", 0) + 1)
+                self._done[r] = True
+                self._maybe_complete(r)
+                continue
+            self._tables[r, have:need] = fresh
+            self._row_blocks[r].extend(fresh)
 
     def _loop_body(self) -> None:
         while self._running:
-            # Admit as many prefilled requests as there are free rows; block
-            # briefly when completely idle.
+            # Live rows' block growth outranks new admissions for pool
+            # space (an admitted row must never be starved mid-stream by
+            # a newcomer).
+            if self._paged:
+                self._ensure_capacity_paged()
+            # Admit as many prefilled requests as there are free rows —
+            # deferred (pool-pressure) admissions first, in arrival
+            # order; block briefly when completely idle.
             free = self._free_rows()
             admitted_any = False
             while free:
-                try:
-                    item = self._ready.get(
-                        timeout=0.02 if not admitted_any and len(free) == self.n_slots
-                        else 0.0)
-                except queue.Empty:
-                    break
+                from_pending = bool(self._paged and self._pending)
+                if from_pending:
+                    item = self._pending[0]
+                else:
+                    try:
+                        item = self._ready.get(
+                            timeout=0.02 if not admitted_any
+                            and len(free) == self.n_slots else 0.0)
+                    except queue.Empty:
+                        break
                 if item is None:
                     return
                 req = item[0]
                 if req.deadline is not None and req.deadline.expired():
                     # Prefilled but the budget ran out before a row freed:
                     # drop the KV block instead of occupying a slot.
+                    if from_pending:
+                        self._pending.popleft()
+                    self._discard_item(item)
                     self._cancel_deadline(
                         req, "deadline expired before row admission")
                     continue
                 try:
-                    self._admit(item, free.pop(0))
+                    self._admit(item, free[0])
+                    free.pop(0)
+                    if from_pending:
+                        self._pending.popleft()
                     admitted_any = True
+                except PoolExhausted as exc:
+                    # No blocks even after eviction. A request larger
+                    # than the whole pool can never admit — fail it;
+                    # otherwise park it until completions free blocks.
+                    bs = self._pool.block_size
+                    cols = min(min(item[4], self.max_seq - 1)
+                               + self._step_chunk + 1, self.max_seq)
+                    nb_need = max(item[3] // bs, (cols - 1) // bs + 1)
+                    if nb_need > self._pool.num_blocks - 1:
+                        if from_pending:
+                            self._pending.popleft()
+                        self._discard_item(item)
+                        self._fail_request(req, ValueError(
+                            f"prompt needs {nb_need} KV blocks but the "
+                            f"pool holds {self._pool.num_blocks - 1}"))
+                        continue
+                    if not from_pending:
+                        # Park WITHOUT the radix pins: a parked item
+                        # holding pins makes its prefix unevictable,
+                        # and two mutually-pinned parked items with no
+                        # live rows would starve each other forever.
+                        # Dropping them is fully correct — the row
+                        # cache already holds the gathered prefix KV,
+                        # so the retry scatters every bucket block
+                        # itself (it just shares nothing).
+                        self._discard_item(item)
+                        item = item[:6] + ([], item[7], item[8])
+                        self._pending.append(item)
+                    if all(r is None for r in self._row_req):
+                        # Nothing decoding => nothing will free blocks
+                        # except concurrent radix pins draining; don't
+                        # spin at full speed waiting for them.
+                        time.sleep(0.005)
+                    break
+                except _StaleAdmission as exc:
+                    # Per-request casualty of a pool rebuild — fail it,
+                    # keep admitting (the pool itself is healthy again).
+                    if from_pending:
+                        self._pending.popleft()
+                    self._fail_request(req, exc)
+                    continue
                 except Exception as exc:
                     # Row insertion donates the shared cache — treat any
                     # admit failure as a device-state loss.
+                    if from_pending:
+                        self._pending.popleft()
                     self._fail_request(item[0], exc)
                     self._recover(exc)
                     break
@@ -843,7 +1291,31 @@ class ContinuousGenerator:
                     if req is not None and (req.rep_penalty != 1.0
                                             or req.stop_tokens):
                         controls = True
-                if controls:
+                if self._paged:
+                    # Pool-donating dispatch under the pool lock so the
+                    # prefill thread's prefix gathers order before it.
+                    with self._pool.lock:
+                        common = (self.params, self._pool.caches,
+                                  jnp.asarray(self._tables),
+                                  jnp.asarray(self._tok),
+                                  jnp.asarray(self._pos),
+                                  jnp.asarray(self._done),
+                                  jnp.asarray(self._seeds),
+                                  jnp.asarray(self._temps),
+                                  jnp.asarray(self._topps),
+                                  jnp.asarray(self._topks),
+                                  jnp.asarray(self._minps),
+                                  jnp.asarray(eos_vec))
+                        if controls:
+                            (self._pool.caches, tok, pos, done,
+                             self._counts, toks) = self._decode_paged(True)(
+                                *common, self._ensure_counts(),
+                                jnp.asarray(self._pens),
+                                jnp.asarray(self._stops))
+                        else:
+                            (self._pool.caches, tok, pos, done,
+                             toks) = self._decode_paged(False)(*common)
+                elif controls:
                     (self._caches, tok, pos, done, self._counts,
                      toks) = self._decode(True)(
                         self.params, self._caches, jnp.asarray(self._tok),

@@ -266,7 +266,28 @@ def serve_combined(
     routes[("POST", "/generate")] = lambda body: (200, gateway.route_generate(body))
     routes[("POST", "/generate/stream")] = (
         lambda body: (200, gateway.route_generate_stream(body)))
-    routes[("GET", "/stats")] = lambda _body: (200, gateway.get_stats())
+
+    def _stats(_body):
+        """Gateway /stats, plus per-lane paged-KV pool health when a
+        decode lane runs the paged cache (additive key; the
+        reference-exact schema is untouched for dense deployments)."""
+        out = gateway.get_stats()
+        kv = {}
+        for w in workers:
+            gen = getattr(w, "generator", None)
+            if gen is None or not hasattr(gen, "stats"):
+                continue
+            try:
+                pool = gen.stats().get("kv_pool")
+            except Exception:
+                pool = None
+            if pool:
+                kv[w.node_id] = pool
+        if kv:
+            out["kv_pool"] = kv
+        return 200, out
+
+    routes[("GET", "/stats")] = _stats
     # Lane health is addressable through the gateway process in combined mode.
     for w in workers:
         routes[("GET", f"/health/{w.node_id}")] = lambda _b, w=w: (200, w.get_health())

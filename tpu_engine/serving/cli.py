@@ -238,6 +238,22 @@ def main(argv=None) -> int:
                             help="continuous-scheduler prefix cache budget "
                                  "(device KV MB; repeated prompts skip "
                                  "prefill; 0 disables)")
+        parser.add_argument("--kv-block-size", type=int, default=0,
+                            help="paged KV cache (continuous scheduler): "
+                                 "columns per block, e.g. 16 or 32. Rows "
+                                 "reserve blocks for the tokens they hold "
+                                 "instead of max_seq each — several times "
+                                 "more concurrent rows at the same HBM. "
+                                 "0 (default) keeps the dense cache")
+        parser.add_argument("--kv-blocks", type=int, default=0,
+                            help="paged pool size in blocks (0 = auto: "
+                                 "the dense layout's capacity)")
+        parser.add_argument("--prefix-sharing", choices=["on", "off"],
+                            default="on",
+                            help="block-level radix prefix sharing (paged "
+                                 "mode): shared prompt prefixes reuse "
+                                 "already-filled KV blocks and skip their "
+                                 "prefill compute")
         parser.add_argument("--quantize", choices=["int8"], default=None,
                             help="weight-only quantization: dense/conv "
                                  "kernels stored int8 with per-channel "
@@ -292,6 +308,10 @@ def main(argv=None) -> int:
                                      gen_spec_k=args.gen_spec_k,
                                      gen_prefix_cache_mb=args.gen_prefix_cache_mb,
                                      gen_prefill_chunk=args.gen_prefill_chunk,
+                                     gen_kv_block_size=args.kv_block_size,
+                                     gen_kv_blocks=args.kv_blocks,
+                                     gen_prefix_sharing=(
+                                         args.prefix_sharing == "on"),
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)

@@ -313,6 +313,9 @@ class WorkerNode:
                         step_chunk=self.config.gen_step_chunk,
                         prefix_cache_mb=self.config.gen_prefix_cache_mb,
                         prefill_chunk=self.config.gen_prefill_chunk,
+                        kv_block_size=self.config.gen_kv_block_size,
+                        kv_blocks=self.config.gen_kv_blocks,
+                        prefix_sharing=self.config.gen_prefix_sharing,
                         device=getattr(engine, "_device", None))
                 else:
                     from tpu_engine.runtime.generator import Generator

@@ -71,6 +71,22 @@ class WorkerConfig:
     # admit via window-decode dispatches so decode chunks interleave
     # instead of stalling behind one long prompt forward (0 = off).
     gen_prefill_chunk: int = 256
+    # Paged KV cache (continuous scheduler; runtime.kv_blocks). 0 keeps
+    # the dense per-slot cache (current behavior). >0 switches to a
+    # block pool of this many columns per block: rows reserve blocks for
+    # the tokens they actually hold instead of max_seq each, and the
+    # radix tree maps shared prompt prefixes onto already-filled blocks
+    # (prefill resumes mid-prompt). Must divide every prompt bucket
+    # (16/32/64... all work with the default buckets).
+    gen_kv_block_size: int = 0
+    # Pool size in blocks (0 = auto: the dense layout's capacity,
+    # n_slots * ceil(max_seq/block) + the null block). At equal HBM the
+    # paged pool admits several times more concurrent short rows.
+    gen_kv_blocks: int = 0
+    # Block-level radix prefix sharing (paged mode only): shared system
+    # prompts skip their prefill compute and share KV blocks
+    # copy-on-write. Off = paging without sharing.
+    gen_prefix_sharing: bool = True
     # Batch scheduler only: run each group's decode as ONE fused dispatch
     # (lax.while_loop, zero per-chunk host syncs; identical streams).
     # Worth enabling where dispatch latency is high; costs one compile per

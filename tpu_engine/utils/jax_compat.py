@@ -1,0 +1,30 @@
+"""Compatibility aliases across the image's jax toolchain range.
+
+The container currently ships jax 0.4.37, which predates two renames the
+newer API docs (and some of this codebase) assume. One shared shim keeps
+every kernel and shard_map site working on either side of the rename —
+fix a future version bump HERE, not in four call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# pltpu.CompilerParams (new) vs pltpu.TPUCompilerParams (jax<0.5).
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+_shard_map_new = getattr(jax, "shard_map", None)
+
+if _shard_map_new is not None:
+    shard_map = _shard_map_new
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(*args, **kwargs):
+        """jax<0.5 shard_map, accepting the new `check_vma` spelling of
+        the old `check_rep` knob."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_impl(*args, **kwargs)

@@ -159,6 +159,33 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            [({**node(h), "model": g.get("model", g.get("target", "?"))}, 1)
             for h, g in gen])
 
+    # Paged KV cache pool (continuous scheduler with kv_block_size > 0):
+    # capacity/sharing gauges plus the prefix-sharing compute counters.
+    kv = [(h, g.get("kv_pool")) for h, g in gen
+          if isinstance(g, dict) and g.get("kv_pool")]
+    metric("tpu_engine_kv_blocks_total", "gauge",
+           "Paged KV pool capacity in blocks (null block excluded)",
+           [(node(h), p.get("blocks_total")) for h, p in kv])
+    metric("tpu_engine_kv_blocks_free", "gauge",
+           "Paged KV pool blocks currently free",
+           [(node(h), p.get("blocks_free")) for h, p in kv])
+    metric("tpu_engine_kv_blocks_shared", "gauge",
+           "Paged KV pool blocks referenced more than once "
+           "(radix prefix sharing)",
+           [(node(h), p.get("blocks_shared")) for h, p in kv])
+    metric("tpu_engine_kv_radix_nodes", "gauge",
+           "Radix-tree nodes indexing shared prompt prefixes",
+           [(node(h), p.get("radix_nodes")) for h, p in kv])
+    metric("tpu_engine_kv_evictions_total", "counter",
+           "Radix leaves evicted under pool pressure",
+           [(node(h), p.get("evictions")) for h, p in kv])
+    metric("tpu_engine_kv_prefix_hit_tokens_total", "counter",
+           "Prompt tokens served from shared KV blocks (prefill skipped)",
+           [(node(h), p.get("prefix_hit_tokens")) for h, p in kv])
+    metric("tpu_engine_kv_prefilled_tokens_total", "counter",
+           "Prompt tokens actually prefilled on the device",
+           [(node(h), p.get("prefilled_tokens")) for h, p in kv])
+
     # Resilience layer, lane side (the "admission" /health block appears
     # only once admission control has made a decision).
     adm = [(h, h.get("admission")) for h in healths if h.get("admission")]

@@ -3,8 +3,9 @@ multi-process launch code."""
 
 from __future__ import annotations
 
+import errno
 import socket
-from typing import List
+from typing import Callable, List, Tuple
 
 
 def free_ports(n: int = 1) -> List[int]:
@@ -31,3 +32,32 @@ def free_ports(n: int = 1) -> List[int]:
 
 def free_port() -> int:
     return free_ports(1)[0]
+
+
+# Bind failures that mean "someone else grabbed the probed port" — the
+# retryable half of the free_port() TOCTOU; anything else re-raises.
+_BIND_ERRNOS = (errno.EADDRINUSE, getattr(errno, "EACCES", 13))
+
+
+def launch_with_retry(launch: Callable[[int], object],
+                      attempts: int = 3) -> Tuple[int, object]:
+    """Run ``launch(port)`` on a freshly probed port, retrying the WHOLE
+    pick+launch on a lost probe-close→bind race — the consumer-owns-the-
+    retry rule `free_ports` documents, packaged so every server-spawn
+    site (tests' serve_worker/serve_combined fixtures, tools) shares one
+    implementation instead of re-deriving it (bench.launch_ready is the
+    subprocess-shaped original). Retries on EADDRINUSE `OSError` and on
+    ``ChildProcessError`` (subprocess launchers raise it when the child
+    exits before ready). Returns (port, launch's result)."""
+    last: BaseException = RuntimeError("unreachable")
+    for _ in range(max(1, attempts)):
+        port = free_port()
+        try:
+            return port, launch(port)
+        except OSError as exc:
+            if (not isinstance(exc, ChildProcessError)
+                    and exc.errno not in _BIND_ERRNOS):
+                raise
+            last = exc
+    raise RuntimeError(
+        f"bind failed after {attempts} attempts on fresh ports: {last}")
