@@ -1112,6 +1112,249 @@ def run_paged_ab(model: str = "gpt2-small-test", n_requests: int = 16,
     return results
 
 
+def run_mixed_ab(model: str = "gpt2-small-test", n_short: int = 12,
+                 n_long: int = 4, max_new: int = 40, long_max_new: int = 4,
+                 short_prompt_len: int = 8, long_prompt_len: int = 440,
+                 mean_gap_ms: float = 25.0, dtype: str = "float32",
+                 block_size: int = 16, max_seq: int = 512,
+                 step_chunk: int = 8, prefill_chunk: int = 256,
+                 mixed_budget: int = 16, n_slots: int = 4,
+                 model_kwargs: Optional[dict] = None,
+                 repeats: int = 2) -> dict:
+    """Mixed stepping vs the two-thread paged scheduler under long-prompt
+    interference (the --mixed-step tentpole A/B). Workload: Poisson
+    arrivals of short decode-heavy requests with long prompts injected
+    between them — the pattern whose admission prefills head-of-line
+    block decode dispatches in the two-path scheduler. Both arms run the
+    SAME paged pool, prompts, seeds, and arrival gaps; only the stepping
+    differs. Reports, per arm:
+
+    - ITL p50/p99 over the short rows' token inter-arrival gaps (each
+      delivery's gap is charged to its first token, 0 to the rest —
+      exactly what a streaming client sees), TTFT p50/p99, tokens/s;
+    - device dispatches per generated token, from the scheduler's own
+      counters (baseline: decode chunks + admission dispatches; mixed:
+      the per-tick ragged dispatch);
+    - one-dispatch-per-tick asserted from the mixed stats (ticks and
+      dispatches are counted at different code sites).
+
+    A seeded-identity check reruns two prompts on a DENSE scheduler and
+    requires byte-identical streams from the mixed arm. CPU mesh by
+    default; the on-chip campaign's `mixed` stage reruns it on the
+    device."""
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    _ensure_builtin_models_imported()
+    # The registry test model's default geometry is dispatch-overhead-
+    # dominated on CPU (a 16-wide tick costs less than a scheduler
+    # wakeup), which buries the admission-interference signal in noise —
+    # by default the scenario sizes it up (d256 x 4 layers) so compute,
+    # not jitter, is measured. `model_kwargs={}` keeps the tiny
+    # geometry (the --quick smoke).
+    if model_kwargs is None and model == "gpt2-small-test":
+        model_kwargs = dict(d_model=256, n_layers=4, n_heads=8,
+                            d_ff=1024, vocab=2048)
+    spec = create_model(model, max_seq=max_seq, **(model_kwargs or {}))
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(42)
+    width = -(-max_seq // block_size)
+    kv_blocks = n_slots * width + 1
+
+    # One interleaved arrival schedule: a long prompt after every
+    # n_short//n_long short requests. (kind, prompt, max_new, seed)
+    shorts = [[rnd.randrange(1, 200) for _ in range(short_prompt_len)]
+              for _ in range(n_short)]
+    longs = [[rnd.randrange(1, 200) for _ in range(long_prompt_len)]
+             for _ in range(n_long)]
+    schedule = []
+    li, stride = 0, max(1, n_short // max(1, n_long))
+    for i, p in enumerate(shorts):
+        schedule.append(("short", p, max_new, 100 + i))
+        if (i + 1) % stride == 0 and li < n_long:
+            schedule.append(("long", longs[li], long_max_new, 500 + li))
+            li += 1
+    gaps = [rnd.expovariate(1000.0 / mean_gap_ms) / 1000.0
+            for _ in schedule]
+
+    # The shared nearest-rank helper — one definition with /trace's
+    # summary percentiles, so the bench's p50/p99 and the server's agree.
+    from tpu_engine.utils.tracing import percentile
+
+    import queue as _q
+
+    class _StampQueue(_q.Queue):
+        """Stream queue that timestamps each delivery AT put() — i.e. on
+        the scheduler's decode thread. ITL measured here is the server's
+        actual emission cadence; a consumer thread per request would add
+        GIL-wakeup jitter of the same magnitude as a tick and measure
+        the load generator instead of the scheduler."""
+
+        def __init__(self):
+            super().__init__()
+            self.stamps: list = []
+
+        def put(self, item, **kw):
+            if item is not None:
+                self.stamps.append((time.perf_counter(), len(item)))
+            super().put(item, **kw)
+
+    def run_arm(mixed: bool) -> Tuple[dict, list]:
+        gen = ContinuousGenerator(
+            spec, params=params, dtype=dtype, n_slots=n_slots,
+            step_chunk=step_chunk, max_seq=max_seq,
+            kv_block_size=block_size, kv_blocks=kv_blocks,
+            prefill_chunk=prefill_chunk, prefix_sharing=False,
+            mixed_step=mixed,
+            mixed_token_budget=mixed_budget if mixed else 0)
+        try:
+            # Warm every compiled width outside the timed window (short
+            # bucket, long bucket, decode, and the mixed tick widths) —
+            # then SNAPSHOT the lifetime dispatch counters so the
+            # warm-up's dispatches and tokens stay out of BOTH sides of
+            # the dispatches-per-token ratio.
+            gen.generate([shorts[0]], max_new_tokens=2)
+            gen.generate([longs[0][:long_prompt_len]], max_new_tokens=2)
+            warm = gen.stats()
+
+            futs, queues, submit_ts = [], [], []
+            t0 = time.perf_counter()
+            for i, (kind, prompt, mn, seed) in enumerate(schedule):
+                time.sleep(gaps[i])
+                q = _StampQueue()
+                queues.append(q)
+                submit_ts.append(time.perf_counter())
+                futs.append(gen.submit(prompt, max_new_tokens=mn,
+                                       temperature=0.7, seed=seed,
+                                       stream=q))
+            outs = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            st = gen.stats()
+        finally:
+            gen.stop()
+
+        itl, ttft = [], []
+        for i, (kind, _p, _mn, _s) in enumerate(schedule):
+            stamps = queues[i].stamps
+            if kind != "short" or not stamps:
+                continue
+            ttft.append(stamps[0][0] - submit_ts[i])
+            prev = stamps[0][0]
+            for t, n in stamps[1:]:
+                itl.append(t - prev)          # charged to the 1st token
+                itl.extend([0.0] * (n - 1))
+                prev = t
+        itl.sort()
+        ttft.sort()
+        tokens = sum(len(o) for o in outs)
+        if mixed:
+            m, m0 = st["mixed"], warm["mixed"]
+            dispatches = m["dispatches"] - m0["dispatches"]
+            new_tokens = (m["decode_tokens"] + m["prefill_tokens"]
+                          - m0["decode_tokens"] - m0["prefill_tokens"])
+        else:
+            dispatches = (st.get("chunks", 0) - warm.get("chunks", 0)
+                          + st.get("admission_dispatches", 0)
+                          - warm.get("admission_dispatches", 0))
+            new_tokens = tokens + sum(len(p) for _k, p, _m, _s in schedule)
+        arm = {
+            "itl_p50_ms": round((percentile(itl, 50) or 0) * 1e3, 2),
+            "itl_p99_ms": round((percentile(itl, 99) or 0) * 1e3, 2),
+            "ttft_p50_ms": round((percentile(ttft, 50) or 0) * 1e3, 2),
+            "ttft_p99_ms": round((percentile(ttft, 99) or 0) * 1e3, 2),
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / wall, 2) if wall else 0.0,
+            "wall_s": round(wall, 3),
+            "device_dispatches": int(dispatches),
+            "dispatches_per_token": round(dispatches / max(1, new_tokens),
+                                          4),
+        }
+        if mixed:
+            # Lifetime counters (warm-up included) for the invariant;
+            # device_dispatches above is the measured-window count.
+            arm["lifetime_ticks"] = m["ticks"]
+            arm["lifetime_dispatches"] = m["dispatches"]
+            arm["one_dispatch_per_tick"] = (m["dispatches"] == m["ticks"])
+            arm["coscheduled_ticks"] = m["coscheduled_ticks"]
+            arm["cow_copies"] = st["kv_pool"]["cow_copies"]
+        return arm, outs
+
+    results = {"model": model, "model_kwargs": model_kwargs or {},
+               "max_seq": max_seq,
+               "block_size": block_size, "n_slots": n_slots,
+               "step_chunk": step_chunk, "prefill_chunk": prefill_chunk,
+               "mixed_token_budget": mixed_budget,
+               "workload": {"short": n_short, "long": n_long,
+                            "short_prompt_len": short_prompt_len,
+                            "long_prompt_len": long_prompt_len,
+                            "mean_gap_ms": mean_gap_ms}}
+    # Arms alternate and each keeps its lowest-p99 repeat: the two-CPU
+    # bench host runs arms sequentially, so a background stall mid-run
+    # lands on one arm only — best-of-N per arm is the standard
+    # least-external-interference estimate (both arms get the same
+    # chance). Stream identity is asserted across EVERY repeat.
+    baseline = mixed_arm = None
+    base_outs = mixed_outs = None
+    streams_stable = True
+    for rep in range(max(1, repeats)):
+        b_arm, b_o = run_arm(mixed=False)
+        m_arm, m_o = run_arm(mixed=True)
+        streams_stable &= (b_o == m_o)
+        if base_outs is not None:
+            streams_stable &= (b_o == base_outs and m_o == mixed_outs)
+        base_outs, mixed_outs = b_o, m_o
+        if baseline is None or b_arm["itl_p99_ms"] < baseline["itl_p99_ms"]:
+            baseline = b_arm
+        if (mixed_arm is None
+                or m_arm["itl_p99_ms"] < mixed_arm["itl_p99_ms"]):
+            mixed_arm = m_arm
+        record_partial(f"mixed_ab_rep{rep}",
+                       {"baseline_itl_p99_ms": b_arm["itl_p99_ms"],
+                        "mixed_itl_p99_ms": m_arm["itl_p99_ms"]})
+    results["repeats"] = max(1, repeats)
+    results["paged_two_thread"] = baseline
+    record_partial("mixed_ab_baseline", baseline)
+    results["mixed"] = mixed_arm
+    record_partial("mixed_ab_mixed", mixed_arm)
+
+    # Seeded streams must be identical across arms (every repeat) AND vs
+    # the dense path.
+    results["streams_match_baseline"] = streams_stable
+    dense = ContinuousGenerator(spec, params=params, dtype=dtype,
+                                n_slots=2, step_chunk=step_chunk,
+                                max_seq=max_seq)
+    try:
+        idx = [0, 1]
+        dense_outs = [
+            dense.generate([schedule[i][1]],
+                           max_new_tokens=schedule[i][2],
+                           temperature=0.7, seed=schedule[i][3])[0]
+            for i in idx]
+        results["streams_match_dense"] = (
+            dense_outs == [mixed_outs[i] for i in idx])
+    finally:
+        dense.stop()
+    results["itl_p99_speedup"] = round(
+        baseline["itl_p99_ms"] / max(mixed_arm["itl_p99_ms"], 1e-9), 2)
+    # p50 of per-token gaps is 0 whenever chunked deliveries dominate
+    # (7 of 8 tokens in a chunk arrive at gap 0) — a ratio against it is
+    # noise, so it is reported only when both medians are nonzero.
+    results["itl_p50_speedup"] = (
+        round(baseline["itl_p50_ms"] / mixed_arm["itl_p50_ms"], 2)
+        if baseline["itl_p50_ms"] > 0 and mixed_arm["itl_p50_ms"] > 0
+        else None)
+    results["checks_passed"] = bool(
+        mixed_arm.get("one_dispatch_per_tick")
+        and results["streams_match_dense"]
+        and results["streams_match_baseline"])
+    return results
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -1249,7 +1492,7 @@ def _main() -> int:
     ap.add_argument("--scenario",
                     choices=["infer", "generate", "compute", "decode-ab",
                              "spec-ab", "mixed", "prefill-mfu", "longctx",
-                             "miss-sweep", "paged-ab"],
+                             "miss-sweep", "paged-ab", "mixed-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -1283,7 +1526,7 @@ def _main() -> int:
         args.model = "gpt2"
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
-    if args.scenario == "paged-ab" and args.model == "resnet50":
+    if args.scenario in ("paged-ab", "mixed-ab") and args.model == "resnet50":
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
         # Host-side runs also downshift the model: a 124M-param decode
@@ -1408,6 +1651,25 @@ def _main() -> int:
                 result["prefill_token_savings_frac"], **result,
         })
         return 0
+
+    if args.scenario == "mixed-ab":
+        result = run_mixed_ab(
+            model=args.model,
+            n_short=8 if args.quick else 12,
+            n_long=2 if args.quick else 4,
+            max_new=24 if args.quick else 40,
+            long_prompt_len=120 if args.quick else 440,
+            max_seq=128 if args.quick else 512,
+            prefill_chunk=64 if args.quick else 256,
+            model_kwargs={} if args.quick else None)
+        record_partial("mixed_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "mixed_step_itl_p99_speedup",
+            "value": result["itl_p99_speedup"], "unit": "x",
+            "vs_baseline": None, "model": args.model, **result,
+        })
+        return 0 if result["checks_passed"] else 1
 
     proc = None
     port = args.port

@@ -23,7 +23,7 @@ import sys
 
 OK, FAIL = "✓", "✗"
 _results = []
-_TOTAL = 6  # --kernel-parity appends a 7th step
+_TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -70,9 +70,13 @@ def main() -> int:
                          "parity on this host's backend (in-process, no "
                          "server; compiles a small kernel — seconds on "
                          "CPU, validates Mosaic on a TPU host)")
+    ap.add_argument("--mixed-parity", action="store_true",
+                    help="step 8: RAGGED paged-attention kernel (the "
+                         "--mixed-step read path) vs the XLA gather "
+                         "reference at mixed q_lens {1, 7, 16, 17} — "
+                         "decode rows and prefill chunks in one batch")
     args = ap.parse_args()
-    if args.kernel_parity:
-        _TOTAL = 7
+    _TOTAL = 6 + int(args.kernel_parity) + int(args.mixed_parity)
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -163,6 +167,31 @@ def main() -> int:
                  f"(max|Δ| f32 {diff:.2e}, bf16 {bf16:.2e})")
         except Exception as exc:
             step(7, "paged-attention kernel parity", False, f"({exc})")
+
+    # 8 (--mixed-parity): the ragged kernel behind --mixed-step serving —
+    # one batch mixing decode rows (q_len 1) and prefill chunks (q_len up
+    # to block_size+1, crossing a block boundary) against the XLA gather
+    # reference. On a TPU host this validates the Mosaic compile the
+    # tunnel-watchdog campaign needs before re-enabling mixed mode.
+    if args.mixed_parity:
+        n = _TOTAL
+        try:
+            import jax.numpy as jnp
+
+            from tpu_engine.ops.paged_attention import ragged_parity_check
+
+            diff = max(ragged_parity_check(q_lens=(1, 7, 16, 17)),
+                       ragged_parity_check(q_lens=(1, 3, 8, 9),
+                                           n_heads=8, n_kv_heads=2,
+                                           d_head=16, block_size=8,
+                                           table_len=8))
+            bf16 = ragged_parity_check(q_lens=(1, 7, 16, 17),
+                                       dtype=jnp.bfloat16)
+            step(n, "ragged mixed-step kernel parity",
+                 diff < 2e-5 and bf16 < 2e-2,
+                 f"(max|Δ| f32 {diff:.2e}, bf16 {bf16:.2e})")
+        except Exception as exc:
+            step(n, "ragged mixed-step kernel parity", False, f"({exc})")
 
     n_ok = sum(_results)
     print(f"\n{n_ok}/{len(_results)} checks passed")

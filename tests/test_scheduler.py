@@ -99,7 +99,10 @@ def test_eos_frees_slot(spec, params):
     s = ContinuousGenerator(spec, params=params, dtype="float32",
                             n_slots=2, step_chunk=4)
     try:
-        prompt = [5, 9, 3]
+        # A prompt whose greedy stream contains a token NOT seen earlier
+        # ([5, 9, 3] degenerates to one repeated token under this image's
+        # jax 0.4.37 random init — no valid EOS candidate existed).
+        prompt = [7, 2]
         full = _greedy_ref(params, spec, prompt, 8)
         # Force EOS at a token's FIRST occurrence (greedy sequences repeat;
         # truncation happens at the earliest match).
@@ -107,8 +110,8 @@ def test_eos_frees_slot(spec, params):
         got = s.submit(prompt, max_new_tokens=8, eos_id=full[k]).result(60)
         assert got == full[:k]
         # Slot is reusable afterwards.
-        again = s.submit([7, 2], max_new_tokens=4).result(60)
-        assert again == _greedy_ref(params, spec, [7, 2], 4)
+        again = s.submit([11, 13], max_new_tokens=4).result(60)
+        assert again == _greedy_ref(params, spec, [11, 13], 4)
         assert s.stats()["active"] == 0
     finally:
         s.stop()

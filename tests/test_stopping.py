@@ -39,7 +39,11 @@ def sched():
 
 def test_penalty_changes_greedy_stream(gen):
     plain = gen.generate(PROMPTS, max_new_tokens=12)
-    pen = gen.generate(PROMPTS, max_new_tokens=12, repetition_penalty=1.8)
+    # 3.0, not 1.8: this image's jax 0.4.37 random init gives one token a
+    # logit gap that survives /1.8 and still wins the argmax — the
+    # property under test (a strong penalty kills immediate repeats)
+    # needs a penalty actually stronger than the init's logit gap.
+    pen = gen.generate(PROMPTS, max_new_tokens=12, repetition_penalty=3.0)
     assert plain != pen
     # greedy + strong penalty: no immediate token repeats in the stream
     for row in pen:

@@ -28,9 +28,20 @@ A final trace-coverage pass asserts every resilience decision the
 span in ``/trace/export`` — the tracing layer provably covers the
 failure paths, not just the happy path.
 
+``--mixed`` runs a STANDALONE mixed-stepping fault scenario instead: it
+spawns its own combined server (gpt2-small-test decode lane with
+``--kv-block-size 16 --mixed-step`` and a tiny token budget so prefills
+span many ticks), fires /generate requests whose deadlines expire
+mid-prefill-chunk, and asserts via ``/stats`` + ``/trace/export`` that
+every cancelled row returned its blocks to the pool, none reappears in a
+later tick's ragged batch (active drains to 0, the pool refills), the
+scheduler stayed one-dispatch-per-tick throughout, and a subsequent
+request still decodes correctly.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
+  python3 tools/fault_injection.py --mixed
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -262,6 +273,147 @@ def slow_lane_phase(port: int, victim: str, victim_ids, n: int,
     return report
 
 
+def launch_mixed_server(attempts: int = 3):
+    """Spawn a combined server with a mixed-stepping decode lane sized so
+    prefills span MANY ticks (budget 2 tokens/tick): a short deadline
+    reliably expires mid-prefill-chunk. Returns (port, Popen)."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("TPU_ENGINE_PLATFORM", "cpu")
+
+    def spawn(port: int):
+        cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+               "--model", "gpt2-small-test", "--lanes", "1",
+               "--port", str(port), "--kv-block-size", "16",
+               "--mixed-step", "--mixed-token-budget", "2",
+               "--gen-prefill-chunk", "16"]
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=sys.stderr, stderr=sys.stderr)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChildProcessError(
+                    f"server exited rc={proc.returncode} before ready")
+            try:
+                status, _ = _call(port, "GET", "/stats", timeout=2.0)
+                if status == 200:
+                    return proc
+            except OSError:
+                pass
+            time.sleep(0.5)
+        proc.terminate()
+        raise TimeoutError("server never became ready")
+
+    return launch_with_retry(spawn, attempts=attempts)
+
+
+def mixed_phase(port: int, checks: list) -> dict:
+    """Mixed-stepping cancellation scenario: deadline-expired rows
+    mid-prefill-chunk must return their blocks and never appear in a
+    later tick's ragged batch."""
+    # Warm the decode lane (compiles happen here, not under deadlines).
+    status, body = _call(port, "POST", "/generate", {
+        "request_id": "mx_warm", "prompt_tokens": [5, 9, 3],
+        "max_new_tokens": 4}, timeout=600)
+    checks.append(("mixed: warm generate ok",
+                   status == 200 and len(body.get("tokens", [])) == 4))
+    warm_tokens = body.get("tokens")
+    _, stats0 = _call(port, "GET", "/stats")
+    mixed0 = next(iter(stats0.get("mixed", {}).values()), {})
+
+    # Long prompts (bucket 64 at gpt2-small-test's max_seq) with tiny
+    # deadlines: at 2 tokens/tick the ~60-token prefill spans ~30 ticks,
+    # so these deadlines expire mid-prefill-chunk, between ticks.
+    expired = survived = 0
+    for i in range(6):
+        prompt = [(i * 13 + j) % 90 + 1 for j in range(58)]
+        try:
+            status, body = _call(port, "POST", "/generate", {
+                "request_id": f"mx_dead_{i}", "prompt_tokens": prompt,
+                "max_new_tokens": 20, "deadline_ms": 40 + 10 * i,
+            }, timeout=120)
+        except OSError:
+            status, body = 0, {}
+        if status in (500, 503):
+            expired += 1
+        elif status == 200:
+            survived += 1
+    checks.append(("mixed: deadlines expired mid-prefill", expired > 0))
+
+    # Drain: every cancelled row must return its blocks (free + radix-held
+    # == total) and leave the batch (active == 0).
+    pool = active = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        _, stats = _call(port, "GET", "/stats")
+        mixed = next(iter(stats.get("mixed", {}).values()), {})
+        pool = next(iter(stats.get("kv_pool", {}).values()), {})
+        active = mixed.get("active")
+        if active == 0 and pool and (
+                pool["blocks_free"] + pool["radix_nodes"]
+                >= pool["blocks_total"]):
+            break
+        time.sleep(0.2)
+    checks.append(("mixed: cancelled rows left the ragged batch "
+                   "(active drained to 0)", active == 0))
+    checks.append(("mixed: cancelled rows returned their blocks",
+                   bool(pool) and pool["blocks_free"] + pool["radix_nodes"]
+                   >= pool["blocks_total"]))
+
+    # One dispatch per tick held through the churn, and ticks advanced.
+    _, stats = _call(port, "GET", "/stats")
+    mixed = next(iter(stats.get("mixed", {}).values()), {})
+    checks.append(("mixed: one dispatch per tick",
+                   mixed.get("ticks", 0) == mixed.get("dispatches", -1)))
+    checks.append(("mixed: ticks advanced during the scenario",
+                   mixed.get("ticks", 0) > mixed0.get("ticks", 0)))
+
+    # The scheduler still serves correctly after the cancellations — and
+    # a repeated seeded prompt reproduces the warm stream exactly (no
+    # half-written state leaked into the pool or radix tree).
+    status, body = _call(port, "POST", "/generate", {
+        "request_id": "mx_after", "prompt_tokens": [5, 9, 3],
+        "max_new_tokens": 4}, timeout=120)
+    checks.append(("mixed: post-cancel request streams identically",
+                   status == 200 and body.get("tokens") == warm_tokens))
+
+    # Trace coverage: the mixed_step spans are in /trace/export with the
+    # ragged-batch attrs the tentpole promises.
+    _, export = _call(port, "GET", "/trace/export")
+    spans = [e for e in export.get("traceEvents", [])
+             if e.get("ph") == "X" and e.get("name") == "mixed_step"]
+    has_attrs = any("prefill_tokens" in (e.get("args") or {})
+                    and "decode_rows" in (e.get("args") or {})
+                    for e in spans)
+    checks.append(("mixed: mixed_step spans exported with "
+                   "prefill_tokens/decode_rows attrs",
+                   len(spans) > 0 and has_attrs))
+    return {"expired": expired, "survived": survived,
+            "kv_pool": pool, "mixed": mixed,
+            "mixed_step_spans": len(spans)}
+
+
+def run_mixed_standalone() -> int:
+    port, proc = launch_mixed_server()
+    checks: list = []
+    try:
+        report = {"mode": "mixed-standalone", "port": port,
+                  "phases": {"mixed": mixed_phase(port, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=8000)
@@ -282,7 +434,14 @@ def main() -> int:
                          "on with --slow-lane) instead of targeting an "
                          "already-running one; the launch retries on the "
                          "free-port bind race")
+    ap.add_argument("--mixed", action="store_true",
+                    help="standalone mixed-stepping scenario: spawns its "
+                         "own --mixed-step server and asserts cancelled "
+                         "mid-prefill rows return their blocks (see "
+                         "module docstring); ignores the other flags")
     args = ap.parse_args()
+    if args.mixed:
+        return run_mixed_standalone()
     proc = None
     if args.launch:
         args.breaker_timeout = min(args.breaker_timeout, 2.0)

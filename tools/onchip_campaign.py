@@ -307,10 +307,39 @@ def main() -> int:
             max_new=48 if q else 96, dtype="bfloat16")
         return res
 
+    @stage(artifact, out, "mixed")
+    def _mixed():
+        # Mixed stepping on-chip: (a) Mosaic compile + exactness of the
+        # RAGGED paged-attention kernel (decode rows and prefill chunks
+        # in one batch — CPU rounds only ever ran the interpreter),
+        # (b) the two-thread-vs-mixed ITL A/B against the real chip.
+        import jax.numpy as jnp
+
+        from tpu_engine.ops.paged_attention import ragged_parity_check
+
+        res = {"ragged_kernel_parity": {
+            "f32_max_abs_diff": ragged_parity_check(
+                q_lens=(1, 7, 16, 17), block_size=16, n_blocks=33,
+                table_len=8, d_head=64),
+            "bf16_max_abs_diff": ragged_parity_check(
+                q_lens=(1, 7, 16, 17), dtype=jnp.bfloat16, block_size=16,
+                n_blocks=33, table_len=8, d_head=64),
+            "gqa_max_abs_diff": ragged_parity_check(
+                q_lens=(1, 3, 16, 17), n_heads=8, n_kv_heads=2,
+                d_head=64, block_size=16, n_blocks=33, table_len=8),
+        }}
+        res["ab"] = bench.run_mixed_ab(
+            model=model, n_short=8 if q else 12, n_long=2 if q else 3,
+            max_new=24 if q else 40,
+            long_prompt_len=120 if q else 440,
+            max_seq=128 if q else 512,
+            prefill_chunk=64 if q else 256, dtype="bfloat16")
+        return res
+
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
-               _decode_int8, _flash, _flash_tiling, _paged, _spec,
+               _decode_int8, _flash, _flash_tiling, _paged, _mixed, _spec,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

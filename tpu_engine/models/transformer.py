@@ -506,6 +506,90 @@ def transformer_decode_rows_paged(params, token_t, caches: KVCache, tables,
     return logits[:, 0], KVCache(k_new, v_new)
 
 
+def _block_step_rows_ragged(bp, h, cache_kv, tables, pos0, qlen,
+                            cfg: TransformerConfig, *, dtype, attn_fn):
+    """One ragged mixed step against the PAGED pool: row b consumes
+    qlen[b] new tokens at logical columns [pos0[b], pos0[b]+qlen[b])
+    (decode rows: qlen 1; admitting rows: a prefill chunk). All W slots'
+    K/V scatter into the rows' pool blocks BEFORE the attention read
+    (write-before-attend); padding slots (i >= qlen) scatter into the
+    null block and their outputs are garbage the scheduler ignores."""
+    ck, cv = cache_kv
+    bs = ck.shape[1]
+    b, w = h.shape[:2]
+    x = _norm(bp["ln1"], h, cfg)
+    offs = jnp.arange(w)[None, :]
+    logical = pos0[:, None] + offs                           # (B, W)
+    q, k, v = _project_qkv(bp, x, cfg, dtype=dtype, positions=logical)
+    rows = jnp.arange(b)[:, None]
+    max_col = tables.shape[1] * bs - 1
+    cols = jnp.minimum(logical, max_col)  # padding may run off the table
+    blk = tables[rows, cols // bs]
+    blk = jnp.where(offs < qlen[:, None], blk, 0)  # padding -> null block
+    off = cols % bs
+    ck = ck.at[blk, off].set(k.astype(ck.dtype))
+    cv = cv.at[blk, off].set(v.astype(cv.dtype))
+    a = attn_fn(q, ck, cv, tables, pos0, qlen)  # grouped, unexpanded
+    h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, w, -1), dtype=dtype)
+    h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
+    return h.astype(dtype), (ck, cv)
+
+
+def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
+                                 pos0, qlen, cfg: TransformerConfig, *,
+                                 dtype=jnp.bfloat16, attn_fn=None,
+                                 sample_slot=None):
+    """The mixed prefill+decode primitive (runtime.scheduler
+    --mixed-step): one ragged batch where each row consumes qlen[b] >= 0
+    new tokens, writing their KV straight into the row's pool blocks in
+    the SAME dispatch. tokens: (B, W) int32 right-aligned at slot 0;
+    caches: (L, NB, bs, H_kv, D) pool pair; tables: (B, nb) block
+    tables; pos0: (B,) logical column of each row's first slot; qlen:
+    (B,) valid slots. ``attn_fn`` defaults to
+    `ops.paged_attention.default_ragged_attention()`.
+
+    ``sample_slot`` (B,) selects ONE slot per row to project through the
+    LM head — the scheduler samples exactly one token per row per tick
+    (decode rows: slot 0; completing rows: slot L-1-pos0), and gathering
+    the hidden state BEFORE ln_f/head turns the (B*W, d)x(d, vocab)
+    matmul into (B, d)x(d, vocab) on the per-tick hot path (ln_f and the
+    head are per-position, so the selected slot's logits are bit-equal
+    either way). Returns (logits (B, vocab), caches) — or
+    (logits (B, W, vocab), caches) when ``sample_slot`` is None."""
+    if attn_fn is None:
+        from tpu_engine.ops.paged_attention import default_ragged_attention
+
+        attn_fn = default_ragged_attention()
+    if cfg.sliding_window is not None:
+        raise NotImplementedError(
+            "sliding_window models are not supported by the paged KV "
+            "cache (use the dense scheduler)")
+    b, w = tokens.shape
+    h = nn.embedding(params["tok_embed"], tokens)
+    if cfg.pos == "learned":
+        logical = jnp.clip(pos0[:, None] + jnp.arange(w)[None, :], 0,
+                           params["pos_embed"]["table"].shape[0] - 1)
+        h = h + params["pos_embed"]["table"][logical]
+    h = h.astype(dtype)
+
+    def body(carry, layer):
+        bp, ck, cv = layer
+        h, (ck, cv) = _block_step_rows_ragged(
+            bp, carry, (ck, cv), tables, pos0, qlen, cfg, dtype=dtype,
+            attn_fn=attn_fn)
+        return h, (ck, cv)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h,
+                                     (params["blocks"], caches.k, caches.v))
+    if sample_slot is not None:
+        h = h[jnp.arange(b), sample_slot][:, None]    # (B, 1, d)
+    h = _norm(params["ln_f"], h, cfg)
+    logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    if sample_slot is not None:
+        return logits[:, 0], KVCache(k_new, v_new)
+    return logits, KVCache(k_new, v_new)
+
+
 def _block_decode_window(bp, h, cache_kv, pos_vec, cfg: TransformerConfig, *,
                          dtype, start_vec):
     """Width-W decode with PER-ROW cache positions — the speculative-decode

@@ -30,6 +30,14 @@ with G = n_heads/kv_heads, so one grid step computes all G group queries
 against its KV head's block — the (G, bs) score tile feeds the MXU once
 per block instead of G times.
 
+The RAGGED variant (`ragged_paged_attention[_reference]`) generalizes
+q_len from 1 to >= 1 per row: the mixed scheduler (--mixed-step) serves
+decode rows (one token) and admitting rows (a prefill chunk) in ONE
+dispatch, with causal masking inside each row's new-token window
+(query slot i attends kpos <= pos0 + i). Query slots stack with the
+group heads on the sublane axis ((W*G, bs) score tiles), so the same
+one-block-per-grid-step streaming serves both shapes.
+
 On-chip status: interpreter-validated only (this round's tunnel state);
 the `paged` stage of tools/onchip_campaign.py runs the Mosaic compile +
 parity + the dense-vs-paged A/B when the device link recovers. Selection
@@ -181,26 +189,194 @@ def paged_attention(q, k_pool, v_pool, tables, pos_vec, *, interpret=None):
                        lengths, interpret=bool(interpret))
 
 
+# -- ragged (mixed prefill+decode) variant ------------------------------------
+#
+# The mixed scheduler (runtime.scheduler, --mixed-step) folds admission
+# prefill into the decode dispatch: one ragged batch where decode rows
+# contribute ONE new token and admitting rows contribute a prefill chunk
+# of up to W tokens (PAPERS.md "Ragged Paged Attention"). The attention
+# read side generalizes the decode kernel above from q_len == 1 to
+# q_len >= 1 per row: row b's query slot i sits at logical position
+# pos0[b] + i and attends causally within its own history
+# (kpos <= pos0[b] + i); slots i >= qlen[b] are padding whose output the
+# scheduler ignores.
+
+
+def ragged_paged_attention_reference(q, k_pool, v_pool, tables, pos0, qlen):
+    """XLA gather path, ragged queries. q: (B, W, H, D);
+    k_pool/v_pool: (NB, bs, H_kv, D); tables: (B, nb) int32 block ids;
+    pos0: (B,) logical position of each row's FIRST query slot;
+    qlen: (B,) valid query slots (padding slots produce garbage the
+    caller must ignore — masking them costs more than ignoring).
+    Returns (B, W, H, D)."""
+    del qlen  # padding slots are ignored by contract, not masked
+    bs = k_pool.shape[1]
+    b, w = q.shape[:2]
+    nb = tables.shape[1]
+    kk = k_pool[tables].reshape(b, nb * bs, k_pool.shape[2],
+                                k_pool.shape[3])
+    vv = v_pool[tables].reshape(b, nb * bs, v_pool.shape[2],
+                                v_pool.shape[3])
+    kpos = jnp.arange(nb * bs)
+    qpos = pos0[:, None] + jnp.arange(w)[None, :]              # (B, W)
+    valid = (kpos[None, None, :] <= qpos[:, :, None]).astype(jnp.int32)
+    return dot_product_attention(q, kk, vv, mask=valid)
+
+
+def _ragged_kernel(tables_ref, pos0_ref, lengths_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_sc, l_sc, acc_sc, *, block_size: int,
+                   scale: float, group: int):
+    """One (row, kv-head, block) grid step of the ragged variant.
+    q_ref/o_ref (1, 1, W*G, D) — query slots ride the sublane axis
+    interleaved with the G group heads (row r = slot r//G, head r%G);
+    k_ref/v_ref (1, bs, 1, D). Causal masking within the new-token
+    window: score row r keeps kpos <= pos0 + r//G."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    length = lengths_ref[b]   # pos0 + qlen: cols the row's queries can see
+    pos0 = pos0_ref[b]
+
+    def fold():
+        q = q_ref[0, 0]                    # (W*G, D)
+        k = k_ref[0, :, 0, :]              # (bs, D)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (W*G, bs)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        qpos = pos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                               0) // group
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m = m_sc[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=-1)
+        acc_sc[...] = acc_sc[...] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = m_new
+
+    # Blocks wholly past the row's last query position do no work — a
+    # decode row (q_len 1) in a batch with a wide prefill chunk costs
+    # only its own history's blocks.
+    @pl.when(j * block_size < length)
+    def _live_block():
+        fold()
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ragged_call(q, k_pool, v_pool, tables, pos0, lengths, *,
+                 interpret: bool):
+    b, w, h, d = q.shape
+    _, bs, h_kv, _ = k_pool.shape
+    nb = tables.shape[1]
+    g = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    # (B, W, H, D) -> (B, H_kv, W*G, D): slot-major within each KV head so
+    # score row r maps to query slot r//G (matches _ragged_kernel).
+    qh = (q.reshape(b, w, h_kv, g, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, h_kv, w * g, d))
+    kernel = functools.partial(_ragged_kernel, block_size=bs, scale=scale,
+                               group=g)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,        # tables, pos0, lengths
+            grid=(b, h_kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, w * g, d),
+                             lambda b, h, j, tables, pos0, lengths:
+                             (b, h, 0, 0)),
+                pl.BlockSpec((1, bs, 1, d),
+                             lambda b, h, j, tables, pos0, lengths:
+                             (tables[b, j], 0, h, 0)),
+                pl.BlockSpec((1, bs, 1, d),
+                             lambda b, h, j, tables, pos0, lengths:
+                             (tables[b, j], 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, w * g, d),
+                lambda b, h, j, tables, pos0, lengths: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((w * g,), jnp.float32),
+                pltpu.VMEM((w * g,), jnp.float32),
+                pltpu.VMEM((w * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, w * g, d), v_pool.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, pos0, lengths, qh, k_pool, v_pool)
+    return (out.reshape(b, h_kv, w, g, d).transpose(0, 2, 1, 3, 4)
+            .reshape(b, w, h, d))
+
+
+def ragged_paged_attention(q, k_pool, v_pool, tables, pos0, qlen, *,
+                           interpret=None):
+    """Pallas-kernel drop-in for `ragged_paged_attention_reference` (same
+    signature/contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pos0 = jnp.asarray(pos0, jnp.int32)
+    lengths = pos0 + jnp.asarray(qlen, jnp.int32)
+    return _ragged_call(q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
+                        pos0, lengths, interpret=bool(interpret))
+
+
 _PAGED_CACHE = {}
+
+
+def _select_impl(kind: str, kernel_fn, reference_fn):
+    """One `TPU_ENGINE_PAGED` selection rule for BOTH read paths
+    (decode and ragged) — "1" forces the Pallas kernel (interpreter
+    off-TPU — slow, for parity tests), "0" forces the XLA gather
+    reference, unset/"auto" picks the kernel on TPU only."""
+    import os
+
+    mode = os.environ.get("TPU_ENGINE_PAGED", "auto")
+    key = (kind, mode)
+    fn = _PAGED_CACHE.get(key)
+    if fn is None:
+        if mode == "1" or (mode == "auto"
+                           and jax.default_backend() == "tpu"):
+            fn = kernel_fn
+        else:
+            fn = reference_fn
+        _PAGED_CACHE[key] = fn
+    return fn
 
 
 def default_paged_attention():
     """Serving-path paged-attention selection, one rule with
-    `models.transformer.default_attention`: `TPU_ENGINE_PAGED` "1" forces
-    the Pallas kernel (interpreter off-TPU — slow, for parity tests),
-    "0" forces the XLA gather reference, unset/"auto" kernel on TPU."""
-    import os
+    `models.transformer.default_attention` (see `_select_impl`)."""
+    return _select_impl("paged", paged_attention,
+                        paged_attention_reference)
 
-    mode = os.environ.get("TPU_ENGINE_PAGED", "auto")
-    fn = _PAGED_CACHE.get(mode)
-    if fn is None:
-        if mode == "1" or (mode == "auto"
-                           and jax.default_backend() == "tpu"):
-            fn = paged_attention
-        else:
-            fn = paged_attention_reference
-        _PAGED_CACHE[mode] = fn
-    return fn
+
+def default_ragged_attention():
+    """Ragged-variant selection — the same env knob and rule as
+    `default_paged_attention` governs both read paths."""
+    return _select_impl("ragged", ragged_paged_attention,
+                        ragged_paged_attention_reference)
 
 
 def parity_check(batch: int = 2, n_heads: int = 4, n_kv_heads: int = 2,
@@ -232,3 +408,41 @@ def parity_check(batch: int = 2, n_heads: int = 4, n_kv_heads: int = 2,
     ref = paged_attention_reference(q, k_pool, v_pool, tables, pos)
     return float(jnp.max(jnp.abs(ours.astype(jnp.float32)
                                  - ref.astype(jnp.float32))))
+
+
+def ragged_parity_check(q_lens=(1, 7, 16, 17), n_heads: int = 4,
+                        n_kv_heads: int = 2, d_head: int = 8,
+                        block_size: int = 16, n_blocks: int = 33,
+                        table_len: int = 6, dtype=jnp.float32,
+                        seed: int = 0) -> float:
+    """Max |kernel - reference| over VALID query slots of a random ragged
+    workload — one row per entry of `q_lens` (mixed decode q_len=1 rows
+    and prefill-chunk rows in the same batch, the --mixed-step shape).
+    Shared by tests/test_mixed_step.py, diagnostics.py --mixed-parity,
+    and the on-chip campaign's `mixed` stage."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    batch = len(q_lens)
+    w = max(q_lens)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (batch, w, n_heads, d_head), dtype)
+    k_pool = jax.random.normal(
+        keys[1], (n_blocks, block_size, n_kv_heads, d_head), dtype)
+    v_pool = jax.random.normal(
+        keys[2], (n_blocks, block_size, n_kv_heads, d_head), dtype)
+    tables = np.zeros((batch, table_len), np.int32)
+    pos0 = np.zeros((batch,), np.int32)
+    for r, ql in enumerate(q_lens):
+        tables[r] = 1 + rng.permutation(n_blocks - 1)[:table_len]
+        # Row history + this chunk must fit the table.
+        pos0[r] = int(rng.integers(0, table_len * block_size - ql + 1))
+    tables = jnp.asarray(tables)
+    qlen = jnp.asarray(np.asarray(q_lens, np.int32))
+    pos0 = jnp.asarray(pos0)
+    ours = ragged_paged_attention(q, k_pool, v_pool, tables, pos0, qlen)
+    ref = ragged_paged_attention_reference(q, k_pool, v_pool, tables,
+                                           pos0, qlen)
+    diff = jnp.abs(ours.astype(jnp.float32) - ref.astype(jnp.float32))
+    valid = (jnp.arange(w)[None, :] < qlen[:, None])  # padding slots: ignored
+    return float(jnp.max(jnp.where(valid[:, :, None, None], diff, 0.0)))

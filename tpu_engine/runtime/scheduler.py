@@ -11,16 +11,27 @@ and leave between chunks —
 - The batch is `n_slots` rows over one preallocated KV cache
   (L, n_slots, max_seq, H, D). All shapes static: the decode chunk and the
   per-bucket prefill/insert executables each compile exactly once.
-- **Admission**: a new request prefills alone on a (1, prompt-bucket)
-  executable, then its KV slice is written into a free row
-  (`dynamic_update_slice` on the row axis) with per-row `pos`/`start`.
+- **Admission** (two-path modes): a new request prefills alone on a
+  (1, prompt-bucket) executable — on the PREFILL THREAD, so admission
+  compute never stalls the decode loop's host side — then its KV slice
+  is written into a free row (`dynamic_update_slice` on the row axis)
+  with per-row `pos`/`start`.
 - **Decode** runs `transformer_decode_rows` — every row carries its own
   cache position, so rows admitted at different times decode side by side.
   Finished rows (EOS or budget) free their slot between chunks; idle rows
   burn lanes of an already-launched batch, not wall-clock.
+- **Mixed stepping** (`mixed_step=True`, paged layout only) replaces the
+  two-path discipline: the prefill thread becomes pure batch formation
+  (bucket pick + radix lookup), and each tick issues ONE ragged dispatch
+  (`transformer_step_rows_ragged`) serving decode rows (1 token each)
+  and admitting rows' budgeted prefill chunks together — admission work
+  rides the decode dispatch instead of contending with it on the device
+  queue (PERF.md "Mixed stepping": 3.7× lower ITL p99 under
+  long-prompt interference, identical streams).
 - Sampling is the generator's per-row fold_in(seed, position) scheme, so a
   seeded request emits identical tokens whether it was admitted into an
-  empty, full, or draining batch (tested).
+  empty, full, or draining batch — and whichever stepping discipline or
+  cache layout served it (tested).
 
 `submit()` returns a Future; a daemon thread runs the admit→decode→emit
 loop. `generate()` is a blocking convenience with the same signature as
@@ -49,6 +60,7 @@ from tpu_engine.models.transformer import (
     transformer_decode_rows_paged,
     transformer_decode_window,
     transformer_prefill,
+    transformer_step_rows_ragged,
 )
 from tpu_engine.runtime.generator import (
     _DTYPES,
@@ -65,6 +77,7 @@ from tpu_engine.runtime.kv_blocks import (
     scatter_blocks,
 )
 from tpu_engine.utils.deadline import Deadline, DeadlineExceeded
+from tpu_engine.utils.metrics import LatencyHistogram
 from tpu_engine.utils.sampling import (
     MAX_STOP_TOKENS,
     clamp_top_k,
@@ -179,6 +192,8 @@ class ContinuousGenerator:
         kv_block_size: int = 0,
         kv_blocks: int = 0,
         prefix_sharing: bool = True,
+        mixed_step: bool = False,
+        mixed_token_budget: int = 0,
     ):
         """`kv_block_size` > 0 switches the KV cache from one dense
         (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
@@ -188,7 +203,22 @@ class ContinuousGenerator:
         maps any shared prompt prefix onto already-filled blocks and
         resumes prefill mid-prompt. 0 (default) keeps the dense cache:
         behavior, compiled executables, and streams are exactly the
-        pre-paging scheduler's."""
+        pre-paging scheduler's.
+
+        `mixed_step` (paged mode only) merges the prefill and decode
+        paths into a single token-budgeted mixed step: each tick forms
+        ONE ragged batch of (decode rows x 1 token) + (admitting rows x
+        a prefill chunk) and issues exactly one compiled dispatch
+        (transformer_step_rows_ragged) — admission work rides the
+        decode dispatch instead of queueing beside it, so a long prompt
+        can no longer head-of-line-block in-flight rows' tokens. The
+        prefill thread becomes pure batch formation (bucket pick +
+        radix lookup; no device work). `mixed_token_budget` caps new
+        tokens per tick (decode rows count 1 each; the remainder is
+        split over admitting rows' chunks, and also caps the compiled
+        chunk width) so per-tick latency stays bounded; 0 = auto
+        (prefill_chunk). Seeded streams are byte-identical to the dense
+        and two-path paged schedulers (tested)."""
         if isinstance(model, str):
             _ensure_builtin_models_imported()
             model = create_model(model)
@@ -299,6 +329,52 @@ class ContinuousGenerator:
         # granularity instead of stalling behind a long prompt (0 = off).
         self._prefill_chunk = int(prefill_chunk)
         self._window_exe = None
+        # Mixed stepping (paged only): ONE ragged dispatch per tick.
+        self._mixed = bool(mixed_step)
+        if self._mixed and not self._paged:
+            raise ValueError("mixed_step requires the paged KV cache "
+                             "(set kv_block_size > 0)")
+        # In mixed mode decode rows advance one token per tick, so block
+        # growth and admission headroom reserve a 1-column horizon, not a
+        # step_chunk-sized one.
+        self._decode_horizon = 1 if self._mixed else self._step_chunk
+        if self._mixed:
+            budget = int(mixed_token_budget) or (self._prefill_chunk
+                                                 if self._prefill_chunk > 0
+                                                 else 256)
+            self._mixed_budget = max(1, budget)
+            # Per-row chunk cap == compiled ragged width. Exactly two
+            # compiled widths exist per controls variant (1 and the cap):
+            # a narrower final chunk pads with null-block slots instead of
+            # compiling its own executable.
+            self._chunk_cap = max(1, min(
+                self._prefill_chunk if self._prefill_chunk > 0 else budget,
+                budget))
+            self._prefilling = [False] * self.n_slots
+            self._row_prompt: List[Optional[np.ndarray]] = \
+                [None] * self.n_slots
+            self._row_prompt_toks: List[Optional[List[int]]] = \
+                [None] * self.n_slots
+            self._row_L = [0] * self.n_slots
+            self._row_w0 = [0] * self.n_slots
+            self._stats["mixed"] = {
+                "ticks": 0, "dispatches": 0, "prefill_tokens": 0,
+                "decode_tokens": 0, "coscheduled_ticks": 0,
+                "token_budget": self._mixed_budget,
+                "chunk_cap": self._chunk_cap,
+            }
+        # TTFT / inter-token-latency histograms — the two numbers mixed
+        # stepping exists to improve, scrapeable at /metrics
+        # (tpu_engine_ttft_seconds / tpu_engine_itl_seconds) on every
+        # scheduler mode. ITL samples are per stream delivery: the gap
+        # since the row's previous visible tokens.
+        self.ttft_hist = LatencyHistogram()
+        self.itl_hist = LatencyHistogram()
+        self._row_last_emit = [0.0] * self.n_slots
+        # Optional tracing (set by the serving worker): per-tick
+        # `mixed_step` spans carrying prefill_tokens/decode_rows attrs.
+        self.tracer = None
+        self.trace_node = "scheduler"
         self._running = True
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="continuous-prefill", daemon=True)
@@ -558,6 +634,64 @@ class ContinuousGenerator:
                     donate_argnums=(1, 12) if controls else (1,))
             return self._decode_exe[("paged", controls)]
 
+    def _mixed_step_exe(self, width: int, controls: bool):
+        """Compiled mixed step: ONE ragged dispatch serving decode rows
+        (q_len 1) and prefill-chunk rows (q_len up to `width`) together —
+        forward, KV pool writes, and sampling fused. Per-row host inputs:
+        `sample_slot` picks the logits slot to sample (decode: 0;
+        completing prefill: L-1-pos0), `fold_pos` is the sampled token's
+        logical position (the fold_in(seed, position) rule every path
+        shares), `active` marks rows whose sample is REAL this tick
+        (mid-prompt rows ride along without emitting or touching
+        counts). Exactly two widths compile per controls variant (1 and
+        the chunk cap)."""
+        key = ("mixed", width, controls)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                from tpu_engine.ops.paged_attention import (
+                    default_ragged_attention,
+                )
+
+                cfg, dtype = self.cfg, self._dtype
+                attn_fn = default_ragged_attention()
+
+                def mixed_step(params, caches, tables, tokens, pos0, qlen,
+                               sample_slot, fold_pos, active, done,
+                               seeds, temps, topps, topks, minps, eos_vec,
+                               counts=None, pens=None, stops=None):
+                    # sample_slot gathers the hidden state BEFORE the LM
+                    # head: one (B, vocab) projection per tick, not W.
+                    logits, caches = transformer_step_rows_ragged(
+                        params, tokens, caches, tables, pos0, qlen, cfg,
+                        dtype=dtype, attn_fn=attn_fn,
+                        sample_slot=sample_slot)
+                    rows = jnp.arange(tokens.shape[0])
+                    if controls:
+                        logits = apply_repetition_penalty(logits, counts,
+                                                          pens)
+                    nxt = _sample(logits, seeds, fold_pos, temps, topps,
+                                  topks, minps)
+                    live = active & ~done
+                    nxt = jnp.where(live, nxt, eos_vec)
+                    if controls:
+                        counts = counts.at[rows, nxt].add(
+                            live.astype(jnp.int32))
+                    done = done | (live & (nxt == eos_vec))
+                    if controls:
+                        done = done | (live & jnp.any(
+                            nxt[:, None] == stops, axis=1))
+                    if controls:
+                        return caches, nxt, done, counts
+                    return caches, nxt, done
+
+                self._decode_exe[key] = jax.jit(
+                    mixed_step,
+                    donate_argnums=(1, 16) if controls else (1,))
+            return self._decode_exe[key]
+
     # -- public API ------------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -628,6 +762,11 @@ class ContinuousGenerator:
         out = dict(self._stats, n_slots=self.n_slots,
                    active=int(sum(r is not None for r in self._row_req)),
                    prefix_cache=self._prefix_cache.stats())
+        if self._mixed:
+            # Snapshot, not the live nested dict — callers diff stats()
+            # across time (bench warm-up subtraction) and must not see
+            # their baseline mutate under them.
+            out["mixed"] = dict(self._stats["mixed"])
         if self._paged:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = len(self._pending)
@@ -661,6 +800,17 @@ class ContinuousGenerator:
             self._stats["deadline_cancelled"] = (
                 self._stats.get("deadline_cancelled", 0) + 1)
         self._fail_request(req, DeadlineExceeded(message))
+
+    def _count_admission_dispatch(self, n: int = 1) -> None:
+        """Device dispatches issued by the ADMISSION side of the two-path
+        scheduler (prefill forwards/windows, prefix gathers, row
+        scatters) — the dispatches mixed stepping folds into the decode
+        tick. `bench.py --scenario mixed-ab` reads chunks +
+        admission_dispatches as the baseline's dispatch count. Lock: the
+        prefill and decode threads both increment."""
+        with self._stats_lock:
+            self._stats["admission_dispatches"] = (
+                self._stats.get("admission_dispatches", 0) + n)
 
     @staticmethod
     def _fail_request(req: _Request, exc: BaseException) -> None:
@@ -698,7 +848,11 @@ class ContinuousGenerator:
             except Exception as exc:
                 self._fail_request(req, exc)
                 continue
-            if req.sink is not None:
+            if req.sink is not None and not self._mixed:
+                # Mixed mode records its real (multi-tick) "prefill"
+                # span at prompt completion in _tick_mixed — staging the
+                # batch-formation wrapper here too would double-count
+                # the stage and pollute its histogram with ~µs samples.
                 dur_us = (time.perf_counter() - t0) * 1e6
                 req.sink.stage("prefill", dur_us,
                                start_ts=time.time() - dur_us / 1e6,
@@ -789,6 +943,7 @@ class ContinuousGenerator:
                 with pool.lock:  # dispatch-order fence vs pool donation
                     row_caches = self._gather(pb // bs)(
                         pool.caches.k, pool.caches.v, jnp.asarray(ids))
+                self._count_admission_dispatch()
             else:
                 row_caches = init_caches(self.cfg, 1, pb, self._dtype)
                 if self._device is not None:
@@ -821,6 +976,7 @@ class ContinuousGenerator:
                     self.params, jnp.asarray(tokens[:, w0:w0 + width]),
                     row_caches, jnp.asarray([w0], jnp.int32),
                     jnp.asarray([0], jnp.int32), head)
+                self._count_admission_dispatch()
                 if head == "all":
                     logits = wlog[0, Leff - 1 - w0]
                 w0 += width
@@ -837,8 +993,42 @@ class ContinuousGenerator:
         return (req, row_caches, first_tok, pb, L, row_counts, matched,
                 prompt, gen)
 
+    def _run_prefill_mixed(self, req: _Request):
+        """Mixed-mode batch formation (the prefill thread's whole job
+        here): pick the bucket, take the radix pins, precompute the
+        penalty counts — NO device work. The prompt's forward pass runs
+        inside the decode thread's ragged ticks instead. Returns the
+        same 9-tuple shape as `_run_prefill_paged` (row_caches and
+        first_tok slots None — both materialize in-dispatch), so every
+        downstream path (deadline drop, pool-pressure parking, shutdown
+        drain, `_discard_item`) works unchanged."""
+        pool = self._pool
+        pb = next((b for b in self._prompt_buckets if b >= len(req.prompt)),
+                  self._prompt_buckets[-1])
+        prompt = req.prompt[-pb:]
+        L = len(prompt)
+        matched: List[int] = []
+        t0 = time.perf_counter()
+        with pool.lock:
+            gen = pool.generation
+            if self._prefix_sharing:
+                matched = pool.radix.lookup(prompt)  # pins for this row
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("radix_lookup", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           matched_tokens=len(matched) * pool.block_size)
+        row_counts = None
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            # Prompt-token counts only — the first sampled token joins
+            # in-dispatch (the ragged step's counts scatter).
+            row_counts = token_counts([prompt], 1, self.cfg.vocab)
+        return (req, None, None, pb, L, row_counts, matched, prompt, gen)
+
     def _run_prefill(self, req: _Request):
         if self._paged:
+            if self._mixed:
+                return self._run_prefill_mixed(req)
             return self._run_prefill_paged(req)
         pb = next((b for b in self._prompt_buckets if b >= len(req.prompt)),
                   self._prompt_buckets[-1])
@@ -894,11 +1084,13 @@ class ContinuousGenerator:
                         jnp.asarray(tokens[:, w0:min(w0 + w, pb)]),
                         row_caches, jnp.asarray([w0], jnp.int32),
                         start_vec, head)
+                self._count_admission_dispatch(len(starts))
                 logits = wlog[0, -1]
             else:
                 logits, row_caches = self._prefill()(
                     self.params, jnp.asarray(tokens), jnp.asarray(attn),
                     jnp.asarray(pos_ids))
+                self._count_admission_dispatch()
             if prefix_cache.budget > 0:
                 prefix_cache.put(key, logits, row_caches)
         # First token from the prefill logits at logical position L (same
@@ -931,7 +1123,7 @@ class ContinuousGenerator:
                     "kv pool was rebuilt during this request's admission")
             # Cover the bucket AND the first decode chunk's columns so
             # the chunk never writes through an unallocated table entry.
-            cols = min(first_col + self._step_chunk + 1, self.max_seq)
+            cols = min(first_col + self._decode_horizon + 1, self.max_seq)
             need = max(nb_bucket, (cols - 1) // bs + 1)
             fresh = pool.alloc(need - m)  # PoolExhausted -> defer
             ids = np.zeros((nb_bucket,), np.int32)
@@ -953,6 +1145,7 @@ class ContinuousGenerator:
                 pool.caches, row_caches.k, row_caches.v, jnp.asarray(ids))
             if self._prefix_sharing:
                 pool.radix.insert(prompt, table)
+        self._count_admission_dispatch()
         self._tables[row, :] = 0
         self._tables[row, :len(table)] = table
         self._row_blocks[row] = table
@@ -967,6 +1160,71 @@ class ContinuousGenerator:
             self._counts = self._ensure_counts().at[row].set(
                 jnp.asarray(row_counts[0]))
         self._init_row(req, row, first_tok, pos=first_col, start=0)
+
+    def _admit_mixed(self, item, row: int) -> None:
+        """Mixed-mode admission (decode thread): allocate the bucket's
+        blocks up front (radix-matched prefix blocks enter the table
+        pinned), make the two write targets private, and mark the row
+        PREFILLING — the prompt forward runs chunk-by-chunk inside the
+        subsequent ragged ticks, writing KV straight into these blocks.
+        Raises PoolExhausted (nothing consumed) to defer under pool
+        pressure, exactly like `_admit_paged`."""
+        (req, _rc, _ft, pb, L, row_counts, matched, prompt, gen) = item
+        pool = self._pool
+        bs = pool.block_size
+        m = len(matched)
+        Leff = max(L, 1)
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        first_col = min(L, self.max_seq - 1)  # first decode write column
+        # Resume at the block boundary at/below the radix match; the last
+        # prompt block always recomputes so logits for the first sample
+        # come from this row's own forward (sampling params stay OUT of
+        # the radix key, same rule as the two-path scheduler).
+        p0 = (min(m * bs, Leff - 1) // bs) * bs
+        with pool.lock:
+            if gen != pool.generation:
+                raise _StaleAdmission(
+                    "kv pool was rebuilt during this request's admission")
+            cols = min(first_col + self._decode_horizon + 1, self.max_seq)
+            need = max(pb // bs, (cols - 1) // bs + 1)
+            fresh = pool.alloc(need - m)  # PoolExhausted -> defer
+            table = list(matched) + fresh
+            # Blocks this row will WRITE must be private: the resumed
+            # window's first block (shared only on a whole-prompt match)
+            # and the decode append block. The two indices coincide
+            # whenever both are shared, so at most ONE copy ever happens
+            # — a PoolExhausted here leaves no partial swap behind.
+            try:
+                for bi in sorted({p0 // bs, first_col // bs}):
+                    wid, copied = pool.ensure_writable(table[bi])
+                    if copied:
+                        table[bi] = wid
+            except PoolExhausted:
+                pool.release_many(fresh)
+                raise
+            pool.prefix_hit_tokens += p0
+            pool.prefilled_tokens += Leff - p0
+        self._tables[row, :] = 0
+        self._tables[row, :len(table)] = table
+        self._row_blocks[row] = table
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("kv_alloc", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           blocks=len(table), shared_blocks=m)
+        if row_counts is not None:
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._set_row_params(req, row, pos=first_col, start=0)
+        self._prefilling[row] = True
+        self._row_prompt[row] = right_pad_prompt(prompt, pb)[0]
+        self._row_prompt_toks[row] = prompt
+        self._row_L[row] = L
+        self._row_w0[row] = p0
+        self._row_emitted[row] = []
+        self._done[row] = False
+        self._stats["admitted"] += 1
 
     def _release_row_blocks(self, row: int) -> None:
         """Return a freed row's block references to the pool (blocks the
@@ -987,9 +1245,10 @@ class ContinuousGenerator:
                 if item[8] == self._pool.generation:
                     self._pool.release_many(item[6])
 
-    def _init_row(self, req: _Request, row: int, first_tok: int, *,
-                  pos: int, start: int) -> None:
-        """Host-side row state shared by both admission paths."""
+    def _set_row_params(self, req: _Request, row: int, *, pos: int,
+                        start: int) -> None:
+        """Per-row sampling/stopping vectors — shared by every admission
+        path (dense, paged, mixed)."""
         self._start[row] = start
         self._pos[row] = pos
         self._seeds[row] = int(req.seed) & 0x7FFFFFFF
@@ -1000,12 +1259,24 @@ class ContinuousGenerator:
         self._pens[row] = req.rep_penalty
         self._stops[row] = -1
         self._stops[row, :len(req.stop_tokens)] = req.stop_tokens
-        self._tok[row] = first_tok
         self._row_req[row] = req
+
+    def _first_token_metrics(self, req: _Request, row: int) -> None:
+        """TTFT observation at the moment a request's first token exists."""
+        now = time.perf_counter()
+        self.ttft_hist.observe(max(0.0, now - req.t_submit))
+        self._row_last_emit[row] = now
+
+    def _init_row(self, req: _Request, row: int, first_tok: int, *,
+                  pos: int, start: int) -> None:
+        """Host-side row state shared by both two-path admission modes."""
+        self._set_row_params(req, row, pos=pos, start=start)
+        self._tok[row] = first_tok
         self._row_emitted[row] = [first_tok]
         self._done[row] = ((req.eos_id >= 0 and first_tok == req.eos_id)
                            or first_tok in req.stop_tokens)
         self._stats["admitted"] += 1
+        self._first_token_metrics(req, row)
         self._push_stream(row, req)  # first token flushes at admission
         self._maybe_complete(row)
 
@@ -1013,7 +1284,10 @@ class ContinuousGenerator:
         """Decode-thread half of admission: splice the prefilled KV block
         into the shared cache and initialise the row's host-side state."""
         if self._paged:
-            self._admit_paged(item, row)
+            if self._mixed:
+                self._admit_mixed(item, row)
+            else:
+                self._admit_paged(item, row)
             return
         req, row_caches, first_tok, pb, L, row_counts = item
         req.t_admit = time.perf_counter()
@@ -1024,7 +1298,20 @@ class ContinuousGenerator:
         else:
             self._caches = self._insert(False)(
                 self._caches, row_caches.k, row_caches.v, row)
+        self._count_admission_dispatch()
         self._init_row(req, row, first_tok, pos=pb, start=pb - L)
+
+    def _clear_mixed_row(self, row: int) -> None:
+        """Drop a row's mixed-mode prefill state (completion, deadline
+        cancel, recovery, shutdown): the row must never reappear in a
+        later tick's ragged batch."""
+        if not self._mixed:
+            return
+        self._prefilling[row] = False
+        self._row_prompt[row] = None
+        self._row_prompt_toks[row] = None
+        self._row_L[row] = 0
+        self._row_w0[row] = 0
 
     def _visible_tokens(self, row: int, req: _Request) -> List[int]:
         """The request's client-visible tokens so far: budget-capped and
@@ -1067,6 +1354,7 @@ class ContinuousGenerator:
             self._row_emitted[row] = []
             self._done[row] = True
             self._release_row_blocks(row)
+            self._clear_mixed_row(row)
             self._stats["completed"] += 1
 
     def _cancel_expired_rows(self) -> None:
@@ -1085,6 +1373,7 @@ class ContinuousGenerator:
                 self._row_emitted[r] = []
                 self._done[r] = True
                 self._release_row_blocks(r)
+                self._clear_mixed_row(r)
 
     def _recover(self, exc: BaseException) -> None:
         """Device-step failure recovery. The prefill/decode executables
@@ -1099,6 +1388,7 @@ class ContinuousGenerator:
                 self._fail_request(req, exc)
             self._row_req[r] = None
             self._row_emitted[r] = []
+            self._clear_mixed_row(r)
         self._pos[:] = 0
         self._start[:] = 0
         self._tok[:] = 0
@@ -1138,6 +1428,7 @@ class ContinuousGenerator:
                     self._row_req[r] = None
                     self._row_emitted[r] = []
                 self._release_row_blocks(r)
+                self._clear_mixed_row(r)
             if self._paged:
                 while self._pending:
                     item = self._pending.popleft()
@@ -1165,7 +1456,9 @@ class ContinuousGenerator:
         for r, req in enumerate(self._row_req):
             if req is None or self._done[r]:
                 continue  # done rows rewrite their own (allocated) column
-            last_col = min(int(self._pos[r]) + self._step_chunk,
+            if self._mixed and self._prefilling[r]:
+                continue  # bucket + first-decode blocks reserved at admit
+            last_col = min(int(self._pos[r]) + self._decode_horizon,
                            self.max_seq - 1)
             need = last_col // bs + 1
             have = len(self._row_blocks[r])
@@ -1182,6 +1475,167 @@ class ContinuousGenerator:
                 continue
             self._tables[r, have:need] = fresh
             self._row_blocks[r].extend(fresh)
+
+    def _tick_mixed(self) -> None:
+        """One mixed tick: form the ragged batch (decode rows x 1 token +
+        admitting rows x a budgeted prefill chunk), issue exactly ONE
+        compiled dispatch, and apply the results host-side. Budget rule:
+        decode rows are always included (1 token each); the remaining
+        budget splits over prefilling rows in row order — the first
+        prefilling row always gets at least one token, so admission can
+        never deadlock behind a saturated decode batch."""
+        pool = self._pool
+        B = self.n_slots
+        t0 = time.perf_counter()
+        eos_vec = np.full((B,), -1, np.int32)
+        controls = False
+        n_decode = 0
+        prefill_rows: List[int] = []
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            if req.eos_id >= 0:
+                eos_vec[r] = req.eos_id
+            if req.rep_penalty != 1.0 or req.stop_tokens:
+                controls = True
+            if self._prefilling[r]:
+                prefill_rows.append(r)
+            else:
+                n_decode += 1
+        budget_left = max(1, self._mixed_budget - n_decode)
+        chunk = np.zeros((B,), np.int32)
+        for r in prefill_rows:
+            remaining = max(self._row_L[r], 1) - self._row_w0[r]
+            c = min(remaining, self._chunk_cap, budget_left)
+            chunk[r] = max(0, c)
+            budget_left -= chunk[r]
+        width = self._chunk_cap if prefill_rows and chunk.max() > 0 else 1
+
+        tokens = np.zeros((B, width), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        qlen = np.zeros((B,), np.int32)
+        sample_slot = np.zeros((B,), np.int32)
+        fold_pos = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        completing = [False] * B
+        prefill_tokens = 0
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue  # free rows: qlen 0, inactive, null-block writes
+            if self._prefilling[r]:
+                w0 = self._row_w0[r]
+                c = int(chunk[r])
+                Leff = max(self._row_L[r], 1)
+                pos0[r] = w0
+                qlen[r] = c
+                prefill_tokens += c
+                if c > 0:
+                    tokens[r, :c] = self._row_prompt[r][w0:w0 + c]
+                    if w0 <= Leff - 1 < w0 + c:
+                        # This chunk reaches the prompt's last token: the
+                        # dispatch samples the request's FIRST token from
+                        # slot Leff-1-w0 at logical position L (the exact
+                        # _first_token rule of the two-path modes).
+                        completing[r] = True
+                        active[r] = True
+                        sample_slot[r] = Leff - 1 - w0
+                        fold_pos[r] = self._row_L[r]
+            else:
+                pos0[r] = self._pos[r]
+                qlen[r] = 1
+                tokens[r, 0] = self._tok[r]
+                fold_pos[r] = int(self._pos[r]) + 1
+                active[r] = not self._done[r]
+
+        # ONE dispatch, under the pool lock (it donates the pool buffers).
+        with pool.lock:
+            common = (self.params, pool.caches, jnp.asarray(self._tables),
+                      jnp.asarray(tokens), jnp.asarray(pos0),
+                      jnp.asarray(qlen), jnp.asarray(sample_slot),
+                      jnp.asarray(fold_pos), jnp.asarray(active),
+                      jnp.asarray(self._done), jnp.asarray(self._seeds),
+                      jnp.asarray(self._temps), jnp.asarray(self._topps),
+                      jnp.asarray(self._topks), jnp.asarray(self._minps),
+                      jnp.asarray(eos_vec))
+            if controls:
+                pool.caches, nxt, done, self._counts = self._mixed_step_exe(
+                    width, True)(*common, self._ensure_counts(),
+                                 jnp.asarray(self._pens),
+                                 jnp.asarray(self._stops))
+            else:
+                pool.caches, nxt, done = self._mixed_step_exe(
+                    width, False)(*common)
+        start_host_copies(nxt, done)
+        nxt = np.array(nxt)
+        done_new = np.array(done)
+        # Dispatch counted only past the host sync above — a device-step
+        # failure surfaces asynchronously AT that sync (not at the
+        # enqueue), and a recovered failure must leave dispatches and
+        # ticks equal (the invariant scrapers and the bench assert).
+        # Still a separate statement/site from the tick counter below.
+        self._stats["mixed"]["dispatches"] += 1
+
+        m = self._stats["mixed"]
+        m["ticks"] += 1
+        m["prefill_tokens"] += prefill_tokens
+        m["decode_tokens"] += n_decode
+        if prefill_tokens and n_decode:
+            m["coscheduled_ticks"] += 1
+
+        for r in list(range(B)):
+            req = self._row_req[r]
+            if req is None:
+                continue
+            if self._prefilling[r]:
+                self._row_w0[r] += int(chunk[r])
+                if not completing[r]:
+                    continue
+                # Prompt consumed: the row becomes a decode row. Index
+                # the now-filled prompt blocks in the radix tree (mixed
+                # mode inserts at COMPLETION — a cancelled mid-prefill
+                # row must never leave half-written blocks indexed).
+                self._prefilling[r] = False
+                if self._prefix_sharing:
+                    with pool.lock:
+                        pool.radix.insert(self._row_prompt_toks[r],
+                                          self._row_blocks[r])
+                if req.sink is not None:
+                    dur_us = (time.perf_counter() - req.t_admit) * 1e6
+                    req.sink.stage("prefill", dur_us,
+                                   start_ts=time.time() - dur_us / 1e6,
+                                   prompt_len=self._row_L[r])
+                    req.t_admit = time.perf_counter()  # decode span start
+                first_tok = int(nxt[r])
+                self._tok[r] = first_tok
+                self._done[r] = bool(done_new[r])
+                self._row_emitted[r] = [first_tok]
+                self._first_token_metrics(req, r)
+                self._push_stream(r, req)
+                self._maybe_complete(r)
+                continue
+            tok_r = int(nxt[r])
+            self._tok[r] = tok_r
+            self._done[r] = bool(done_new[r])
+            if not self._done[r]:
+                self._pos[r] = min(int(self._pos[r]) + 1, self.max_seq - 1)
+            if req.max_new - len(self._row_emitted[r]) > 0:
+                self._row_emitted[r].append(tok_r)
+                now = time.perf_counter()
+                if self._row_last_emit[r] > 0:
+                    self.itl_hist.observe(
+                        max(0.0, now - self._row_last_emit[r]))
+                self._row_last_emit[r] = now
+            self._push_stream(r, req)
+            self._maybe_complete(r)
+
+        if self.tracer is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            self.tracer.record(
+                "tick", "mixed_step", self.trace_node, dur_us,
+                start_ts=time.time() - dur_us / 1e6,
+                attrs={"prefill_tokens": int(prefill_tokens),
+                       "decode_rows": int(n_decode),
+                       "width": int(width)})
 
     def _loop_body(self) -> None:
         while self._running:
@@ -1230,7 +1684,7 @@ class ContinuousGenerator:
                     # otherwise park it until completions free blocks.
                     bs = self._pool.block_size
                     cols = min(min(item[4], self.max_seq - 1)
-                               + self._step_chunk + 1, self.max_seq)
+                               + self._decode_horizon + 1, self.max_seq)
                     nb_need = max(item[3] // bs, (cols - 1) // bs + 1)
                     if nb_need > self._pool.num_blocks - 1:
                         if from_pending:
@@ -1245,10 +1699,11 @@ class ContinuousGenerator:
                         # holding pins makes its prefix unevictable,
                         # and two mutually-pinned parked items with no
                         # live rows would starve each other forever.
-                        # Dropping them is fully correct — the row
-                        # cache already holds the gathered prefix KV,
-                        # so the retry scatters every bucket block
-                        # itself (it just shares nothing).
+                        # Dropping them is fully correct — two-path
+                        # items already hold the gathered prefix KV in
+                        # their row cache, and mixed items simply
+                        # re-prefill from position 0 at the retry
+                        # (either way the request just shares nothing).
                         self._discard_item(item)
                         item = item[:6] + ([], item[7], item[8])
                         self._pending.append(item)
@@ -1275,6 +1730,16 @@ class ContinuousGenerator:
                     break
             self._cancel_expired_rows()
             if all(r is None for r in self._row_req):
+                continue
+
+            if self._mixed:
+                # ONE ragged dispatch serves this tick's decode rows and
+                # prefill chunks together (admission folded into the
+                # decode dispatch — no second device path to contend).
+                try:
+                    self._tick_mixed()
+                except Exception as exc:
+                    self._recover(exc)
                 continue
 
             try:
@@ -1353,5 +1818,13 @@ class ContinuousGenerator:
                 if need > 0:
                     self._row_emitted[r].extend(
                         int(t) for t in toks_host[r, :need])
+                    # ITL sample: the gap since this row's previous
+                    # visible tokens (one per delivery — the cadence a
+                    # streaming client actually sees).
+                    now = time.perf_counter()
+                    if self._row_last_emit[r] > 0:
+                        self.itl_hist.observe(
+                            max(0.0, now - self._row_last_emit[r]))
+                    self._row_last_emit[r] = now
                 self._push_stream(r, req)  # fresh tokens flush per chunk
                 self._maybe_complete(r)

@@ -54,7 +54,8 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     server.route("GET", "/health", lambda _body: (200, worker.get_health()))
     server.route("GET", "/metrics", lambda _body: (
         200, render_prometheus([worker.get_health()],
-                               recorders={worker.node_id: worker.tracer}),
+                               recorders={worker.node_id: worker.tracer},
+                               named_hists=worker.latency_histograms()),
         "text/plain; version=0.0.4"))
     server.route("GET", "/trace", lambda _body: (200, {
         "summary": {worker.node_id: worker.tracer.summary()},
@@ -268,23 +269,28 @@ def serve_combined(
         lambda body: (200, gateway.route_generate_stream(body)))
 
     def _stats(_body):
-        """Gateway /stats, plus per-lane paged-KV pool health when a
-        decode lane runs the paged cache (additive key; the
+        """Gateway /stats, plus per-lane paged-KV pool and mixed-step
+        health when a decode lane runs them (additive keys; the
         reference-exact schema is untouched for dense deployments)."""
         out = gateway.get_stats()
-        kv = {}
+        kv, mixed = {}, {}
         for w in workers:
             gen = getattr(w, "generator", None)
             if gen is None or not hasattr(gen, "stats"):
                 continue
             try:
-                pool = gen.stats().get("kv_pool")
+                st = gen.stats()
             except Exception:
-                pool = None
-            if pool:
-                kv[w.node_id] = pool
+                continue
+            if st.get("kv_pool"):
+                kv[w.node_id] = st["kv_pool"]
+            if st.get("mixed"):
+                mixed[w.node_id] = dict(st["mixed"],
+                                        active=st.get("active"))
         if kv:
             out["kv_pool"] = kv
+        if mixed:
+            out["mixed"] = mixed
         return 200, out
 
     routes[("GET", "/stats")] = _stats
@@ -404,12 +410,20 @@ def serve_combined(
     routes[("GET", "/trace")] = _trace
     routes[("GET", "/trace/export")] = _trace_export
     routes[("POST", "/admin/profile")] = _admin_profile
+    def _named_hists():
+        named = {}
+        for w in workers:
+            for name, by_node in w.latency_histograms().items():
+                named.setdefault(name, {}).update(by_node)
+        return named
+
     routes[("GET", "/metrics")] = lambda _b: (
         200, render_prometheus([w.get_health() for w in workers],
                                gateway.get_stats(),
                                recorders={**{w.node_id: w.tracer
                                              for w in workers},
-                                          "gateway": gateway.tracer}),
+                                          "gateway": gateway.tracer},
+                               named_hists=_named_hists()),
         "text/plain; version=0.0.4")
 
     # Hot weight reload (no serving pause; the reference restarts worker

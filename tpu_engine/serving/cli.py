@@ -254,6 +254,20 @@ def main(argv=None) -> int:
                                  "mode): shared prompt prefixes reuse "
                                  "already-filled KV blocks and skip their "
                                  "prefill compute")
+        parser.add_argument("--mixed-step", action="store_true",
+                            help="mixed prefill+decode stepping (needs "
+                                 "--kv-block-size): every scheduler tick "
+                                 "issues ONE ragged dispatch serving decode "
+                                 "rows (1 token each) and admitting rows' "
+                                 "prefill chunks together — long prompts "
+                                 "stop spiking in-flight rows' inter-token "
+                                 "latency (bench.py --scenario mixed-ab)")
+        parser.add_argument("--mixed-token-budget", type=int, default=0,
+                            help="new tokens per mixed tick (decode rows "
+                                 "count 1 each; the rest splits over "
+                                 "admitting rows' chunks and caps the "
+                                 "compiled chunk width). 0 = auto "
+                                 "(--gen-prefill-chunk)")
         parser.add_argument("--quantize", choices=["int8"], default=None,
                             help="weight-only quantization: dense/conv "
                                  "kernels stored int8 with per-channel "
@@ -312,6 +326,9 @@ def main(argv=None) -> int:
                                      gen_kv_blocks=args.kv_blocks,
                                      gen_prefix_sharing=(
                                          args.prefix_sharing == "on"),
+                                     gen_mixed_step=args.mixed_step,
+                                     gen_mixed_token_budget=(
+                                         args.mixed_token_budget),
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)

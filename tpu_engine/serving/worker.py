@@ -316,7 +316,13 @@ class WorkerNode:
                         kv_block_size=self.config.gen_kv_block_size,
                         kv_blocks=self.config.gen_kv_blocks,
                         prefix_sharing=self.config.gen_prefix_sharing,
+                        mixed_step=self.config.gen_mixed_step,
+                        mixed_token_budget=(
+                            self.config.gen_mixed_token_budget),
                         device=getattr(engine, "_device", None))
+                    # Per-tick mixed_step spans land in the lane's ring.
+                    self.generator.tracer = self.tracer
+                    self.generator.trace_node = self.node_id
                 else:
                     from tpu_engine.runtime.generator import Generator
 
@@ -376,7 +382,10 @@ class WorkerNode:
         # (total, hits) served on this lane's behalf outside this process's
         # Python path — the native HTTP front reports through here.
         self.external_counters = None
-        self.tracer = SpanRecorder()
+        # NOTE: self.tracer was created near the top of __init__ (the
+        # engine, batchers, and generation scheduler all hold references
+        # to it); a second assignment here would orphan their recorder —
+        # their spans (xla_compile, mixed_step) would never export.
 
     # -- fault injection -------------------------------------------------------
 
@@ -1287,6 +1296,19 @@ class WorkerNode:
         return results
 
     # -- observability --------------------------------------------------------
+
+    def latency_histograms(self) -> dict:
+        """Named Prometheus histograms beyond the stage-latency family:
+        the decode lane's TTFT and inter-token-latency distributions
+        (`utils.metrics.render_named_histograms` renders them at
+        /metrics). Empty for lanes without a continuous scheduler."""
+        gen = self.generator
+        if gen is None or not hasattr(gen, "ttft_hist"):
+            return {}
+        return {
+            "tpu_engine_ttft_seconds": {self.node_id: gen.ttft_hist},
+            "tpu_engine_itl_seconds": {self.node_id: gen.itl_hist},
+        }
 
     def get_health(self) -> dict:
         """Exact /health schema (``worker_node.cpp:85-103``)."""

@@ -87,6 +87,17 @@ class WorkerConfig:
     # prompts skip their prefill compute and share KV blocks
     # copy-on-write. Off = paging without sharing.
     gen_prefix_sharing: bool = True
+    # Mixed prefill+decode stepping (paged mode only): each scheduler
+    # tick forms ONE ragged batch of (decode rows x 1 token) +
+    # (admitting rows x a prefill chunk) and issues exactly one device
+    # dispatch — admission rides the decode dispatch instead of
+    # contending with it, so long prompts stop spiking in-flight rows'
+    # inter-token latency. Off = the two-path scheduler above.
+    gen_mixed_step: bool = False
+    # Per-tick new-token budget for mixed stepping (decode rows count 1
+    # each; the rest splits over admitting rows' prefill chunks and caps
+    # the compiled chunk width). 0 = auto (gen_prefill_chunk).
+    gen_mixed_token_budget: int = 0
     # Batch scheduler only: run each group's decode as ONE fused dispatch
     # (lax.while_loop, zero per-chunk host syncs; identical streams).
     # Worth enabling where dispatch latency is high; costs one compile per

@@ -106,12 +106,54 @@ def _esc(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
 
 
+def render_named_histograms(
+        named: Dict[str, Dict[str, "LatencyHistogram"]],
+        help_texts: Optional[Dict[str, str]] = None) -> List[str]:
+    """Exposition lines for standalone named histograms (metric name ->
+    node -> LatencyHistogram) — TTFT / inter-token latency live here,
+    outside the stage-latency family, because they are request-level
+    distributions a dashboard alerts on directly. Unobserved histograms
+    are skipped (additive exposition: keys appear once there is data)."""
+    lines: List[str] = []
+    help_texts = help_texts or {}
+    for name in sorted(named):
+        series = [(node, named[name][node].snapshot())
+                  for node in sorted(named[name])]
+        series = [(n, s) for n, s in series if s["count"]]
+        if not series:
+            continue
+        lines.append(f"# HELP {name} "
+                     f"{help_texts.get(name, 'Latency distribution')}")
+        lines.append(f"# TYPE {name} histogram")
+        for node, snap in series:
+            lbl = f'node="{_esc(node)}"'
+            for bound, cum in zip(snap["le"], snap["cumulative"]):
+                lines.append(
+                    f'{name}_bucket{{{lbl},le="{_fmt_le(bound)}"}} {cum}')
+            lines.append(f'{name}_bucket{{{lbl},le="+Inf"}} {snap["inf"]}')
+            lines.append(f"{name}_sum{{{lbl}}} {snap['sum']:.9f}")
+            lines.append(f"{name}_count{{{lbl}}} {snap['count']}")
+    return lines
+
+
+_NAMED_HIST_HELP = {
+    "tpu_engine_ttft_seconds":
+        "Time to first token (submit -> first sampled token), decode lane",
+    "tpu_engine_itl_seconds":
+        "Inter-token latency (gap between a row's token deliveries), "
+        "decode lane",
+}
+
+
 def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
-                      recorders: Optional[Dict[str, object]] = None) -> bytes:
+                      recorders: Optional[Dict[str, object]] = None,
+                      named_hists: Optional[
+                          Dict[str, Dict[str, object]]] = None) -> bytes:
     """healths: per-lane WorkerNode.get_health() dicts; stats: optional
     Gateway.get_stats(); recorders: optional node -> SpanRecorder map for
-    the per-stage latency histograms. Returns the exposition body
-    (text/plain 0.0.4)."""
+    the per-stage latency histograms; named_hists: optional metric name
+    -> node -> LatencyHistogram map (TTFT / ITL). Returns the exposition
+    body (text/plain 0.0.4)."""
     lines: List[str] = []
 
     def metric(name, mtype, help_text, samples):
@@ -186,6 +228,30 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "Prompt tokens actually prefilled on the device",
            [(node(h), p.get("prefilled_tokens")) for h, p in kv])
 
+    # Mixed prefill+decode stepping (continuous scheduler --mixed-step):
+    # one ragged dispatch per tick — ticks and dispatches are counted at
+    # different sites precisely so scrapers can assert they stay equal.
+    mx = [(h, g.get("mixed")) for h, g in gen
+          if isinstance(g, dict) and g.get("mixed")]
+    metric("tpu_engine_mixed_ticks_total", "counter",
+           "Mixed scheduler ticks executed",
+           [(node(h), m.get("ticks")) for h, m in mx])
+    metric("tpu_engine_mixed_dispatches_total", "counter",
+           "Device dispatches issued by mixed ticks (== ticks by design)",
+           [(node(h), m.get("dispatches")) for h, m in mx])
+    metric("tpu_engine_mixed_prefill_tokens_total", "counter",
+           "Prompt tokens consumed inside mixed ticks",
+           [(node(h), m.get("prefill_tokens")) for h, m in mx])
+    metric("tpu_engine_mixed_decode_tokens_total", "counter",
+           "Decode tokens produced by mixed ticks",
+           [(node(h), m.get("decode_tokens")) for h, m in mx])
+    metric("tpu_engine_mixed_coscheduled_ticks_total", "counter",
+           "Ticks that carried BOTH decode rows and prefill chunks",
+           [(node(h), m.get("coscheduled_ticks")) for h, m in mx])
+    metric("tpu_engine_mixed_token_budget", "gauge",
+           "Per-tick new-token budget (--mixed-token-budget)",
+           [(node(h), m.get("token_budget")) for h, m in mx])
+
     # Resilience layer, lane side (the "admission" /health block appears
     # only once admission control has made a decision).
     adm = [(h, h.get("admission")) for h in healths if h.get("admission")]
@@ -251,4 +317,7 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
                    [({}, res.get("hedge_threshold_ms"))])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
+    if named_hists:
+        lines.extend(render_named_histograms(named_hists,
+                                             _NAMED_HIST_HELP))
     return ("\n".join(lines) + "\n").encode()
