@@ -23,7 +23,8 @@ import sys
 
 OK, FAIL = "✓", "✗"
 _results = []
-_TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8
+_TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
+#             --spec-parity step 9
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -75,8 +76,15 @@ def main() -> int:
                          "--mixed-step read path) vs the XLA gather "
                          "reference at mixed q_lens {1, 7, 16, 17} — "
                          "decode rows and prefill chunks in one batch")
+    ap.add_argument("--spec-parity", action="store_true",
+                    help="step 9: ragged kernel at the SPECULATIVE "
+                         "verify-window shapes (--spec-k serving): "
+                         "undrafted decode rows, k+1 verify windows, "
+                         "and block-boundary prefill chunks in one "
+                         "batch vs the XLA gather reference")
     args = ap.parse_args()
-    _TOTAL = 6 + int(args.kernel_parity) + int(args.mixed_parity)
+    _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+              + int(args.spec_parity))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -174,7 +182,7 @@ def main() -> int:
     # reference. On a TPU host this validates the Mosaic compile the
     # tunnel-watchdog campaign needs before re-enabling mixed mode.
     if args.mixed_parity:
-        n = _TOTAL
+        n = 6 + int(args.kernel_parity) + 1
         try:
             import jax.numpy as jnp
 
@@ -192,6 +200,33 @@ def main() -> int:
                  f"(max|Δ| f32 {diff:.2e}, bf16 {bf16:.2e})")
         except Exception as exc:
             step(n, "ragged mixed-step kernel parity", False, f"({exc})")
+
+    # 9 (--spec-parity): the ragged kernel at the verify-window shapes
+    # the --spec-k scheduler dispatches — greedy identity depends on the
+    # verify window's logits matching the plain path's bit-for-bit, so
+    # kernel-vs-reference parity here is the on-chip gate before
+    # enabling continuous speculation on a device.
+    if args.spec_parity:
+        n = 6 + int(args.kernel_parity) + int(args.mixed_parity) + 1
+        try:
+            import jax.numpy as jnp
+
+            from tpu_engine.ops.paged_attention import (
+                spec_verify_parity_check,
+            )
+
+            diff = max(spec_verify_parity_check(k=4),
+                       spec_verify_parity_check(k=3, n_heads=8,
+                                                n_kv_heads=2, d_head=16,
+                                                block_size=8,
+                                                table_len=8))
+            bf16 = spec_verify_parity_check(k=4, dtype=jnp.bfloat16)
+            step(n, "speculative verify-window kernel parity",
+                 diff < 2e-5 and bf16 < 2e-2,
+                 f"(max|Δ| f32 {diff:.2e}, bf16 {bf16:.2e})")
+        except Exception as exc:
+            step(n, "speculative verify-window kernel parity", False,
+                 f"({exc})")
 
     n_ok = sum(_results)
     print(f"\n{n_ok}/{len(_results)} checks passed")

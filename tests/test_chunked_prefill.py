@@ -20,6 +20,7 @@ def _mk(chunk):
                                prefill_chunk=chunk, prefix_cache_mb=0)
 
 
+@pytest.mark.slow
 def test_chunked_matches_monolithic():
     mono = _mk(0)
     chunked = _mk(16)

@@ -196,6 +196,7 @@ def _spread_until_both(pg: int, prefix: str, cap: int = 400,
 
 @pytest.mark.skipif(not os.path.exists(REFERENCE_BENCH),
                     reason="reference checkout not present")
+@pytest.mark.slow
 def test_two_process_worker_failover_and_recovery():
     """Gateway + 2 worker processes over real TCP; kill one worker, traffic
     keeps flowing through ring-order failover; restart it, the breaker
@@ -298,6 +299,7 @@ print(json.dumps(info))
            "collectives ('Multiprocess computations aren't implemented on "
            "the CPU backend') — the rendezvous child's all-reduce dies; "
            "passes on a pod backend", strict=False)
+@pytest.mark.slow
 def test_jax_distributed_two_process_rendezvous(tmp_path):
     """2-process jax.distributed rendezvous + hybrid_mesh DCN branch +
     one cross-process collective (VERDICT r3 item 7: the process_count>1

@@ -54,6 +54,7 @@ def test_dcn_recommendation():
            "collectives — the DCN child ranks die at their first "
            "cross-process op, so the mesh front's HTTP port never opens "
            "(Connection refused); passes on a pod backend", strict=False)
+@pytest.mark.slow
 def test_dcn_hybrid_mesh_train_and_serve():
     """2 REAL processes, hybrid (DCN x ICI) mesh with `data` crossing the
     process boundary (VERDICT r4 missing item 2): one /infer through the
@@ -72,6 +73,7 @@ def test_dcn_hybrid_mesh_train_and_serve():
     reason="this image's jaxlib 0.4.37 CPU backend lacks multiprocess "
            "collectives ('Multiprocess computations aren't implemented on "
            "the CPU backend'); passes on a pod backend", strict=False)
+@pytest.mark.slow
 def test_multiprocess_initialize_and_collective(tmp_path):
     """REAL 2-process coverage of the initialize() multi-process branch
     (round-1 VERDICT item 10: it had never executed anywhere): two spawned

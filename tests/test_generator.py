@@ -75,6 +75,7 @@ def test_sampled_generation_valid(gen):
     assert toks != other or True  # non-flaky: just exercise the path
 
 
+@pytest.mark.slow
 def test_long_prompt_truncates(gen):
     prompt = list(range(1, 40))  # longer than the largest prompt bucket (16)
     got = gen.generate([prompt], max_new_tokens=4)[0]
@@ -140,6 +141,7 @@ def test_top_p_batch_invariant(gen):
     assert alone == batched
 
 
+@pytest.mark.slow
 def test_top_k_one_equals_greedy():
     """top_k=1 collapses categorical sampling to argmax at any temperature
     (given the model's max logit is unique — boundary ties are all kept,

@@ -152,6 +152,7 @@ def test_bert_spec_apply_uses_padding_mask(hf_bert):
 
 # -- ResNet-50 v1.5 ------------------------------------------------------------
 
+@pytest.mark.slow
 def test_resnet50_v1_golden_parity():
     cfg = transformers.ResNetConfig(
         embedding_size=64, hidden_sizes=[256, 512, 1024, 2048],

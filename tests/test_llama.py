@@ -63,6 +63,7 @@ def test_llama_golden_parity(hf_llama):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_prefill_decode_matches_full_forward(hf_llama):
     """Greedy rollout through the cached prefill+decode path (GQA cache,
     rotated keys) must equal re-running the full uncached forward each

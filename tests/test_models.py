@@ -44,6 +44,7 @@ def test_mlp_deterministic():
     np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
 
 
+@pytest.mark.slow
 def test_resnet50_small_forward():
     # Small image keeps CPU compile/runtime reasonable; architecture (depth,
     # strides, expansion) is identical to 224.

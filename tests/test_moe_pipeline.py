@@ -155,6 +155,7 @@ def test_pipeline_rejects_bad_divisibility():
 
 # -- MoE transformer family ---------------------------------------------------
 
+@pytest.mark.slow
 def test_moe_gpt_forward_and_generate():
     """gpt2-moe family: forward is finite; decode loop equals the full
     forward (drop-free capacity) so /generate serves MoE models."""

@@ -43,6 +43,7 @@ def _loss(params, toks, remat):
     return jnp.mean(logits ** 2)
 
 
+@pytest.mark.slow
 def test_remat_gradients_match(setup):
     params, toks = setup
     g0 = jax.grad(functools.partial(_loss, toks=toks, remat=False))(params)
@@ -52,6 +53,7 @@ def test_remat_gradients_match(setup):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_remat_cuts_backward_activation_memory(setup):
     params, toks = setup
     temps = {}

@@ -51,6 +51,7 @@ def test_greedy_matches_full_forward(sched, spec, params):
     assert got == _greedy_ref(params, spec, prompt, 6)
 
 
+@pytest.mark.slow
 def test_staggered_admission_is_isolated(sched, spec, params):
     """Requests submitted while others are mid-decode produce exactly the
     tokens they'd produce alone — admission must not perturb rows."""
@@ -64,6 +65,7 @@ def test_staggered_admission_is_isolated(sched, spec, params):
     assert f3.result(60) == _greedy_ref(params, spec, [1, 4, 4, 2], 8)
 
 
+@pytest.mark.slow
 def test_more_requests_than_slots(sched, spec, params):
     """Oversubscription: requests queue for slots, all complete correctly."""
     prompts = [[i + 1, i + 2] for i in range(9)]  # 9 reqs, 4 slots
@@ -117,6 +119,7 @@ def test_eos_frees_slot(spec, params):
         s.stop()
 
 
+@pytest.mark.slow
 def test_worker_continuous_scheduler(spec, params):
     """Serving integration: gen_scheduler='continuous' — concurrent
     /generate requests decode in one shared batch and answer correctly."""

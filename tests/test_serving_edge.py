@@ -62,6 +62,7 @@ def _short_request(port: int, payload: bytes) -> int:
         return int(data.split(b" ", 2)[1])
 
 
+@pytest.mark.slow
 def test_native_front_connection_churn(combined_stack):
     """Thousands of short-lived connections with one long-lived keep-alive
     client must not stall the accept loop (round-1 http_front.h:156-162

@@ -115,6 +115,7 @@ def test_decode_paths_agree(spec):
     assert a == c
 
 
+@pytest.mark.slow
 def test_speculative_agrees(spec):
     from tpu_engine.runtime.speculative import SpeculativeGenerator
 

@@ -51,6 +51,7 @@ def test_greedy_matches_plain_self_draft(target, plain):
     assert spec.last_stats["mean_tokens_per_round"] > spec.k * 0.9
 
 
+@pytest.mark.slow
 def test_greedy_matches_plain_disagreeing_draft(target, plain):
     """Random independent draft: rejects nearly everything, output still
     exactly the plain greedy stream (speculation never changes content)."""
@@ -63,6 +64,7 @@ def test_greedy_matches_plain_disagreeing_draft(target, plain):
     assert got == want
 
 
+@pytest.mark.slow
 def test_greedy_matches_plain_small_draft(target, plain):
     """Differently-shaped draft (1 layer, same vocab)."""
     draft = create_model("gpt2-small-test", n_layers=1, d_model=32,
@@ -73,6 +75,7 @@ def test_greedy_matches_plain_small_draft(target, plain):
     assert got == want
 
 
+@pytest.mark.slow
 def test_eos_truncation(target, plain):
     spec = _spec_gen(target, create_model("gpt2-small-test"))
     spec.draft_params = spec.params
@@ -83,6 +86,7 @@ def test_eos_truncation(target, plain):
         assert 7 not in row
 
 
+@pytest.mark.slow
 def test_budget_respected(target):
     spec = _spec_gen(target, create_model("gpt2-small-test"))
     spec.draft_params = spec.params
@@ -90,6 +94,7 @@ def test_budget_respected(target):
     assert all(len(r) == 5 for r in out)
 
 
+@pytest.mark.slow
 def test_stochastic_deterministic_per_seed(target):
     spec = _spec_gen(target, create_model("gpt2-small-test"))
     a = spec.generate(PROMPTS, max_new_tokens=8, temperature=0.8,
@@ -103,6 +108,7 @@ def test_stochastic_deterministic_per_seed(target):
     assert c[1:] == a[1:]
 
 
+@pytest.mark.slow
 def test_stochastic_tokens_valid(target):
     cfg = target.config
     spec = _spec_gen(target, create_model("gpt2-small-test"))
@@ -112,6 +118,7 @@ def test_stochastic_tokens_valid(target):
         assert all(0 <= t < cfg.vocab for t in row)
 
 
+@pytest.mark.slow
 def test_mixed_temperature_batch(target, plain):
     """Greedy rows of a mixed batch still match plain greedy exactly."""
     spec = _spec_gen(target, create_model("gpt2-small-test"))
@@ -145,6 +152,7 @@ def test_non_causal_rejected():
         SpeculativeGenerator(bert, bert)
 
 
+@pytest.mark.slow
 def test_large_batch_splits(target, plain):
     spec = _spec_gen(target, create_model("gpt2-small-test"))
     spec.draft_params = spec.params
@@ -154,6 +162,7 @@ def test_large_batch_splits(target, plain):
     assert got == want
 
 
+@pytest.mark.slow
 def test_gqa_rope_target(plain):
     """Speculation over the llama dialect (RoPE + GQA + RMSNorm)."""
     tgt = create_model("llama-small-test")
@@ -215,6 +224,7 @@ def test_worker_speculative_unresolvable_draft():
                                 gen_scheduler="speculative"))
 
 
+@pytest.mark.slow
 def test_partial_bucket_idle_rows_do_not_gate(target):
     """Idle bucket-padding rows start done: a 1-prompt batch in an 8-wide
     bucket with a disagreeing draft must not run ~max_new rounds because

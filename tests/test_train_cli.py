@@ -32,6 +32,7 @@ def _train(args, timeout=300):
         env=_child_env())
 
 
+@pytest.mark.slow
 def test_train_resume_and_serve(tmp_path):
     out1 = str(tmp_path / "ck1")
     r = _train(["--model", "gpt2-small-test", "--steps", "12",

@@ -28,6 +28,7 @@ def params(spec):
     return spec.init(jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_yolo_multi_resolution(spec, params):
     for h, w in [(64, 64), (96, 64), (128, 128)]:
         y = spec.apply(params, jnp.ones((2, h, w, 3)), dtype=jnp.float32)
@@ -35,6 +36,7 @@ def test_yolo_multi_resolution(spec, params):
         assert bool(jnp.isfinite(y).all())
 
 
+@pytest.mark.slow
 def test_yolo_batch_independence(spec, params):
     """Row i's detections don't depend on other rows (BN uses stored stats)."""
     x = jax.random.normal(jax.random.PRNGKey(1), (3, 64, 64, 3))

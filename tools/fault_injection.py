@@ -38,10 +38,20 @@ later tick's ragged batch (active drains to 0, the pool refills), the
 scheduler stayed one-dispatch-per-tick throughout, and a subsequent
 request still decodes correctly.
 
+``--spec`` runs a STANDALONE speculative-decoding fault scenario: it
+spawns a combined server with a ``--spec-k 4`` paged decode lane, fires
+/generate requests whose deadlines expire mid-verification (between
+verify ticks, draft windows in flight), and asserts via ``/stats`` +
+``/trace/export`` that every cancelled row returned its blocks, the
+scheduler stayed one-verify-dispatch-per-tick, post-cancel streams are
+byte-identical to pre-cancel ones, and ``spec_verify`` spans carry the
+proposed/accepted attrs.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
   python3 tools/fault_injection.py --mixed
+  python3 tools/fault_injection.py --spec
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -396,6 +406,151 @@ def mixed_phase(port: int, checks: list) -> dict:
             "mixed_step_spans": len(spans)}
 
 
+def launch_spec_server(attempts: int = 3):
+    """Spawn a combined server with a speculative decode lane
+    (--spec-k 4 over the paged pool): verify windows advance rows
+    multiple tokens per tick, and short deadlines expire between verify
+    ticks — mid-verification from the request's point of view. Returns
+    (port, Popen)."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("TPU_ENGINE_PLATFORM", "cpu")
+
+    def spawn(port: int):
+        cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+               "--model", "gpt2-small-test", "--lanes", "1",
+               "--port", str(port), "--kv-block-size", "16",
+               "--spec-k", "4", "--gen-prefill-chunk", "16"]
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=sys.stderr, stderr=sys.stderr)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChildProcessError(
+                    f"server exited rc={proc.returncode} before ready")
+            try:
+                status, _ = _call(port, "GET", "/stats", timeout=2.0)
+                if status == 200:
+                    return proc
+            except OSError:
+                pass
+            time.sleep(0.5)
+        proc.terminate()
+        raise TimeoutError("server never became ready")
+
+    return launch_with_retry(spawn, attempts=attempts)
+
+
+def spec_phase(port: int, checks: list) -> dict:
+    """Speculative-decoding cancellation scenario: rows deadline-
+    cancelled mid-verification (between verify ticks, draft windows in
+    flight) must return every pool block, and post-cancel streams must
+    be identical — no rejected-tail ghost or half-freed block may leak
+    into later requests."""
+    # Warm the decode lane + capture the oracle stream. [3, 3, 3]
+    # degenerates into a repetitive loop on this init, so the warm run
+    # also exercises real draft acceptance.
+    status, body = _call(port, "POST", "/generate", {
+        "request_id": "sp_warm", "prompt_tokens": [3, 3, 3],
+        "max_new_tokens": 12}, timeout=600)
+    checks.append(("spec: warm generate ok",
+                   status == 200 and len(body.get("tokens", [])) == 12))
+    warm_tokens = body.get("tokens")
+    _, stats0 = _call(port, "GET", "/stats")
+    spec0 = next(iter(stats0.get("spec", {}).values()), {})
+    checks.append(("spec: scheduler speculating (drafts proposed)",
+                   spec0.get("proposed_tokens", 0) > 0))
+
+    # Long generations with tiny deadlines: they admit, enter verify
+    # ticks, and expire mid-stream — the row must free between ticks.
+    expired = survived = 0
+    for i in range(6):
+        prompt = [(i * 13 + j) % 90 + 1 for j in range(40)]
+        try:
+            status, body = _call(port, "POST", "/generate", {
+                "request_id": f"sp_dead_{i}", "prompt_tokens": prompt,
+                "max_new_tokens": 40, "deadline_ms": 30 + 10 * i,
+            }, timeout=120)
+        except OSError:
+            status, body = 0, {}
+        if status in (500, 503):
+            expired += 1
+        elif status == 200:
+            survived += 1
+    checks.append(("spec: deadlines expired mid-verification",
+                   expired > 0))
+
+    # Drain: every cancelled row returns its blocks and leaves the batch.
+    pool = active = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        _, stats = _call(port, "GET", "/stats")
+        spec = next(iter(stats.get("spec", {}).values()), {})
+        pool = next(iter(stats.get("kv_pool", {}).values()), {})
+        active = spec.get("active")
+        if active == 0 and pool and (
+                pool["blocks_free"] + pool["radix_nodes"]
+                >= pool["blocks_total"]):
+            break
+        time.sleep(0.2)
+    checks.append(("spec: cancelled rows left the batch "
+                   "(active drained to 0)", active == 0))
+    checks.append(("spec: cancelled rows returned their blocks",
+                   bool(pool) and pool["blocks_free"] + pool["radix_nodes"]
+                   >= pool["blocks_total"]))
+
+    # One verify dispatch per tick held through the churn.
+    _, stats = _call(port, "GET", "/stats")
+    spec = next(iter(stats.get("spec", {}).values()), {})
+    checks.append(("spec: one dispatch per tick",
+                   spec.get("ticks", 0) == spec.get("dispatches", -1)))
+    checks.append(("spec: ticks advanced during the scenario",
+                   spec.get("ticks", 0) > spec0.get("ticks", 0)))
+
+    # Post-cancel stream identity: the seeded warm prompt reproduces its
+    # stream exactly (no stale draft KV or leaked block corrupts it).
+    status, body = _call(port, "POST", "/generate", {
+        "request_id": "sp_after", "prompt_tokens": [3, 3, 3],
+        "max_new_tokens": 12}, timeout=120)
+    checks.append(("spec: post-cancel request streams identically",
+                   status == 200 and body.get("tokens") == warm_tokens))
+
+    # Trace coverage: spec_verify spans with draft/accept attrs.
+    _, export = _call(port, "GET", "/trace/export")
+    spans = [e for e in export.get("traceEvents", [])
+             if e.get("ph") == "X" and e.get("name") == "spec_verify"]
+    has_attrs = any("proposed" in (e.get("args") or {})
+                    and "accepted" in (e.get("args") or {})
+                    for e in spans)
+    checks.append(("spec: spec_verify spans exported with "
+                   "proposed/accepted attrs",
+                   len(spans) > 0 and has_attrs))
+    return {"expired": expired, "survived": survived,
+            "kv_pool": pool, "spec": spec,
+            "spec_verify_spans": len(spans)}
+
+
+def run_spec_standalone() -> int:
+    port, proc = launch_spec_server()
+    checks: list = []
+    try:
+        report = {"mode": "spec-standalone", "port": port,
+                  "phases": {"spec": spec_phase(port, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def run_mixed_standalone() -> int:
     port, proc = launch_mixed_server()
     checks: list = []
@@ -439,9 +594,17 @@ def main() -> int:
                          "own --mixed-step server and asserts cancelled "
                          "mid-prefill rows return their blocks (see "
                          "module docstring); ignores the other flags")
+    ap.add_argument("--spec", action="store_true",
+                    help="standalone speculative-decoding scenario: "
+                         "spawns its own --spec-k server, deadline-"
+                         "cancels rows mid-verification, and asserts "
+                         "every pool block returns and post-cancel "
+                         "streams are identical; ignores the other flags")
     args = ap.parse_args()
     if args.mixed:
         return run_mixed_standalone()
+    if args.spec:
+        return run_spec_standalone()
     proc = None
     if args.launch:
         args.breaker_timeout = min(args.breaker_timeout, 2.0)

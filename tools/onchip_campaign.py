@@ -307,6 +307,33 @@ def main() -> int:
             max_new=48 if q else 96, dtype="bfloat16")
         return res
 
+    @stage(artifact, out, "spec_continuous")
+    def _spec_cont():
+        # Continuous speculative decoding on-chip: (a) Mosaic compile +
+        # exactness of the ragged kernel at the VERIFY-WINDOW shapes
+        # (--spec-k dispatches q_len k+1 windows beside decode rows and
+        # prefill chunks — CPU rounds only ever ran the interpreter),
+        # (b) the plain-vs-spec counter A/B (tokens per row-dispatch,
+        # stream identity, cancelled-row block return) on the device.
+        import jax.numpy as jnp
+
+        from tpu_engine.ops.paged_attention import spec_verify_parity_check
+
+        res = {"verify_window_kernel_parity": {
+            "f32_max_abs_diff": spec_verify_parity_check(
+                k=4, block_size=16, n_blocks=33, table_len=8, d_head=64),
+            "bf16_max_abs_diff": spec_verify_parity_check(
+                k=4, dtype=jnp.bfloat16, block_size=16, n_blocks=33,
+                table_len=8, d_head=64),
+            "gqa_max_abs_diff": spec_verify_parity_check(
+                k=4, n_heads=8, n_kv_heads=2, d_head=64, block_size=16,
+                n_blocks=33, table_len=8),
+        }}
+        res["ab"] = bench.run_spec_continuous_ab(
+            model=model, max_new=24 if q else 96,
+            max_seq=128 if q else 256, dtype="bfloat16")
+        return res
+
     @stage(artifact, out, "mixed")
     def _mixed():
         # Mixed stepping on-chip: (a) Mosaic compile + exactness of the
@@ -339,7 +366,8 @@ def main() -> int:
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
-               _decode_int8, _flash, _flash_tiling, _paged, _mixed, _spec,
+               _decode_int8, _flash, _flash_tiling, _paged, _mixed,
+               _spec_cont, _spec,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

@@ -538,7 +538,7 @@ def _block_step_rows_ragged(bp, h, cache_kv, tables, pos0, qlen,
 def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
                                  pos0, qlen, cfg: TransformerConfig, *,
                                  dtype=jnp.bfloat16, attn_fn=None,
-                                 sample_slot=None):
+                                 sample_slot=None, sample_width: int = 1):
     """The mixed prefill+decode primitive (runtime.scheduler
     --mixed-step): one ragged batch where each row consumes qlen[b] >= 0
     new tokens, writing their KV straight into the row's pool blocks in
@@ -554,8 +554,15 @@ def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
     the hidden state BEFORE ln_f/head turns the (B*W, d)x(d, vocab)
     matmul into (B, d)x(d, vocab) on the per-tick hot path (ln_f and the
     head are per-position, so the selected slot's logits are bit-equal
-    either way). Returns (logits (B, vocab), caches) — or
-    (logits (B, W, vocab), caches) when ``sample_slot`` is None."""
+    either way). ``sample_width`` > 1 widens the gather to the VERIFY
+    WINDOW of speculative decoding: slots sample_slot[b]..sample_slot[b]
+    + sample_width - 1 (clipped to W-1; slots past qlen are padding the
+    caller ignores) project through the head, so one dispatch yields the
+    per-position logits that score a whole draft window while rows that
+    only sample once still pay a (B*S, d)x(d, vocab) head, not
+    (B*W, d)x(d, vocab). Returns (logits (B, vocab), caches) — or
+    (B, sample_width, vocab) when sample_width > 1, or (B, W, vocab)
+    when ``sample_slot`` is None."""
     if attn_fn is None:
         from tpu_engine.ops.paged_attention import default_ragged_attention
 
@@ -582,11 +589,15 @@ def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
     h, (k_new, v_new) = jax.lax.scan(body, h,
                                      (params["blocks"], caches.k, caches.v))
     if sample_slot is not None:
-        h = h[jnp.arange(b), sample_slot][:, None]    # (B, 1, d)
+        slots = jnp.minimum(sample_slot[:, None]
+                            + jnp.arange(sample_width)[None, :], w - 1)
+        h = h[jnp.arange(b)[:, None], slots]          # (B, S, d)
     h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
     if sample_slot is not None:
-        return logits[:, 0], KVCache(k_new, v_new)
+        if sample_width == 1:
+            return logits[:, 0], KVCache(k_new, v_new)
+        return logits, KVCache(k_new, v_new)
     return logits, KVCache(k_new, v_new)
 
 

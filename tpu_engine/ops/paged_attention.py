@@ -446,3 +446,14 @@ def ragged_parity_check(q_lens=(1, 7, 16, 17), n_heads: int = 4,
     diff = jnp.abs(ours.astype(jnp.float32) - ref.astype(jnp.float32))
     valid = (jnp.arange(w)[None, :] < qlen[:, None])  # padding slots: ignored
     return float(jnp.max(jnp.where(valid[:, :, None, None], diff, 0.0)))
+
+
+def spec_verify_parity_check(k: int = 4, **kw) -> float:
+    """Ragged parity at the SPECULATIVE verify-window shapes the
+    --spec-k scheduler dispatches each tick: an undrafted decode row
+    (q_len 1), two full verify windows (q_len k+1 — one of them placed
+    to cross a block boundary by the random pos0 draw), and prefill-
+    chunk rows at the block size and one past it, all in ONE ragged
+    batch. Shared by tests, diagnostics.py --spec-parity, and the
+    on-chip campaign's `spec` stage (which adds GQA/bf16 variants)."""
+    return ragged_parity_check(q_lens=(1, k + 1, k + 1, 16, 17), **kw)

@@ -266,6 +266,21 @@ class BlockPool:
         for i in block_ids:
             self.release(i)
 
+    def release_tail(self, block_list: List[int], keep: int) -> int:
+        """Trim a row's block table IN PLACE to its first ``keep``
+        entries, releasing the rest — the speculative scheduler's
+        block-boundary rewind: blocks allocated for a verify window whose
+        rejected tail (or shrinking token budget) moved past them return
+        to the pool instead of idling on the row. Returns blocks
+        released. Tail blocks are the row's private append blocks by
+        construction; a radix-referenced block would simply drop to the
+        tree's refcount and survive."""
+        freed = 0
+        while len(block_list) > max(0, int(keep)):
+            self.release(block_list.pop())
+            freed += 1
+        return freed
+
     def ensure_writable(self, block_id: int) -> Tuple[int, bool]:
         """Copy-on-write: a caller about to APPEND into ``block_id``
         gets a private copy when anything else (tree node, other row)

@@ -194,6 +194,10 @@ class ContinuousGenerator:
         prefix_sharing: bool = True,
         mixed_step: bool = False,
         mixed_token_budget: int = 0,
+        spec_k: int = 0,
+        spec_draft: str = "ngram",
+        spec_draft_model=None,
+        spec_draft_params=None,
     ):
         """`kv_block_size` > 0 switches the KV cache from one dense
         (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
@@ -218,7 +222,29 @@ class ContinuousGenerator:
         split over admitting rows' chunks, and also caps the compiled
         chunk width) so per-tick latency stays bounded; 0 = auto
         (prefill_chunk). Seeded streams are byte-identical to the dense
-        and two-path paged schedulers (tested)."""
+        and two-path paged schedulers (tested).
+
+        `spec_k` > 0 (paged layouts only — two-path AND mixed) turns on
+        CONTINUOUS SPECULATIVE DECODING: each tick a host-side drafter
+        proposes up to spec_k tokens per decode row (`spec_draft`
+        "ngram" = the deterministic prompt-lookup drafter, no second
+        model; "model" = greedy proposals from `spec_draft_model`, one
+        extra draft dispatch per drafted row), and the tick's ONE ragged
+        dispatch verifies every row's window (decode rows become
+        q_len = proposals+1 ragged rows beside any prefill chunks),
+        advancing each row by its accepted prefix plus one
+        corrected/bonus token — 1..spec_k+1 tokens per dispatch. Greedy
+        streams are byte-identical to plain continuous/mixed decode for
+        ANY draft (the verify loop re-derives every token with the same
+        fold_in(seed, position) sampling rule, penalties and stop lists
+        included); temperature>0 rows without filters take the
+        rejection-sampling path — unbiased draws from the target
+        distribution, deterministic per seed, but NOT byte-equal to
+        plain decode (MIGRATION.md); rows carrying top_p/top_k/min_p or
+        sampled-with-controls are simply not drafted (q_len 1 — plain,
+        byte-identical). Rejected draft tails leave stale KV the
+        position masks hide; blocks over-allocated for the speculation
+        horizon are returned as a row's remaining budget shrinks."""
         if isinstance(model, str):
             _ensure_builtin_models_imported()
             model = create_model(model)
@@ -334,10 +360,52 @@ class ContinuousGenerator:
         if self._mixed and not self._paged:
             raise ValueError("mixed_step requires the paged KV cache "
                              "(set kv_block_size > 0)")
-        # In mixed mode decode rows advance one token per tick, so block
-        # growth and admission headroom reserve a 1-column horizon, not a
-        # step_chunk-sized one.
-        self._decode_horizon = 1 if self._mixed else self._step_chunk
+        # Continuous speculative decoding (paged layouts only): drafts
+        # verified inside the per-tick ragged dispatch.
+        self._spec_k = int(spec_k)
+        self._spec = self._spec_k > 0
+        self._drafter = None
+        if self._spec:
+            if not self._paged:
+                raise ValueError("speculative decoding (spec_k > 0) "
+                                 "requires the paged KV cache (set "
+                                 "kv_block_size > 0)")
+            if self._spec_k > self.max_seq - 2:
+                raise ValueError(f"spec_k={self._spec_k} cannot fit a "
+                                 f"verify window in max_seq={self.max_seq}")
+            from tpu_engine.runtime.speculative import make_drafter
+
+            self._drafter = make_drafter(
+                spec_draft, self._spec_k, draft_model=spec_draft_model,
+                draft_params=spec_draft_params, dtype=self._dtype,
+                device=device)
+            dcfg = getattr(self._drafter, "cfg", None)
+            if dcfg is not None and dcfg.vocab != self.cfg.vocab:
+                raise ValueError(f"draft vocab {dcfg.vocab} != target "
+                                 f"vocab {self.cfg.vocab}")
+            self._stats["spec"] = {
+                "k": self._spec_k, "draft": self._drafter.name,
+                "ticks": 0, "dispatches": 0, "proposed_tokens": 0,
+                "accepted_tokens": 0, "emitted_tokens": 0,
+                # (row, tick) pairs that emitted: emitted/row_ticks is
+                # the mean per-ROW advance per dispatch — the honest
+                # speculation win (plain ragged ticks are exactly 1.0;
+                # emitted/dispatches alone would conflate co-batching).
+                "row_ticks": 0,
+                "draft_dispatches": 0, "tail_blocks_released": 0,
+            }
+        # Decode rows advance one token per tick in mixed mode (spec off)
+        # and up to spec_k+1 in spec mode, so block growth and admission
+        # headroom reserve exactly that horizon, not a step_chunk-sized
+        # one.
+        if self._spec:
+            self._decode_horizon = self._spec_k + 1
+        else:
+            self._decode_horizon = 1 if self._mixed else self._step_chunk
+        # The drafter needs each row's token history (prompt + emitted);
+        # mixed mode already keeps the prompt for its in-tick prefill.
+        if self._spec and not self._mixed:
+            self._row_prompt_toks = [None] * self.n_slots
         if self._mixed:
             budget = int(mixed_token_budget) or (self._prefill_chunk
                                                  if self._prefill_chunk > 0
@@ -692,6 +760,156 @@ class ContinuousGenerator:
                     donate_argnums=(1, 16) if controls else (1,))
             return self._decode_exe[key]
 
+    def _spec_step_exe(self, width: int, controls: bool,
+                       stochastic: bool = False):
+        """Compiled speculative step: ONE ragged dispatch scoring every
+        row's verify window — decode rows carry [pending token, draft_1..
+        draft_n] (q_len = n+1), mixed-mode admitting rows their prefill
+        chunk — then an unrolled spec_k+1-slot accept/emit loop over the
+        window's per-position logits (`transformer_step_rows_ragged`
+        sample_width). Slot j's logits are conditioned on the draft
+        prefix, which equals the true stream exactly while the chain
+        holds, so:
+
+        - deterministic rows (temperature 0 — penalties, stops, and
+          filter knobs included) re-derive each token with the exact
+          plain-path `_sample(fold_in(seed, position))` rule and chain
+          while the draft matches it: byte-identical streams for any
+          draft, counts evolving sequentially inside the window;
+        - temperature>0 rows with n_draft > 0 take the shared
+          rejection-sampling rule against the deterministic proposal
+          (accept d with prob p(d), residual = p minus d's mass —
+          `runtime.speculative.rejection_acceptance` with a point-mass
+          q), unbiased but not byte-equal;
+        - completing prefill rows (n_draft 0, sample_slot = L-1-w0) fall
+          out as the j=0 iteration — the same single sample the plain
+          mixed step takes.
+
+        Rows emit 1..spec_k+1 tokens; EOS/stop hits stop the chain and
+        later slots emit eos_vec. Exactly two ragged widths compile per
+        (controls, stochastic) variant (spec_k+1 and max(chunk cap,
+        spec_k+1)); `stochastic` is a COMPILE-TIME flag like `controls`
+        — the all-greedy common case never traces the per-slot (B, V)
+        softmax + tagged uniform/categorical draws whose results it
+        would discard."""
+        key = ("spec", width, controls, stochastic)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                from tpu_engine.ops.paged_attention import (
+                    default_ragged_attention,
+                )
+                from tpu_engine.runtime.speculative import (
+                    _TAG_ACCEPT,
+                    _TAG_RESID,
+                    _tagged_categorical,
+                    _tagged_uniform,
+                )
+
+                cfg, dtype = self.cfg, self._dtype
+                attn_fn = default_ragged_attention()
+                S = self._spec_k + 1
+
+                def spec_step(params, caches, tables, tokens, pos0, qlen,
+                              sample_slot, fold0, n_draft, stoch, active,
+                              done, seeds, temps, topps, topks, minps,
+                              eos_vec, counts=None, pens=None, stops=None):
+                    logits, caches = transformer_step_rows_ragged(
+                        params, tokens, caches, tables, pos0, qlen, cfg,
+                        dtype=dtype, attn_fn=attn_fn,
+                        sample_slot=sample_slot, sample_width=S)
+                    b, w = tokens.shape
+                    rows = jnp.arange(b)
+                    run_counts = counts
+                    alive = active & ~done
+                    new_done = done
+                    n_emit = jnp.zeros((b,), jnp.int32)
+                    # Draft slots whose token the target kept (the chain
+                    # held). Counted on-device because the host cannot
+                    # infer it from n_emit alone: a stream that stops ON
+                    # an accepted draft token has no corrected/bonus
+                    # slot, so "emitted - 1" would undercount.
+                    n_acc = jnp.zeros((b,), jnp.int32)
+                    use_sto = stoch & (n_draft > 0)
+                    t_safe = jnp.maximum(temps, 1e-6)
+                    emitted = []
+                    for j in range(S):
+                        lg = logits[:, j]
+                        lg_p = (apply_repetition_penalty(lg, run_counts,
+                                                         pens)
+                                if controls else lg)
+                        fold = fold0 + j
+                        det = _sample(lg_p, seeds, fold, temps, topps,
+                                      topks, minps)
+                        # The draft token this slot must reproduce for
+                        # the chain to continue (decode rows: window slot
+                        # j+1; prefill/undrafted rows never chain).
+                        didx = jnp.minimum(sample_slot + j + 1, w - 1)
+                        d_next = tokens[rows, didx]
+                        has_draft = j < n_draft
+                        det_chain = has_draft & (d_next == det)
+                        if stochastic:
+                            # Rejection sampling vs the point-mass
+                            # proposal, for temp>0 drafted rows.
+                            p = jax.nn.softmax(lg / t_safe[:, None],
+                                               axis=-1)
+                            u = _tagged_uniform(seeds, fold, _TAG_ACCEPT)
+                            acc = has_draft & (u < p[rows, d_next])
+                            resid = p.at[rows, d_next].set(0.0)
+                            resid = jnp.where(has_draft[:, None],
+                                              resid, p)
+                            tot = jnp.sum(resid, axis=-1, keepdims=True)
+                            dist = jnp.where(
+                                tot > 0,
+                                resid / jnp.maximum(tot, 1e-30), p)
+                            corr = _tagged_categorical(
+                                seeds, fold, _TAG_RESID,
+                                jnp.log(jnp.maximum(dist, 1e-30)))
+                            sto_tok = jnp.where(acc, d_next, corr)
+                            tok_j = jnp.where(use_sto, sto_tok, det)
+                            chain = jnp.where(use_sto, acc, det_chain)
+                        else:
+                            tok_j = det
+                            chain = det_chain
+                        tok_j = jnp.where(alive, tok_j, eos_vec)
+                        if controls:
+                            run_counts = run_counts.at[rows, tok_j].add(
+                                alive.astype(jnp.int32))
+                        emitted.append(tok_j)
+                        n_emit = n_emit + alive.astype(jnp.int32)
+                        n_acc = n_acc + (alive & chain).astype(jnp.int32)
+                        stop_j = alive & (tok_j == eos_vec)
+                        if controls:
+                            stop_j = stop_j | (alive & jnp.any(
+                                tok_j[:, None] == stops, axis=1))
+                        new_done = new_done | stop_j
+                        alive = alive & ~stop_j & chain
+                    out = jnp.stack(emitted, axis=1)          # (B, S)
+                    if controls:
+                        return (caches, out, n_emit, n_acc, new_done,
+                                run_counts)
+                    return caches, out, n_emit, n_acc, new_done
+
+                self._decode_exe[key] = jax.jit(
+                    spec_step,
+                    donate_argnums=(1, 18) if controls else (1,))
+            return self._decode_exe[key]
+
+    @staticmethod
+    def _spec_eligible(req: _Request) -> bool:
+        """Rows the drafter may propose for. Deterministic (greedy) rows
+        always qualify — the verify loop re-derives each token with the
+        exact plain-path rule, penalties/stops included, so the stream
+        is byte-identical for any draft. temperature>0 rows qualify only
+        without filters/penalties/stops: the rejection-sampling residual
+        composes with none of them (such rows ride at q_len 1 — plain)."""
+        if req.temperature == 0.0:
+            return True
+        return (req.top_p >= 1.0 and req.top_k == 0 and req.min_p == 0.0
+                and req.rep_penalty == 1.0 and not req.stop_tokens)
+
     # -- public API ------------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -767,6 +985,19 @@ class ContinuousGenerator:
             # across time (bench warm-up subtraction) and must not see
             # their baseline mutate under them.
             out["mixed"] = dict(self._stats["mixed"])
+        if self._spec:
+            spec = dict(self._stats["spec"])
+            spec["accept_ratio"] = (
+                round(spec["accepted_tokens"]
+                      / max(1, spec["proposed_tokens"]), 4)
+                if spec["proposed_tokens"] else None)
+            spec["tokens_per_dispatch"] = (
+                round(spec["emitted_tokens"] / spec["dispatches"], 3)
+                if spec["dispatches"] else None)
+            spec["tokens_per_row_dispatch"] = (
+                round(spec["emitted_tokens"] / spec["row_ticks"], 3)
+                if spec["row_ticks"] else None)
+            out["spec"] = spec
         if self._paged:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = len(self._pending)
@@ -1159,6 +1390,9 @@ class ContinuousGenerator:
             # the pool scatter above; no fused insert executable needed).
             self._counts = self._ensure_counts().at[row].set(
                 jnp.asarray(row_counts[0]))
+        if self._spec:
+            # The drafter's lookup corpus: prompt + emitted-so-far.
+            self._row_prompt_toks[row] = prompt
         self._init_row(req, row, first_tok, pos=first_col, start=0)
 
     def _admit_mixed(self, item, row: int) -> None:
@@ -1302,16 +1536,17 @@ class ContinuousGenerator:
         self._init_row(req, row, first_tok, pos=pb, start=pb - L)
 
     def _clear_mixed_row(self, row: int) -> None:
-        """Drop a row's mixed-mode prefill state (completion, deadline
-        cancel, recovery, shutdown): the row must never reappear in a
-        later tick's ragged batch."""
-        if not self._mixed:
-            return
-        self._prefilling[row] = False
-        self._row_prompt[row] = None
-        self._row_prompt_toks[row] = None
-        self._row_L[row] = 0
-        self._row_w0[row] = 0
+        """Drop a row's mixed-mode prefill / speculative state
+        (completion, deadline cancel, recovery, shutdown): the row must
+        never reappear in a later tick's ragged batch, and the drafter
+        must never see a freed row's history."""
+        if self._mixed:
+            self._prefilling[row] = False
+            self._row_prompt[row] = None
+            self._row_L[row] = 0
+            self._row_w0[row] = 0
+        if self._mixed or self._spec:
+            self._row_prompt_toks[row] = None
 
     def _visible_tokens(self, row: int, req: _Request) -> List[int]:
         """The request's client-visible tokens so far: budget-capped and
@@ -1458,7 +1693,7 @@ class ContinuousGenerator:
                 continue  # done rows rewrite their own (allocated) column
             if self._mixed and self._prefilling[r]:
                 continue  # bucket + first-decode blocks reserved at admit
-            last_col = min(int(self._pos[r]) + self._decode_horizon,
+            last_col = min(int(self._pos[r]) + self._row_horizon(r, req),
                            self.max_seq - 1)
             need = last_col // bs + 1
             have = len(self._row_blocks[r])
@@ -1475,6 +1710,63 @@ class ContinuousGenerator:
                 continue
             self._tables[r, have:need] = fresh
             self._row_blocks[r].extend(fresh)
+
+    def _row_horizon(self, r: int, req: _Request) -> int:
+        """Columns past `pos` the next tick may write for row r. Static
+        (`_decode_horizon`) except under speculation, where a row nearing
+        its token budget can only write its remaining tokens — the
+        drafter caps proposals the same way, so allocation and the
+        post-tick trim agree and never churn blocks."""
+        if not self._spec:
+            return self._decode_horizon
+        return min(self._decode_horizon,
+                   max(1, req.max_new - len(self._row_emitted[r])))
+
+    def _trim_row_tail(self, r: int, req: _Request) -> None:
+        """Return over-allocated speculation-horizon blocks: a verify
+        window that crossed a block boundary may have allocated a block
+        the row — after rejections, near its budget — can no longer
+        write. The stale draft KV in retained blocks stays invisible via
+        position masking; blocks wholly past the reachable horizon go
+        back to the pool for other rows. Never touches radix-shared
+        prefix blocks (they sit below `pos`, always within the horizon)."""
+        bs = self._pool.block_size
+        last_col = min(int(self._pos[r]) + self._row_horizon(r, req),
+                       self.max_seq - 1)
+        need = last_col // bs + 1
+        blocks = self._row_blocks[r]
+        if len(blocks) <= need:
+            return
+        with self._pool.lock:
+            freed = self._pool.release_tail(blocks, need)
+        if freed:
+            self._tables[r, need:need + freed] = 0
+            self._stats["spec"]["tail_blocks_released"] += freed
+
+    def _complete_prefill_row(self, r: int, req: "_Request",
+                              first_tok: int, done: bool) -> None:
+        """Prompt consumed: the row becomes a decode row. Index the
+        now-filled prompt blocks in the radix tree (mixed mode inserts
+        at COMPLETION — a cancelled mid-prefill row must never leave
+        half-written blocks indexed), stamp the prefill span, and emit
+        the first token. Shared by _tick_mixed and _tick_spec."""
+        self._prefilling[r] = False
+        if self._prefix_sharing:
+            with self._pool.lock:
+                self._pool.radix.insert(self._row_prompt_toks[r],
+                                        self._row_blocks[r])
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - req.t_admit) * 1e6
+            req.sink.stage("prefill", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           prompt_len=self._row_L[r])
+            req.t_admit = time.perf_counter()  # decode span start
+        self._tok[r] = first_tok
+        self._done[r] = done
+        self._row_emitted[r] = [first_tok]
+        self._first_token_metrics(req, r)
+        self._push_stream(r, req)
+        self._maybe_complete(r)
 
     def _tick_mixed(self) -> None:
         """One mixed tick: form the ragged batch (decode rows x 1 token +
@@ -1590,28 +1882,8 @@ class ContinuousGenerator:
                 self._row_w0[r] += int(chunk[r])
                 if not completing[r]:
                     continue
-                # Prompt consumed: the row becomes a decode row. Index
-                # the now-filled prompt blocks in the radix tree (mixed
-                # mode inserts at COMPLETION — a cancelled mid-prefill
-                # row must never leave half-written blocks indexed).
-                self._prefilling[r] = False
-                if self._prefix_sharing:
-                    with pool.lock:
-                        pool.radix.insert(self._row_prompt_toks[r],
-                                          self._row_blocks[r])
-                if req.sink is not None:
-                    dur_us = (time.perf_counter() - req.t_admit) * 1e6
-                    req.sink.stage("prefill", dur_us,
-                                   start_ts=time.time() - dur_us / 1e6,
-                                   prompt_len=self._row_L[r])
-                    req.t_admit = time.perf_counter()  # decode span start
-                first_tok = int(nxt[r])
-                self._tok[r] = first_tok
-                self._done[r] = bool(done_new[r])
-                self._row_emitted[r] = [first_tok]
-                self._first_token_metrics(req, r)
-                self._push_stream(r, req)
-                self._maybe_complete(r)
+                self._complete_prefill_row(r, req, int(nxt[r]),
+                                           bool(done_new[r]))
                 continue
             tok_r = int(nxt[r])
             self._tok[r] = tok_r
@@ -1636,6 +1908,235 @@ class ContinuousGenerator:
                 attrs={"prefill_tokens": int(prefill_tokens),
                        "decode_rows": int(n_decode),
                        "width": int(width)})
+
+    def _tick_spec(self) -> None:
+        """One SPECULATIVE ragged tick — the spec_k>0 replacement for
+        both the paged decode chunk (two-path mode) and `_tick_mixed`
+        (mixed mode). Host side: ask the drafter for up to spec_k
+        deterministic proposals per eligible decode row, form ONE ragged
+        batch (decode rows: q_len = proposals+1 verify windows; mixed
+        admitting rows: their budgeted prefill chunk), issue exactly one
+        compiled dispatch, and advance each row by its accepted prefix
+        plus the corrected/bonus token. Rejected tails leave stale KV
+        past the new `pos` — invisible by position masking, overwritten
+        (write-before-attend) when the stream reaches those columns."""
+        pool = self._pool
+        B = self.n_slots
+        S = self._spec_k + 1
+        t0 = time.perf_counter()
+        eos_vec = np.full((B,), -1, np.int32)
+        controls = False
+        n_decode = 0
+        prefill_rows: List[int] = []
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            if req.eos_id >= 0:
+                eos_vec[r] = req.eos_id
+            if req.rep_penalty != 1.0 or req.stop_tokens:
+                controls = True
+            if self._mixed and self._prefilling[r]:
+                prefill_rows.append(r)
+            else:
+                n_decode += 1
+        chunk = np.zeros((B,), np.int32)
+        if self._mixed:
+            # Mixed budget rule unchanged: decode rows count 1 each (the
+            # verify window RE-DERIVES tokens, it does not widen the
+            # budgeted stream), remainder over admitting rows.
+            budget_left = max(1, self._mixed_budget - n_decode)
+            for r in prefill_rows:
+                remaining = max(self._row_L[r], 1) - self._row_w0[r]
+                c = min(remaining, self._chunk_cap, budget_left)
+                chunk[r] = max(0, c)
+                budget_left -= chunk[r]
+
+        # Drafting (host-side, before batch formation). The cap keeps a
+        # window inside both the row's token budget (never propose past
+        # max_tokens) and the cache (window columns < max_seq).
+        drafts: List[List[int]] = [[] for _ in range(B)]
+        proposed = 0
+        for r, req in enumerate(self._row_req):
+            if (req is None or self._done[r]
+                    or (self._mixed and self._prefilling[r])):
+                continue
+            kcap = min(self._spec_k,
+                       req.max_new - len(self._row_emitted[r]) - 1,
+                       self.max_seq - 2 - int(self._pos[r]))
+            if kcap <= 0 or not self._spec_eligible(req):
+                continue
+            em = self._row_emitted[r]
+            scan = getattr(self._drafter, "max_scan", 0)
+            if scan:
+                # The drafter only scans its last max_scan tokens —
+                # slice the tails BEFORE concatenating so a long prompt
+                # costs O(max_scan), not O(L), of list copy per row per
+                # tick on the decode thread.
+                need = scan - len(em)
+                pp = self._row_prompt_toks[r] or []
+                ctx = (pp[-need:] if need > 0 else []) + em[-scan:]
+            else:
+                ctx = (self._row_prompt_toks[r] or []) + em
+            d = self._drafter.propose(ctx, kcap)[:kcap]
+            if d:
+                drafts[r] = [int(t) for t in d]
+                proposed += len(drafts[r])
+
+        # Exactly two compiled ragged widths per controls variant:
+        # S (decode-only ticks) and max(chunk cap, S) (mixed ticks that
+        # carry a prefill chunk).
+        width = S
+        if self._mixed and prefill_rows and chunk.max() > 0:
+            width = max(self._chunk_cap, S)
+        tokens = np.zeros((B, width), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        qlen = np.zeros((B,), np.int32)
+        sample_slot = np.zeros((B,), np.int32)
+        fold0 = np.zeros((B,), np.int32)
+        n_draft = np.zeros((B,), np.int32)
+        stoch = np.zeros((B,), bool)
+        active = np.zeros((B,), bool)
+        completing = [False] * B
+        prefill_tokens = 0
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            if self._mixed and self._prefilling[r]:
+                w0 = self._row_w0[r]
+                c = int(chunk[r])
+                Leff = max(self._row_L[r], 1)
+                pos0[r] = w0
+                qlen[r] = c
+                prefill_tokens += c
+                if c > 0:
+                    tokens[r, :c] = self._row_prompt[r][w0:w0 + c]
+                    if w0 <= Leff - 1 < w0 + c:
+                        completing[r] = True
+                        active[r] = True
+                        sample_slot[r] = Leff - 1 - w0
+                        fold0[r] = self._row_L[r]
+            else:
+                nd = len(drafts[r])
+                pos0[r] = self._pos[r]
+                qlen[r] = 1 + nd
+                tokens[r, 0] = self._tok[r]
+                if nd:
+                    tokens[r, 1:1 + nd] = drafts[r]
+                fold0[r] = int(self._pos[r]) + 1
+                n_draft[r] = nd
+                # Only DRAFTED temp>0 rows ever take the rejection path;
+                # the flag below selects the compiled variant, so the
+                # all-greedy common case never traces it.
+                stoch[r] = req.temperature > 0 and nd > 0
+                active[r] = not self._done[r]
+        stochastic = bool(stoch.any())
+
+        # ONE dispatch, under the pool lock (it donates the pool buffers).
+        with pool.lock:
+            common = (self.params, pool.caches, jnp.asarray(self._tables),
+                      jnp.asarray(tokens), jnp.asarray(pos0),
+                      jnp.asarray(qlen), jnp.asarray(sample_slot),
+                      jnp.asarray(fold0), jnp.asarray(n_draft),
+                      jnp.asarray(stoch), jnp.asarray(active),
+                      jnp.asarray(self._done), jnp.asarray(self._seeds),
+                      jnp.asarray(self._temps), jnp.asarray(self._topps),
+                      jnp.asarray(self._topks), jnp.asarray(self._minps),
+                      jnp.asarray(eos_vec))
+            if controls:
+                (pool.caches, emitted, n_emit, n_acc, done,
+                 self._counts) = self._spec_step_exe(
+                    width, True, stochastic)(
+                    *common, self._ensure_counts(),
+                    jnp.asarray(self._pens), jnp.asarray(self._stops))
+            else:
+                (pool.caches, emitted, n_emit, n_acc,
+                 done) = self._spec_step_exe(
+                    width, False, stochastic)(*common)
+        start_host_copies(emitted, n_emit, n_acc, done)
+        emitted_h = np.array(emitted)
+        n_emit_h = np.array(n_emit)
+        n_acc_h = np.array(n_acc)
+        done_new = np.array(done)
+        # Dispatch counted only past the host sync (failure surfaces AT
+        # the sync; a recovered failure must leave dispatches == ticks).
+        # Separate statement/site from the tick counters below, so the
+        # one-dispatch-per-tick invariant stays independently assertable.
+        sp = self._stats["spec"]
+        sp["dispatches"] += 1
+        if self._mixed:
+            self._stats["mixed"]["dispatches"] += 1
+
+        sp["ticks"] += 1
+        sp["proposed_tokens"] += proposed
+        sp["draft_dispatches"] = getattr(self._drafter, "dispatches", 0)
+        if self._mixed:
+            m = self._stats["mixed"]
+            m["ticks"] += 1
+            m["prefill_tokens"] += prefill_tokens
+            if prefill_tokens and n_decode:
+                m["coscheduled_ticks"] += 1
+
+        accepted = 0
+        decode_emitted = 0
+        for r in list(range(B)):
+            req = self._row_req[r]
+            if req is None:
+                continue
+            if self._mixed and self._prefilling[r]:
+                self._row_w0[r] += int(chunk[r])
+                if not completing[r]:
+                    continue
+                self._complete_prefill_row(r, req, int(emitted_h[r, 0]),
+                                           bool(done_new[r]))
+                continue
+            ne = int(n_emit_h[r])
+            toks = [int(t) for t in emitted_h[r, :ne]]
+            accepted += int(n_acc_h[r])
+            decode_emitted += ne
+            if ne:
+                sp["row_ticks"] += 1
+            self._done[r] = bool(done_new[r])
+            if ne:
+                self._tok[r] = toks[-1]
+                # The done-marking token (EOS/stop) is never written to
+                # the cache — same rule as plain decode's pos freeze.
+                adv = ne - 1 if self._done[r] else ne
+                self._pos[r] = min(int(self._pos[r]) + adv,
+                                   self.max_seq - 1)
+                need = req.max_new - len(self._row_emitted[r])
+                if need > 0:
+                    self._row_emitted[r].extend(toks[:need])
+                    now = time.perf_counter()
+                    if self._row_last_emit[r] > 0:
+                        self.itl_hist.observe(
+                            max(0.0, now - self._row_last_emit[r]))
+                    self._row_last_emit[r] = now
+            self._push_stream(r, req)
+            self._maybe_complete(r)
+            if self._row_req[r] is not None and not self._done[r]:
+                self._trim_row_tail(r, req)
+        sp["accepted_tokens"] += accepted
+        sp["emitted_tokens"] += decode_emitted
+        if self._mixed:
+            self._stats["mixed"]["decode_tokens"] += decode_emitted
+
+        if self.tracer is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            start_ts = time.time() - dur_us / 1e6
+            self.tracer.record(
+                "tick", "spec_verify", self.trace_node, dur_us,
+                start_ts=start_ts,
+                attrs={"decode_rows": int(n_decode),
+                       "proposed": int(proposed),
+                       "accepted": int(accepted),
+                       "width": int(width)})
+            if self._mixed:
+                self.tracer.record(
+                    "tick", "mixed_step", self.trace_node, dur_us,
+                    start_ts=start_ts,
+                    attrs={"prefill_tokens": int(prefill_tokens),
+                           "decode_rows": int(n_decode),
+                           "width": int(width)})
 
     def _loop_body(self) -> None:
         while self._running:
@@ -1732,12 +2233,17 @@ class ContinuousGenerator:
             if all(r is None for r in self._row_req):
                 continue
 
-            if self._mixed:
+            if self._mixed or self._spec:
                 # ONE ragged dispatch serves this tick's decode rows and
                 # prefill chunks together (admission folded into the
                 # decode dispatch — no second device path to contend).
+                # Speculation upgrades decode rows to verify windows in
+                # the SAME single dispatch.
                 try:
-                    self._tick_mixed()
+                    if self._spec:
+                        self._tick_spec()
+                    else:
+                        self._tick_mixed()
                 except Exception as exc:
                     self._recover(exc)
                 continue

@@ -1,6 +1,18 @@
 """Speculative decoding: draft-model proposals verified by the target in
 one windowed MXU pass, with the whole generation loop compiled on-device.
 
+This module is also the shared substrate for CONTINUOUS speculation
+(runtime.scheduler, --spec-k): `NGramDrafter` / `ModelDrafter` are the
+host-side proposal sources the continuous scheduler's per-tick ragged
+verify windows consume, and the tagged per-(seed, position) RNG streams
+(`_tagged_uniform` / `_tagged_categorical`) key both lanes' stochastic
+acceptance identically. The vectorized (B, k) acceptance helpers below
+trace into THIS module's batch lane; the continuous scheduler applies
+the same per-slot rule inline in its compiled spec step (its window is
+sequential — penalties/stops evolve slot to slot), so a change to the
+acceptance math here must be mirrored there (see the note at the
+scheduler's spec-step builder).
+
 The reference cannot express any decode loop at all (its engine is one-shot
 ``Session::Run``, ``/root/reference/src/inference_engine.cpp:176-183``);
 runtime.generator gave it a chunked scan loop; this module removes the
@@ -101,6 +113,248 @@ def _tagged_categorical(seeds, positions, tag, log_probs):
     return jax.vmap(row)(seeds, positions, log_probs).astype(jnp.int32)
 
 
+# -- shared acceptance helpers -------------------------------------------------
+#
+# Both speculative lanes — this module's batch-to-completion generator and
+# the continuous scheduler's per-tick verify windows
+# (runtime.scheduler, --spec-k) — reduce to the same two acceptance rules
+# over a draft window scored by (B, W=k+1, V) target logits. These
+# vectorized (B, k) definitions trace into the BATCH lane's compiled
+# round loop; the continuous lane evaluates the identical per-slot rule
+# inline (keyed by the same tagged RNG streams) because its window math
+# is sequential. Keep the two in lockstep.
+
+
+def greedy_acceptance(d, g):
+    """Greedy (temperature 0) acceptance: the longest draft prefix
+    matching the target argmax. ``d`` (B, k) proposals; ``g`` (B, W)
+    target argmax tokens (g[:, i] is the target's token AFTER window slot
+    i). Returns (n_acc (B,), emitted (B, W)) — the emitted tokens are the
+    TARGET's own tokens (for accepted slots they equal the draft), so the
+    stream is byte-identical to plain greedy decode for any draft."""
+    k = d.shape[1]
+    cum = jnp.cumprod((d == g[:, :k]).astype(jnp.int32), axis=1)
+    return jnp.sum(cum, axis=1), g
+
+
+def rejection_acceptance(d, p, q, seeds, logical):
+    """Standard speculative rejection sampling: accept d_i with prob
+    min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from
+    norm(max(p - q, 0)); when all k accept, draw the bonus token from
+    p_k. ``d`` (B, k) proposals; ``p`` (B, W, V) target probabilities;
+    ``q`` (B, k, V) draft probabilities. Every emitted token is an
+    unbiased sample from the target distribution. Returns
+    (n_acc (B,), emitted (B, W)). The continuous scheduler's
+    deterministic drafters specialize this rule to a point-mass q
+    (accept is u < p(d); residual zeros the proposed token's mass) — but
+    per-slot and inline in its compiled spec step, because penalties and
+    stops evolve slot to slot there; it does not call this helper."""
+    bb, k = d.shape
+    v = p.shape[-1]
+    slot = jnp.arange(k + 1)[None, :]
+    p_d = jnp.take_along_axis(p[:, :k], d[..., None], axis=2)[..., 0]
+    q_d = jnp.take_along_axis(q, d[..., None], axis=2)[..., 0]
+    u = _tagged_uniform(seeds, logical, _TAG_ACCEPT, (k,))
+    ratio = p_d / jnp.maximum(q_d, 1e-30)
+    acc = u < jnp.minimum(ratio, 1.0)
+    cum = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(cum, axis=1)
+    # Residual/bonus distribution at the first rejected slot (p_k when
+    # all k accepted; q zero-padded there).
+    q_pad = jnp.concatenate(
+        [q, jnp.zeros((bb, 1, v), q.dtype)], axis=1)
+    p_j = jnp.take_along_axis(p, n_acc[:, None, None], axis=1)[:, 0]
+    q_j = jnp.take_along_axis(q_pad, n_acc[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_j - q_j, 0.0)
+    tot = jnp.sum(resid, axis=-1, keepdims=True)
+    dist = jnp.where(tot > 0, resid, p_j)
+    corr = _tagged_categorical(seeds, logical, _TAG_RESID,
+                               jnp.log(jnp.maximum(dist, 1e-30)))
+    d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
+    emitted = jnp.where(slot == n_acc[:, None], corr[:, None], d_ext)
+    return n_acc, emitted
+
+
+# -- drafters for the continuous scheduler ------------------------------------
+
+
+class NGramDrafter:
+    """Host-side n-gram / prompt-lookup drafter (the continuous
+    scheduler's default, --spec-draft ngram): propose the tokens that
+    FOLLOWED the most recent earlier occurrence of the context's longest
+    matching tail n-gram. No second model, no device work, fully
+    deterministic — and strong exactly where speculation pays most:
+    repeated text (retrieval-stuffed prompts, code, the degenerate loops
+    small models greedy-decode into). An empty or match-free history
+    proposes nothing, which costs the scheduler only a q_len-1 tick."""
+
+    name = "ngram"
+    dispatches = 0  # host-side: never touches the device
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_scan: int = 1024):
+        if not 1 <= int(min_ngram) <= int(max_ngram):
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+                             f"{min_ngram}..{max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        # The backward scan runs per eligible row per scheduler tick on
+        # the decode thread — bound it so a match-free long context
+        # (e.g. a 4k retrieval prompt) costs O(max_scan), not O(L),
+        # of host time per tick.
+        self.max_scan = int(max_scan)
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        """Up to ``k`` proposed continuation tokens (possibly none)."""
+        ctx = list(context)[-self.max_scan:]
+        if k <= 0 or len(ctx) < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, len(ctx) - 1),
+                       self.min_ngram - 1, -1):
+            tail = ctx[-n:]
+            # Most recent EARLIER occurrence of the tail n-gram whose
+            # continuation (which may overlap the tail itself — the
+            # self-repetition case) fills the whole window; matches too
+            # near the end of history keep the longest seen as fallback.
+            best: List[int] = []
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i:i + n] == tail:
+                    cont = ctx[i + n:i + n + k]
+                    if len(cont) >= k:
+                        return [int(t) for t in cont]
+                    if len(cont) > len(best):
+                        best = cont
+            if best:
+                return [int(t) for t in best]
+        return []
+
+
+class ModelDrafter:
+    """Registry draft model proposing greedily from a bounded recent
+    context window (--spec-draft model). Stateless across ticks: each
+    propose() is ONE compiled dispatch on the draft model — a prefill
+    over the last ``context_window`` tokens fused with k greedy single
+    steps — so there is no per-row draft cache to rewind on rejection.
+    These draft dispatches are separate from (and counted separately to)
+    the scheduler's one verify dispatch per tick; the n-gram drafter is
+    the zero-extra-dispatch default. Deterministic (greedy argmax), and
+    acceptance math never depends on draft quality — a random-init draft
+    only costs speed, never correctness."""
+
+    name = "model"
+
+    def __init__(self, spec: Union[str, ModelSpec], params=None, k: int = 4,
+                 dtype=jnp.bfloat16, context_window: int = 64, device=None):
+        if isinstance(spec, str):
+            _ensure_builtin_models_imported()
+            spec = create_model(spec)
+        if (not isinstance(spec.config, TransformerConfig)
+                or not spec.config.causal):
+            raise ValueError(
+                f"draft model '{spec.name}' is not a decoder transformer")
+        if k < 1:
+            raise ValueError(f"speculation depth k must be >= 1, got {k}")
+        self.spec = spec
+        self.cfg: TransformerConfig = spec.config
+        self.k = int(k)
+        self._dtype = dtype if not isinstance(dtype, str) else _DTYPES[dtype]
+        self._device = device
+        self._ctx = int(min(context_window, self.cfg.max_seq - self.k - 1))
+        if self._ctx < 1:
+            # A non-positive window would slice context[-0:] (the WHOLE
+            # history) and feed positions past the draft's max_seq —
+            # silent garbage proposals. Fail like the checks above.
+            raise ValueError(
+                f"draft model '{spec.name}' max_seq {self.cfg.max_seq} "
+                f"cannot hold a context window for k={self.k} "
+                f"(needs max_seq >= k + 2)")
+        # propose() only reads context[-self._ctx:]; advertising that lets
+        # the scheduler slice tails before concatenating, so a long prompt
+        # costs O(ctx) host time per drafted row per tick, not O(L).
+        self.max_scan = self._ctx
+        self.params = (params if params is not None
+                       else spec.init(jax.random.PRNGKey(1)))
+        if device is not None:
+            self.params = jax.device_put(self.params, device)
+        self._exe: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self.dispatches = 0
+
+    def _exe_for(self, pb: int):
+        exe = self._exe.get(pb)
+        if exe is not None:
+            return exe
+        cfg, dtype, k = self.cfg, self._dtype, self.k
+
+        def run(dparams, tokens, attn, pos_ids, start):
+            caches = init_caches(cfg, 1, pb + k, dtype)
+            logits, caches = transformer_prefill(
+                dparams, tokens, caches, cfg, dtype=dtype,
+                attn_mask=attn, pos_ids=pos_ids)
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
+            if k == 1:
+                return first[None, :][:, 0]
+
+            def body(carry, i):
+                tok, caches = carry
+                lg, caches = transformer_decode_rows(
+                    dparams, tok, caches,
+                    jnp.full((1,), pb, jnp.int32) + i, cfg, dtype=dtype,
+                    start_vec=start)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (nxt, caches), nxt
+
+            _, outs = jax.lax.scan(body, (first, caches),
+                                   jnp.arange(k - 1))
+            return jnp.concatenate([first[None, :], outs], axis=0)[:, 0]
+
+        with self._lock:
+            return self._exe.setdefault(pb, jax.jit(run))
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        if k <= 0 or not len(context):
+            return []
+        ctx = [int(t) for t in context[-self._ctx:]]
+        L = len(ctx)
+        pb = 16
+        while pb < L:
+            pb *= 2
+        # Cap the bucket so the k-1 decode steps (positions pb..pb+k-2)
+        # stay inside the draft's max_seq — the 16-token floor would
+        # otherwise feed a small draft positions past its embedding table
+        # and silently propose garbage (L <= _ctx <= max_seq-k-1 < cap,
+        # so the cap always still holds the context).
+        pb = min(pb, max(16, self._ctx), self.cfg.max_seq - self.k)
+        ctx = ctx[-pb:]
+        L = len(ctx)
+        tokens = np.zeros((1, pb), np.int32)
+        attn = np.zeros((1, pb), np.int32)
+        pos_ids = np.zeros((1, pb), np.int32)
+        tokens[0, pb - L:] = ctx
+        attn[0, pb - L:] = 1
+        pos_ids[0, pb - L:] = np.arange(L)
+        props = self._exe_for(pb)(
+            self.params, jnp.asarray(tokens), jnp.asarray(attn),
+            jnp.asarray(pos_ids), jnp.asarray([pb - L], jnp.int32))
+        self.dispatches += 1
+        return [int(t) for t in np.asarray(props)[:min(k, self.k)]]
+
+
+def make_drafter(kind: str, k: int, *, draft_model=None, draft_params=None,
+                 dtype=jnp.bfloat16, device=None):
+    """Drafter factory for the continuous scheduler's --spec-draft knob."""
+    if kind == "ngram":
+        return NGramDrafter()
+    if kind == "model":
+        if draft_model is None:
+            raise ValueError("spec_draft='model' needs a draft model "
+                             "(spec_draft_model / --gen-draft-model)")
+        return ModelDrafter(draft_model, params=draft_params, k=k,
+                            dtype=dtype, device=device)
+    raise ValueError(f"unknown drafter kind {kind!r} "
+                     "(expected 'ngram' or 'model')")
+
+
 class SpeculativeGenerator:
     """Batch-mode generator with draft-model speculation.
 
@@ -172,6 +426,10 @@ class SpeculativeGenerator:
         self._lock = threading.Lock()
         # Round-trip stats (filled after each generate call).
         self.last_stats: dict = {}
+        # Lifetime acceptance counters (scraped at /stats and /metrics —
+        # tpu_engine_spec_accept_ratio et al.). GIL-safe increments on
+        # the single gen-batcher thread; reads race benignly.
+        self._cum = {"verify_passes": 0, "emitted": 0, "live_rounds": 0}
 
     # -- compiled whole-generation function --------------------------------
 
@@ -260,52 +518,25 @@ class SpeculativeGenerator:
                     tparams, wtokens, tcaches, pos, tcfg,
                     dtype=dtype, start_vec=start)      # (B, W, V)
 
-                # ---- greedy acceptance (exact-match against argmax).
+                # ---- acceptance: the shared helpers (one definition
+                # with the continuous scheduler's per-tick verify).
                 g = jnp.argmax(tl, axis=-1).astype(jnp.int32)   # (B, W)
-                acc_g = (d == g[:, :k])
-                cum_g = jnp.cumprod(acc_g.astype(jnp.int32), axis=1)
-                n_acc_g = jnp.sum(cum_g, axis=1)                # (B,)
+                n_acc_g, e_g = greedy_acceptance(d, g)
                 slot = jnp.arange(w)[None, :]
 
                 if stochastic:
-                    # ---- stochastic acceptance (rejection sampling).
                     t_safe = jnp.maximum(temps, 1e-6)[:, None, None]
                     p = jax.nn.softmax(tl / t_safe, axis=-1)    # (B, W, V)
                     q = jax.nn.softmax(dlg / t_safe, axis=-1)   # (B, k, V)
-                    p_d = jnp.take_along_axis(
-                        p[:, :k], d[..., None], axis=2)[..., 0]  # (B, k)
-                    q_d = jnp.take_along_axis(
-                        q, d[..., None], axis=2)[..., 0]
-                    u = _tagged_uniform(seeds, logical, _TAG_ACCEPT, (k,))
-                    ratio = p_d / jnp.maximum(q_d, 1e-30)
-                    acc_s = u < jnp.minimum(ratio, 1.0)
-                    cum_s = jnp.cumprod(acc_s.astype(jnp.int32), axis=1)
-                    n_acc_s = jnp.sum(cum_s, axis=1)
-                    # Residual/bonus distribution at the first rejected
-                    # slot (p_k when all k accepted; q zero-padded there).
-                    q_pad = jnp.concatenate(
-                        [q, jnp.zeros((bb, 1, q.shape[-1]), q.dtype)],
-                        axis=1)
-                    p_j = jnp.take_along_axis(
-                        p, n_acc_s[:, None, None], axis=1)[:, 0]  # (B, V)
-                    q_j = jnp.take_along_axis(
-                        q_pad, n_acc_s[:, None, None], axis=1)[:, 0]
-                    resid = jnp.maximum(p_j - q_j, 0.0)
-                    tot = jnp.sum(resid, axis=-1, keepdims=True)
-                    dist = jnp.where(tot > 0, resid, p_j)
-                    corr = _tagged_categorical(
-                        seeds, logical, _TAG_RESID,
-                        jnp.log(jnp.maximum(dist, 1e-30)))
-                    d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
-                    e_s = jnp.where(slot == n_acc_s[:, None],
-                                    corr[:, None], d_ext)
+                    n_acc_s, e_s = rejection_acceptance(d, p, q, seeds,
+                                                        logical)
                     # ---- per-row greedy/stochastic select.
                     use_s = temps > 0
                     n_acc = jnp.where(use_s, n_acc_s, n_acc_g)
-                    emitted = jnp.where(use_s[:, None], e_s, g)  # (B, W)
+                    emitted = jnp.where(use_s[:, None], e_s, e_g)  # (B, W)
                 else:
                     n_acc = n_acc_g
-                    emitted = g
+                    emitted = e_g
                 n_emit = n_acc + 1
 
                 # ---- write emitted tokens, advance bookkeeping.
@@ -448,6 +679,9 @@ class SpeculativeGenerator:
         stats = np.asarray(stats)
         rounds, emitted = int(stats[0]), int(stats[1])
         live_row_rounds = int(stats[2])
+        self._cum["verify_passes"] += rounds
+        self._cum["emitted"] += emitted
+        self._cum["live_rounds"] += live_row_rounds
         self.last_stats = {
             "rounds": rounds,
             "tokens_in_rounds": emitted,
@@ -470,6 +704,32 @@ class SpeculativeGenerator:
                 for r in range(n)]
 
     def stats(self) -> dict:
+        # Lifetime acceptance, in the SAME "spec" schema the continuous
+        # scheduler exposes (utils.metrics renders both lanes through one
+        # tpu_engine_spec_* family). Per live-row verify pass the stream
+        # advances 1 + accepted tokens, so accepted = emitted - live
+        # rounds; proposed = k per live round (the batch lane always
+        # drafts a full window).
+        lr = self._cum["live_rounds"]
+        spec_block = {
+            "k": self.k,
+            "draft": self.draft_spec.name,
+            "lane": "batch",
+            "dispatches": self._cum["verify_passes"],
+            "proposed_tokens": self.k * lr,
+            "accepted_tokens": max(0, self._cum["emitted"] - lr),
+            "emitted_tokens": self._cum["emitted"],
+            "accept_ratio": (round((self._cum["emitted"] - lr)
+                                   / (self.k * lr), 4) if lr else None),
+            # Same semantics as the continuous lane's two gauges:
+            # per-dispatch conflates co-batching (B rows per verify
+            # pass), per-ROW-dispatch is the speculation win itself.
+            "tokens_per_dispatch": (
+                round(self._cum["emitted"] / self._cum["verify_passes"], 3)
+                if self._cum["verify_passes"] else None),
+            "tokens_per_row_dispatch": (round(self._cum["emitted"] / lr, 3)
+                                        if lr else None),
+        }
         return {
             "target": self.spec.name,
             "draft": self.draft_spec.name,
@@ -478,5 +738,6 @@ class SpeculativeGenerator:
             "batch_buckets": list(self._batch_buckets),
             "prompt_buckets": list(self._prompt_buckets),
             "compiled": sorted(self._exe),
+            "spec": spec_block,
             **self.last_stats,
         }

@@ -269,11 +269,12 @@ def serve_combined(
         lambda body: (200, gateway.route_generate_stream(body)))
 
     def _stats(_body):
-        """Gateway /stats, plus per-lane paged-KV pool and mixed-step
-        health when a decode lane runs them (additive keys; the
-        reference-exact schema is untouched for dense deployments)."""
+        """Gateway /stats, plus per-lane paged-KV pool, mixed-step, and
+        speculative-decoding health when a decode lane runs them
+        (additive keys; the reference-exact schema is untouched for
+        dense deployments)."""
         out = gateway.get_stats()
-        kv, mixed = {}, {}
+        kv, mixed, spec = {}, {}, {}
         for w in workers:
             gen = getattr(w, "generator", None)
             if gen is None or not hasattr(gen, "stats"):
@@ -287,10 +288,15 @@ def serve_combined(
             if st.get("mixed"):
                 mixed[w.node_id] = dict(st["mixed"],
                                         active=st.get("active"))
+            if st.get("spec"):
+                spec[w.node_id] = dict(st["spec"],
+                                       active=st.get("active"))
         if kv:
             out["kv_pool"] = kv
         if mixed:
             out["mixed"] = mixed
+        if spec:
+            out["spec"] = spec
         return 200, out
 
     routes[("GET", "/stats")] = _stats

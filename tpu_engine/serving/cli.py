@@ -268,6 +268,23 @@ def main(argv=None) -> int:
                                  "admitting rows' chunks and caps the "
                                  "compiled chunk width). 0 = auto "
                                  "(--gen-prefill-chunk)")
+        parser.add_argument("--spec-k", type=int, default=0,
+                            help="continuous speculative decoding (needs "
+                                 "--kv-block-size; composes with "
+                                 "--mixed-step): a drafter proposes up to "
+                                 "this many tokens per decode row per tick "
+                                 "and the tick's ONE ragged dispatch "
+                                 "verifies every window — rows advance "
+                                 "1..k+1 tokens per dispatch, greedy "
+                                 "streams byte-identical to plain decode "
+                                 "(bench.py --scenario spec-ab). 0 = off")
+        parser.add_argument("--spec-draft", choices=["ngram", "model"],
+                            default="ngram",
+                            help="drafter for --spec-k: ngram = host-side "
+                                 "prompt-lookup (no second model, no extra "
+                                 "dispatches; default), model = greedy "
+                                 "proposals from --gen-draft-model (one "
+                                 "draft dispatch per drafted row per tick)")
         parser.add_argument("--quantize", choices=["int8"], default=None,
                             help="weight-only quantization: dense/conv "
                                  "kernels stored int8 with per-channel "
@@ -329,6 +346,8 @@ def main(argv=None) -> int:
                                      gen_mixed_step=args.mixed_step,
                                      gen_mixed_token_budget=(
                                          args.mixed_token_budget),
+                                     gen_continuous_spec_k=args.spec_k,
+                                     gen_spec_draft=args.spec_draft,
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)

@@ -284,6 +284,15 @@ class WorkerNode:
         self._gen_processor: Optional[BatchProcessor[_GenItem, _GenResult]] = None
         self._continuous = self.config.gen_scheduler == "continuous"
         self._speculative = self.config.gen_scheduler == "speculative"
+        if self.config.gen_continuous_spec_k > 0 and not self._continuous:
+            # --spec-k is the continuous scheduler's knob; under any other
+            # gen_scheduler the flag would build that lane's generator and
+            # silently serve without speculation — same loud contract as
+            # every other spec misconfiguration.
+            raise RuntimeError(
+                f"--spec-k requires gen_scheduler=continuous, got "
+                f"{self.config.gen_scheduler!r} (batch-lane speculation "
+                f"is gen_scheduler=speculative)")
         if getattr(self.engine.spec, "config", None) is not None:
             try:
                 if self._speculative:
@@ -319,6 +328,7 @@ class WorkerNode:
                         mixed_step=self.config.gen_mixed_step,
                         mixed_token_budget=(
                             self.config.gen_mixed_token_budget),
+                        **self._continuous_spec_kwargs(),
                         device=getattr(engine, "_device", None))
                     # Per-tick mixed_step spans land in the lane's ring.
                     self.generator.tracer = self.tracer
@@ -339,8 +349,25 @@ class WorkerNode:
                         observer=self._batch_observer,
                     )
                     self._gen_processor.start()
-            except ValueError:
+            except ValueError as e:
+                if self.config.gen_continuous_spec_k > 0:
+                    # The operator explicitly asked for speculation: any
+                    # construction failure (non-decoder draft model,
+                    # draft max_seq too small for k, non-generating
+                    # target) is a misconfiguration, not the quiet
+                    # "this model can't generate" lane fallback.
+                    raise RuntimeError(
+                        f"speculative lane misconfigured: {e}") from e
                 self.generator = None
+        elif self.config.gen_continuous_spec_k > 0:
+            # Config-less models skip generator construction entirely, so
+            # the ValueError conversion above can never fire for them —
+            # guard the skip path too, or --spec-k on a non-generating
+            # model silently serves without a decode lane.
+            raise RuntimeError(
+                f"speculative lane misconfigured: model "
+                f"'{getattr(self.engine.spec, 'name', self.config.model)}' "
+                f"has no generation lane to speculate on")
         # Worker-level counters, distinct from the LRU's own accounting
         # (reference worker_node.cpp:141-142).
         self._total_requests = 0
@@ -423,34 +450,20 @@ class WorkerNode:
 
     _AUTO_DRAFT = {"gpt2": "distilgpt2", "gpt2-small-test": "gpt2-small-test"}
 
-    def _build_speculative(self):
-        """Construct the speculative-decoding lane (gen_scheduler=
-        "speculative"): resolve the draft model (explicit config or the
-        auto map), load optional draft weights, share the target's params
-        with the engine.
-
-        Error contract: the caller treats ValueError as "this model can't
-        generate" (non-transformer targets fall back to no generation lane,
-        same as the other schedulers), so ONLY the target-isn't-a-decoder
-        case may raise ValueError here. Every speculative-specific
-        misconfiguration (unresolvable draft, vocab mismatch, bad k) is
-        re-raised as RuntimeError so startup fails loudly instead of
-        silently serving without a generation lane."""
+    def _resolve_draft_spec(self):
+        """Resolve the configured draft model (explicit gen_draft_model or
+        the auto map) and optional checkpoint into (spec, params or None).
+        Shared by the batch speculative lane and the continuous
+        scheduler's --spec-draft model drafter. Raises RuntimeError on a
+        misconfiguration so startup fails loudly."""
         from tpu_engine.models.registry import (
             create_model, _ensure_builtin_models_imported)
-        from tpu_engine.models.transformer import TransformerConfig
-        from tpu_engine.runtime.speculative import SpeculativeGenerator
 
-        tgt_cfg = getattr(self.engine.spec, "config", None)
-        if not isinstance(tgt_cfg, TransformerConfig) or not tgt_cfg.causal:
-            raise ValueError(
-                f"model '{self.engine.spec.name}' is not a decoder "
-                "transformer; generation unsupported")
         draft_name = (self.config.gen_draft_model
                       or self._AUTO_DRAFT.get(self.engine.spec.name))
         if draft_name is None:
             raise RuntimeError(
-                f"gen_scheduler=speculative needs a draft model for "
+                f"a draft model is required for "
                 f"'{self.engine.spec.name}': set gen_draft_model "
                 f"(--gen-draft-model)")
         _ensure_builtin_models_imported()
@@ -473,12 +486,80 @@ class WorkerNode:
         if self.config.gen_draft_path:
             draft_params = _load_model_path(draft_spec,
                                             self.config.gen_draft_path)
-        else:
+        return draft_spec, draft_params
+
+    def _continuous_spec_kwargs(self) -> dict:
+        """Continuous-speculation kwargs for ContinuousGenerator
+        (--spec-k / --spec-draft). Empty when off. Misconfiguration
+        raises RuntimeError — the continuous branch's ValueError handler
+        means "this model can't generate", and silently dropping the
+        decode lane over a spec typo must not pass for that."""
+        k = int(self.config.gen_continuous_spec_k)
+        if k <= 0:
+            return {}
+        if self.config.gen_kv_block_size <= 0:
+            raise RuntimeError(
+                "--spec-k requires the paged KV cache (--kv-block-size)")
+        max_seq = getattr(self.engine.spec.config, "max_seq", None)
+        if max_seq is not None and k > max_seq - 2:
+            # Pre-checked here because ContinuousGenerator's ValueError
+            # would be read as "this model can't generate" and silently
+            # drop the decode lane.
+            raise RuntimeError(
+                f"--spec-k {k} cannot fit a verify window in the "
+                f"model's max_seq {max_seq}")
+        if self.config.gen_spec_draft not in ("ngram", "model"):
+            # Pre-checked so make_drafter's ValueError can't be read as
+            # "this model can't generate" and silently drop the lane.
+            raise RuntimeError(
+                f"--spec-draft must be 'ngram' or 'model', got "
+                f"{self.config.gen_spec_draft!r}")
+        kw = {"spec_k": k, "spec_draft": self.config.gen_spec_draft}
+        if self.config.gen_spec_draft == "model":
+            draft_spec, draft_params = self._resolve_draft_spec()
+            target_vocab = getattr(self.engine.spec.config, "vocab", None)
+            if (target_vocab is not None
+                    and draft_spec.config.vocab != target_vocab):
+                raise RuntimeError(
+                    f"speculative lane misconfigured: draft vocab "
+                    f"{draft_spec.config.vocab} != target {target_vocab}")
+            if draft_params is None:
+                print(f"[{self.node_id}] WARNING: --spec-draft model "
+                      f"'{draft_spec.name}' is randomly initialized (no "
+                      f"gen_draft_path); expect ~zero acceptance — the "
+                      f"ngram drafter is the better default", flush=True)
+            kw["spec_draft_model"] = draft_spec
+            kw["spec_draft_params"] = draft_params
+        return kw
+
+    def _build_speculative(self):
+        """Construct the speculative-decoding lane (gen_scheduler=
+        "speculative"): resolve the draft model (explicit config or the
+        auto map), load optional draft weights, share the target's params
+        with the engine.
+
+        Error contract: the caller treats ValueError as "this model can't
+        generate" (non-transformer targets fall back to no generation lane,
+        same as the other schedulers), so ONLY the target-isn't-a-decoder
+        case may raise ValueError here. Every speculative-specific
+        misconfiguration (unresolvable draft, vocab mismatch, bad k) is
+        re-raised as RuntimeError so startup fails loudly instead of
+        silently serving without a generation lane."""
+        from tpu_engine.models.transformer import TransformerConfig
+        from tpu_engine.runtime.speculative import SpeculativeGenerator
+
+        tgt_cfg = getattr(self.engine.spec, "config", None)
+        if not isinstance(tgt_cfg, TransformerConfig) or not tgt_cfg.causal:
+            raise ValueError(
+                f"model '{self.engine.spec.name}' is not a decoder "
+                "transformer; generation unsupported")
+        draft_spec, draft_params = self._resolve_draft_spec()
+        if draft_params is None:
             # A random-init draft accepts ~nothing: the lane degrades to
             # pure overhead (bench.py spec-ab's measured floor). Loud
             # warning, not an error — random drafts are the test fixture.
             print(f"[{self.node_id}] WARNING: speculative draft "
-                  f"'{draft_name}' is randomly initialized (no "
+                  f"'{draft_spec.name}' is randomly initialized (no "
                   f"gen_draft_path); expect ~zero acceptance and worse "
                   f"throughput than gen_scheduler=batch", flush=True)
         try:

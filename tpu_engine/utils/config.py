@@ -98,6 +98,17 @@ class WorkerConfig:
     # each; the rest splits over admitting rows' prefill chunks and caps
     # the compiled chunk width). 0 = auto (gen_prefill_chunk).
     gen_mixed_token_budget: int = 0
+    # Continuous speculative decoding (paged mode only, two-path or
+    # mixed): each tick a drafter proposes up to this many tokens per
+    # decode row and the tick's ONE ragged dispatch verifies every
+    # window, advancing rows 1..k+1 tokens per dispatch. Greedy streams
+    # byte-identical to plain decode for any draft; 0 = off (--spec-k).
+    gen_continuous_spec_k: int = 0
+    # Drafter for continuous speculation (--spec-draft): "ngram" = the
+    # host-side prompt-lookup drafter (no second model, no extra
+    # dispatches); "model" = greedy proposals from gen_draft_model
+    # (one extra draft dispatch per drafted row per tick).
+    gen_spec_draft: str = "ngram"
     # Batch scheduler only: run each group's decode as ONE fused dispatch
     # (lax.while_loop, zero per-chunk host syncs; identical streams).
     # Worth enabling where dispatch latency is high; costs one compile per

@@ -252,6 +252,48 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "Per-tick new-token budget (--mixed-token-budget)",
            [(node(h), m.get("token_budget")) for h, m in mx])
 
+    # Speculative decoding — one family for BOTH lanes (the continuous
+    # scheduler's --spec-k per-tick verify windows and the batch
+    # gen_scheduler=speculative generator expose the same "spec" stats
+    # schema; the `lane` label tells them apart). accept_ratio is the
+    # headline: accepted draft tokens / proposed, lifetime.
+    sp = [(h, g.get("spec")) for h, g in gen
+          if isinstance(g, dict) and g.get("spec")]
+    metric("tpu_engine_spec_k", "gauge",
+           "Speculation depth (draft tokens per window)",
+           [({**node(h), "lane": s.get("lane", "continuous")}, s.get("k"))
+            for h, s in sp])
+    metric("tpu_engine_spec_dispatches_total", "counter",
+           "Verify dispatches issued (continuous: == scheduler ticks)",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("dispatches")) for h, s in sp])
+    metric("tpu_engine_spec_proposed_tokens_total", "counter",
+           "Draft tokens proposed for verification",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("proposed_tokens")) for h, s in sp])
+    metric("tpu_engine_spec_accepted_tokens_total", "counter",
+           "Draft tokens accepted by the target",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("accepted_tokens")) for h, s in sp])
+    metric("tpu_engine_spec_emitted_tokens_total", "counter",
+           "Tokens emitted by speculative verification "
+           "(accepted + corrected/bonus)",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("emitted_tokens")) for h, s in sp])
+    metric("tpu_engine_spec_accept_ratio", "gauge",
+           "Lifetime draft acceptance ratio (accepted / proposed)",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("accept_ratio")) for h, s in sp])
+    metric("tpu_engine_spec_tokens_per_dispatch", "gauge",
+           "Mean tokens per verify dispatch (co-batched rows included)",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("tokens_per_dispatch")) for h, s in sp])
+    metric("tpu_engine_spec_tokens_per_row_dispatch", "gauge",
+           "Mean per-row stream advance per verify dispatch "
+           "(1.0 = no speculation win)",
+           [({**node(h), "lane": s.get("lane", "continuous")},
+             s.get("tokens_per_row_dispatch")) for h, s in sp])
+
     # Resilience layer, lane side (the "admission" /health block appears
     # only once admission control has made a decision).
     adm = [(h, h.get("admission")) for h in healths if h.get("admission")]
