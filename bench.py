@@ -1520,6 +1520,145 @@ def run_spec_continuous_ab(model: str = "gpt2-small-test",
     return results
 
 
+def run_crash_ab(n_streams: int = 12, max_new: int = 48,
+                 model: str = "gpt2-small-test") -> dict:
+    """Crash-tolerant streaming A/B (DESIGN.md "Crash-tolerant
+    streaming"): kill -9 a worker process while its /generate/stream
+    load is mid-generation, with the gateway's stream journal + health
+    prober ON vs OFF.
+
+    Four standalone worker processes are spawned once; each arm routes
+    across three of them through an in-process gateway and kills that
+    arm's designated victim the moment a victim-primary stream is
+    provably mid-flight. Reported per arm:
+
+    - stream_completion_rate: streams ending in a clean terminal event;
+    - identical_rate: streams byte-identical to an unkilled blocking
+      control run (greedy AND seeded-sampled — the resume determinism
+      rule);
+    - availability: short blocking /generate probes fired AFTER the kill
+      (ring failover answers these in both arms; the prober just makes
+      the dead lane invisible sooner);
+    - resumed_streams / prober_ejections (ON arm only).
+
+    The A/B criterion: failover ON completes and matches 100% of
+    streams; OFF loses exactly the mid-flight victim streams — the
+    measured cost of binding a request to a lane instead of the fleet."""
+    import random
+    import signal
+
+    from tools.fault_injection import (
+        control_oracle,
+        drive_streams_with_kill,
+        launch_worker_procs,
+        rid_for_lane,
+        tally_streams,
+        victim_lane_for_port,
+    )
+    from tpu_engine.serving.gateway import Gateway
+    from tpu_engine.utils.config import GatewayConfig
+
+    ports, procs = launch_worker_procs(4)
+    try:
+        def run_arm(indices, victim_idx, failover: bool) -> dict:
+            gw = Gateway(
+                [f"127.0.0.1:{ports[i]}" for i in indices],
+                GatewayConfig(
+                    failover_streams=failover,
+                    health_probe_interval_s=0.25 if failover else 0.0,
+                    health_probe_failures=2))
+            try:
+                lanes = gw.worker_names()
+                victim_lane = victim_lane_for_port(
+                    lanes, ports[victim_idx])
+
+                requests = []
+                for k in range(n_streams):
+                    lane = (victim_lane if k % 3 == 0
+                            else lanes[k % len(lanes)])
+                    params = ({} if k % 2 == 0
+                              else {"temperature": 0.9, "seed": 300 + k})
+                    tag = f"{'on' if failover else 'off'}{k}"
+                    requests.append({
+                        "request_id": rid_for_lane(gw._ring, lane, tag),
+                        "prompt_tokens": [(k * 11 + j) % 90 + 1
+                                          for j in range(5 + k % 4)],
+                        "max_new_tokens": (max_new + 12
+                                           if lane == victim_lane
+                                           else max_new),
+                        **params})
+                victim_rids = {r["request_id"] for r in requests
+                               if gw._ring.get_node(r["request_id"])
+                               == victim_lane}
+                control = control_oracle(ports[0], requests)
+
+                def kill_victim():
+                    procs[victim_idx].send_signal(signal.SIGKILL)
+                    procs[victim_idx].wait(timeout=10)
+
+                results, killed = drive_streams_with_kill(
+                    gw, requests, victim_rids, kill_victim,
+                    random.Random(1 if failover else 2))
+                # Availability AFTER the kill: short blocking probes;
+                # ring failover answers them in both arms.
+                avail_ok = 0
+                for i in range(6):
+                    try:
+                        gw.route_generate(
+                            {"request_id": f"avail_{failover}_{i}",
+                             "prompt_tokens": [7, i + 1],
+                             "max_new_tokens": 4})
+                        avail_ok += 1
+                    except Exception:
+                        pass
+                complete, identical, resumed = tally_streams(
+                    results, control)
+                fo = gw.get_stats().get("failover", {})
+                return {
+                    "failover": failover, "streams": len(requests),
+                    "victim_primary_streams": len(victim_rids),
+                    "victim_killed_mid_stream": killed,
+                    "completed": complete,
+                    "stream_completion_rate": round(
+                        complete / len(requests), 3),
+                    "identical": identical,
+                    "identical_rate": round(
+                        identical / len(requests), 3),
+                    "availability_post_kill": round(avail_ok / 6, 3),
+                    "resumed_streams": resumed,
+                    "resumes_attempted": fo.get("resumes_attempted", 0),
+                    "tokens_replayed": fo.get("tokens_replayed", 0),
+                    "prober_ejections": fo.get("prober_ejections", 0),
+                }
+            finally:
+                gw.stop()
+
+        on = run_arm([0, 1, 2], 1, True)
+        record_partial("crash_on", on)
+        off = run_arm([0, 2, 3], 3, False)
+        record_partial("crash_off", off)
+        results = {"model": model, "n_streams_per_arm": n_streams,
+                   "failover_on": on, "failover_off": off}
+        results["checks_passed"] = bool(
+            on["victim_killed_mid_stream"]
+            and off["victim_killed_mid_stream"]
+            and on["stream_completion_rate"] == 1.0
+            and on["identical_rate"] == 1.0
+            and on["resumed_streams"] >= 1
+            and on["prober_ejections"] >= 1
+            and off["stream_completion_rate"] < 1.0)
+        return results
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -1658,7 +1797,8 @@ def _main() -> int:
                     choices=["infer", "generate", "compute", "decode-ab",
                              "spec-ab", "spec-batch-ab", "mixed",
                              "prefill-mfu", "longctx",
-                             "miss-sweep", "paged-ab", "mixed-ab"],
+                             "miss-sweep", "paged-ab", "mixed-ab",
+                             "crash-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -1752,6 +1892,23 @@ def _main() -> int:
             "metric": "spec_tokens_per_row_dispatch",
             "value": result["tokens_per_dispatch_ratio"], "unit": "x",
             "vs_baseline": 1.0, "model": args.model, **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "crash-ab":
+        # Crash-tolerant streaming A/B: worker processes serve the tiny
+        # registry model on the host backend (the kill is the variable
+        # under test, not the chip).
+        result = run_crash_ab(n_streams=8 if args.quick else 12)
+        record_partial("crash_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "crash_stream_completion_rate",
+            "value": result["failover_on"]["stream_completion_rate"],
+            "unit": "fraction",
+            "vs_baseline": result["failover_off"][
+                "stream_completion_rate"],
+            **result,
         })
         return 0 if result["checks_passed"] else 1
 

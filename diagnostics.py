@@ -82,9 +82,16 @@ def main() -> int:
                          "undrafted decode rows, k+1 verify windows, "
                          "and block-boundary prefill chunks in one "
                          "batch vs the XLA gather reference")
+    ap.add_argument("--failover", action="store_true",
+                    help="step 10: one scripted kill/resume against a "
+                         "local worker pair (spawned here): kill -9 the "
+                         "stream's lane mid-generation and print the "
+                         "spliced-vs-control diff — the crash-tolerant "
+                         "streaming smoke without the full "
+                         "fault_injection --crash chaos run")
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-              + int(args.spec_parity))
+              + int(args.spec_parity) + int(args.failover))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -227,6 +234,83 @@ def main() -> int:
         except Exception as exc:
             step(n, "speculative verify-window kernel parity", False,
                  f"({exc})")
+
+    # 10 (--failover): one scripted kill/resume against a local worker
+    # pair — the journal splice, live, in one line: spawn two standalone
+    # workers, stream through a failover-enabled gateway, kill -9 the
+    # serving lane mid-stream, and diff the spliced stream against an
+    # unkilled blocking control.
+    if args.failover:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + 1)
+        procs = []
+        try:
+            import signal
+            import threading
+
+            from tools.fault_injection import (
+                _call,
+                launch_worker_procs,
+                rid_for_lane,
+            )
+            from tpu_engine.serving.gateway import Gateway, _parse_sse
+            from tpu_engine.utils.config import GatewayConfig
+
+            ports, procs = launch_worker_procs(2)
+            gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                         GatewayConfig(failover_streams=True))
+            victim_lane = next(l for l in gw.worker_names()
+                               if str(ports[0]) in l)
+            rid = rid_for_lane(gw._ring, victim_lane, "fo")
+            req = {"request_id": rid, "prompt_tokens": [5, 9, 3, 17],
+                   "max_new_tokens": 24, "temperature": 0.9, "seed": 7}
+            _, ctl = _call(ports[1], "POST", "/generate",
+                           dict(req, request_id="ctl"), timeout=600)
+            control = ctl["tokens"]
+            toks, final = [], {}
+
+            def consume():
+                for frame in gw.route_generate_stream(dict(req)):
+                    evt = _parse_sse(frame)
+                    if evt and evt.get("done"):
+                        final.update(evt)
+                        break
+                    if evt and "tokens" in evt:
+                        toks.extend(evt["tokens"])
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            import time as _time
+
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and len(toks) < 2:
+                _time.sleep(0.02)
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait(timeout=10)
+            t.join(timeout=300)
+            gw.stop()
+            spliced = final.get("tokens")
+            if spliced == control and toks == control:
+                detail = (f"(identical: {len(control)} tokens, "
+                          f"resumed={final.get('resumed', 0)}, "
+                          f"replayed="
+                          f"{gw.failover.get('tokens_replayed')})")
+                ok = True
+            else:
+                div = next((i for i, (a, b) in enumerate(
+                    zip(spliced or [], control))
+                    if a != b), min(len(spliced or []), len(control)))
+                detail = (f"(DIVERGED at token {div}: "
+                          f"spliced={spliced} control={control})")
+                ok = False
+            step(n, "stream kill/resume splice vs control", ok, detail)
+        except Exception as exc:
+            step(n, "stream kill/resume splice vs control", False,
+                 f"({exc})")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
 
     n_ok = sum(_results)
     print(f"\n{n_ok}/{len(_results)} checks passed")

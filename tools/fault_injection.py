@@ -47,11 +47,25 @@ scheduler stayed one-verify-dispatch-per-tick, post-cancel streams are
 byte-identical to pre-cancel ones, and ``spec_verify`` spans carry the
 proposed/accepted attrs.
 
+``--crash`` runs the STANDALONE crash-tolerant-streaming chaos scenario
+(DESIGN.md "Crash-tolerant streaming"): it spawns three standalone worker
+processes (`cli worker`, paged KV), routes /generate/stream load across
+them through an in-process gateway with ``failover_streams`` + the health
+prober on, kill -9s one worker while its streams are mid-generation, and
+asserts every stream still completes **byte-identical** to an unkilled
+control run (greedy AND seeded-sampled, penalties/stops included), the
+prober ejects the dead lane, zero KV blocks leak on the survivors, and
+every failover decision (resume, eject) has a matching counter AND span.
+A final pass repeats the kill with failover DISABLED and asserts today's
+behavior is unchanged: the victim stream truncates, and /stats carries no
+failover block.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
   python3 tools/fault_injection.py --mixed
   python3 tools/fault_injection.py --spec
+  python3 tools/fault_injection.py --crash
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -551,6 +565,372 @@ def run_spec_standalone() -> int:
             proc.kill()
 
 
+def launch_worker_procs(n: int = 3, attempts: int = 3):
+    """Spawn ``n`` standalone worker processes (``cli worker``, paged KV,
+    tiny chunks so streams span many frames) — the killable unit of the
+    crash scenario. Returns (ports, procs)."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("TPU_ENGINE_PLATFORM", "cpu")
+
+    def make_spawn(i):
+        def spawn(port: int):
+            cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "worker",
+                   str(port), f"w{i}", "gpt2-small-test",
+                   "--kv-block-size", "16", "--step-chunk", "2",
+                   "--prefill-chunk", "16"]
+            proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                    stdout=sys.stderr, stderr=sys.stderr)
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise ChildProcessError(
+                        f"worker exited rc={proc.returncode} before ready")
+                try:
+                    status, _ = _call(port, "GET", "/health", timeout=2.0)
+                    if status == 200:
+                        return proc
+                except OSError:
+                    pass
+                time.sleep(0.5)
+            proc.terminate()
+            raise TimeoutError("worker never became ready")
+        return spawn
+
+    ports, procs = [], []
+    for i in range(n):
+        port, proc = launch_with_retry(make_spawn(i), attempts=attempts)
+        ports.append(port)
+        procs.append(proc)
+    return ports, procs
+
+
+def _worker_pool_clean(port: int, timeout_s: float = 30.0):
+    """Poll a worker's /health until its scheduler is idle and every KV
+    block is accounted for (free list + radix-held). Returns the final
+    kv_pool dict (or None if /health never settled)."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _, health = _call(port, "GET", "/health", timeout=5.0)
+        except OSError:
+            time.sleep(0.3)
+            continue
+        gen = health.get("generator", {})
+        last = gen.get("kv_pool")
+        if (gen.get("active") == 0 and last and
+                last["blocks_free"] + last["radix_nodes"]
+                >= last["blocks_total"]):
+            return last
+        time.sleep(0.3)
+    return None
+
+
+def drive_streams_with_kill(gw, requests, victim_rids, kill, rng,
+                            arrival_rate: float = 8.0,
+                            kill_window_s: float = 120.0):
+    """The shared chaos drive (also used by ``bench.py --scenario
+    crash-ab``): fire each request as a /generate/stream through ``gw``
+    at Poisson arrivals, invoke ``kill()`` once, the moment a
+    victim-primary stream is provably mid-generation (>= 3 tokens
+    relayed, not yet finished), then join. Returns (results, killed)
+    where results[rid] = (streamed_tokens, final_event) — final_event is
+    None for a truncated stream and {"harness_exception": ...} when the
+    iterator raised."""
+    import threading
+
+    from tpu_engine.serving.gateway import _parse_sse
+
+    progress = {r["request_id"]: 0 for r in requests}
+    results: dict = {}
+    lock = threading.Lock()
+
+    def consume(req):
+        toks, final = [], None
+        try:
+            for frame in gw.route_generate_stream(dict(req)):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    final = evt
+                    break
+                if "tokens" in evt:
+                    toks.extend(evt["tokens"])
+                    with lock:
+                        progress[req["request_id"]] = len(toks)
+        except Exception as exc:
+            final = {"harness_exception": str(exc)}
+        with lock:
+            results[req["request_id"]] = (toks, final)
+
+    threads = []
+    for req in requests:
+        t = threading.Thread(target=consume, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(rng.expovariate(arrival_rate))
+    killed = False
+    deadline = time.monotonic() + kill_window_s
+    while time.monotonic() < deadline:
+        with lock:
+            live = [r for r in victim_rids
+                    if progress[r] >= 3 and r not in results]
+        if live:
+            kill()
+            killed = True
+            break
+        time.sleep(0.02)
+    for t in threads:
+        t.join(timeout=600)
+    return results, killed
+
+
+def stream_completed(final) -> bool:
+    """A stream counts as completed only on a clean terminal event."""
+    return bool(final and final.get("done") and "error" not in final)
+
+
+def victim_lane_for_port(lanes, port: int) -> str:
+    """The gateway lane name backed by the worker on ``port`` (lane
+    names are client URLs; suffix-match so port 80 never matches 8080)."""
+    return next(l for l in lanes if l.endswith(f":{port}"))
+
+
+def control_oracle(port: int, requests) -> dict:
+    """Blocking /generate control run against ONE healthy worker — the
+    uninterrupted oracle spliced streams must match byte-for-byte.
+    Returns {request_id: tokens}; raises on any non-200."""
+    control = {}
+    for r in requests:
+        status, body = _call(port, "POST", "/generate",
+                             dict(r, request_id="ctl_" + r["request_id"]),
+                             timeout=600)
+        if status != 200:
+            raise RuntimeError(f"control run failed ({status}): {body}")
+        control[r["request_id"]] = body["tokens"]
+    return control
+
+
+def tally_streams(results, control):
+    """(complete, identical, resumed) over drive_streams_with_kill
+    results vs the control oracle."""
+    complete = sum(1 for toks, final in results.values()
+                   if stream_completed(final))
+    identical = sum(1 for rid, (toks, final) in results.items()
+                    if toks == control[rid]
+                    and final and final.get("tokens") == control[rid])
+    resumed = sum(1 for _, final in results.values()
+                  if final and final.get("resumed"))
+    return complete, identical, resumed
+
+
+def rid_for_lane(ring, lane: str, tag: str, cap: int = 4000) -> str:
+    """Mine a request id whose ring primary is ``lane`` (shared by the
+    chaos harness, bench crash-ab, and diagnostics --failover). The
+    reference-faithful FNV-1a ring is SKEWED — its own published split is
+    46.8/24.7/38.5 — so similar-prefix candidates can run long streaks on
+    one lane; iterate plenty before giving up."""
+    for i in range(cap):
+        rid = f"{tag}_{i}"
+        if ring.get_node(rid) == lane:
+            return rid
+    raise RuntimeError(f"no rid within {cap} candidates maps to {lane}")
+
+
+def crash_phase(ports, procs, checks: list) -> dict:
+    """Kill -9 one worker while its streams are mid-generation under
+    Poisson load; with failover on, every stream must complete
+    byte-identical to the unkilled control run."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    victim_lane = victim_lane_for_port(lanes, ports[1])
+    victim_proc = procs[1]
+
+    # Request mix: greedy, seeded-sampled, and controls (penalty + stop)
+    # streams; rids are chosen AGAINST the ring so a known share starts on
+    # the victim lane, with long budgets so they are mid-flight at kill.
+    requests = []
+    for k in range(12):
+        lane = victim_lane if k % 3 == 0 else lanes[k % len(lanes)]
+        params = {}
+        if k % 3 == 1:
+            params = {"temperature": 0.9, "seed": 100 + k}
+        elif k % 3 == 2:
+            params = {"temperature": 0.8, "seed": 200 + k,
+                      "repetition_penalty": 1.3, "stop_tokens": [7],
+                      "top_p": 0.9}
+        requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"cr{k}"),
+            "prompt_tokens": [(k * 7 + j) % 90 + 1 for j in range(6 + k % 5)],
+            "max_new_tokens": 60 if lane == victim_lane else 24,
+            **params})
+    victim_rids = {r["request_id"] for r in requests
+                   if gw._ring.get_node(r["request_id"]) == victim_lane}
+
+    # Control: every request, blocking, against ONE healthy worker — the
+    # uninterrupted oracle the spliced streams must match byte-for-byte.
+    try:
+        control = control_oracle(ports[0], requests)
+    except RuntimeError as exc:
+        checks.append(("crash: control generate", False))
+        return {"error": str(exc)}
+    # Warm the other lanes' compile caches so the kill lands mid-decode,
+    # not mid-compile (the resume path itself re-warms the radix).
+    for p in ports[1:]:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+
+    def kill_victim():
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=10)
+
+    results, killed = drive_streams_with_kill(
+        gw, requests, victim_rids, kill_victim, random.Random(0))
+    checks.append(("crash: victim killed mid-stream", killed))
+
+    # Every stream completed, byte-identical to the unkilled control.
+    complete, identical, resumed = tally_streams(results, control)
+    mismatches = [
+        {"rid": rid, "control": control[rid], "streamed": toks,
+         "final_tokens": (final or {}).get("tokens"),
+         "resumed": (final or {}).get("resumed", 0),
+         "victim_primary": rid in victim_rids,
+         "final": {k: v for k, v in (final or {}).items()
+                   if k not in ("tokens",)},
+         "params": next(r for r in requests
+                        if r["request_id"] == rid)}
+        for rid, (toks, final) in results.items()
+        if toks != control[rid]
+        or not final or final.get("tokens") != control[rid]]
+    checks.append(("crash: all streams completed "
+                   f"({complete}/{len(requests)})",
+                   complete == len(requests)))
+    checks.append(("crash: all streams byte-identical to control "
+                   f"({identical}/{len(requests)})",
+                   identical == len(requests)))
+    checks.append(("crash: at least one stream resumed", resumed >= 1))
+
+    # Failover decisions: counters == spans, prober ejected the corpse.
+    # Wait for the ejection FIRST — the prober needs ~2 probe intervals
+    # after the kill — then settle the counter/span comparison (the
+    # prober bumps the counter before recording its span, so one
+    # snapshot can land between the two).
+    ejected = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if victim_lane in gw.ejected_lanes():
+            ejected = True
+            break
+        time.sleep(0.1)
+    checks.append(("crash: prober ejected the dead lane", ejected))
+    fo, resume_spans, eject_spans = {}, [], []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        fo = gw.get_stats().get("failover", {})
+        spans = gw.tracer.snapshot()
+        resume_spans = [s for s in spans if s["op"] == "resume"]
+        eject_spans = [s for s in spans if s["op"] == "prober"
+                       and s["attrs"]["action"] == "eject"]
+        if (len(resume_spans) == fo.get("resumes_attempted", -1)
+                and len(eject_spans) == fo.get("prober_ejections", -1)):
+            break
+        time.sleep(0.1)
+    checks.append(("crash: resumes attempted >= 1",
+                   fo.get("resumes_attempted", 0) >= 1))
+    checks.append(("crash: failover counters == resume spans",
+                   len(resume_spans) == fo.get("resumes_attempted", -1)))
+    checks.append(("crash: prober ejections == eject spans",
+                   len(eject_spans) == fo.get("prober_ejections", -1)
+                   and fo.get("prober_ejections", 0) >= 1))
+
+    # Post-kill availability: a FRESH stream admits and completes.
+    fresh = {"request_id": "post_kill", "prompt_tokens": [9, 8, 7],
+             "max_new_tokens": 8}
+    ctl = _call(ports[0], "POST", "/generate",
+                dict(fresh, request_id="ctl_post"), timeout=600)[1]
+    toks = []
+    for frame in gw.route_generate_stream(dict(fresh)):
+        evt = _parse_sse(frame)
+        if evt and evt.get("done"):
+            checks.append(("crash: post-kill stream completes identically",
+                           "error" not in evt
+                           and evt["tokens"] == ctl["tokens"]))
+            break
+        if evt and "tokens" in evt:
+            toks.extend(evt["tokens"])
+
+    # Zero KV blocks leaked on the survivors.
+    for p in (ports[0], ports[2]):
+        pool = _worker_pool_clean(p)
+        checks.append((f"crash: no KV blocks leaked on survivor :{p}",
+                       pool is not None))
+    gw.stop()
+
+    # A/B: failover DISABLED is today's behavior — the victim stream
+    # truncates (no terminal event), and /stats carries no failover block.
+    gw_off = Gateway([f"127.0.0.1:{ports[0]}", f"127.0.0.1:{ports[2]}"],
+                     GatewayConfig())
+    off_victim_lane = victim_lane_for_port(gw_off.worker_names(), ports[2])
+    off_rid = rid_for_lane(gw_off._ring, off_victim_lane, "off")
+    off_req = {"request_id": off_rid, "prompt_tokens": [4, 5, 6],
+               "max_new_tokens": 60}
+    def kill_off_victim():
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait(timeout=10)
+
+    off_results, off_killed = drive_streams_with_kill(
+        gw_off, [off_req], {off_rid}, kill_off_victim, random.Random(1))
+    _, off_final = off_results[off_rid]
+    truncated = off_killed and not stream_completed(off_final)
+    checks.append(("crash: failover OFF leaves the stream truncated "
+                   "(today's behavior)", truncated))
+    checks.append(("crash: failover OFF /stats has no failover block",
+                   "failover" not in gw_off.get_stats()))
+    gw_off.stop()
+    return {"streams": len(requests), "complete": complete,
+            "identical": identical, "mismatches": mismatches,
+            "resumed_streams": resumed,
+            "victim_primary_streams": len(victim_rids),
+            "failover": fo, "resume_spans": len(resume_spans),
+            "failover_off_truncated": truncated}
+
+
+def run_crash_standalone() -> int:
+    ports, procs = launch_worker_procs(3)
+    checks: list = []
+    try:
+        report = {"mode": "crash-standalone", "worker_ports": ports,
+                  "phases": {"crash": crash_phase(ports, procs, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_mixed_standalone() -> int:
     port, proc = launch_mixed_server()
     checks: list = []
@@ -600,11 +980,20 @@ def main() -> int:
                          "cancels rows mid-verification, and asserts "
                          "every pool block returns and post-cancel "
                          "streams are identical; ignores the other flags")
+    ap.add_argument("--crash", action="store_true",
+                    help="standalone crash-tolerant-streaming scenario: "
+                         "spawns three worker processes, kill -9s one "
+                         "mid-stream under Poisson load, and asserts "
+                         "every stream completes byte-identical to an "
+                         "unkilled control run with zero KV-block leaks "
+                         "(see module docstring); ignores the other flags")
     args = ap.parse_args()
     if args.mixed:
         return run_mixed_standalone()
     if args.spec:
         return run_spec_standalone()
+    if args.crash:
+        return run_crash_standalone()
     proc = None
     if args.launch:
         args.breaker_timeout = min(args.breaker_timeout, 2.0)

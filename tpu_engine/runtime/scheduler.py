@@ -443,6 +443,16 @@ class ContinuousGenerator:
         # `mixed_step` spans carrying prefill_tokens/decode_rows attrs.
         self.tracer = None
         self.trace_node = "scheduler"
+        # Liveness: stamped at the top of every decode-loop iteration.
+        # The loop iterates continuously even when idle (bounded admission
+        # waits), so a growing age means the loop is WEDGED — inside a
+        # hung device dispatch — not merely quiet. The prefill thread
+        # blocks when idle, so its signal is a busy-age instead: set while
+        # a prompt's forward pass runs, None otherwise. stats() reports
+        # the max of the two as last_tick_age_s. /health surfaces the age
+        # (WorkerConfig.scheduler_stall_s turns it into unhealthy).
+        self._last_tick = time.monotonic()
+        self._prefill_busy_since = None
         self._running = True
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="continuous-prefill", daemon=True)
@@ -936,6 +946,16 @@ class ContinuousGenerator:
                                              if stop_tokens else None)
         if not 0.0 <= float(min_p) <= 1.0:
             raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        # Deterministic capacity clamp: the out_of_cache backstop
+        # (_maybe_complete) fires only after a whole decode chunk, so a
+        # row stopping THERE ends with a chunk-alignment-dependent ±1
+        # tokens (L mod step_chunk differs between an uninterrupted run
+        # and a (prompt ⧺ emitted) failover resume of the same stream).
+        # Clamping the budget to the row's reachable capacity makes the
+        # budget rule — which is exact and alignment-independent — always
+        # fire first: same total wherever the stream is resumed.
+        max_new_tokens = min(int(max_new_tokens),
+                             max(0, self.max_seq - 1 - len(prompt)))
         req = _Request(list(prompt), int(max_new_tokens), int(eos_id),
                        float(temperature), int(seed), float(top_p),
                        clamp_top_k(top_k), rep_penalty=pens[0],
@@ -977,8 +997,13 @@ class ContinuousGenerator:
                 self._pool.radix.clear()
 
     def stats(self) -> dict:
+        now = time.monotonic()
+        busy = self._prefill_busy_since
+        age = max(now - self._last_tick,
+                  (now - busy) if busy is not None else 0.0)
         out = dict(self._stats, n_slots=self.n_slots,
                    active=int(sum(r is not None for r in self._row_req)),
+                   last_tick_age_s=round(age, 3),
                    prefix_cache=self._prefix_cache.stats())
         if self._mixed:
             # Snapshot, not the live nested dict — callers diff stats()
@@ -1064,42 +1089,54 @@ class ContinuousGenerator:
             req = self._queue.get()
             if req is None:
                 break
-            if req.deadline is not None and req.deadline.expired():
-                # The client's budget ran out while the request queued —
-                # skip the prefill forward entirely.
-                self._cancel_deadline(req, "deadline expired before prefill")
-                continue
-            t0 = time.perf_counter()
-            if req.sink is not None:
-                wait_us = (t0 - req.t_submit) * 1e6
-                req.sink.stage("queue_wait", wait_us,
-                               start_ts=time.time() - wait_us / 1e6)
+            # Liveness: the prefill thread blocks on the queue when idle
+            # (no age signal there), but a device forward pass hung INSIDE
+            # _run_prefill would wedge every admission while the decode
+            # loop keeps idle-ticking — so stats() folds this busy-age
+            # into last_tick_age_s alongside the decode heartbeat.
+            self._prefill_busy_since = time.monotonic()
             try:
-                item = self._run_prefill(req)
-            except Exception as exc:
-                self._fail_request(req, exc)
-                continue
-            if req.sink is not None and not self._mixed:
-                # Mixed mode records its real (multi-tick) "prefill"
-                # span at prompt completion in _tick_mixed — staging the
-                # batch-formation wrapper here too would double-count
-                # the stage and pollute its histogram with ~µs samples.
-                dur_us = (time.perf_counter() - t0) * 1e6
-                req.sink.stage("prefill", dur_us,
-                               start_ts=time.time() - dur_us / 1e6,
-                               prompt_len=len(req.prompt))
-            # Bounded put with a running check: if the decode loop already
-            # exited, don't block forever on a full queue.
-            placed = False
-            while self._running:
-                try:
-                    self._ready.put(item, timeout=0.1)
-                    placed = True
-                    break
-                except queue.Full:
+                if req.deadline is not None and req.deadline.expired():
+                    # The client's budget ran out while the request queued
+                    # — skip the prefill forward entirely.
+                    self._cancel_deadline(req,
+                                          "deadline expired before prefill")
                     continue
-            if not placed:
-                self._fail_request(req, RuntimeError("scheduler stopped"))
+                t0 = time.perf_counter()
+                if req.sink is not None:
+                    wait_us = (t0 - req.t_submit) * 1e6
+                    req.sink.stage("queue_wait", wait_us,
+                                   start_ts=time.time() - wait_us / 1e6)
+                try:
+                    item = self._run_prefill(req)
+                except Exception as exc:
+                    self._fail_request(req, exc)
+                    continue
+                if req.sink is not None and not self._mixed:
+                    # Mixed mode records its real (multi-tick) "prefill"
+                    # span at prompt completion in _tick_mixed — staging
+                    # the batch-formation wrapper here too would
+                    # double-count the stage and pollute its histogram
+                    # with ~µs samples.
+                    dur_us = (time.perf_counter() - t0) * 1e6
+                    req.sink.stage("prefill", dur_us,
+                                   start_ts=time.time() - dur_us / 1e6,
+                                   prompt_len=len(req.prompt))
+                # Bounded put with a running check: if the decode loop
+                # already exited, don't block forever on a full queue.
+                placed = False
+                while self._running:
+                    try:
+                        self._ready.put(item, timeout=0.1)
+                        placed = True
+                        break
+                    except queue.Full:
+                        continue
+                if not placed:
+                    self._fail_request(req,
+                                       RuntimeError("scheduler stopped"))
+            finally:
+                self._prefill_busy_since = None
         # Shutdown: fail whatever never got prefilled — a dropped future
         # would hang its caller for the full result() timeout.
         while True:
@@ -1613,14 +1650,26 @@ class ContinuousGenerator:
     def _recover(self, exc: BaseException) -> None:
         """Device-step failure recovery. The prefill/decode executables
         donate ``self._caches``, so after a failed step the KV buffer may
-        already be invalidated — every in-flight row's state is lost. Fail
-        their futures with the real error, rebuild the cache, reset slot
-        state, and keep the loop serving (a transient device error must not
-        silently kill the daemon and hang all future /generate calls —
-        ADVICE round 1, scheduler.py:310)."""
+        already be invalidated — every in-flight row's state is lost.
+        Each row fails with a per-row RETRYABLE event (not the bare
+        device error): the exception carries ``retryable=True`` and
+        ``tokens_emitted``, so a streaming client — or the gateway's
+        stream journal — can resume the generation on another lane from
+        the exact emitted prefix instead of reading an opaque 500. Then
+        rebuild the cache, reset slot state, assert the rebuilt
+        pool/radix invariants, and keep the loop serving (a transient
+        device error must not silently kill the daemon and hang all
+        future /generate calls — ADVICE round 1, scheduler.py:310)."""
         for r, req in enumerate(self._row_req):
             if req is not None:
-                self._fail_request(req, exc)
+                n_emitted = len(self._visible_tokens(r, req))
+                row_exc = RuntimeError(
+                    f"row {r} lost to a device-step failure after "
+                    f"{n_emitted} emitted tokens: {exc}")
+                row_exc.retryable = True
+                row_exc.tokens_emitted = n_emitted
+                row_exc.__cause__ = exc
+                self._fail_request(req, row_exc)
             self._row_req[r] = None
             self._row_emitted[r] = []
             self._clear_mixed_row(r)
@@ -1634,9 +1683,30 @@ class ContinuousGenerator:
             # dropping the radix tree (its blocks died with the pool).
             with self._pool.lock:
                 self._pool.reset()
+                # Post-recover invariants, checked on the raw fields
+                # under the lock (stats() re-locks): a violated rebuild
+                # would corrupt every stream admitted afterwards, so it
+                # must be loud, not latent.
+                pool = self._pool
+                violations = []
+                if len(pool._free) != pool.num_blocks - 1:
+                    violations.append(
+                        f"free list {len(pool._free)} != "
+                        f"{pool.num_blocks - 1}")
+                if pool.radix.nodes != 0:
+                    violations.append(
+                        f"radix not empty ({pool.radix.nodes} nodes)")
+                if int(np.sum(pool._ref[1:])) != 0:
+                    violations.append("nonzero refcounts after reset")
             self._tables[:, :] = 0
             for r in range(self.n_slots):
                 self._row_blocks[r] = []
+            if violations:
+                self._stats["recover_invariant_violations"] = (
+                    self._stats.get("recover_invariant_violations", 0)
+                    + len(violations))
+                print(f"[scheduler] POST-RECOVER INVARIANT VIOLATED: "
+                      f"{'; '.join(violations)}", flush=True)
         else:
             caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                                  self._dtype)
@@ -2140,6 +2210,7 @@ class ContinuousGenerator:
 
     def _loop_body(self) -> None:
         while self._running:
+            self._last_tick = time.monotonic()  # liveness heartbeat
             # Live rows' block growth outranks new admissions for pool
             # space (an admitted row must never be starved mid-stream by
             # a newcomer).

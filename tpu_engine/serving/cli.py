@@ -79,11 +79,35 @@ def main(argv=None) -> int:
         from tpu_engine.utils.config import WorkerConfig
 
         if not rest:
-            print("Usage: worker_node <port> <node_id> [model_path]")
+            print("Usage: worker_node <port> <node_id> [model_path] "
+                  "[--kv-block-size N] [--kv-blocks N] [--step-chunk N] "
+                  "[--prefill-chunk N] [--scheduler-stall-s S]")
             return 1
-        port = int(rest[0])
-        node_id = rest[1] if len(rest) > 1 else f"worker_{port}"
-        model_arg = rest[2] if len(rest) > 2 else os.environ.get("MODEL_PATH", "resnet50")
+        parser = argparse.ArgumentParser(prog="worker_node")
+        parser.add_argument("port", type=int)
+        parser.add_argument("node_id", nargs="?", default=None)
+        parser.add_argument("model_arg", nargs="?", default=None)
+        # Optional generation knobs so a STANDALONE worker (the unit the
+        # `gateway` command routes across, and the unit the chaos harness
+        # kill -9s) can serve the same paged/continuous configuration as
+        # combined mode — the positional reference argv stays verbatim.
+        parser.add_argument("--kv-block-size", type=int, default=None,
+                            help="paged KV block size (0/unset = dense)")
+        parser.add_argument("--kv-blocks", type=int, default=None,
+                            help="paged KV pool size in blocks (0 = auto)")
+        parser.add_argument("--step-chunk", type=int, default=None,
+                            help="decode chunk length per dispatch")
+        parser.add_argument("--prefill-chunk", type=int, default=None,
+                            help="prefill chunk width")
+        parser.add_argument("--scheduler-stall-s", type=float, default=None,
+                            help="decode-loop liveness threshold: /health "
+                                 "reads unhealthy when the loop has not "
+                                 "ticked for this long (0/unset = report "
+                                 "age only)")
+        args = parser.parse_args(rest)
+        port = args.port
+        node_id = args.node_id or f"worker_{port}"
+        model_arg = args.model_arg or os.environ.get("MODEL_PATH", "resnet50")
         # A real path loads real weights (HF/torch/orbax via the worker's
         # _load_model_path); a bare registry name serves random init. HF
         # checkpoint dirs resolve their registry model from config.json
@@ -106,9 +130,20 @@ def main(argv=None) -> int:
                 )
 
                 model = model_name_from_hf(model_path)
+        gen_kw = {}
+        if args.kv_block_size is not None:
+            gen_kw["gen_kv_block_size"] = args.kv_block_size
+        if args.kv_blocks is not None:
+            gen_kw["gen_kv_blocks"] = args.kv_blocks
+        if args.step_chunk is not None:
+            gen_kw["gen_step_chunk"] = args.step_chunk
+        if args.prefill_chunk is not None:
+            gen_kw["gen_prefill_chunk"] = args.prefill_chunk
+        if args.scheduler_stall_s is not None:
+            gen_kw["scheduler_stall_s"] = args.scheduler_stall_s
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
-                           model_path=model_path)
+                           model_path=model_path, **gen_kw)
         worker, server = serve_worker(cfg, background=True)
         _run_forever([server, worker])
         return 0
@@ -126,13 +161,38 @@ def main(argv=None) -> int:
         parser.add_argument("--breaker-timeout", type=float, default=30.0,
                             help="circuit-breaker OPEN->HALF_OPEN timeout "
                                  "seconds (reference gateway.cpp:22)")
+        parser.add_argument("--failover-streams", action="store_true",
+                            help="crash-tolerant streaming: journal "
+                                 "/generate/stream token events and resume "
+                                 "a mid-stream worker failure on another "
+                                 "ring lane, splicing one seamless "
+                                 "byte-identical stream (default: the "
+                                 "stream terminates with an error event)")
+        parser.add_argument("--health-probe-interval", type=float,
+                            default=0.0,
+                            help="proactive lane health prober: GET each "
+                                 "worker's /health at this interval and "
+                                 "eject lanes from routing after 3 "
+                                 "consecutive failures, restoring them on "
+                                 "recovery (seconds; 0 = off)")
+        parser.add_argument("--retry-budget", type=float, default=None,
+                            help="global retry budget: failover retries "
+                                 "(stream resumes included) capped at this "
+                                 "fraction of recent requests "
+                                 "(default: unlimited)")
         args = parser.parse_args(rest)
-        _gw, server = serve_gateway(
+        gw_kw = {}
+        if args.retry_budget is not None:
+            gw_kw["retry_budget_ratio"] = args.retry_budget
+        gw, server = serve_gateway(
             args.workers,
             GatewayConfig(port=args.port,
-                          breaker_timeout_s=args.breaker_timeout),
+                          breaker_timeout_s=args.breaker_timeout,
+                          failover_streams=args.failover_streams,
+                          health_probe_interval_s=args.health_probe_interval,
+                          **gw_kw),
             background=True)
-        _run_forever([server])
+        _run_forever([server, gw])
         return 0
 
     if cmd == "serve":
@@ -205,6 +265,36 @@ def main(argv=None) -> int:
                             help="per-lane admission cap: concurrent "
                                  "requests beyond this shed 503 "
                                  "(default 0 = unbounded)")
+        parser.add_argument("--failover-streams", action="store_true",
+                            help="crash-tolerant streaming: journal "
+                                 "/generate/stream token events and resume "
+                                 "a mid-stream lane failure on another "
+                                 "ring lane (prompt + emitted tokens, "
+                                 "budget offset), splicing one seamless "
+                                 "byte-identical stream")
+        parser.add_argument("--health-probe-interval", type=float,
+                            default=None,
+                            help="proactive lane health prober: probe each "
+                                 "lane's health at this interval, ejecting "
+                                 "lanes after 3 consecutive failures and "
+                                 "restoring them on recovery (seconds; "
+                                 "default off)")
+        parser.add_argument("--scheduler-stall-s", type=float, default=None,
+                            help="decode-loop liveness threshold: a "
+                                 "continuous scheduler whose loop has not "
+                                 "ticked for this long reads unhealthy in "
+                                 "/health (wedged-device detection; set "
+                                 "above the worst first-request compile; "
+                                 "default off — age is reported either "
+                                 "way)")
+        parser.add_argument("--native-front", choices=["auto", "on", "off"],
+                            default="auto",
+                            help="serving edge: the C++ HttpFront when "
+                                 "available (auto), required (on), or the "
+                                 "Python front (off — required for "
+                                 "incremental SSE streaming granularity; "
+                                 "the C++ front ships a stream as one "
+                                 "buffered body)")
         parser.add_argument("--gen-scheduler",
                             choices=["batch", "continuous", "speculative"],
                             default="continuous",
@@ -305,6 +395,10 @@ def main(argv=None) -> int:
             gw_kw["hedge_quantile"] = args.hedge_quantile
         if args.hedge_min_ms is not None:
             gw_kw["hedge_min_ms"] = args.hedge_min_ms
+        if args.failover_streams:
+            gw_kw["failover_streams"] = True
+        if args.health_probe_interval is not None:
+            gw_kw["health_probe_interval_s"] = args.health_probe_interval
         gateway_config = None
         if gw_kw:
             from tpu_engine.utils.config import GatewayConfig
@@ -332,6 +426,8 @@ def main(argv=None) -> int:
             bb_kw["batch_timeout_ms"] = args.batch_timeout_ms
         if args.max_queue_depth is not None:
             bb_kw["max_queue_depth"] = args.max_queue_depth
+        if args.scheduler_stall_s is not None:
+            bb_kw["scheduler_stall_s"] = args.scheduler_stall_s
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,
@@ -351,11 +447,14 @@ def main(argv=None) -> int:
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
                                      model_path=args.model_path)
-        _gw, workers, server = serve_combined(
+        native_front = {"auto": None, "on": True, "off": False}[
+            args.native_front]
+        gw, workers, server = serve_combined(
             model=args.model, lanes=args.lanes, port=args.port,
             warmup=args.warmup, worker_config=worker_config,
-            gateway_config=gateway_config, mesh=args.mesh)
-        _run_forever([server, *workers])
+            gateway_config=gateway_config, mesh=args.mesh,
+            native_front=native_front)
+        _run_forever([server, *workers, gw])
         return 0
 
     if cmd == "import-weights":

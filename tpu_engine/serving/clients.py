@@ -189,42 +189,55 @@ class HttpWorkerClient:
         except Exception as exc:
             conn.close()
             self._release(None)
-            if deadline_clamped and isinstance(exc, (socket.timeout,
-                                                     TimeoutError)):
-                # The socket timed out because the CLIENT's budget ran out
-                # — for THIS request that is terminal (DeadlineExceeded,
-                # no failover: the budget is spent). But the lane HELD the
-                # request past the budget without answering, which is also
-                # the signature of a hang: mark the exception lane_suspect
-                # so the gateway still feeds the breaker. Consecutive-
-                # failure breakers self-correct on any within-budget
-                # success (cache hits), so only a lane that NEVER answers
-                # inside client budgets accrues enough to OPEN — which is
-                # precisely a lane traffic should leave.
-                shed = DeadlineExceeded(
-                    f"worker {self.url}: deadline expired awaiting "
-                    "response")
-                shed.lane_suspect = True
-                raise shed from exc
-            raise WorkerError(f"worker {self.url}: {exc}") from exc
-        if 400 <= resp.status < 500:
-            # Client error (bad payload, unsupported op): the request is at
-            # fault, not the worker — don't feed the breaker. Connection is
-            # still good (response fully read).
+            raise self._transport_error(exc, deadline_clamped) from exc
+        if resp.status != 200:
+            raise self._classify_error_response(conn, resp.status, data)
+        self._release(conn)
+        return data
+
+    def _transport_error(self, exc: BaseException,
+                         deadline_clamped: bool) -> Exception:
+        """Transport-layer failure -> the exception to raise (one
+        classification shared by the blocking and streaming paths). A
+        socket timeout under a deadline-clamped read means the CLIENT's
+        budget ran out — for THIS request that is terminal
+        (DeadlineExceeded, no failover: the budget is spent). But the
+        lane HELD the request past the budget without answering, which
+        is also the signature of a hang: mark the exception lane_suspect
+        so the gateway still feeds the breaker. Consecutive-failure
+        breakers self-correct on any within-budget success, so only a
+        lane that NEVER answers inside client budgets accrues enough to
+        OPEN — which is precisely a lane traffic should leave. Anything
+        else is a lane fault (WorkerError -> breaker + failover)."""
+        if deadline_clamped and isinstance(exc, (socket.timeout,
+                                                 TimeoutError)):
+            shed = DeadlineExceeded(
+                f"worker {self.url}: deadline expired awaiting response")
+            shed.lane_suspect = True
+            return shed
+        return WorkerError(f"worker {self.url}: {exc}")
+
+    def _classify_error_response(self, conn, status: int,
+                                 data: bytes) -> Exception:
+        """Non-200 response -> the exception to raise, with one breaker/
+        pool semantics shared by the blocking and streaming paths: 4xx =
+        the request's fault (ValueError, conn still healthy — response
+        fully read); a classified 503 shed mirrors the in-process
+        exception types so the gateway treats a remote lane exactly like
+        a local one (fail over on overload/drain, stop on an expired
+        deadline — no breaker penalty either way); anything else is a
+        WorkerError with the conn closed (an unclassifiable 503 — a
+        dying proxy, a non-resilience server — lands here too)."""
+        if 400 <= status < 500:
             detail = ""
             try:
                 detail = json.loads(data).get("error", "")
             except Exception:
                 pass
             self._release(conn)
-            raise ValueError(
-                f"worker {self.url} rejected request ({resp.status}): {detail}")
-        if resp.status == 503:
-            # Resilience shed: mirror the in-process exception types so the
-            # gateway treats a remote lane exactly like a local one (fail
-            # over on overload/drain, stop on an expired deadline — no
-            # breaker penalty either way). An unclassifiable 503 (a dying
-            # proxy, a non-resilience server) stays a WorkerError below.
+            return ValueError(
+                f"worker {self.url} rejected request ({status}): {detail}")
+        if status == 503:
             kind = None
             try:
                 kind = json.loads(data).get("kind")
@@ -234,13 +247,10 @@ class HttpWorkerClient:
                 self._release(conn)  # response fully read; conn healthy
                 exc_cls = (Overloaded if kind == "overloaded"
                            else DeadlineExceeded)
-                raise exc_cls(f"worker {self.url} shed request ({kind})")
-        if resp.status != 200:
-            conn.close()
-            self._release(None)
-            raise WorkerError(f"worker {self.url} returned {resp.status}")
-        self._release(conn)
-        return data
+                return exc_cls(f"worker {self.url} shed request ({kind})")
+        conn.close()
+        self._release(None)
+        return WorkerError(f"worker {self.url} returned {status}")
 
     def infer(self, payload: dict) -> dict:
         return self._request("POST", "/infer", payload)
@@ -259,21 +269,126 @@ class HttpWorkerClient:
                              timeout_s=self._gen_timeout)
 
     def generate_stream(self, payload: dict):
-        """Streaming across an HTTP hop degrades to one terminal event
-        (the blocking /generate result re-framed as SSE): multi-host
-        deployments keep the wire contract; per-chunk streaming granularity
-        is a combined-mode (in-process lane) property."""
-        from tpu_engine.serving.http import sse_event
+        """TRUE streaming across the HTTP hop: POST /generate/stream on
+        the worker and yield each SSE frame as it arrives over the
+        chunked response. A gateway in front of remote workers now sees
+        tokens at the same granularity as an in-process lane — which is
+        what lets its crash-tolerant stream journal resume a mid-stream
+        worker death from the exact relayed prefix. (Previously this
+        degraded to the blocking /generate re-framed as one terminal
+        event; the event schema is unchanged, only the delivery
+        granularity improved.)
 
-        result = self.generate(payload)
+        Error contract: admission failures (connect error, 4xx, shed
+        503) raise HERE, before the iterator is handed back — the same
+        classification as ``_request_raw``, so breaker accounting and
+        failover at iterator creation still work. A transport failure
+        MID-stream raises ``WorkerError`` from the iterator; a premature
+        EOF (worker killed between frames) simply ends the iteration
+        without a terminal ``done`` event — the consumer must treat a
+        truncated stream as a failure."""
+        conn = self._acquire()
+        t = self._gen_timeout
+        deadline_clamped = False
+        if isinstance(payload, dict) and payload.get("deadline_ms") is not None:
+            # Same deadline clamp as _request_raw: frames arrive per
+            # decode chunk, so the per-read timeout only needs to cover
+            # the remaining budget (+ slack for the worker's own 503).
+            budget = max(0.05, float(payload["deadline_ms"]) / 1000.0 + 0.25)
+            if budget < t:
+                t, deadline_clamped = budget, True
+        try:
+            conn.timeout = t
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
+            body = json.dumps(payload).encode()
+            headers = {"Content-Type": "application/json"}
+            if isinstance(payload.get("traceparent"), str):
+                headers["traceparent"] = payload["traceparent"]
+            conn.request("POST", "/generate/stream", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+        except Exception as exc:
+            conn.close()
+            self._release(None)
+            raise self._transport_error(exc, deadline_clamped) from exc
+        if resp.status != 200:
+            try:
+                data = resp.read()
+            except Exception:
+                # The error BODY itself failed to read: the connection is
+                # poisoned mid-response and must not rejoin the pool.
+                conn.close()
+                self._release(None)
+                raise WorkerError(
+                    f"worker {self.url} returned {resp.status} "
+                    f"(error body unreadable)")
+            raise self._classify_error_response(conn, resp.status, data)
 
-        def events():
-            yield sse_event({"tokens": result["tokens"]})
-            yield sse_event({"done": True, **result})
-        return events()
+        def frames():
+            clean = False
+            try:
+                buf = b""
+                while True:
+                    line = resp.readline()  # chunked decode is transparent
+                    if not line:
+                        break  # end of response body
+                    buf += line
+                    if buf.endswith(b"\n\n"):
+                        yield buf
+                        buf = b""
+                # A dangling partial frame means the body was truncated
+                # MID-event (sse_event always terminates with a blank
+                # line): drop it — an unterminated SSE frame can only
+                # corrupt the consumer's parse (and a failover splice
+                # must resume from the last COMPLETE event) — and treat
+                # the connection as dirty, not reusable.
+                clean = not buf
+            except Exception as exc:
+                # Transport death mid-stream (ConnectionReset,
+                # IncompleteRead on an aborted chunked body): a lane
+                # fault the consumer can fail over — EXCEPT a timeout
+                # under a deadline-clamped read, which is the client's
+                # own budget expiring (terminal, lane_suspect — same
+                # classification as _request_raw).
+                raise self._transport_error(exc, deadline_clamped) from exc
+            finally:
+                # `clean` distinguishes a fully-read body (keep-alive
+                # connection reusable) from an error OR an abandoning
+                # consumer (GeneratorExit lands here too): those must
+                # close, or the pool slot would carry a poisoned conn.
+                if clean:
+                    self._release(conn)
+                else:
+                    conn.close()
+                    self._release(None)
+        return frames()
 
     def drain(self) -> dict:
         return self._request("POST", "/admin/drain", {"action": "drain"})
 
     def health(self) -> dict:
         return self._request("GET", "/health")
+
+    def probe_health(self, timeout_s: float = 5.0) -> dict:
+        """/health on a DEDICATED short-lived connection, bypassing the
+        data pool: a lane whose pool slots are all held by long-lived
+        streams is busy, not dead — the gateway's prober must never read
+        pool exhaustion as `health_probe_failures` consecutive failures
+        and eject its most-loaded healthy lane."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise WorkerError(
+                    f"worker {self.url} /health returned {resp.status}")
+            return json.loads(data)
+        except WorkerError:
+            raise
+        except Exception as exc:
+            raise WorkerError(f"worker {self.url}: {exc}") from exc
+        finally:
+            conn.close()

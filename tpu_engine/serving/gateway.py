@@ -26,12 +26,20 @@ Improvements over the reference (documented, not silent):
   dispatch for idempotent ops — the slow-lane/overload story the
   breaker-only reference has no answer for. All knobs default
   off/permissive; with defaults the routing behavior and wire schemas are
-  byte-identical to the reference parity described above.
+  byte-identical to the reference parity described above;
+- crash-tolerant streaming (``failover_streams``, DESIGN.md
+  "Crash-tolerant streaming"): a /generate/stream journal that resumes a
+  mid-stream lane failure on another ring lane (prompt ⧺ emitted tokens,
+  budget offset) and splices the continuation byte-identically, plus a
+  proactive /health prober (``health_probe_interval_s``) that ejects dead
+  lanes from rotation in O(probe interval) and restores them on recovery.
+  Both default off.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import threading
 import time
 import uuid
@@ -44,8 +52,11 @@ from tpu_engine.serving.clients import (
     LocalWorkerClient,
     WorkerError,
 )
+from tpu_engine.serving.http import sse_event
 from tpu_engine.serving.resilience import (
+    FailoverCounters,
     LatencyTracker,
+    ProbeStateMachine,
     ResilienceCounters,
     RetryBudget,
     backoff_delay,
@@ -79,6 +90,23 @@ _SHED = object()
 
 def _ok(result) -> bool:
     return result is not None and result is not _SHED
+
+
+def _parse_sse(frame: bytes) -> Optional[dict]:
+    """One SSE frame (``sse_event`` output) -> its JSON payload, or None
+    for anything unparseable (relayed verbatim, never dropped)."""
+    try:
+        text = frame.decode()
+    except Exception:
+        return None
+    text = text.strip()
+    if not text.startswith("data: "):
+        return None
+    try:
+        evt = json.loads(text[len("data: "):])
+    except Exception:
+        return None
+    return evt if isinstance(evt, dict) else None
 
 
 class _RouteTrace:
@@ -147,8 +175,30 @@ class Gateway:
         # every shed/retry/hedge the counters report is explainable
         # per-request in /trace/export.
         self.tracer = SpanRecorder(self.config.trace_capacity)
+        # Crash-tolerant streaming + proactive lane health (DESIGN.md
+        # "Crash-tolerant streaming"): stream-resume and prober decisions
+        # counted here, lanes the prober ejected excluded from dispatch.
+        self.failover = FailoverCounters()
+        self._ejected: set = set()
+        self._probe_state = ProbeStateMachine(
+            self.config.health_probe_failures)
+        self._prober_stop = threading.Event()
+        self._prober_thread: Optional[threading.Thread] = None
         for w in workers or []:
             self.add_worker(w)
+        if self.config.health_probe_interval_s > 0:
+            self._prober_thread = threading.Thread(
+                target=self._probe_loop, name="gw-prober", daemon=True)
+            self._prober_thread.start()
+
+    def stop(self) -> None:
+        """Stop the background health prober (idempotent; routing itself
+        keeps working — the gateway has no other owned threads)."""
+        self._prober_stop.set()
+        t = self._prober_thread
+        if t is not None:
+            t.join(timeout=5)
+            self._prober_thread = None
 
     # -- membership (elastic; reference ring was fixed at launch) ------------
 
@@ -212,6 +262,66 @@ class Gateway:
         with self._lock:
             return self._breakers.get(name)
 
+    # -- proactive lane health (prober) ---------------------------------------
+
+    def _probe_loop(self) -> None:
+        """Background prober: GET every lane's /health each interval;
+        `health_probe_failures` consecutive failures eject the lane from
+        dispatch (no breaker penalty — ejection is reversible and
+        fleet-wide in one sweep), the next success restores it. Catches a
+        dead or wedged worker in O(probe interval) instead of one
+        breaker trip per victim request."""
+        interval = self.config.health_probe_interval_s
+        while not self._prober_stop.wait(interval):
+            with self._lock:
+                clients = dict(self._clients)
+            for name, client in clients.items():
+                ok = False
+                try:
+                    # Dedicated probe connection where the client offers
+                    # one (HTTP lanes): probes must never contend with
+                    # data traffic for pool slots.
+                    probe = getattr(client, "probe_health", client.health)
+                    ok = bool(probe().get("healthy", False))
+                except Exception:
+                    ok = False  # unreachable = failed probe
+                action = self._probe_state.record(name, ok)
+                with self._lock:
+                    present = name in self._clients
+                if not present:
+                    # Removed while this sweep held the stale snapshot:
+                    # record() just resurrected its state — drop it again
+                    # so a later lane reusing the name starts clean (and
+                    # unique elastic lane names don't leak entries).
+                    self._probe_state.forget(name)
+                    continue
+                if action is None:
+                    continue
+                with self._lock:
+                    if name not in self._clients:
+                        continue  # removed between the checks
+                    if action == "eject":
+                        self._ejected.add(name)
+                    else:
+                        self._ejected.discard(name)
+                self.failover.bump("prober_ejections" if action == "eject"
+                                   else "prober_restores")
+                self._prober_span(name, action)
+
+    def _prober_span(self, lane: str, action: str) -> None:
+        """Zero-duration ``prober`` marker span per eject/restore — the
+        counters say how often, the spans say WHICH lane and when
+        (fault_injection --crash asserts the two agree)."""
+        ctx = TraceContext.root(f"prober:{lane}").child()
+        self.tracer.record(
+            "prober", "prober", "gateway", 0,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+            start_ts=time.time(), attrs={"lane": lane, "action": action})
+
+    def ejected_lanes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ejected)
+
     def remove_worker(self, name: str, drain: bool = False) -> None:
         """Remove a lane from every ring. ``drain=True`` = graceful
         (lame-duck) removal: the lane refuses NEW admissions first — so a
@@ -233,6 +343,9 @@ class Gateway:
             self._breakers.pop(name, None)
             self._latency.pop(name, None)  # stale window must not feed thresholds
             self._untyped.discard(name)
+            self._ejected.discard(name)
+        # A later lane reusing the name must start with clean probe state.
+        self._probe_state.forget(name)
         for ring in rings.values():
             ring.remove_node(name)
         with self._lock:
@@ -270,13 +383,308 @@ class Gateway:
 
     def route_generate_stream(self, payload: dict):
         """Streaming variant: same routing; the selected lane's SSE
-        event-chunk iterator is handed back for chunked transfer. Breaker
-        accounting happens at admission (iterator creation) — a mid-stream
-        failure terminates that stream with an error event instead of
-        failing over (tokens already sent can't be replayed elsewhere)."""
-        return self._route(payload, op="generate_stream")
+        event-chunk iterator is handed back for chunked transfer.
+        Breaker accounting happens at admission (iterator creation) AND
+        on mid-stream lane faults (below).
 
-    def _route(self, payload: dict, op: str) -> dict:
+        Default (``failover_streams`` off): a mid-stream failure still
+        ends the client's stream (error event or truncation — same
+        frames, same wire behavior as before), but the dying lane's
+        breaker now records the fault, preserving the breaker signal the
+        old buffering HTTP shim got for free at iterator creation. With
+        ``failover_streams`` on, the gateway journals every token event
+        it relays and a retryable mid-stream failure RESUMES the stream
+        on another ring lane (prompt ⧺ emitted tokens as a forced
+        prefix), splicing the continuation so the client sees one
+        seamless, byte-identical stream — the request is bound to the
+        fleet, not to the lane that happened to start it."""
+        if not self.config.failover_streams:
+            info: dict = {}
+            it = self._route(payload, op="generate_stream",
+                             out_info=info)
+            return self._breaker_watched(it, info.get("lane"))
+        return self._stream_with_failover(payload)
+
+    def _breaker_watched(self, it, lane: Optional[str]):
+        """Relay a stream iterator byte-identically, but feed a
+        mid-stream LANE fault to the lane's breaker — admission-time
+        accounting alone would let a lane that admits streams and then
+        dies stay CLOSED forever. Two fault shapes: a mid-iteration
+        exception (transport death), and a worker-side in-band terminal
+        error EVENT marked retryable (device fault re-framed as SSE —
+        the shape the old buffering HTTP shim surfaced as a WorkerError
+        at dispatch). Request-fault and shed signals pass through
+        unpenalized (`shed` marker / exception class), the same
+        classification `_try_node` applies at admission."""
+        def watched():
+            try:
+                for frame in it:
+                    # Cheap prefilter keeps the per-token hot path at
+                    # relay cost: only terminal frames carry "done".
+                    if b'"done"' in frame:
+                        evt = _parse_sse(frame)
+                        if (evt is not None and evt.get("done")
+                                and "error" in evt
+                                and evt.get("retryable")
+                                and not evt.get("shed")):
+                            self._stream_fault_penalty(lane)
+                    yield frame
+            except (KeyError, ValueError, TypeError):
+                raise
+            except ShedError as exc:
+                if getattr(exc, "lane_suspect", False):
+                    self._stream_fault_penalty(lane)  # hang signature
+                raise
+            except Exception:
+                self._stream_fault_penalty(lane)
+                raise
+        return watched()
+
+    def _stream_fault_penalty(self, lane: Optional[str]) -> None:
+        breaker = self.breaker_for(lane) if lane else None
+        if breaker is not None:
+            breaker.record_failure()
+
+    def _resume_payload(self, payload: dict, emitted: List[int],
+                        max_new: int,
+                        deadline: Optional[Deadline]) -> dict:
+        """The resume request: the original payload with the emitted
+        tokens appended to the prompt as a forced prefix and the token
+        budget offset by the emitted count. Determinism across the
+        splice boundary needs no extra wire fields: the scheduler
+        samples with fold_in(seed, absolute position) and replays
+        penalty counts / stop matching from the (prompt ⧺ emitted)
+        prefix at admission, so greedy AND seeded sampled continuations
+        are byte-identical to an uninterrupted run (tests/test_failover
+        pins this; MIGRATION.md documents the positional-fold
+        requirement)."""
+        prompt = [int(t) for t in payload.get("prompt_tokens", ())]
+        resume = {**payload,
+                  "prompt_tokens": prompt + list(emitted),
+                  "max_new_tokens": max_new - len(emitted)}
+        if deadline is not None:
+            # Forward the budget REMAINING now — a resume must never
+            # restart the client's clock.
+            resume["deadline_ms"] = max(0.0, deadline.remaining_ms())
+        return resume
+
+    def _resume_span(self, request_id: str, ctx, index: int,
+                     replayed: int, outcome: str,
+                     lane: Optional[str]) -> None:
+        """One ``resume`` span per resume attempt, parented under the
+        request's trace — resumes_attempted and these spans must agree
+        (fault_injection --crash asserts it)."""
+        child = ctx.child()
+        self.tracer.record(
+            request_id, "resume", "gateway", 0,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=ctx.span_id, start_ts=time.time(),
+            attrs={"resume": index, "tokens_replayed": replayed,
+                   "outcome": outcome, "lane": lane or "?"})
+
+    def _stream_with_failover(self, payload: dict):
+        """Crash-tolerant /generate/stream: the journal is the request
+        payload plus every token relayed so far; a retryable mid-stream
+        failure (transport death, truncated stream, a worker error event
+        marked retryable, a drain shed) re-dispatches to the next ring
+        lane as a resume — consuming the PR 1 retry budget and the
+        request's original deadline — and the continuation is spliced in
+        with no duplicated or missing tokens. Non-resumable ends (budget
+        exhausted, deadline expired, all lanes down, resume cap) emit a
+        terminal error event carrying ``retryable``, ``trace_id``, and
+        ``tokens_emitted`` so the CLIENT can resume manually."""
+        rid = payload.get("request_id")
+        if rid is None:
+            rid = uuid.uuid4().hex
+            payload = {**payload, "request_id": rid}
+        request_id = str(rid)
+        # Pin the deadline ONCE: every resume forwards what remains.
+        deadline = Deadline.from_request(
+            payload, default_ms=self.config.default_deadline_ms)
+        try:
+            max_new = int(payload.get("max_new_tokens", 32))
+        except (TypeError, ValueError):
+            # Malformed budget: let the normal path 400 it.
+            return self._route(payload, op="generate_stream")
+        parent = TraceContext.from_request(payload)
+        ctx = (parent.child() if parent is not None
+               else TraceContext.root(request_id))
+        info: dict = {}
+        # Admission of the FIRST segment keeps every existing semantic:
+        # shed/400/no-workers raise here, before the 200 SSE commits.
+        first = self._route(payload, op="generate_stream", out_info=info)
+        cfg = self.config
+
+        def terminal_error(reason: str, retryable: bool,
+                           emitted: List[int]) -> bytes:
+            return sse_event({
+                "done": True, "error": str(reason)[:300],
+                "retryable": bool(retryable),
+                "request_id": request_id, "trace_id": ctx.trace_id,
+                "tokens_emitted": len(emitted),
+                "tokens": list(emitted)})
+
+        def spliced():
+            emitted: List[int] = []
+            it = first
+            lane = info.get("lane")
+            resumes = 0
+            while True:
+                # failure: (reason, retryable, lane_fault) — lane_fault
+                # feeds the lane's breaker; sheds and client-budget
+                # expiries don't (the healthy-lane rule).
+                failure: Optional[tuple] = None
+                finished = False
+                try:
+                    try:
+                        for frame in it:
+                            evt = _parse_sse(frame)
+                            if evt is None:
+                                yield frame  # not ours to interpret
+                                continue
+                            if not evt.get("done"):
+                                toks = evt.get("tokens")
+                                if isinstance(toks, list):
+                                    # Materialize BEFORE extending: a
+                                    # malformed token raising mid-extend
+                                    # would leave the journal holding
+                                    # tokens of a frame the client never
+                                    # received, and the resume would
+                                    # splice past them.
+                                    emitted.extend([int(t) for t in toks])
+                                yield frame
+                                continue
+                            if "error" in evt:
+                                # Worker-side terminal error: its own
+                                # `retryable` classification decides
+                                # (absent = not retryable — never resume
+                                # blind); a `shed` marker means a HEALTHY
+                                # lane refused (drain/overload) — resume
+                                # without a breaker penalty.
+                                retr = bool(evt.get("retryable", False))
+                                failure = (str(evt.get("error")), retr,
+                                           retr and not evt.get("shed",
+                                                                False))
+                            else:
+                                # Clean terminal: rewrite the summary to
+                                # the FULL spliced stream (a resumed
+                                # segment's summary covers only its
+                                # continuation).
+                                done = {**evt, "request_id": request_id,
+                                        "tokens": list(emitted)}
+                                if resumes:
+                                    done["resumed"] = resumes
+                                yield sse_event(done)
+                                finished = True
+                            break
+                        else:
+                            # Iterator exhausted without a terminal
+                            # event: the lane died between frames
+                            # (kill -9 closes the socket mid-chunk) —
+                            # resumable truncation.
+                            failure = ("stream truncated mid-generation",
+                                       True, True)
+                    finally:
+                        # Settle the segment iterator NOW, not at GC: a
+                        # finished HTTP segment reads one step past the
+                        # done event so its pooled connection releases
+                        # clean; every other exit closes it promptly
+                        # (dead conns must never wait for a collector).
+                        if finished:
+                            try:
+                                next(it)
+                            except StopIteration:
+                                pass
+                            except Exception:
+                                pass
+                        try:
+                            it.close()
+                        except Exception:
+                            pass
+                except DeadlineExceeded as exc:
+                    # Budget spent: terminal. No lane penalty UNLESS the
+                    # lane held the request past the budget without
+                    # answering (lane_suspect — the hang signature, same
+                    # rule _route applies at admission).
+                    failure = (str(exc), False,
+                               bool(getattr(exc, "lane_suspect", False)))
+                except ShedError as exc:
+                    failure = (str(exc), True, False)  # drain: move on
+                except Exception as exc:
+                    failure = (str(exc), True, True)   # transport fault
+                if finished:
+                    return
+                reason, retryable, lane_fault = failure
+                self.failover.bump("stream_failures")
+                if lane_fault:
+                    # Admission recorded a breaker SUCCESS for this lane;
+                    # without this, a lane that admits streams and then
+                    # dies mid-generation would stay CLOSED forever.
+                    self._stream_fault_penalty(lane)
+                if len(emitted) >= max_new > 0:
+                    # The budget was fully delivered; only the terminal
+                    # frame was lost. Synthesize it — nothing to resume.
+                    done = {"done": True, "request_id": request_id,
+                            "tokens": list(emitted)}
+                    if resumes:
+                        done["resumed"] = resumes
+                    yield sse_event(done)
+                    return
+                if not retryable:
+                    yield terminal_error(reason, False, emitted)
+                    return
+                if deadline is not None and deadline.expired():
+                    self._count(None, "deadline_expired")
+                    yield terminal_error(
+                        f"deadline exceeded after mid-stream failure "
+                        f"({reason})", False, emitted)
+                    return
+                if resumes >= cfg.failover_max_resumes:
+                    yield terminal_error(
+                        f"stream failed after {resumes} resumes "
+                        f"({reason})", True, emitted)
+                    return
+                # Budget accounting rides the resume DISPATCH below, not a
+                # separate pre-draw: the dead lane is (almost always) the
+                # rid's ring primary, so the skip-path failover march
+                # charges the global retry budget one token per alternate
+                # lane tried — a resume costs exactly what any other
+                # extra dispatch costs, and budget exhaustion surfaces
+                # from _route as the terminal error.
+                resumes += 1
+                replayed = len(emitted)
+                self.failover.bump("resumes_attempted")
+                self.failover.bump("tokens_replayed", replayed)
+                resume = self._resume_payload(payload, emitted, max_new,
+                                              deadline)
+                skip = (lane,) if lane else ()
+                nxt_info: dict = {}
+                try:
+                    it = self._route(resume, op="generate_stream",
+                                     skip=skip, out_info=nxt_info)
+                except Exception as exc:
+                    # No lane could admit the resume (all down, all
+                    # shedding, or the deadline died en route).
+                    self.failover.bump("resumes_failed")
+                    self._resume_span(request_id, ctx, resumes, replayed,
+                                      "failed", lane)
+                    yield terminal_error(
+                        f"resume dispatch failed ({exc})",
+                        not isinstance(exc, DeadlineExceeded), emitted)
+                    return
+                self.failover.bump("resumes_succeeded")
+                lane = nxt_info.get("lane")
+                self._resume_span(request_id, ctx, resumes, replayed,
+                                  "ok", lane)
+        return spliced()
+
+    def _route(self, payload: dict, op: str, skip: tuple = (),
+               out_info: Optional[dict] = None) -> dict:
+        """``skip``: lanes excluded from dispatch for this route (the
+        stream-resume path skips the lane that just died mid-stream).
+        ``out_info``: optional dict the dispatch layer fills with
+        ``{"lane": name}`` on success — the resume journal needs to know
+        which lane served a stream to skip it on the next attempt."""
         with self._lock:
             self._total_requests += 1
         self._retry_budget.record_request()
@@ -293,7 +701,8 @@ class Gateway:
         t0 = time.perf_counter()
         start = time.time()
         try:
-            result = self._route_inner(payload, op, request_id, trace)
+            result = self._route_inner(payload, op, request_id, trace,
+                                       skip=skip, out_info=out_info)
             trace.outcome = "ok"
             return result
         except ShedError as exc:
@@ -326,7 +735,8 @@ class Gateway:
                 attrs={"decision": decision})
 
     def _route_inner(self, payload: dict, op: str, request_id: str,
-                     trace: _RouteTrace) -> dict:
+                     trace: _RouteTrace, skip: tuple = (),
+                     out_info: Optional[dict] = None) -> dict:
         # Deadline admission: an already-expired request sheds HERE — one
         # cheap 503 + Retry-After instead of a doomed dispatch chain (and,
         # downstream, a burned batch row).
@@ -367,18 +777,29 @@ class Gateway:
         except RuntimeError:  # every lane of this model was removed
             raise GatewayError(f"no workers available for model '{mdl}'")
 
+        if skip and primary in skip:
+            # The resume path excludes the lane that just failed its
+            # stream: go straight to ring-order failover (budgeted and
+            # deadline-bounded like any other failover march).
+            with self._lock:
+                self._failovers += 1
+            return self._failover(ring, primary, payload, op, probing,
+                                  deadline, skip=skip, trace=trace,
+                                  out_info=out_info)
         if self.config.hedge_enabled and op in _HEDGEABLE_OPS:
             return self._route_hedged(ring, primary, payload, op,
                                       probing, deadline, trace)
         result = self._try_node(primary,
                                 self._with_deadline(payload, deadline),
-                                op=op, probing=probing, trace=trace)
+                                op=op, probing=probing, trace=trace,
+                                out_info=out_info, ring=ring)
         if not _ok(result):
             with self._lock:
                 self._failovers += 1
             result = self._failover(ring, primary, payload, op,
-                                    probing, deadline,
-                                    shed_seen=result is _SHED, trace=trace)
+                                    probing, deadline, skip=skip,
+                                    shed_seen=result is _SHED, trace=trace,
+                                    out_info=out_info)
         return result
 
     def _shed(self, exc):
@@ -399,7 +820,8 @@ class Gateway:
     def _failover(self, ring, primary: str, payload: dict, op: str,
                   probing: bool, deadline: Optional[Deadline],
                   skip: tuple = (), shed_seen: bool = False,
-                  trace: Optional[_RouteTrace] = None) -> dict:
+                  trace: Optional[_RouteTrace] = None,
+                  out_info: Optional[dict] = None) -> dict:
         """Ring-order failover across every other lane (gateway.cpp:51-59)
         — now deadline-bounded, budgeted, and backed off: each attempt
         consumes the global retry budget (failover storms cannot amplify
@@ -447,7 +869,8 @@ class Gateway:
             result = self._try_node(node,
                                     self._with_deadline(payload, deadline),
                                     op=op, probing=probing, trace=trace,
-                                    kind="retry")
+                                    kind="retry", out_info=out_info,
+                                    ring=ring)
             if _ok(result):
                 return result
             shed_seen = shed_seen or result is _SHED
@@ -518,7 +941,8 @@ class Gateway:
             p_started.set()
             return self._try_node(primary,
                                   self._with_deadline(payload, deadline),
-                                  op, probing, trace=trace, kind="primary")
+                                  op, probing, trace=trace, kind="primary",
+                                  ring=ring)
 
         p_fut = pool.submit(_primary_task)
 
@@ -593,7 +1017,8 @@ class Gateway:
         self._count(trace, "hedges")
         h_fut = pool.submit(self._try_node, hedge_node,
                             self._with_deadline(payload, deadline),
-                            op, probing, trace, "hedge")
+                            op, probing, trace=trace, kind="hedge",
+                            ring=ring)
         pending = {p_fut: primary, h_fut: hedge_node}
         first_error: Optional[BaseException] = None
         shed_seen = False
@@ -659,7 +1084,9 @@ class Gateway:
     def _try_node(self, node: str, payload: dict, op: str = "infer",
                   probing: bool = False,
                   trace: Optional[_RouteTrace] = None,
-                  kind: str = "primary") -> Optional[dict]:
+                  kind: str = "primary",
+                  out_info: Optional[dict] = None,
+                  ring=None) -> Optional[dict]:
         """Breaker-gated dispatch (reference tryNode, gateway.cpp:80-128).
         Returns None on failure so the caller can fail over. `probing`:
         the gateway couldn't resolve the request's model itself, so a
@@ -675,8 +1102,27 @@ class Gateway:
         with self._lock:
             client = self._clients.get(node)
             breaker = self._breakers.get(node)
+            ejected = node in self._ejected
         if client is None or breaker is None:
             return None
+        if ejected:
+            # The health prober took this lane out of rotation: skip it
+            # like a failed dispatch (the caller fails over) with no
+            # breaker penalty — ejection is the prober's reversible call.
+            # Fail OPEN when probe evidence alone has ejected every lane
+            # of THIS request's ring (e.g. a fleet-wide compile stall
+            # tripping a tight scheduler_stall_s): ejection is only
+            # honored while at least one peer remains in rotation, so
+            # request evidence — the breakers — stays the last word on a
+            # total outage. Per-ring, not fleet-wide: one model's lanes
+            # all dying must fail open for THAT model even while other
+            # models' lanes are healthy.
+            peers = (ring.get_all_nodes() if ring is not None
+                     else list(self._clients))
+            with self._lock:
+                all_ejected = all(p in self._ejected for p in peers)
+            if not all_ejected:
+                return None
         if not breaker.allow_request():
             return None
         ctx = None
@@ -701,6 +1147,8 @@ class Gateway:
             response = getattr(client, op)(payload)
             breaker.record_success()
             outcome = "ok"
+            if out_info is not None:
+                out_info["lane"] = node
             return response
         except WorkerError:
             breaker.record_failure()
@@ -776,4 +1224,13 @@ class Gateway:
                 res["hedge_threshold_ms"] = round(
                     self._hedge_threshold_s() * 1000.0, 3)
             out["resilience"] = res
+        # Additive "failover" block (crash-tolerant streaming + prober),
+        # present only once the feature is configured or has decided
+        # something — defaults-only /stats stays byte-identical.
+        if (self.config.failover_streams
+                or self.config.health_probe_interval_s > 0
+                or self.failover.any_nonzero()):
+            fo = self.failover.as_dict()
+            fo["ejected_lanes"] = self.ejected_lanes()
+            out["failover"] = fo
         return out

@@ -18,6 +18,11 @@ SRE-standard pieces:
   p95").
 - ``AdmissionController`` — worker-side bounded queue depth with
   deadline-aware early rejection and a drain (lame-duck) mode.
+- ``ProbeStateMachine`` / ``FailoverCounters`` — the proactive lane
+  health prober's eject/restore state machine and the stream-failover
+  decision counters (DESIGN.md "Crash-tolerant streaming"): a breaker
+  discovers a dead lane one victim request at a time, a prober in
+  O(probe interval) for the whole fleet.
 
 Every knob defaults to off/permissive (see ``GatewayConfig`` /
 ``WorkerConfig``): with defaults, behavior and wire schemas are
@@ -175,6 +180,59 @@ class ResilienceCounters:
     def as_dict(self) -> dict:
         with self._lock:
             return dict(self._c)
+
+
+class FailoverCounters(ResilienceCounters):
+    """Every crash-tolerant-streaming decision, counted — the additive
+    ``/stats`` ``failover`` block and the ``tpu_engine_failover_*``
+    Prometheus family. Each ``resumes_attempted`` / ``prober_*`` bump has
+    a matching gateway span (``resume`` / ``prober``), and
+    ``tools/fault_injection.py --crash`` asserts the two agree."""
+
+    FIELDS = ("stream_failures", "resumes_attempted", "resumes_succeeded",
+              "resumes_failed", "tokens_replayed", "prober_ejections",
+              "prober_restores")
+
+
+class ProbeStateMachine:
+    """Per-lane eject/restore state from a stream of probe outcomes:
+    ``fail_threshold`` CONSECUTIVE failures eject a lane (once — repeat
+    failures while ejected stay silent), any success restores an ejected
+    lane and zeroes the failure run. Pure state, no threads: the gateway
+    owns the probe loop, this owns the decisions (unit-testable)."""
+
+    def __init__(self, fail_threshold: int = 3):
+        self.fail_threshold = max(1, int(fail_threshold))
+        self._fails: dict = {}     # lane -> consecutive probe failures
+        self._ejected: set = set()
+        self._lock = threading.Lock()
+
+    def record(self, lane: str, ok: bool) -> Optional[str]:
+        """Feed one probe outcome; returns "eject", "restore", or None."""
+        with self._lock:
+            if ok:
+                self._fails[lane] = 0
+                if lane in self._ejected:
+                    self._ejected.discard(lane)
+                    return "restore"
+                return None
+            n = self._fails.get(lane, 0) + 1
+            self._fails[lane] = n
+            if n >= self.fail_threshold and lane not in self._ejected:
+                self._ejected.add(lane)
+                return "eject"
+            return None
+
+    def ejected(self, lane: str) -> bool:
+        with self._lock:
+            return lane in self._ejected
+
+    def forget(self, lane: str) -> None:
+        """Drop a removed lane's state so a later lane reusing the name
+        starts clean."""
+        with self._lock:
+            self._fails.pop(lane, None)
+            self._ejected.discard(lane)
 
 
 class AdmissionController:

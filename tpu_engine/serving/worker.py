@@ -1259,13 +1259,18 @@ class WorkerNode:
             # gap still surfaces as the stream's terminal error event.
             self._admission.admit(deadline)
             self._admission.release()
+            one_shot_parent = TraceContext.from_request(request)
+            one_shot_ctx = (one_shot_parent.child()
+                            if one_shot_parent is not None
+                            else TraceContext.root(request_id))
 
             def one_shot():
                 try:
                     # handle_generate admits (depth/drain/deadline) itself.
                     result = self.handle_generate(normalized)
                 except Exception as exc:  # terminal error event, stream ends
-                    yield sse_event({"done": True, "error": str(exc)[:300]})
+                    yield sse_event(self._stream_error(
+                        exc, request_id, one_shot_ctx.trace_id, 0))
                     return
                 yield sse_event({"tokens": result["tokens"]})
                 yield sse_event({"done": True, **result})
@@ -1296,23 +1301,27 @@ class WorkerNode:
             raise
 
         def events():
+            sent = 0  # tokens relayed to the client so far (resume offset)
             try:
                 while True:
                     try:
                         item = q.get(timeout=600)
                     except queue.Empty:
-                        yield sse_event({"done": True,
-                                         "error": "generation stalled (no "
-                                                  "tokens for 600s)"})
+                        yield sse_event(self._stream_error(
+                            RuntimeError("generation stalled (no tokens "
+                                         "for 600s)"),
+                            request_id, tctx.trace_id, sent))
                         return
                     if item is None:
                         break
+                    sent += len(item)
                     yield sse_event({"tokens": item})
                 elapsed_us = int((time.perf_counter() - t0) * 1e6)
                 try:
                     tokens = fut.result(timeout=10)
                 except Exception as exc:
-                    yield sse_event({"done": True, "error": str(exc)[:300]})
+                    yield sse_event(self._stream_error(
+                        exc, request_id, tctx.trace_id, sent))
                     return
                 self.tracer.record(
                     request_id, "generate_stream", self.node_id,
@@ -1327,6 +1336,39 @@ class WorkerNode:
             finally:
                 self._admission.release()
         return events()
+
+    @staticmethod
+    def _stream_error(exc: BaseException, request_id: str, trace_id: str,
+                      tokens_emitted: int) -> dict:
+        """Terminal error event for a failed stream — no longer opaque: it
+        carries everything a client (or the gateway's stream journal)
+        needs to RESUME the generation elsewhere. ``retryable``
+        distinguishes lane faults (another lane can continue the stream
+        byte-identically) from spent budgets and bad requests;
+        ``tokens_emitted`` is the resume offset (prompt ⧺ that many
+        already-received tokens); ``trace_id`` joins the event to the
+        request's trace tree. An exception may pre-classify itself with a
+        ``retryable`` attribute (the scheduler's _recover row events do)."""
+        retryable = getattr(exc, "retryable", None)
+        if retryable is None:
+            if isinstance(exc, DeadlineExceeded):
+                retryable = False  # the budget is spent: no lane can help
+            elif isinstance(exc, ShedError):
+                retryable = True   # overload/drain: healthy lanes elsewhere
+            elif isinstance(exc, (KeyError, ValueError, TypeError)):
+                retryable = False  # the request is at fault
+            else:
+                retryable = True   # lane/device fault
+        out = {"done": True, "error": str(exc)[:300],
+               "retryable": bool(retryable),
+               "request_id": request_id, "trace_id": trace_id,
+               "tokens_emitted": int(tokens_emitted)}
+        if isinstance(exc, ShedError):
+            # Policy refusal from a HEALTHY lane: the gateway's failover
+            # journal resumes these WITHOUT a breaker penalty (the same
+            # shed-vs-fault split _try_node applies at admission).
+            out["shed"] = True
+        return out
 
     def _process_gen_batch(self, items: List[_GenItem]) -> List[_GenResult]:
         """Group by eos_id (a compile-time scalar of the decode executable);
@@ -1417,6 +1459,19 @@ class WorkerNode:
                 out["generator"] = self.generator.stats()
             except Exception:
                 pass
+            else:
+                # Scheduler liveness: a wedged decode loop (stuck inside a
+                # device dispatch) is process-alive but cannot serve —
+                # last-tick age is the only signal that sees it. With
+                # scheduler_stall_s > 0 a stale loop flips the lane
+                # unhealthy, so the gateway's prober ejects it like a
+                # dead process instead of breakers tripping one victim
+                # request at a time.
+                age = out["generator"].get("last_tick_age_s")
+                stall = float(self.config.scheduler_stall_s or 0.0)
+                if stall > 0 and age is not None and age > stall:
+                    out["healthy"] = False
+                    out["scheduler_stalled"] = True
         # Additive, and only once admission control has anything to say
         # (a defaults-only lane keeps the reference-exact key set).
         dropped = self.batch_processor.deadline_dropped

@@ -122,6 +122,14 @@ class WorkerConfig:
     # On by default — recording is lock-guarded ring writes, ~1 µs/span.
     # 0 disables span recording AND the /metrics stage histograms.
     trace_capacity: int = 2048
+    # Scheduler liveness (continuous decode lane): /health reports the
+    # decode loop's last-tick age, and when this threshold is > 0 a lane
+    # whose loop has not ticked for this many seconds reads unhealthy —
+    # a wedged device loop is process-alive but cannot serve, and only
+    # liveness (not request success) can see that. 0 (default) reports
+    # the age without flipping health. Set it comfortably above the
+    # worst first-request XLA compile on the deployment's backend.
+    scheduler_stall_s: float = 0.0
 
     @classmethod
     def from_env(cls, **overrides) -> "WorkerConfig":
@@ -175,6 +183,26 @@ class GatewayConfig:
     hedge_quantile: float = 0.95        # threshold = quantile of recent latency
     hedge_min_ms: float = 50.0          # floor under the quantile threshold
     hedge_min_samples: int = 20         # before this, hedge_min_ms alone rules
+
+    # Crash-tolerant streaming (--failover-streams): the gateway journals
+    # every /generate/stream token event it relays and, on a retryable
+    # mid-stream failure (lane death, transport error, truncation,
+    # drain), re-dispatches to another ring lane as a RESUME — prompt ⧺
+    # emitted tokens, max_tokens offset by the emitted count — splicing
+    # the continuation into one seamless stream (byte-identical to an
+    # uninterrupted run: sampling keys fold per absolute position). Off
+    # (default) keeps today's terminate-with-error behavior.
+    failover_streams: bool = False
+    # Resume attempts per stream; each also consumes the retry budget.
+    failover_max_resumes: int = 3
+    # Proactive lane health prober (--health-probe-interval): a gateway
+    # background thread GETs every lane's /health at this interval and
+    # EJECTS lanes from routing after `health_probe_failures` consecutive
+    # failures (restoring them on the next success) — dead workers leave
+    # rotation in O(probe interval) instead of one breaker trip per
+    # victim request. 0 (default) = no prober.
+    health_probe_interval_s: float = 0.0
+    health_probe_failures: int = 3
 
     # Tracing ring-buffer capacity for the gateway's own spans (route +
     # per-attempt children + resilience decision markers). 0 disables.

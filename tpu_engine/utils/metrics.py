@@ -357,6 +357,31 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
             metric("tpu_engine_hedge_threshold_ms", "gauge",
                    "Current hedge latency threshold",
                    [({}, res.get("hedge_threshold_ms"))])
+        fo = stats.get("failover")
+        if fo:
+            # Crash-tolerant streaming + proactive lane health (the
+            # /stats "failover" block; present once configured or first
+            # exercised — same gating as the resilience family).
+            for key, help_text in (
+                    ("stream_failures",
+                     "Mid-stream failures observed by the stream journal"),
+                    ("resumes_attempted",
+                     "Stream resume dispatches attempted"),
+                    ("resumes_succeeded",
+                     "Stream resumes admitted on another lane"),
+                    ("resumes_failed",
+                     "Stream resumes no lane could admit"),
+                    ("tokens_replayed",
+                     "Tokens re-prefixed into resume prompts"),
+                    ("prober_ejections",
+                     "Lanes ejected from routing by the health prober"),
+                    ("prober_restores",
+                     "Ejected lanes restored by the health prober")):
+                metric(f"tpu_engine_failover_{key}_total", "counter",
+                       help_text, [({}, fo.get(key))])
+            metric("tpu_engine_failover_ejected_lanes", "gauge",
+                   "Lanes currently ejected from routing",
+                   [({}, len(fo.get("ejected_lanes", ())))])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
     if named_hists:
