@@ -1659,6 +1659,236 @@ def run_crash_ab(n_streams: int = 12, max_new: int = 48,
                 proc.kill()
 
 
+def run_affinity_ab(model: str = "gpt2-small-test", n_requests: int = 48,
+                    n_tenants: int = 8, prefix_len: int = 96,
+                    suffix_len: int = 8, max_new: int = 8,
+                    mean_gap_ms: float = 50.0, block_size: int = 16,
+                    lanes: int = 3, slots_per_lane: int = 2,
+                    kv_blocks_per_lane: int = 36, max_seq: int = 256,
+                    quick: bool = False) -> dict:
+    """Prefix-affinity routing A/B (the PR 7 tentpole): a
+    shared-system-prompt Poisson workload over >= 3 in-process lanes
+    behind the gateway, --prefix-affinity ON vs OFF.
+
+    Workload: ``n_tenants`` distinct system prompts (each
+    ``prefix_len`` tokens = full radix blocks), each request = one
+    tenant's prefix + a unique suffix, Poisson arrivals, unique
+    request_ids. Per-lane pools are sized so ONE lane cannot hold every
+    tenant's prefix (the fleet-capacity shape): request_id routing
+    scatters every tenant across every lane — each lane churns through
+    all ``n_tenants`` prefixes and keeps evicting/re-prefilling them —
+    while affinity routing partitions tenants across lanes so each
+    lane's radix holds its share resident. Reported per arm:
+
+    - fleet prefill-skip ratio (sum prefix_hit / (hit + prefilled)
+      across lanes, warmup excluded) — the bar: ON >= 2x OFF;
+    - client-side TTFT p50/p99 through /generate/stream — ON p99 must
+      beat OFF (skipped prefill is exactly the TTFT term);
+    - per-lane radix_lookups/radix_hits/prefix_hit_tokens (the /stats
+      blind-spot fix — affinity effectiveness observable per lane).
+
+    A separate OFFLOAD phase exercises the hierarchical host-RAM tier on
+    one lane (tiny device pool + --kv-host-blocks): fillers demote the
+    tenant prefix, a re-hit must SWAP IN instead of recomputing
+    (swap_in_events > 0, prefill tokens skipped) with the stream
+    byte-identical to the pre-demotion run.
+
+    Runs on the CPU mesh (tiny registry model — routing convergence,
+    radix hit ratios, and swap-in counters are topology/workload
+    properties, not model-size properties); on-chip rerun pending like
+    r06-r09."""
+    import queue as _q
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+    from tpu_engine.serving.gateway import Gateway
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+    _ensure_builtin_models_imported()
+    if quick:
+        # Smaller run, proportionally tighter pools: 6 tenants x 6 radix
+        # blocks must still exceed one lane's capacity or the off arm
+        # stops thrashing and the contrast (the thing under test)
+        # vanishes into the smaller sample.
+        n_requests, n_tenants = 24, 6
+        kv_blocks_per_lane = min(kv_blocks_per_lane, 30)
+    spec = create_model(model, max_seq=max_seq)
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(7)
+    tenants = [[rnd.randrange(1, 200) for _ in range(prefix_len)]
+               for _ in range(n_tenants)]
+    requests = []
+    for i in range(n_requests):
+        prompt = (tenants[i % n_tenants]
+                  + [rnd.randrange(1, 200) for _ in range(suffix_len)])
+        requests.append({"request_id": f"aff-{i}", "prompt_tokens": prompt,
+                         "max_new_tokens": max_new})
+    gaps = [rnd.expovariate(1000.0 / mean_gap_ms) / 1000.0
+            for _ in range(n_requests)]
+
+    def make_fleet():
+        workers = []
+        for i in range(lanes):
+            cfg = WorkerConfig(
+                node_id=f"lane_{i+1}", model=model,
+                gen_max_batch_size=slots_per_lane, gen_step_chunk=8,
+                gen_prefix_cache_mb=0, gen_kv_block_size=block_size,
+                gen_kv_blocks=kv_blocks_per_lane)
+            engine = InferenceEngine(spec, params=params, dtype="float32")
+            workers.append(WorkerNode(cfg, engine=engine))
+        return workers
+
+    def fleet_kv(workers):
+        per_lane, agg = {}, {"prefix_hit_tokens": 0, "prefilled_tokens": 0,
+                             "radix_lookups": 0, "radix_hits": 0}
+        for w in workers:
+            pool = w.generator.stats()["kv_pool"]
+            per_lane[w.node_id] = {k: pool[k] for k in agg}
+            for k in agg:
+                agg[k] += pool[k]
+        return per_lane, agg
+
+    from tpu_engine.serving.gateway import _parse_sse
+    from tpu_engine.utils.tracing import percentile
+
+    def first_token_ttft(gw, req, out):
+        t0 = time.perf_counter()
+        toks = []
+        ttft = None
+        for frame in gw.route_generate_stream(dict(req)):
+            evt = _parse_sse(frame)
+            if evt is None or evt.get("done"):
+                continue
+            if ttft is None and evt.get("tokens"):
+                ttft = time.perf_counter() - t0
+            toks.extend(evt.get("tokens", ()))
+        out.put((req["request_id"], ttft, toks))
+
+    def run_arm(affinity: bool) -> dict:
+        workers = make_fleet()
+        gw = Gateway(workers, GatewayConfig(
+            prefix_affinity=affinity, affinity_block_size=block_size))
+        try:
+            # Warm EVERY lane's compile set on both the miss path (full
+            # bucket prefill) and the radix-hit resumed-window path, with
+            # a warm-only prefix, then snapshot the counters so the
+            # measured ratios exclude warmup.
+            warm_prefix = [rnd.randrange(200, 255)
+                           for _ in range(prefix_len)]
+            for w in workers:
+                for s in ((1, 2, 3, 4), (9, 8, 7)):
+                    w.handle_generate({
+                        "request_id": f"warm-{w.node_id}-{len(s)}",
+                        "prompt_tokens": warm_prefix + list(s),
+                        "max_new_tokens": 2})
+            _, base = fleet_kv(workers)
+
+            out: "_q.Queue" = _q.Queue()
+            threads = []
+            t0 = time.perf_counter()
+            for req, gap in zip(requests, gaps):
+                time.sleep(gap)
+                th = threading.Thread(target=first_token_ttft,
+                                      args=(gw, req, out), daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=600)
+            wall = time.perf_counter() - t0
+            got = {}
+            ttfts = []
+            while not out.empty():
+                rid, ttft, toks = out.get()
+                got[rid] = toks
+                if ttft is not None:
+                    ttfts.append(ttft)
+            ttfts.sort()  # percentile() takes a pre-sorted list
+            per_lane, agg = fleet_kv(workers)
+            hit = agg["prefix_hit_tokens"] - base["prefix_hit_tokens"]
+            filled = agg["prefilled_tokens"] - base["prefilled_tokens"]
+            arm = {
+                "affinity": affinity, "requests": len(requests),
+                "completed": sum(1 for t in got.values() if t),
+                "wall_s": round(wall, 3),
+                "fleet_prefill_skip_frac": round(
+                    hit / (hit + filled), 4) if hit + filled else 0.0,
+                "prefix_hit_tokens": hit, "prefilled_tokens": filled,
+                "ttft_p50_ms": round(1e3 * (percentile(ttfts, 50) or 0), 2),
+                "ttft_p99_ms": round(1e3 * (percentile(ttfts, 99) or 0), 2),
+                "per_lane_kv": per_lane,
+            }
+            st = gw.get_stats()
+            if affinity:
+                arm["affinity_stats"] = st["affinity"]
+            else:
+                arm["affinity_block_absent"] = "affinity" not in st
+            return arm, got
+        finally:
+            gw.stop()
+            for w in workers:
+                w.stop()
+
+    results = {"model": model, "lanes": lanes, "n_requests": n_requests,
+               "n_tenants": n_tenants, "prefix_len": prefix_len,
+               "block_size": block_size,
+               "kv_blocks_per_lane": kv_blocks_per_lane}
+    off, off_streams = run_arm(False)
+    record_partial("affinity_off", off)
+    on, on_streams = run_arm(True)
+    record_partial("affinity_on", on)
+    results["affinity_off"], results["affinity_on"] = off, on
+    results["skip_gain"] = round(
+        on["fleet_prefill_skip_frac"]
+        / max(1e-9, off["fleet_prefill_skip_frac"]), 2)
+    results["streams_identical_on_vs_off"] = all(
+        on_streams.get(r) == off_streams.get(r) for r in on_streams)
+
+    # -- offload phase: host tier swap-in instead of recompute ---------------
+    g = ContinuousGenerator(spec, params=params, dtype="float32",
+                            n_slots=slots_per_lane, step_chunk=8,
+                            max_seq=max_seq, kv_block_size=block_size,
+                            kv_blocks=20, kv_host_blocks=16)
+    try:
+        tprompt = tenants[0] + [3, 1, 4]
+        want = g.generate([tprompt], max_new_tokens=max_new)[0]
+        for _ in range(4):  # fillers demote the tenant prefix
+            g.generate([[rnd.randrange(1, 200) for _ in range(72)]],
+                       max_new_tokens=2)
+        mid = g.stats()["kv_pool"]
+        got = g.generate([tprompt], max_new_tokens=max_new)[0]
+        pool = g.stats()["kv_pool"]
+        results["offload"] = {
+            "demotions": pool["host"]["demotions"],
+            "swap_ins": pool["host"]["swap_ins"],
+            "swap_in_events": pool["host"]["swap_in_events"],
+            "swapped_in_tokens": pool["host"]["swapped_in_tokens"],
+            "prefill_tokens_skipped_on_rehit":
+                pool["prefix_hit_tokens"] - mid["prefix_hit_tokens"],
+            "stream_identical_after_swap_in": got == want,
+        }
+    finally:
+        g.stop()
+    record_partial("affinity_offload", results["offload"])
+
+    results["checks_passed"] = bool(
+        on["completed"] == n_requests and off["completed"] == n_requests
+        and results["streams_identical_on_vs_off"]
+        and on["fleet_prefill_skip_frac"]
+        >= 2.0 * off["fleet_prefill_skip_frac"]
+        and on["ttft_p99_ms"] < off["ttft_p99_ms"]
+        and off["affinity_block_absent"]
+        and results["offload"]["swap_in_events"] > 0
+        and results["offload"]["prefill_tokens_skipped_on_rehit"] > 0
+        and results["offload"]["stream_identical_after_swap_in"])
+    return results
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -1798,7 +2028,7 @@ def _main() -> int:
                              "spec-ab", "spec-batch-ab", "mixed",
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
-                             "crash-ab"],
+                             "crash-ab", "affinity-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -1832,7 +2062,7 @@ def _main() -> int:
         args.model = "gpt2"
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
-    if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab")
+    if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -1908,6 +2138,23 @@ def _main() -> int:
             "unit": "fraction",
             "vs_baseline": result["failover_off"][
                 "stream_completion_rate"],
+            **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "affinity-ab":
+        # Prefix-affinity routing + host-tier offload A/B: in-process
+        # lanes on the host backend (routing convergence and radix hit
+        # ratios are the variables under test, not the chip).
+        result = run_affinity_ab(model=args.model, quick=args.quick)
+        record_partial("affinity_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "affinity_prefill_skip_gain",
+            "value": result["skip_gain"], "unit": "x",
+            "vs_baseline": 2.0,
+            "ttft_p99_on_ms": result["affinity_on"]["ttft_p99_ms"],
+            "ttft_p99_off_ms": result["affinity_off"]["ttft_p99_ms"],
             **result,
         })
         return 0 if result["checks_passed"] else 1

@@ -1,0 +1,328 @@
+"""Prefix-affinity routing (gateway --prefix-affinity).
+
+Contracts under test:
+- requests sharing a block-aligned prompt prefix converge on ONE lane
+  (the lane owning the fingerprint on the ring), regardless of their
+  request_ids — the fleet-wide prefix-sharing unlock;
+- the fingerprint is deterministic: equal ring membership => equal
+  lane assignment, across gateway instances;
+- fallback to ring order (the exact pre-affinity behavior) when there
+  is no full block to fingerprint, the affinity lane is ejected or
+  draining, or it is imbalanced vs its ring peers;
+- streams are byte-identical affinity-on vs affinity-off (routing never
+  touches the payload);
+- with defaults everything is off: routing is the request_id ring and
+  /stats carries no "affinity" key (wire compatibility);
+- crash-tolerant streaming composes: a dying affinity lane's stream
+  resumes on another ring lane, spliced byte-identically;
+- every affinity decision has a matching marker span (counters==spans).
+"""
+
+import json
+
+from tpu_engine.serving.gateway import Gateway
+from tpu_engine.serving.http import sse_event
+from tpu_engine.utils.config import GatewayConfig
+from tpu_engine.utils.deadline import Overloaded
+
+
+def sse(obj) -> bytes:
+    return sse_event(obj)
+
+
+def deterministic_tokens(prompt, max_new):
+    toks, ctx = [], list(prompt)
+    for _ in range(max_new):
+        t = (sum(ctx) * 31 + len(ctx)) % 211
+        toks.append(t)
+        ctx.append(t)
+    return toks
+
+
+class GenLane:
+    """Stub lane speaking the blocking + streaming generate contracts
+    over deterministic_tokens; `shed` makes it refuse every admission
+    (drain signature), `down` makes it fail like a dead worker."""
+
+    def __init__(self, node_id, shed=False, down=False, die_after=None):
+        self.node_id = node_id
+        self.shed = shed
+        self.down = down
+        self.die_after = die_after
+        self.calls = 0
+        self.payloads = []
+
+    def _toks(self, payload):
+        return deterministic_tokens(payload["prompt_tokens"],
+                                    payload.get("max_new_tokens", 8))
+
+    def handle_generate(self, payload):
+        self.calls += 1
+        self.payloads.append(dict(payload))
+        if self.shed:
+            raise Overloaded(f"{self.node_id} draining")
+        if self.down:
+            raise RuntimeError(f"{self.node_id} down")
+        return {"request_id": payload["request_id"],
+                "tokens": self._toks(payload), "node_id": self.node_id,
+                "generate_time_us": 1}
+
+    def handle_generate_stream(self, payload):
+        self.calls += 1
+        self.payloads.append(dict(payload))
+        if self.shed:
+            raise Overloaded(f"{self.node_id} draining")
+        if self.down:
+            raise RuntimeError(f"{self.node_id} down")
+        toks = self._toks(payload)
+        arm = self.die_after is not None and self.calls == 1
+
+        def events():
+            for i, t in enumerate(toks):
+                if arm and i >= self.die_after:
+                    return  # truncation: kill -9 signature
+                yield sse({"tokens": [t]})
+            yield sse({"done": True, "tokens": toks,
+                       "node_id": self.node_id,
+                       "request_id": payload["request_id"]})
+        return events()
+
+    def get_health(self):
+        return {"healthy": True, "node_id": self.node_id}
+
+
+SHARED = list(range(100, 132))  # two full blocks at block size 16
+
+
+def make_gw(lanes=None, n=3, prefix="w", **cfg_kw):
+    lanes = lanes or [GenLane(f"{prefix}{i}") for i in range(n)]
+    return lanes, Gateway(lanes, GatewayConfig(**cfg_kw))
+
+
+def affinity_lane(gw, prompt):
+    return gw._ring.get_node(gw._affinity_fingerprint(
+        {"prompt_tokens": prompt}))
+
+
+def off_ring_rids(gw, lane, n=8):
+    """Request ids whose request_id ring primary is NOT `lane` — so a
+    fallback to ring order observably leaves the affinity lane."""
+    out = [r for r in (f"q{i}" for i in range(500))
+           if gw._ring.get_node(r) != lane]
+    return out[:n]
+
+
+def consume(it):
+    toks, final = [], None
+    for frame in it:
+        evt = json.loads(frame.decode().strip()[len("data: "):])
+        if evt.get("done"):
+            final = evt
+        else:
+            toks.extend(evt.get("tokens", ()))
+    return toks, final
+
+
+# -- convergence --------------------------------------------------------------
+
+def test_shared_prefix_converges_on_one_lane():
+    _, gw = make_gw(prefix_affinity=True)
+    served = {gw.route_generate(
+        {"request_id": f"r{i}", "prompt_tokens": SHARED + [i, 7 * i],
+         "max_new_tokens": 1})["node_id"] for i in range(9)}
+    assert len(served) == 1
+    aff = gw.get_stats()["affinity"]
+    assert aff["affinity_routed"] == 9
+    assert aff["assigned"] == {served.pop(): 9}
+    gw.stop()
+
+
+def test_fingerprint_deterministic_across_gateways():
+    _, gw1 = make_gw(prefix_affinity=True)
+    _, gw2 = make_gw(prefix_affinity=True)
+    for seed in (0, 5, 9):
+        prompt = [t + seed for t in SHARED]
+        assert affinity_lane(gw1, prompt) == affinity_lane(gw2, prompt)
+    gw1.stop(); gw2.stop()
+
+
+def test_fingerprint_is_block_aligned_and_capped():
+    _, gw = make_gw(prefix_affinity=True, affinity_block_size=16,
+                    affinity_prefix_blocks=2)
+    base = {"prompt_tokens": SHARED}
+    # A partial trailing block never enters the fingerprint...
+    assert (gw._affinity_fingerprint(base)
+            == gw._affinity_fingerprint({"prompt_tokens": SHARED + [1, 2]}))
+    # ...and blocks past the cap don't either (long prompts sharing the
+    # head still converge).
+    long = SHARED + list(range(64))
+    assert (gw._affinity_fingerprint({"prompt_tokens": long})
+            == gw._affinity_fingerprint(base))
+    # A difference INSIDE the covered blocks changes the fingerprint.
+    other = [SHARED[0] + 1] + SHARED[1:]
+    assert (gw._affinity_fingerprint({"prompt_tokens": other})
+            != gw._affinity_fingerprint(base))
+    gw.stop()
+
+
+def test_short_prompt_falls_back_to_request_id_ring():
+    _, gw = make_gw(prefix_affinity=True)
+    rid = "tiny-1"
+    out = gw.route_generate({"request_id": rid, "prompt_tokens": [1, 2, 3],
+                             "max_new_tokens": 1})
+    assert out["node_id"] == gw._ring.get_node(rid)
+    assert gw.get_stats()["affinity"]["no_fingerprint"] == 1
+    gw.stop()
+
+
+# -- fallback ----------------------------------------------------------------
+
+def test_ejected_affinity_lane_falls_back_to_ring_order():
+    lanes, gw = make_gw(prefix_affinity=True)
+    aff = affinity_lane(gw, SHARED + [0])
+    gw._ejected.add(aff)
+    rid = off_ring_rids(gw, aff, 1)[0]
+    out = gw.route_generate({"request_id": rid,
+                             "prompt_tokens": SHARED + [0],
+                             "max_new_tokens": 1})
+    assert out["node_id"] != aff
+    assert out["node_id"] == gw._ring.get_node(rid)
+    assert gw.get_stats()["affinity"]["ejected_fallbacks"] == 1
+    # Restored lane gets its traffic back.
+    gw._ejected.discard(aff)
+    out2 = gw.route_generate({"request_id": rid,
+                              "prompt_tokens": SHARED + [0],
+                              "max_new_tokens": 1})
+    assert out2["node_id"] == aff
+    gw.stop()
+
+
+def test_draining_affinity_lane_fails_over_in_ring_order():
+    """A draining lane sheds at dispatch — the existing shed/failover
+    machinery moves the request on WITHOUT a breaker penalty; affinity
+    only picked the primary."""
+    lanes = [GenLane(f"w{i}") for i in range(3)]
+    _, gw = make_gw(lanes, prefix_affinity=True)
+    aff = affinity_lane(gw, SHARED + [0])
+    next(l for l in lanes if l.node_id == aff).shed = True
+    out = gw.route_generate({"request_id": "d1",
+                             "prompt_tokens": SHARED + [0],
+                             "max_new_tokens": 1})
+    assert out["node_id"] != aff
+    assert gw.breaker_for(aff).state_name() == "CLOSED"
+    gw.stop()
+
+
+def test_imbalance_fallback_spreads_to_ring_order():
+    _, gw = make_gw(prefix_affinity=True, affinity_max_imbalance=2)
+    aff = affinity_lane(gw, SHARED + [0])
+    rids = off_ring_rids(gw, aff, 8)
+    got = [gw.route_generate({"request_id": r,
+                              "prompt_tokens": SHARED + [i],
+                              "max_new_tokens": 1})["node_id"]
+           for i, r in enumerate(rids)]
+    st = gw.get_stats()["affinity"]
+    # The first two dispatches honor affinity; once the lane runs
+    # max_imbalance ahead of its coldest peer, ring order takes over.
+    assert got[0] == got[1] == aff
+    assert any(l != aff for l in got[2:])
+    assert st["imbalance_fallbacks"] > 0
+    assert st["affinity_routed"] + st["imbalance_fallbacks"] == len(rids)
+    gw.stop()
+
+
+def test_dead_affinity_lane_still_serves_via_failover():
+    """Affinity pointing at a dead lane must not strand requests: the
+    breaker-gated ring-order failover (unchanged) finds a live lane."""
+    lanes = [GenLane(f"w{i}") for i in range(3)]
+    _, gw = make_gw(lanes, prefix_affinity=True)
+    aff = affinity_lane(gw, SHARED + [0])
+    next(l for l in lanes if l.node_id == aff).down = True
+    out = gw.route_generate({"request_id": "f1",
+                             "prompt_tokens": SHARED + [0],
+                             "max_new_tokens": 2})
+    assert out["node_id"] != aff
+    assert out["tokens"] == deterministic_tokens(SHARED + [0], 2)
+    gw.stop()
+
+
+# -- identity & wire compatibility -------------------------------------------
+
+def test_streams_byte_identical_affinity_on_vs_off():
+    req = {"request_id": "same", "prompt_tokens": SHARED + [3],
+           "max_new_tokens": 6}
+    _, gw_off = make_gw()
+    _, gw_on = make_gw(prefix_affinity=True)
+    frames_off = list(gw_off.route_generate_stream(dict(req)))
+    frames_on = list(gw_on.route_generate_stream(dict(req)))
+    assert frames_on == frames_off  # byte-identical SSE wire
+    gw_off.stop(); gw_on.stop()
+
+
+def test_defaults_off_wire_compat():
+    lanes, gw = make_gw()  # defaults: affinity off
+    rid = "plain-7"
+    out = gw.route_generate({"request_id": rid,
+                             "prompt_tokens": SHARED + [1],
+                             "max_new_tokens": 1})
+    assert out["node_id"] == gw._ring.get_node(rid)
+    st = gw.get_stats()
+    assert "affinity" not in st
+    assert gw.affinity.any_nonzero() is False
+    gw.stop()
+
+
+def test_affinity_payload_untouched():
+    lanes, gw = make_gw(prefix_affinity=True)
+    gw.route_generate({"request_id": "p1", "prompt_tokens": SHARED + [2],
+                       "max_new_tokens": 4})
+    served = next(l for l in lanes if l.payloads).payloads[0]
+    assert served["prompt_tokens"] == SHARED + [2]
+    assert served["max_new_tokens"] == 4
+    assert "affinity" not in served  # nothing affinity-shaped on the wire
+    gw.stop()
+
+
+# -- composition with crash-tolerant streaming --------------------------------
+
+def test_resume_skips_dead_affinity_lane_and_splices():
+    lanes = [GenLane(f"w{i}") for i in range(3)]
+    _, gw = make_gw(lanes, prefix_affinity=True, failover_streams=True)
+    prompt = SHARED + [4]
+    aff = affinity_lane(gw, prompt)
+    next(l for l in lanes if l.node_id == aff).die_after = 3
+    control = deterministic_tokens(prompt, 8)
+    toks, final = consume(gw.route_generate_stream(
+        {"request_id": "c1", "prompt_tokens": prompt,
+         "max_new_tokens": 8}))
+    assert toks == control and final["tokens"] == control
+    assert final.get("resumed") == 1
+    # The resume went to a DIFFERENT lane (the dead one is skipped even
+    # though the fingerprint still points at it).
+    resumed_on = [l for l in lanes
+                  if l.node_id != aff and l.payloads]
+    assert resumed_on and resumed_on[0].payloads[-1][
+        "prompt_tokens"] == prompt + control[:3]
+    # The resume's skip of the dead affinity lane is itself a counted,
+    # spanned routing decision (the decisions==counters discipline).
+    assert gw.get_stats()["affinity"]["resume_skips"] == 1
+    gw.stop()
+
+
+def test_affinity_counters_match_marker_spans():
+    _, gw = make_gw(prefix_affinity=True)
+    for i in range(4):
+        gw.route_generate({"request_id": f"s{i}",
+                           "prompt_tokens": SHARED + [i],
+                           "max_new_tokens": 1})
+    gw.route_generate({"request_id": "s-short", "prompt_tokens": [1],
+                       "max_new_tokens": 1})
+    aff = gw.get_stats()["affinity"]
+    spans = [s for s in gw.tracer.snapshot() if s["op"] == "affinity"]
+    by_decision = {}
+    for s in spans:
+        d = s["attrs"]["decision"]
+        by_decision[d] = by_decision.get(d, 0) + 1
+    assert by_decision.get("affinity_routed", 0) == aff["affinity_routed"]
+    assert by_decision.get("no_fingerprint", 0) == aff["no_fingerprint"]
+    gw.stop()

@@ -523,10 +523,16 @@ def test_prober_ejects_and_restores_lane():
         assert _wait(lambda: gw.ejected_lanes() == [])
         fo = gw.get_stats()["failover"]
         assert fo["prober_ejections"] == 1 and fo["prober_restores"] == 1
-        # Counters == spans, prober included.
-        spans = [s for s in gw.tracer.snapshot() if s["op"] == "prober"]
-        actions = sorted(s["attrs"]["action"] for s in spans)
-        assert actions == ["eject", "restore"]
+        # Counters == spans, prober included. Settle first: the prober
+        # bumps the counter BEFORE recording its span, so one snapshot
+        # can land between the two (the same race fault_injection's
+        # crash phase settles) — the restore above was observed via
+        # _ejected, which clears before either.
+        def _actions():
+            return sorted(s["attrs"]["action"]
+                          for s in gw.tracer.snapshot()
+                          if s["op"] == "prober")
+        assert _wait(lambda: _actions() == ["eject", "restore"])
     finally:
         gw.stop()
 

@@ -565,10 +565,12 @@ def run_spec_standalone() -> int:
             proc.kill()
 
 
-def launch_worker_procs(n: int = 3, attempts: int = 3):
+def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=()):
     """Spawn ``n`` standalone worker processes (``cli worker``, paged KV,
     tiny chunks so streams span many frames) — the killable unit of the
-    crash scenario. Returns (ports, procs)."""
+    crash/offload scenarios. ``extra_args`` append to each worker's argv
+    (the offload scenario adds a tiny pool + ``--kv-host-blocks``).
+    Returns (ports, procs)."""
     from tpu_engine.utils.net import launch_with_retry
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -581,7 +583,7 @@ def launch_worker_procs(n: int = 3, attempts: int = 3):
             cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "worker",
                    str(port), f"w{i}", "gpt2-small-test",
                    "--kv-block-size", "16", "--step-chunk", "2",
-                   "--prefill-chunk", "16"]
+                   "--prefill-chunk", "16", *extra_args]
             proc = subprocess.Popen(cmd, cwd=repo, env=env,
                                     stdout=sys.stderr, stderr=sys.stderr)
             deadline = time.monotonic() + 600
@@ -910,6 +912,177 @@ def crash_phase(ports, procs, checks: list) -> dict:
             "failover_off_truncated": truncated}
 
 
+def _worker_pool_clean_tiered(port: int, timeout_s: float = 30.0):
+    """`_worker_pool_clean` for host-tiered workers: demoted radix nodes
+    hold HOST slots, not device blocks, so the device accounting is
+    free + (radix_nodes - host_used) >= total, and the host tier itself
+    must not hold more slots than it has."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _, health = _call(port, "GET", "/health", timeout=5.0)
+        except OSError:
+            time.sleep(0.3)
+            continue
+        gen = health.get("generator", {})
+        last = gen.get("kv_pool")
+        if gen.get("active") == 0 and last:
+            host = last.get("host") or {}
+            used = host.get("blocks_used", 0)
+            if (last["blocks_free"] + last["radix_nodes"] - used
+                    >= last["blocks_total"]
+                    and used <= host.get("blocks_total", 0)):
+                return last
+        time.sleep(0.3)
+    return None
+
+
+def offload_phase(ports, procs, checks: list) -> dict:
+    """Hierarchical host-tier chaos (--offload): kill -9 a worker that
+    HOLDS DEMOTED BLOCKS while one of its streams is mid-generation.
+    The host tier dies with the process — failover must not depend on
+    it: the PR 6 resume completes byte-identically on another lane, and
+    the survivors leak zero device OR host blocks. Before the kill, the
+    phase also proves the tier's point on the victim itself: churn
+    demotes the shared prefix, and a re-hit SWAPS IT BACK IN (swap_in
+    counters move, prefill tokens are skipped) instead of recomputing."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2,
+                               prefix_affinity=True,
+                               affinity_block_size=16))
+    shared = [(j * 13) % 90 + 1 for j in range(32)]  # two full blocks
+
+    # Affinity makes the victim deterministic: the lane owning the
+    # shared prefix's fingerprint serves every shared-prefix request.
+    fp = gw._affinity_fingerprint({"prompt_tokens": shared})
+    victim_lane = gw._ring.get_node(fp)
+    victim_port = next(p for p in ports
+                       if victim_lane.endswith(f":{p}"))
+    victim_idx = ports.index(victim_port)
+    survivor_ports = [p for p in ports if p != victim_port]
+
+    # Warm every lane, then prime the victim's radix with the prefix.
+    for p in ports:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+    status, prime = _call(
+        victim_port, "POST", "/generate",
+        {"request_id": "prime", "prompt_tokens": shared + [5, 6],
+         "max_new_tokens": 4}, timeout=600)
+    _, health = _call(victim_port, "GET", "/health", timeout=10)
+    pool = health["generator"]["kv_pool"]
+    checks.append(("offload: shared prefix primed on victim",
+                   status == 200 and pool["radix_nodes"] >= 2))
+
+    # Churn the victim's tiny pool with distinct prompts until the
+    # shared prefix (and the fillers') blocks demote to the host tier.
+    rnd = random.Random(3)
+    for i in range(6):
+        filler = [rnd.randrange(1, 200) for _ in range(72)]
+        _call(victim_port, "POST", "/generate",
+              {"request_id": f"churn{i}", "prompt_tokens": filler,
+               "max_new_tokens": 2}, timeout=600)
+    _, health = _call(victim_port, "GET", "/health", timeout=10)
+    pool = health["generator"]["kv_pool"]
+    host = pool.get("host") or {}
+    checks.append(("offload: churn demoted blocks to the host tier "
+                   f"(demotions={host.get('demotions', 0)})",
+                   host.get("demotions", 0) > 0))
+
+    # Re-hit through the gateway — affinity must route it to the victim
+    # (the lane owning the fingerprint), whose demoted prefix must swap
+    # back in, not recompute.
+    hit0, si0 = pool["prefix_hit_tokens"], host.get("swap_ins", 0)
+    rehit = gw.route_generate(
+        {"request_id": "rehit", "prompt_tokens": shared + [9, 9],
+         "max_new_tokens": 4})
+    checks.append(("offload: affinity routed the re-hit to the prefix "
+                   "owner", rehit["node_id"]
+                   == f"w{victim_idx}"))
+    _, health = _call(victim_port, "GET", "/health", timeout=10)
+    pool = health["generator"]["kv_pool"]
+    host = pool.get("host") or {}
+    checks.append(("offload: re-hit swapped in instead of recomputing "
+                   f"(swap_ins {si0}->{host.get('swap_ins', 0)})",
+                   host.get("swap_ins", 0) > si0
+                   and pool["prefix_hit_tokens"] > hit0))
+
+    # Mid-stream kill while the victim holds demoted blocks: long
+    # shared-prefix stream (affinity -> victim) + the kill the moment it
+    # is provably mid-generation; resume must splice byte-identically.
+    req = {"request_id": "offload_stream", "prompt_tokens": shared + [2],
+           "max_new_tokens": 48}
+    control = control_oracle(survivor_ports[0], [req])
+
+    def kill_victim():
+        procs[victim_idx].send_signal(signal.SIGKILL)
+        procs[victim_idx].wait(timeout=10)
+
+    results, killed = drive_streams_with_kill(
+        gw, [req], {req["request_id"]}, kill_victim, random.Random(5))
+    checks.append(("offload: victim (holding demoted blocks) killed "
+                   "mid-stream", killed))
+    toks, final = results[req["request_id"]]
+    identical = (stream_completed(final)
+                 and toks == control[req["request_id"]]
+                 and final.get("tokens") == control[req["request_id"]])
+    checks.append(("offload: stream resumed byte-identically on another "
+                   "lane", identical and bool(final.get("resumed"))))
+
+    # Survivors: fresh availability + zero device/host block leaks.
+    status, _ = _call(survivor_ports[0], "POST", "/generate",
+                      {"request_id": "post", "prompt_tokens": [4, 2],
+                       "max_new_tokens": 4}, timeout=600)
+    checks.append(("offload: post-kill availability", status == 200))
+    leak_free = {}
+    for p in survivor_ports:
+        pool = _worker_pool_clean_tiered(p)
+        leak_free[p] = pool is not None
+        checks.append((f"offload: zero device+host blocks leaked on "
+                       f"survivor :{p}", pool is not None))
+    fo = gw.get_stats().get("failover", {})
+    gw.stop()
+    return {"victim_port": victim_port, "killed": killed,
+            "stream_identical": identical,
+            "resumed": (final or {}).get("resumed", 0),
+            "victim_demotions_at_churn": host.get("demotions", 0),
+            "victim_swap_ins": host.get("swap_ins", 0),
+            "failover": fo, "survivors_leak_free": leak_free}
+
+
+def run_offload_standalone() -> int:
+    ports, procs = launch_worker_procs(
+        3, extra_args=("--kv-blocks", "20", "--kv-host-blocks", "16"))
+    checks: list = []
+    try:
+        report = {"mode": "offload-standalone", "worker_ports": ports,
+                  "phases": {"offload": offload_phase(ports, procs,
+                                                      checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_crash_standalone() -> int:
     ports, procs = launch_worker_procs(3)
     checks: list = []
@@ -987,6 +1160,16 @@ def main() -> int:
                          "every stream completes byte-identical to an "
                          "unkilled control run with zero KV-block leaks "
                          "(see module docstring); ignores the other flags")
+    ap.add_argument("--offload", action="store_true",
+                    help="standalone host-tier offload scenario: spawns "
+                         "three host-tiered worker processes, demotes a "
+                         "shared prefix on the affinity lane, asserts a "
+                         "re-hit SWAPS IN instead of recomputing, then "
+                         "kill -9s that worker (holding demoted blocks) "
+                         "mid-stream and asserts the failover resume "
+                         "completes byte-identically with zero device or "
+                         "host blocks leaked on the survivors; ignores "
+                         "the other flags")
     args = ap.parse_args()
     if args.mixed:
         return run_mixed_standalone()
@@ -994,6 +1177,8 @@ def main() -> int:
         return run_spec_standalone()
     if args.crash:
         return run_crash_standalone()
+    if args.offload:
+        return run_offload_standalone()
     proc = None
     if args.launch:
         args.breaker_timeout = min(args.breaker_timeout, 2.0)

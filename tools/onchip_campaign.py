@@ -363,11 +363,20 @@ def main() -> int:
             prefill_chunk=64 if q else 256, dtype="bfloat16")
         return res
 
+    @stage(artifact, out, "affinity")
+    def _affinity():
+        # Prefix-affinity routing + host KV tier on-chip: the fleet
+        # prefill-skip / TTFT A/B and the demote→swap-in counters
+        # against the real chip (CPU rounds: BENCH_r10_builder.json —
+        # convergence ratios are workload properties, but the TTFT win
+        # and swap-in-vs-recompute margin are device properties).
+        return bench.run_affinity_ab(model=model, quick=bool(q))
+
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
                _decode_int8, _flash, _flash_tiling, _paged, _mixed,
-               _spec_cont, _spec,
+               _spec_cont, _spec, _affinity,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

@@ -40,6 +40,22 @@ vLLM-style layout, kept TPU-native:
   have refcount 1 (tree-only) are evicted until enough blocks free. A
   block referenced by any live row is structurally unevictable — its
   refcount is ≥ 2 while a tree node points at it.
+- **Hierarchical host tier** (``host_blocks`` > 0): instead of
+  destroying a cold radix leaf, eviction DEMOTES its block to a pinned
+  host-RAM buffer — the node stays in the tree, keyed and matchable,
+  holding a host slot instead of a device block. A later radix hit on a
+  demoted node SWAPS the block back in (one jitted host→device write,
+  dispatched asynchronously on the prefill thread) instead of
+  recomputing that prefix's prefill. Promotion takes free blocks first
+  and may DISPLACE LRU-colder resident leaves (demoting them to this
+  same tier — the just-requested prefix is hotter by definition, and no
+  cached state is destroyed while the tier has room), but must always
+  leave ``promote_reserve`` free blocks behind (live-row growth
+  outranks resurrection of cold prefixes); when the reserve cannot be
+  met the lookup simply stops at the resident prefix (counted
+  ``swap_in_deferred``). A full host tier makes room by destroying its
+  own LRU demoted leaves. The copies are verbatim dtype-preserving
+  moves, so a demote/promote round trip is bit-exact.
 
 `runtime.scheduler.ContinuousGenerator(kv_block_size=...)` drives this;
 `ops.paged_attention` is the matching attention read path.
@@ -66,14 +82,20 @@ class PoolExhausted(RuntimeError):
 
 
 class _RadixNode:
-    __slots__ = ("children", "parent", "key", "block_id", "last_used")
+    __slots__ = ("children", "parent", "key", "block_id", "last_used",
+                 "host_slot")
 
     def __init__(self, parent: Optional["_RadixNode"], key, block_id: int):
         self.children: Dict[tuple, _RadixNode] = {}
         self.parent = parent
         self.key = key            # the block's token tuple (len block_size)
-        self.block_id = block_id  # -1 on the root only
+        self.block_id = block_id  # -1: root, or a DEMOTED node (host tier)
         self.last_used = 0
+        self.host_slot = -1       # >= 0 while demoted to the host tier
+
+    @property
+    def demoted(self) -> bool:
+        return self.host_slot >= 0
 
 
 class RadixTree:
@@ -97,22 +119,47 @@ class RadixTree:
         return [tuple(tokens[i:i + bs])
                 for i in range(0, (len(tokens) // bs) * bs, bs)]
 
-    def lookup(self, tokens: Sequence[int]) -> List[int]:
+    def lookup(self, tokens: Sequence[int],
+               promote_reserve: Optional[int] = None) -> List[int]:
         """Longest-prefix match over full blocks. Returns the matched
         block ids IN ORDER, each retained once on behalf of the caller
         (release them when the row frees — or immediately on a discarded
-        admission)."""
+        admission).
+
+        ``promote_reserve``: when not None, a match reaching a DEMOTED
+        node (host tier) swaps its block back onto the device instead of
+        treating it as a miss — displacing LRU-colder resident leaves if
+        the free list is short, provided the pool keeps at least that
+        many free blocks after the promotion (live-row growth must never
+        be starved by cold-prefix resurrection; a refused promotion ends
+        the match at the resident prefix and counts
+        ``swap_in_deferred``). None (default) never promotes — direct
+        callers and the sharing-off path keep the pre-tier behavior."""
+        pool = self._pool
+        pool.radix_lookups += 1
         ids: List[int] = []
         node = self.root
         stamp = self._tick()
+        promoted = 0
         for key in self._full_blocks(tokens):
             child = node.children.get(key)
             if child is None:
                 break
+            if child.demoted:
+                if promote_reserve is None or not pool._promote_node(
+                        child, promote_reserve):
+                    if promote_reserve is not None:
+                        pool.swap_in_deferred += 1
+                    break
+                promoted += 1
             child.last_used = stamp
-            self._pool.retain(child.block_id)
+            pool.retain(child.block_id)
             ids.append(child.block_id)
             node = child
+        if promoted:
+            pool.swap_in_events += 1
+        if ids:
+            pool.radix_hits += 1
         return ids
 
     def insert(self, tokens: Sequence[int], block_ids: Sequence[int]) -> int:
@@ -120,7 +167,11 @@ class RadixTree:
         block holding prompt block j (the row's page-table prefix). New
         nodes retain their block (the tree's own reference); existing
         nodes are left pointing at their original block — the newcomer's
-        duplicate block simply stays row-private. Returns nodes added."""
+        duplicate block simply stays row-private. A DEMOTED node is
+        re-adopted instead: the newcomer's block holds exactly these
+        tokens' freshly recomputed KV, so the node points at it and its
+        host slot frees (the device copy is strictly better — no swap-in
+        needed on the next hit). Returns nodes added."""
         added = 0
         node = self.root
         stamp = self._tick()
@@ -132,25 +183,48 @@ class RadixTree:
                 self._pool.retain(child.block_id)
                 self.nodes += 1
                 added += 1
+            elif child.demoted:
+                self._pool._host_free.append(child.host_slot)
+                child.host_slot = -1
+                child.block_id = int(block_ids[j])
+                self._pool.retain(child.block_id)
             child.last_used = stamp
             node = child
         return added
 
     def _evictable(self) -> List[_RadixNode]:
+        """Nodes whose DEVICE block the tree alone references and whose
+        children (if any) are all demoted — the device-resident frontier
+        of each branch, so demotion can proceed root-ward leaf by leaf."""
         out, stack = [], [self.root]
         while stack:
             n = stack.pop()
             for c in n.children.values():
                 if c.children:
                     stack.append(c)
-                elif self._pool.refcount(c.block_id) == 1:
-                    out.append(c)  # leaf, tree-only reference
+                if c.demoted:
+                    continue
+                if (all(g.demoted for g in c.children.values())
+                        and self._pool.refcount(c.block_id) == 1):
+                    out.append(c)  # device frontier, tree-only reference
+        return out
+
+    def _demoted_leaves(self) -> List[_RadixNode]:
+        out, stack = [], [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                if c.children:
+                    stack.append(c)
+                elif c.demoted:
+                    out.append(c)
         return out
 
     def evict(self, n_blocks: int) -> int:
-        """Free up to ``n_blocks`` pool blocks by dropping LRU leaves
-        whose blocks nothing but the tree references. Never touches a
-        block a live row holds (refcount ≥ 2). Returns blocks freed."""
+        """Free up to ``n_blocks`` pool blocks by demoting (host tier
+        configured) or dropping LRU leaves whose blocks nothing but the
+        tree references. Never touches a block a live row OR a pinned
+        lookup holds (refcount ≥ 2). Returns device blocks freed."""
         freed = 0
         while freed < n_blocks:
             leaves = self._evictable()
@@ -160,6 +234,9 @@ class RadixTree:
             for leaf in leaves:
                 if freed >= n_blocks:
                     break
+                if self._pool._demote_leaf(leaf):
+                    freed += 1  # node survives in the tree, demoted
+                    continue
                 del leaf.parent.children[leaf.key]
                 self._pool.release(leaf.block_id)
                 self.nodes -= 1
@@ -169,13 +246,18 @@ class RadixTree:
 
     def clear(self) -> None:
         """Drop every node (weight reload: cached KV is stale). Blocks
-        still referenced by live rows survive until those rows free."""
+        still referenced by live rows survive until those rows free;
+        demoted nodes' host slots free immediately (stale KV)."""
         stack = [self.root]
         while stack:
             n = stack.pop()
             for c in n.children.values():
                 stack.append(c)
-                self._pool.release(c.block_id)
+                if c.demoted:
+                    self._pool._host_free.append(c.host_slot)
+                    c.host_slot = -1
+                else:
+                    self._pool.release(c.block_id)
         self.root = _RadixNode(None, None, -1)
         self.nodes = 0
 
@@ -184,7 +266,8 @@ class BlockPool:
     """Device block pool + host bookkeeping for the paged KV cache."""
 
     def __init__(self, cfg: TransformerConfig, num_blocks: int,
-                 block_size: int, dtype=jnp.bfloat16, device=None):
+                 block_size: int, dtype=jnp.bfloat16, device=None,
+                 host_blocks: int = 0):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
         self.cfg = cfg
@@ -205,11 +288,34 @@ class BlockPool:
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self.radix = RadixTree(self)
         self._copy_exe = None
-        # Counters for /stats, /metrics, and the paged-ab bench.
+        self._promote_exe = None
+        # Hierarchical host tier (module docstring): pinned host buffers
+        # for demoted radix blocks. Dtype matches the device pool exactly
+        # so a demote/promote round trip is bit-identical.
+        self.host_blocks = int(host_blocks)
+        self._host_k = self._host_v = None
+        self._host_free: List[int] = []
+        self._promoting: Optional[_RadixNode] = None
+        if self.host_blocks > 0:
+            hshape = (self.host_blocks, cfg.n_layers, self.block_size,
+                      cfg.kv_heads, cfg.d_head)
+            hdtype = jnp.zeros((), dtype).dtype  # numpy-compatible dtype
+            self._host_k = np.zeros(hshape, hdtype)
+            self._host_v = np.zeros(hshape, hdtype)
+            self._host_free = list(range(self.host_blocks - 1, -1, -1))
+        # Counters for /stats, /metrics, and the paged/affinity benches.
         self.prefix_hit_tokens = 0
         self.prefilled_tokens = 0
         self.evictions = 0
         self.cow_copies = 0
+        self.radix_lookups = 0
+        self.radix_hits = 0
+        self.demotions = 0
+        self.swap_ins = 0          # blocks promoted host -> device
+        self.swap_in_events = 0    # lookups that promoted >= 1 block
+        self.swap_in_deferred = 0  # promotions refused by the reserve rule
+        self.host_evictions = 0    # demoted leaves destroyed (tier full)
+        self.swapped_in_tokens = 0
 
     def _init_device(self) -> KVCache:
         shape = (self.cfg.n_layers, self.num_blocks, self.block_size,
@@ -309,22 +415,114 @@ class BlockPool:
         self.cow_copies += 1
         return new_id, True
 
+    # -- host tier (hold self.lock) -------------------------------------------
+
+    def _demote_leaf(self, leaf: "_RadixNode") -> bool:
+        """Move a tree-only leaf's block to the host tier instead of
+        destroying it: copy device→host (verbatim, dtype-preserving),
+        free the device block, mark the node demoted. A full tier first
+        destroys its own LRU demoted leaf to make room; still no room
+        (tier disabled) → False, and the caller falls back to the
+        destroy path. The device reads happen under the pool lock, so
+        they order after every donation that produced the block."""
+        if self.host_blocks <= 0:
+            return False
+        if not self._host_free:
+            victims = [v for v in self.radix._demoted_leaves()
+                       if v is not self._promoting]
+            if not victims:
+                return False  # demoted interior nodes only: can't destroy
+            victims.sort(key=lambda n: n.last_used)
+            v = victims[0]
+            del v.parent.children[v.key]
+            self._host_free.append(v.host_slot)
+            v.host_slot = -1
+            self.radix.nodes -= 1
+            self.host_evictions += 1
+        slot = self._host_free.pop()
+        bid = leaf.block_id
+        self._host_k[slot] = np.asarray(jax.device_get(self.caches.k[:, bid]))
+        self._host_v[slot] = np.asarray(jax.device_get(self.caches.v[:, bid]))
+        self.release(bid)
+        leaf.block_id = -1
+        leaf.host_slot = slot
+        self.demotions += 1
+        return True
+
+    def _promote_node(self, node: "_RadixNode", reserve: int) -> bool:
+        """Swap a demoted node's block back onto the device, then one
+        jitted host→device block write (dispatched asynchronously; the
+        pool lock orders it against decode-chunk donations exactly like
+        a prefix gather). Block sourcing, in order: the free list, then
+        DISPLACING LRU-colder resident leaves (evict() — which demotes
+        them to this same tier, so no cached state is destroyed while
+        the tier has room; the node being promoted was just requested,
+        so it is by definition hotter than an LRU victim). Either way at
+        least ``reserve`` free blocks must remain afterwards — live
+        rows' growth and admissions outrank resurrecting a cold prefix
+        (the pool-pressure rule the offload tests pin) — else the
+        promotion defers and the caller's match ends at the resident
+        prefix."""
+        need = 1 + max(0, int(reserve))
+        if len(self._free) < need:
+            # The walked chain's nodes are pinned (refcount >= 2), so
+            # displacement can never take a block this lookup relies on;
+            # the node being promoted is freshly stamped and shielded
+            # (_promoting) so a host-full displacement can't destroy it.
+            node.last_used = self.radix._tick()
+            self._promoting = node
+            try:
+                self.radix.evict(need - len(self._free))
+            finally:
+                self._promoting = None
+        if len(self._free) < need:
+            return False
+        if self._promote_exe is None:
+            def promote_block(caches, hk, hv, dst):
+                return KVCache(
+                    jax.lax.dynamic_update_slice_in_dim(
+                        caches.k, hk[None].swapaxes(0, 1), dst, axis=1),
+                    jax.lax.dynamic_update_slice_in_dim(
+                        caches.v, hv[None].swapaxes(0, 1), dst, axis=1))
+
+            self._promote_exe = jax.jit(promote_block, donate_argnums=(0,))
+        bid = self._free.pop()
+        self._ref[bid] = 1  # the tree's own reference
+        hk = jnp.asarray(self._host_k[node.host_slot])
+        hv = jnp.asarray(self._host_v[node.host_slot])
+        if self._device is not None:
+            hk = jax.device_put(hk, self._device)
+            hv = jax.device_put(hv, self._device)
+        self.caches = self._promote_exe(self.caches, hk, hv,
+                                        jnp.int32(bid))
+        self._host_free.append(node.host_slot)
+        node.host_slot = -1
+        node.block_id = bid
+        self.swap_ins += 1
+        self.swapped_in_tokens += self.block_size
+        return True
+
     def reset(self) -> None:
         """Post-device-failure recovery: the donated pool buffers may be
         invalid — rebuild everything (mirrors the dense scheduler's
-        `_recover`)."""
+        `_recover`). The host tier empties too: its blocks are only
+        meaningful as radix entries, and the tree died with the pool —
+        pins and page tables taken against the old generation are void
+        (holders compare ``generation``, never release stale ids)."""
         self.generation += 1
         self.caches = self._init_device()
         self._ref[:] = 0
         self._ref[0] = 1
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self.radix = RadixTree(self)
+        if self.host_blocks > 0:
+            self._host_free = list(range(self.host_blocks - 1, -1, -1))
 
     def stats(self) -> dict:
         with self.lock:
             shared = int(np.sum(self._ref[1:] > 1))
             hit, filled = self.prefix_hit_tokens, self.prefilled_tokens
-            return {
+            out = {
                 "blocks_total": self.num_blocks - 1,  # null excluded
                 "block_size": self.block_size,
                 "blocks_free": len(self._free),
@@ -336,7 +534,23 @@ class BlockPool:
                 "prefilled_tokens": filled,
                 "prefix_savings_frac": round(hit / (hit + filled), 4)
                 if hit + filled else 0.0,
+                # Per-lane radix effectiveness (the affinity bench's and
+                # the gateway /stats blind-spot fix's raw numbers).
+                "radix_lookups": self.radix_lookups,
+                "radix_hits": self.radix_hits,
             }
+            if self.host_blocks > 0:
+                out["host"] = {
+                    "blocks_total": self.host_blocks,
+                    "blocks_used": self.host_blocks - len(self._host_free),
+                    "demotions": self.demotions,
+                    "swap_ins": self.swap_ins,
+                    "swap_in_events": self.swap_in_events,
+                    "swap_in_deferred": self.swap_in_deferred,
+                    "host_evictions": self.host_evictions,
+                    "swapped_in_tokens": self.swapped_in_tokens,
+                }
+            return out
 
 
 # -- device-side block movement (jitted by the scheduler per bucket) ----------

@@ -191,6 +191,7 @@ class ContinuousGenerator:
         prefill_chunk: int = 256,
         kv_block_size: int = 0,
         kv_blocks: int = 0,
+        kv_host_blocks: int = 0,
         prefix_sharing: bool = True,
         mixed_step: bool = False,
         mixed_token_budget: int = 0,
@@ -208,6 +209,18 @@ class ContinuousGenerator:
         resumes prefill mid-prompt. 0 (default) keeps the dense cache:
         behavior, compiled executables, and streams are exactly the
         pre-paging scheduler's.
+
+        `kv_host_blocks` > 0 (paged mode with prefix sharing) adds the
+        HIERARCHICAL HOST TIER under the device pool: LRU eviction
+        demotes cold radix leaves' blocks to pinned host buffers instead
+        of destroying them, and a radix hit on a demoted prefix swaps
+        the blocks back in on the prefill thread (overlapped with batch
+        formation) instead of recomputing that prefill. Promotion never
+        starves live rows: it takes free blocks first, may displace
+        LRU-colder resident leaves (demoted, not destroyed), and must
+        leave one free block per active row after the swap-in, else the
+        lookup stops at the resident prefix and the tail recomputes
+        (counted ``swap_in_deferred``).
 
         `mixed_step` (paged mode only) merges the prefill and decode
         paths into a single token-budgeted mixed step: each tick forms
@@ -275,6 +288,9 @@ class ContinuousGenerator:
         # per-row block tables (runtime.kv_blocks); everything else —
         # row vectors, sampling, admission — is layout-independent.
         self._paged = int(kv_block_size) > 0
+        if int(kv_host_blocks) > 0 and not self._paged:
+            raise ValueError("kv_host_blocks requires the paged KV cache "
+                             "(set kv_block_size > 0)")
         self._caches = None
         self._pool: Optional[BlockPool] = None
         if self._paged:
@@ -294,7 +310,11 @@ class ContinuousGenerator:
                 raise ValueError(
                     f"kv_blocks={nb} cannot hold even one max_seq row "
                     f"({width} blocks + the null block)")
-            self._pool = BlockPool(self.cfg, nb, bs, self._dtype, device)
+            if int(kv_host_blocks) > 0 and not prefix_sharing:
+                raise ValueError("kv_host_blocks requires prefix_sharing "
+                                 "(the host tier holds radix entries)")
+            self._pool = BlockPool(self.cfg, nb, bs, self._dtype, device,
+                                   host_blocks=int(kv_host_blocks))
             self._tables = np.zeros((self.n_slots, width), np.int32)
             self._row_blocks: List[List[int]] = [[] for _ in
                                                  range(self.n_slots)]
@@ -1178,6 +1198,27 @@ class ContinuousGenerator:
             row_counts[0, first_tok] += 1  # first token joins the context
         return first_tok, row_counts
 
+    def _promote_reserve(self) -> int:
+        """Free blocks a host-tier promotion must leave behind: one per
+        live row, so swapping a cold prefix back in can never starve the
+        next tick's live-row block growth (or push rows into
+        pool_starved early completion). Read without the pool lock —
+        a ±1-row-stale reserve only shifts WHEN a promotion defers,
+        never correctness."""
+        return sum(1 for r in self._row_req if r is not None)
+
+    def _record_swap_in(self, req: _Request, swapped: int,
+                        t0: float) -> None:
+        """One ``swap_in`` stage span per lookup that promoted demoted
+        blocks — the trace-side proof a radix hit on the host tier was
+        served by a swap-in, not a recompute (fault_injection --offload
+        and the affinity bench read the matching pool counters)."""
+        if swapped and req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("swap_in", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           blocks=swapped)
+
     def _run_prefill_paged(self, req: _Request):
         """Paged admission prefill: 0-aligned (RIGHT-padded) row cache,
         radix longest-prefix match, prefill resumed mid-prompt past the
@@ -1195,12 +1236,17 @@ class ContinuousGenerator:
         tokens = right_pad_prompt(prompt, pb)
 
         matched: List[int] = []
+        swapped = 0
         t0 = time.perf_counter()
         with pool.lock:
             gen = pool.generation
             if self._prefix_sharing:
-                matched = pool.radix.lookup(prompt)  # pins for this row
+                si0 = pool.swap_ins
+                matched = pool.radix.lookup(          # pins for this row
+                    prompt, promote_reserve=self._promote_reserve())
+                swapped = pool.swap_ins - si0
         m_tok = len(matched) * bs
+        self._record_swap_in(req, swapped, t0)
         try:
             if matched:
                 # The gather IS the row cache init on a hit: matched
@@ -1276,11 +1322,16 @@ class ContinuousGenerator:
         prompt = req.prompt[-pb:]
         L = len(prompt)
         matched: List[int] = []
+        swapped = 0
         t0 = time.perf_counter()
         with pool.lock:
             gen = pool.generation
             if self._prefix_sharing:
-                matched = pool.radix.lookup(prompt)  # pins for this row
+                si0 = pool.swap_ins
+                matched = pool.radix.lookup(          # pins for this row
+                    prompt, promote_reserve=self._promote_reserve())
+                swapped = pool.swap_ins - si0
+        self._record_swap_in(req, swapped, t0)
         if req.sink is not None:
             dur_us = (time.perf_counter() - t0) * 1e6
             req.sink.stage("radix_lookup", dur_us,

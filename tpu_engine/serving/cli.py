@@ -80,7 +80,8 @@ def main(argv=None) -> int:
 
         if not rest:
             print("Usage: worker_node <port> <node_id> [model_path] "
-                  "[--kv-block-size N] [--kv-blocks N] [--step-chunk N] "
+                  "[--kv-block-size N] [--kv-blocks N] "
+                  "[--kv-host-blocks N] [--step-chunk N] "
                   "[--prefill-chunk N] [--scheduler-stall-s S]")
             return 1
         parser = argparse.ArgumentParser(prog="worker_node")
@@ -95,6 +96,12 @@ def main(argv=None) -> int:
                             help="paged KV block size (0/unset = dense)")
         parser.add_argument("--kv-blocks", type=int, default=None,
                             help="paged KV pool size in blocks (0 = auto)")
+        parser.add_argument("--kv-host-blocks", type=int, default=None,
+                            help="hierarchical host-RAM KV tier: demote "
+                                 "cold radix prefixes to this many pinned "
+                                 "host blocks and swap them back in on a "
+                                 "radix hit instead of recomputing "
+                                 "(0/unset = off)")
         parser.add_argument("--step-chunk", type=int, default=None,
                             help="decode chunk length per dispatch")
         parser.add_argument("--prefill-chunk", type=int, default=None,
@@ -135,6 +142,8 @@ def main(argv=None) -> int:
             gen_kw["gen_kv_block_size"] = args.kv_block_size
         if args.kv_blocks is not None:
             gen_kw["gen_kv_blocks"] = args.kv_blocks
+        if args.kv_host_blocks is not None:
+            gen_kw["gen_kv_host_blocks"] = args.kv_host_blocks
         if args.step_chunk is not None:
             gen_kw["gen_step_chunk"] = args.step_chunk
         if args.prefill_chunk is not None:
@@ -180,10 +189,39 @@ def main(argv=None) -> int:
                                  "(stream resumes included) capped at this "
                                  "fraction of recent requests "
                                  "(default: unlimited)")
+        parser.add_argument("--prefix-affinity", action="store_true",
+                            help="route /generate(+/stream) on a "
+                                 "block-aligned prompt-prefix fingerprint "
+                                 "instead of request_id: shared prefixes "
+                                 "converge on the lane whose radix tree "
+                                 "already holds the KV blocks (ring-order "
+                                 "fallback under ejection/imbalance)")
+        parser.add_argument("--affinity-block-size", type=int, default=None,
+                            help="fingerprint block granularity — MUST "
+                                 "match the workers' --kv-block-size "
+                                 "(default 16)")
+        parser.add_argument("--affinity-prefix-blocks", type=int,
+                            default=None,
+                            help="leading blocks the fingerprint covers "
+                                 "(default 4)")
+        parser.add_argument("--affinity-max-imbalance", type=int,
+                            default=None,
+                            help="skip the affinity lane (ring order) once "
+                                 "it is this many recent dispatches hotter "
+                                 "than its least-loaded peer (0 = always "
+                                 "honor affinity)")
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.retry_budget is not None:
             gw_kw["retry_budget_ratio"] = args.retry_budget
+        if args.prefix_affinity:
+            gw_kw["prefix_affinity"] = True
+        if args.affinity_block_size is not None:
+            gw_kw["affinity_block_size"] = args.affinity_block_size
+        if args.affinity_prefix_blocks is not None:
+            gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
+        if args.affinity_max_imbalance is not None:
+            gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
         gw, server = serve_gateway(
             args.workers,
             GatewayConfig(port=args.port,
@@ -338,6 +376,38 @@ def main(argv=None) -> int:
         parser.add_argument("--kv-blocks", type=int, default=0,
                             help="paged pool size in blocks (0 = auto: "
                                  "the dense layout's capacity)")
+        parser.add_argument("--kv-host-blocks", type=int, default=0,
+                            help="hierarchical host-RAM KV tier (needs "
+                                 "--kv-block-size + prefix sharing): LRU "
+                                 "eviction demotes cold radix prefixes to "
+                                 "this many pinned host-RAM blocks, and a "
+                                 "radix hit on a demoted prefix swaps the "
+                                 "blocks back in asynchronously instead "
+                                 "of recomputing its prefill — host RAM "
+                                 "becomes prefix-cache capacity "
+                                 "(bench.py --scenario affinity-ab). "
+                                 "0 = off")
+        parser.add_argument("--prefix-affinity", action="store_true",
+                            help="gateway: route /generate(+/stream) on a "
+                                 "block-aligned prompt-prefix fingerprint "
+                                 "instead of request_id so shared prefixes "
+                                 "converge on the lane whose radix tree "
+                                 "already holds the blocks; falls back to "
+                                 "ring order when the affinity lane is "
+                                 "ejected, broken, or imbalanced")
+        parser.add_argument("--affinity-block-size", type=int, default=None,
+                            help="fingerprint block granularity (defaults "
+                                 "to --kv-block-size when paged, else 16)")
+        parser.add_argument("--affinity-prefix-blocks", type=int,
+                            default=None,
+                            help="leading blocks the fingerprint covers "
+                                 "(default 4)")
+        parser.add_argument("--affinity-max-imbalance", type=int,
+                            default=None,
+                            help="skip the affinity lane (ring order) once "
+                                 "it is this many recent dispatches hotter "
+                                 "than its least-loaded ring peer "
+                                 "(default 0 = always honor affinity)")
         parser.add_argument("--prefix-sharing", choices=["on", "off"],
                             default="on",
                             help="block-level radix prefix sharing (paged "
@@ -399,6 +469,19 @@ def main(argv=None) -> int:
             gw_kw["failover_streams"] = True
         if args.health_probe_interval is not None:
             gw_kw["health_probe_interval_s"] = args.health_probe_interval
+        if args.prefix_affinity:
+            gw_kw["prefix_affinity"] = True
+            # Fingerprint granularity defaults to the lanes' actual block
+            # size — a mismatched pair would converge requests that share
+            # no reusable blocks (or scatter ones that do).
+            if args.affinity_block_size is not None:
+                gw_kw["affinity_block_size"] = args.affinity_block_size
+            elif args.kv_block_size > 0:
+                gw_kw["affinity_block_size"] = args.kv_block_size
+            if args.affinity_prefix_blocks is not None:
+                gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
+            if args.affinity_max_imbalance is not None:
+                gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
         gateway_config = None
         if gw_kw:
             from tpu_engine.utils.config import GatewayConfig
@@ -437,6 +520,7 @@ def main(argv=None) -> int:
                                      gen_prefill_chunk=args.gen_prefill_chunk,
                                      gen_kv_block_size=args.kv_block_size,
                                      gen_kv_blocks=args.kv_blocks,
+                                     gen_kv_host_blocks=args.kv_host_blocks,
                                      gen_prefix_sharing=(
                                          args.prefix_sharing == "on"),
                                      gen_mixed_step=args.mixed_step,

@@ -38,6 +38,7 @@ Improvements over the reference (documented, not silent):
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import json
 import threading
@@ -54,6 +55,7 @@ from tpu_engine.serving.clients import (
 )
 from tpu_engine.serving.http import sse_event
 from tpu_engine.serving.resilience import (
+    AffinityCounters,
     FailoverCounters,
     LatencyTracker,
     ProbeStateMachine,
@@ -179,6 +181,12 @@ class Gateway:
         # "Crash-tolerant streaming"): stream-resume and prober decisions
         # counted here, lanes the prober ejected excluded from dispatch.
         self.failover = FailoverCounters()
+        # Prefix-affinity routing (DESIGN.md "Prefix-affinity routing"):
+        # decisions counted here; per-lane assignment totals and the
+        # recent-dispatch window (imbalance signal) under self._lock.
+        self.affinity = AffinityCounters()
+        self._affinity_assigned: Dict[str, int] = {}
+        self._lane_recent: Dict[str, object] = {}  # lane -> deque[ts]
         self._ejected: set = set()
         self._probe_state = ProbeStateMachine(
             self.config.health_probe_failures)
@@ -342,6 +350,7 @@ class Gateway:
             self._clients.pop(name, None)
             self._breakers.pop(name, None)
             self._latency.pop(name, None)  # stale window must not feed thresholds
+            self._lane_recent.pop(name, None)
             self._untyped.discard(name)
             self._ejected.discard(name)
         # A later lane reusing the name must start with clean probe state.
@@ -678,6 +687,119 @@ class Gateway:
                                   "ok", lane)
         return spliced()
 
+    # -- prefix-affinity routing ----------------------------------------------
+
+    def _affinity_fingerprint(self, payload: dict) -> Optional[str]:
+        """Block-aligned fingerprint of the prompt's leading tokens:
+        floor(len/affinity_block_size) full blocks, capped at
+        affinity_prefix_blocks — the exact granularity the workers'
+        radix trees share at, so two requests with equal fingerprints
+        have reusable KV blocks in common. None when the prompt has no
+        full block (or is malformed — the normal path will 400 it)."""
+        toks = payload.get("prompt_tokens")
+        if not isinstance(toks, (list, tuple)):
+            return None
+        cfg = self.config
+        bs = max(1, int(cfg.affinity_block_size))
+        n = min((len(toks) // bs) * bs,
+                bs * max(1, int(cfg.affinity_prefix_blocks)))
+        if n <= 0:
+            return None
+        try:
+            return "prefix:" + ",".join(str(int(t)) for t in toks[:n])
+        except (TypeError, ValueError):
+            return None
+
+    def _count_lane_dispatch(self, lane: str) -> None:
+        """Stamp one generate-class dispatch on the lane's recent-window
+        deque — the load signal the imbalance fallback compares. Only
+        kept while that fallback is configured (the sole reader), and
+        trimmed on write so a long-lived gateway never accumulates
+        beyond one window of timestamps per lane."""
+        if int(self.config.affinity_max_imbalance) <= 0:
+            return
+        now = time.monotonic()
+        horizon = now - self.config.affinity_window_s
+        with self._lock:
+            dq = self._lane_recent.get(lane)
+            if dq is None:
+                dq = self._lane_recent[lane] = collections.deque()
+            while dq and dq[0] < horizon:
+                dq.popleft()
+            dq.append(now)
+
+    def _recent_dispatches(self, lanes) -> Dict[str, int]:
+        horizon = time.monotonic() - self.config.affinity_window_s
+        out = {}
+        with self._lock:
+            for lane in lanes:
+                dq = self._lane_recent.get(lane)
+                while dq and dq[0] < horizon:
+                    dq.popleft()
+                out[lane] = len(dq) if dq else 0
+        return out
+
+    def _affinity_count(self, trace: Optional[_RouteTrace], decision: str,
+                        lane: Optional[str] = None) -> None:
+        """Bump an affinity counter AND drop a zero-duration ``affinity``
+        marker span under the request's route span (same counters==spans
+        discipline as the resilience markers)."""
+        self.affinity.bump(decision)
+        if trace is not None:
+            child = trace.ctx.child()
+            attrs = {"decision": decision}
+            if lane is not None:
+                attrs["lane"] = lane
+            self.tracer.record(
+                trace.request_id, "affinity", "gateway", 0,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=trace.ctx.span_id, start_ts=time.time(),
+                attrs=attrs)
+
+    def _affinity_primary(self, ring, ring_primary: str, payload: dict,
+                          skip: tuple,
+                          trace: Optional[_RouteTrace]) -> str:
+        """The affinity half of primary selection: route generate-class
+        requests to the lane owning the prompt-prefix fingerprint so
+        shared prefixes converge where the KV blocks already live.
+        Falls back to ``ring_primary`` (the request_id ring — the exact
+        pre-affinity behavior, failover machinery unchanged) when there
+        is nothing to fingerprint, the affinity lane is skipped (a
+        resume off a dead lane), ejected by the prober, refused by its
+        breaker, or running hotter than its least-loaded ring peer by
+        more than affinity_max_imbalance recent dispatches."""
+        fp = self._affinity_fingerprint(payload)
+        if fp is None:
+            self._affinity_count(trace, "no_fingerprint")
+            return ring_primary
+        try:
+            lane = ring.get_node(fp)
+        except RuntimeError:
+            return ring_primary
+        if skip and lane in skip:
+            # Stream resume: the affinity lane just died mid-stream —
+            # ring order takes over (the skip branch of _route_inner).
+            self._affinity_count(trace, "resume_skips", lane=lane)
+            return ring_primary
+        with self._lock:
+            ejected = lane in self._ejected
+            breaker = self._breakers.get(lane)
+        if ejected or breaker is None or not breaker.allow_request():
+            self._affinity_count(trace, "ejected_fallbacks", lane=lane)
+            return ring_primary
+        imb = int(self.config.affinity_max_imbalance)
+        if imb > 0 and lane != ring_primary:
+            recent = self._recent_dispatches(ring.get_all_nodes())
+            if recent.get(lane, 0) - min(recent.values()) >= imb:
+                self._affinity_count(trace, "imbalance_fallbacks",
+                                     lane=lane)
+                return ring_primary
+        self._affinity_count(trace, "affinity_routed", lane=lane)
+        with self._lock:
+            self._affinity_assigned[lane] = (
+                self._affinity_assigned.get(lane, 0) + 1)
+        return lane
+
     def _route(self, payload: dict, op: str, skip: tuple = (),
                out_info: Optional[dict] = None) -> dict:
         """``skip``: lanes excluded from dispatch for this route (the
@@ -776,6 +898,10 @@ class Gateway:
             primary = ring.get_node(request_id)
         except RuntimeError:  # every lane of this model was removed
             raise GatewayError(f"no workers available for model '{mdl}'")
+        if (self.config.prefix_affinity
+                and op in ("generate", "generate_stream")):
+            primary = self._affinity_primary(ring, primary, payload,
+                                             skip, trace)
 
         if skip and primary in skip:
             # The resume path excludes the lane that just failed its
@@ -1147,6 +1273,9 @@ class Gateway:
             response = getattr(client, op)(payload)
             breaker.record_success()
             outcome = "ok"
+            if (self.config.prefix_affinity
+                    and op in ("generate", "generate_stream")):
+                self._count_lane_dispatch(node)
             if out_info is not None:
                 out_info["lane"] = node
             return response
@@ -1233,4 +1362,11 @@ class Gateway:
             fo = self.failover.as_dict()
             fo["ejected_lanes"] = self.ejected_lanes()
             out["failover"] = fo
+        # Additive "affinity" block (prefix-affinity routing), same
+        # gating discipline: a defaults-only /stats stays byte-identical.
+        if self.config.prefix_affinity or self.affinity.any_nonzero():
+            aff = self.affinity.as_dict()
+            with self._lock:
+                aff["assigned"] = dict(self._affinity_assigned)
+            out["affinity"] = aff
         return out

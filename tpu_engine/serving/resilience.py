@@ -194,6 +194,21 @@ class FailoverCounters(ResilienceCounters):
               "prober_restores")
 
 
+class AffinityCounters(ResilienceCounters):
+    """Every prefix-affinity routing decision, counted — the additive
+    ``/stats`` ``affinity`` block and the ``tpu_engine_affinity_*``
+    Prometheus family. ``affinity_routed`` dispatches went to the lane
+    owning the prompt-prefix fingerprint; the ``*_fallbacks`` fields say
+    why a request took ring order instead (the pre-affinity behavior):
+    no block-aligned prefix to fingerprint, the affinity lane was
+    ejected/broken, it was already running hotter than its ring peers
+    by more than ``affinity_max_imbalance`` recent dispatches, or a
+    stream resume just watched it die (``resume_skips``)."""
+
+    FIELDS = ("affinity_routed", "no_fingerprint", "ejected_fallbacks",
+              "imbalance_fallbacks", "resume_skips")
+
+
 class ProbeStateMachine:
     """Per-lane eject/restore state from a stream of probe outcomes:
     ``fail_threshold`` CONSECUTIVE failures eject a lane (once — repeat

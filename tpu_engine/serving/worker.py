@@ -293,6 +293,17 @@ class WorkerNode:
                 f"--spec-k requires gen_scheduler=continuous, got "
                 f"{self.config.gen_scheduler!r} (batch-lane speculation "
                 f"is gen_scheduler=speculative)")
+        if self.config.gen_kv_host_blocks > 0 and (
+                not self._continuous
+                or self.config.gen_kv_block_size <= 0
+                or not self.config.gen_prefix_sharing):
+            # Loud, not the silent "this model can't generate" fallback:
+            # an operator who asked for the host KV tier must never get a
+            # lane that quietly recomputes every evicted prefix instead.
+            raise RuntimeError(
+                "--kv-host-blocks requires the continuous scheduler with "
+                "the paged KV cache and prefix sharing on "
+                "(--kv-block-size > 0, --prefix-sharing on)")
         if getattr(self.engine.spec, "config", None) is not None:
             try:
                 if self._speculative:
@@ -324,6 +335,7 @@ class WorkerNode:
                         prefill_chunk=self.config.gen_prefill_chunk,
                         kv_block_size=self.config.gen_kv_block_size,
                         kv_blocks=self.config.gen_kv_blocks,
+                        kv_host_blocks=self.config.gen_kv_host_blocks,
                         prefix_sharing=self.config.gen_prefix_sharing,
                         mixed_step=self.config.gen_mixed_step,
                         mixed_token_budget=(

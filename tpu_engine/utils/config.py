@@ -83,6 +83,14 @@ class WorkerConfig:
     # n_slots * ceil(max_seq/block) + the null block). At equal HBM the
     # paged pool admits several times more concurrent short rows.
     gen_kv_blocks: int = 0
+    # Hierarchical host-RAM KV tier (paged mode with prefix sharing;
+    # --kv-host-blocks): this many pinned host-RAM blocks under the
+    # device pool. LRU eviction DEMOTES cold radix leaves' blocks to the
+    # host tier instead of destroying them; a radix hit on a demoted
+    # prefix swaps the blocks back in (async, on the prefill thread)
+    # instead of recomputing its prefill — host RAM becomes prefix-cache
+    # capacity. 0 (default) = no tier (evictions destroy, as before).
+    gen_kv_host_blocks: int = 0
     # Block-level radix prefix sharing (paged mode only): shared system
     # prompts skip their prefill compute and share KV blocks
     # copy-on-write. Off = paging without sharing.
@@ -203,6 +211,34 @@ class GatewayConfig:
     # victim request. 0 (default) = no prober.
     health_probe_interval_s: float = 0.0
     health_probe_failures: int = 3
+
+    # Prefix-affinity routing (--prefix-affinity): /generate and
+    # /generate/stream route on a BLOCK-ALIGNED fingerprint of the
+    # prompt's leading tokens instead of request_id, so requests sharing
+    # a prefix (fleet-wide system prompts) converge on the lane whose
+    # radix tree already holds those KV blocks — the per-worker 88%
+    # prefill-skip becomes a fleet-wide win instead of re-paying the
+    # prefix once per lane. Fallback to ring order (the pre-affinity
+    # behavior) when the prompt has no full block to fingerprint, the
+    # affinity lane is ejected/broken, or it is imbalanced (below). Off
+    # (default) keeps routing byte-identical to the request_id ring.
+    prefix_affinity: bool = False
+    # Fingerprint granularity: MUST match the workers' --kv-block-size —
+    # the radix tree shares full blocks only, so a fingerprint over a
+    # partial block would converge requests that share nothing reusable.
+    affinity_block_size: int = 16
+    # Fingerprint covers at most this many leading blocks: requests that
+    # agree on them converge even when their prompts diverge later (the
+    # shared-system-prompt shape); the cap keeps distinct long prompts
+    # from all being "unique" fingerprints with no convergence.
+    affinity_prefix_blocks: int = 4
+    # Imbalance fallback: when > 0, the affinity lane is skipped (ring
+    # order instead) once it has received this many more generate
+    # dispatches than its least-loaded ring peer within the window —
+    # convergence must not turn one hot prefix into one dead lane.
+    # 0 (default) = always honor affinity.
+    affinity_max_imbalance: int = 0
+    affinity_window_s: float = 10.0
 
     # Tracing ring-buffer capacity for the gateway's own spans (route +
     # per-attempt children + resilience decision markers). 0 disables.

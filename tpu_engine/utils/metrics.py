@@ -227,6 +227,39 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
     metric("tpu_engine_kv_prefilled_tokens_total", "counter",
            "Prompt tokens actually prefilled on the device",
            [(node(h), p.get("prefilled_tokens")) for h, p in kv])
+    metric("tpu_engine_kv_radix_lookups_total", "counter",
+           "Radix prefix lookups at admission",
+           [(node(h), p.get("radix_lookups")) for h, p in kv])
+    metric("tpu_engine_kv_radix_hits_total", "counter",
+           "Radix lookups that matched at least one full block",
+           [(node(h), p.get("radix_hits")) for h, p in kv])
+
+    # Hierarchical host-RAM KV tier (--kv-host-blocks): demotions keep
+    # cold prefixes resident in host RAM; swap-ins resurrect them on a
+    # radix hit instead of recomputing prefill.
+    kvh = [(h, p.get("host")) for h, p in kv
+           if isinstance(p, dict) and p.get("host")]
+    metric("tpu_engine_kv_host_blocks_total", "gauge",
+           "Host-RAM KV tier capacity in blocks",
+           [(node(h), t.get("blocks_total")) for h, t in kvh])
+    metric("tpu_engine_kv_host_blocks_used", "gauge",
+           "Host-tier blocks holding demoted radix prefixes",
+           [(node(h), t.get("blocks_used")) for h, t in kvh])
+    metric("tpu_engine_kv_host_demotions_total", "counter",
+           "Device blocks demoted to the host tier (LRU eviction)",
+           [(node(h), t.get("demotions")) for h, t in kvh])
+    metric("tpu_engine_kv_host_swap_ins_total", "counter",
+           "Demoted blocks swapped back onto the device on a radix hit",
+           [(node(h), t.get("swap_ins")) for h, t in kvh])
+    metric("tpu_engine_kv_host_swap_in_deferred_total", "counter",
+           "Promotions refused by the live-row reserve rule",
+           [(node(h), t.get("swap_in_deferred")) for h, t in kvh])
+    metric("tpu_engine_kv_host_evictions_total", "counter",
+           "Demoted prefixes destroyed because the host tier filled",
+           [(node(h), t.get("host_evictions")) for h, t in kvh])
+    metric("tpu_engine_kv_swapped_in_tokens_total", "counter",
+           "Prompt tokens served by host-tier swap-in instead of prefill",
+           [(node(h), t.get("swapped_in_tokens")) for h, t in kvh])
 
     # Mixed prefill+decode stepping (continuous scheduler --mixed-step):
     # one ragged dispatch per tick — ticks and dispatches are counted at
@@ -382,6 +415,32 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
             metric("tpu_engine_failover_ejected_lanes", "gauge",
                    "Lanes currently ejected from routing",
                    [({}, len(fo.get("ejected_lanes", ())))])
+        aff = stats.get("affinity")
+        if aff:
+            # Prefix-affinity routing (the /stats "affinity" block;
+            # present once configured or first exercised).
+            for key, name, help_text in (
+                    ("affinity_routed", "routed",
+                     "Generate dispatches routed to the prefix-affinity "
+                     "lane"),
+                    ("no_fingerprint", "no_fingerprint",
+                     "Generate requests with no full prompt block to "
+                     "fingerprint (ring order)"),
+                    ("ejected_fallbacks", "ejected_fallbacks",
+                     "Affinity lane ejected/broken: fell back to ring "
+                     "order"),
+                    ("imbalance_fallbacks", "imbalance_fallbacks",
+                     "Affinity lane too hot: fell back to ring order"),
+                    ("resume_skips", "resume_skips",
+                     "Stream resumes that skipped the dead affinity "
+                     "lane (ring order)")):
+                metric(f"tpu_engine_affinity_{name}_total", "counter",
+                       help_text, [({}, aff.get(key))])
+            metric("tpu_engine_affinity_assigned_total", "counter",
+                   "Affinity-routed dispatches per lane",
+                   [({"node": lane}, n)
+                    for lane, n in sorted(
+                        (aff.get("assigned") or {}).items())])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
     if named_hists:
