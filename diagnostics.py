@@ -24,7 +24,7 @@ import sys
 OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
-#             --spec-parity step 9
+#             --spec-parity step 9, --failover step 10, --lint step 11
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -89,9 +89,16 @@ def main() -> int:
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
+    ap.add_argument("--lint", action="store_true",
+                    help="step 11: engine-lint static-analysis suite "
+                         "over tpu_engine/ (in-process, no server): lock "
+                         "discipline, hot-path trace leaks, "
+                         "counters==spans pairing, flag discipline — "
+                         "prints the per-rule finding summary")
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-              + int(args.spec_parity) + int(args.failover))
+              + int(args.spec_parity) + int(args.failover)
+              + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -311,6 +318,30 @@ def main() -> int:
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
+
+    # 11 (--lint): the engine-lint suite, in-process — the same gate
+    # tier-1 runs (tests/test_engine_lint.py), surfaced here so an
+    # operator can check a working tree before pushing.
+    if args.lint:
+        n = _TOTAL  # always the last step
+        try:
+            from tools.analyze import baseline as lint_baseline
+            from tools.analyze import run_suite
+
+            report = run_suite()
+            new, old = lint_baseline.split(report.findings)
+            counts = {}
+            for f in new:
+                counts[f.rule] = counts.get(f.rule, 0) + 1
+            summary = (", ".join(f"{r}={c}" for r, c in sorted(
+                counts.items())) or "no findings")
+            step(n, "engine-lint static analysis", not new,
+                 f"({summary}; {len(old)} baselined, "
+                 f"{len(report.waived)} waived)")
+            for f in new:
+                print(f"      {f.format()}")
+        except Exception as exc:
+            step(n, "engine-lint static analysis", False, f"({exc})")
 
     n_ok = sum(_results)
     print(f"\n{n_ok}/{len(_results)} checks passed")

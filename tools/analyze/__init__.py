@@ -1,0 +1,44 @@
+"""engine-lint: project-specific static analysis for tpu_engine.
+
+Four AST-based analyzers enforce, at lint time, the invariants seven PRs
+of concurrency growth left enforced only by chaos harnesses and e2e
+tests:
+
+- **lock discipline** (``tools.analyze.locks``): a registry maps guarded
+  state (block-pool free list / refcounts / radix tree, gateway
+  membership + breaker maps, breaker internals) to its owning lock; any
+  access site not dominated by a ``with <lock>`` in the caller chain is
+  a finding, and the lock-acquisition-order graph built from nested
+  ``with`` blocks must stay acyclic (a cycle is a future deadlock).
+- **hot-path trace leaks** (``tools.analyze.hotpath``): inside functions
+  reachable from the jitted tick/dispatch path, host syncs (``.item()``,
+  ``np.asarray`` on traced values, ``jax.device_get``), Python branches
+  on traced values, and un-memoized ``jax.jit`` creation inside a
+  per-tick call (silent recompilation) are findings.
+- **counters == spans** (``tools.analyze.counters``): every
+  resilience/failover/affinity counter bump must have a marker-span
+  emission reachable from the same function — the discipline
+  ``tools/fault_injection.py`` asserts dynamically, now a lint.
+- **flag discipline** (``tools.analyze.flags``): every CLI flag in
+  ``serving/cli.py`` that threads into ``WorkerConfig``/``GatewayConfig``
+  must agree with the dataclass default (no silent drift), boolean flags
+  must land on default-off fields, and no flag may be parsed then
+  dropped.
+
+``python -m tools.analyze`` runs the suite; ``tests/test_engine_lint.py``
+runs it in-process as a tier-1 gate. ``baseline.json`` suppresses
+accepted pre-existing findings so CI fails only on regressions;
+intentional one-off exceptions use inline ``# lint: <waiver> <reason>``
+comments instead (see ``core.WAIVER_SCOPES``).
+"""
+
+from tools.analyze.core import (  # noqa: F401
+    CodeIndex,
+    Finding,
+    LintReport,
+    RULES,
+    build_index,
+    collect_sources,
+    run_suite,
+)
+from tools.analyze.registry import ENGINE_REGISTRY, Registry  # noqa: F401

@@ -1,0 +1,79 @@
+"""engine-lint CLI.
+
+  python -m tools.analyze                  # human-readable, exit 1 on
+                                           # non-baseline findings
+  python -m tools.analyze --json           # machine-readable report
+  python -m tools.analyze --rules hot-sync,lock-unguarded
+  python -m tools.analyze --update-baseline  # accept current findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tools.analyze import baseline as baseline_mod
+from tools.analyze.core import REPO_ROOT, RULES, run_suite
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root holding the tpu_engine package")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to report "
+                         f"(known: {', '.join(sorted(RULES))})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON report")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_PATH)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings (post-waiver) to "
+                         "the baseline file, sorted and deduplicated")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+    if args.update_baseline and rules is not None:
+        print("--update-baseline cannot be combined with --rules: the "
+              "baseline is whole-suite, and a filtered rewrite would "
+              "silently drop accepted findings of other rules",
+              file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    report = run_suite(args.root, rules=rules)
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        n = baseline_mod.save(report.findings, args.baseline)
+        print(f"baseline updated: {n} accepted findings -> "
+              f"{args.baseline}")
+        return 0
+
+    new, old = baseline_mod.split(report.findings, args.baseline)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(old),
+            "waived": len(report.waived),
+            "counts": {r: sum(1 for f in new if f.rule == r)
+                       for r in sorted({f.rule for f in new})},
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        print(f"engine-lint: {len(new)} finding(s), {len(old)} baselined, "
+              f"{len(report.waived)} waived ({elapsed:.2f}s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Baseline handling: accepted pre-existing findings, keyed
+line-independently so unrelated edits never churn the file. The file is
+kept sorted and deduplicated so diffs stay reviewable; CI fails only on
+findings NOT in the baseline."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Tuple
+
+from tools.analyze.core import Finding
+
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baseline.json")
+
+
+def load(path: str = DEFAULT_PATH) -> set:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def save(findings: List[Finding], path: str = DEFAULT_PATH) -> int:
+    keys = sorted({f.key for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": keys}, f, indent=2)
+        f.write("\n")
+    return len(keys)
+
+
+def split(findings: List[Finding],
+          path: str = DEFAULT_PATH) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined)."""
+    accepted = load(path)
+    new = [f for f in findings if f.key not in accepted]
+    old = [f for f in findings if f.key in accepted]
+    return new, old

@@ -1,0 +1,360 @@
+"""Shared engine-lint infrastructure: findings, waivers, the module
+index, and the best-effort module-level call graph every analyzer
+resolves calls through.
+
+The call graph is deliberately conservative: ``self.m()`` resolves
+within the enclosing class, bare names resolve to module functions or
+``from X import name`` imports of scanned modules, and ``recv.m()``
+resolves only when ``recv``'s unparsed expression is a registered
+receiver alias (``registry.receiver_aliases``, e.g. ``pool`` /
+``self._pool`` -> ``BlockPool``). Unresolvable calls are skipped — an
+analyzer must never report a finding it cannot anchor to real code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RULES = {
+    "lock-unguarded": "guarded state accessed without its owning lock",
+    "lock-order": "lock acquisition-order cycle (deadlock hazard)",
+    "lock-reentry": "non-reentrant lock (re)acquired while already held",
+    "thread-owned": "thread-owned state touched off its owning thread",
+    "hot-sync": "host sync inside jit-traced code",
+    "hot-branch": "Python branch on a traced value inside jitted code",
+    "hot-jit": "jax.jit created un-memoized inside a per-tick call",
+    "counter-span": "decision counter bumped with no reachable marker span",
+    "flag-drift": "CLI flag default diverges from its config-field default",
+    "flag-unwired": "CLI flag parsed but never used",
+    "flag-default-on": "boolean CLI flag lands on a default-on config field",
+    "flag-unknown-field": "CLI flag threads into a nonexistent config field",
+}
+
+# Inline waiver comments: `# lint: <waiver> <reason>` on the finding's
+# line (or the line above) suppresses the rules in its scope. The reason
+# is mandatory by convention — reviewers reject bare waivers.
+WAIVER_SCOPES = {
+    "lockfree-ok": {"lock-unguarded", "thread-owned"},
+    "hotpath-ok": {"hot-sync", "hot-branch", "hot-jit"},
+    "span-ok": {"counter-span"},
+    "flag-ok": {"flag-drift", "flag-unwired", "flag-default-on",
+                "flag-unknown-field"},
+    "lint-ok": set(RULES),
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str       # repo-relative path
+    line: int
+    func: str       # module:qualified.function
+    message: str
+    hint: str = ""
+
+    @property
+    def key(self) -> str:
+        """Stable identity for the baseline: line numbers drift with
+        unrelated edits, so the key is (rule, file, function, message)."""
+        return f"{self.rule}|{self.file}|{self.func}|{self.message}"
+
+    def format(self) -> str:
+        s = f"{self.file}:{self.line} [{self.rule}] {self.func}: " \
+            f"{self.message}"
+        if self.hint:
+            s += f"  (fix: {self.hint})"
+        return s
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]
+    waived: List[Finding]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def unparse(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class FuncInfo:
+    __slots__ = ("module", "qualname", "node", "class_name", "is_nested",
+                 "container")
+
+    def __init__(self, module: "ModuleInfo", qualname: str,
+                 node: ast.AST, class_name: Optional[str],
+                 container: Optional[str]):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name   # innermost class, inherited by
+        self.container = container     # nested defs; container = the
+        self.is_nested = container is not None  # enclosing function key
+
+    @property
+    def key(self) -> str:
+        return f"{self.module.name}:{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def own_nodes(self) -> Iterable[Tuple[ast.AST, tuple]]:
+        """Walk this function's body WITHOUT descending into nested
+        function/class definitions (those are their own FuncInfos).
+        Yields (node, parents) with parents innermost-last."""
+        return _walk_own(self.node, ())
+
+
+def _walk_own(root: ast.AST, parents: tuple):
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)) and parents is not None:
+            # Nested definition: analyzed as its own function; but the
+            # def NODE itself is still yielded so callers can see it.
+            yield child, parents + (root,)
+            continue
+        yield child, parents + (root,)
+        yield from _walk_own(child, parents + (root,))
+
+
+class ModuleInfo:
+    def __init__(self, name: str, file: str, source: str):
+        self.name = name            # dotted module name
+        self.file = file            # repo-relative path
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, Dict[str, str]] = {}  # cls -> method -> qual
+        self.imports: Dict[str, str] = {}  # local name -> "module:attr"
+        self._collect_imports()
+        self._collect_functions(self.tree, [], None, None)
+        self.waivers = self._collect_waivers()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{node.module}:{alias.name}"
+
+    def _collect_functions(self, node: ast.AST, qual: List[str],
+                           cls: Optional[str],
+                           container: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.classes.setdefault(child.name, {})
+                self._collect_functions(child, qual + [child.name],
+                                        child.name, container)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = ".".join(qual + [child.name])
+                fi = FuncInfo(self, q, child, cls, container)
+                self.functions[q] = fi
+                if cls is not None and qual and qual[-1] == cls:
+                    self.classes[cls][child.name] = q
+                self._collect_functions(child, qual + [child.name], cls,
+                                        f"{self.name}:{q}")
+            else:
+                self._collect_functions(child, qual, cls, container)
+
+    def _collect_waivers(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            idx = line.find("# lint:")
+            if idx < 0:
+                continue
+            rest = line[idx + len("# lint:"):].strip()
+            if rest:
+                name = rest.split()[0]
+                if name in WAIVER_SCOPES:
+                    out.setdefault(i, set()).add(name)
+        return out
+
+    def waived_rules_at(self, line: int) -> Set[str]:
+        rules: Set[str] = set()
+        for ln in (line, line - 1):
+            for w in self.waivers.get(ln, ()):
+                rules |= WAIVER_SCOPES[w]
+        return rules
+
+
+class CodeIndex:
+    def __init__(self, modules: Dict[str, ModuleInfo],
+                 receiver_aliases: Optional[Dict[str, str]] = None):
+        self.modules = modules
+        self.receiver_aliases = dict(receiver_aliases or {})
+        self.functions: Dict[str, FuncInfo] = {}
+        self.class_methods: Dict[str, Dict[str, str]] = {}
+        for mod in modules.values():
+            for q, fi in mod.functions.items():
+                self.functions[fi.key] = fi
+            for cls, methods in mod.classes.items():
+                table = self.class_methods.setdefault(cls, {})
+                for m, q in methods.items():
+                    table.setdefault(m, f"{mod.name}:{q}")
+        self._call_edges: Optional[Dict[str, List[Tuple[str, int]]]] = None
+
+    # -- call resolution ------------------------------------------------------
+
+    def resolve_name(self, name: str, caller: FuncInfo) -> Optional[str]:
+        """A bare-name reference from inside `caller`: nested def in an
+        enclosing scope, module-level function, or scanned import."""
+        parts = caller.qualname.split(".")
+        for i in range(len(parts), -1, -1):
+            q = ".".join(parts[:i] + [name])
+            if q in caller.module.functions:
+                return f"{caller.module.name}:{q}"
+        target = caller.module.imports.get(name)
+        if target is not None and target in self.functions:
+            return target
+        return None
+
+    def resolve_call(self, call: ast.Call,
+                     caller: FuncInfo) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.resolve_name(f.id, caller)
+        if isinstance(f, ast.Attribute):
+            recv = unparse(f.value)
+            cls = None
+            if recv == "self":
+                cls = caller.class_name
+            else:
+                cls = self.receiver_aliases.get(recv)
+            if cls is not None:
+                key = self.class_methods.get(cls, {}).get(f.attr)
+                if key is not None:
+                    return key
+        return None
+
+    def call_edges(self) -> Dict[str, List[Tuple[str, int]]]:
+        """caller key -> [(callee key, line)] over every resolvable call
+        AND function-valued arguments (jax.lax.scan(body, ...), thread
+        targets, vmap'd rows — the function flows where the call goes)."""
+        if self._call_edges is not None:
+            return self._call_edges
+        edges: Dict[str, List[Tuple[str, int]]] = {}
+        for key, fi in self.functions.items():
+            out: List[Tuple[str, int]] = []
+            for node, _parents in fi.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(node, fi)
+                if target is not None:
+                    out.append((target, node.lineno))
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        t = self.resolve_name(arg.id, fi)
+                        if t is not None:
+                            out.append((t, node.lineno))
+            edges[key] = out
+        self._call_edges = edges
+        return edges
+
+    def callers_of(self) -> Dict[str, List[Tuple[str, int]]]:
+        rev: Dict[str, List[Tuple[str, int]]] = {}
+        for caller, outs in self.call_edges().items():
+            for callee, line in outs:
+                rev.setdefault(callee, []).append((caller, line))
+        return rev
+
+    def reachable_from(self, roots: Iterable[str]) -> Set[str]:
+        edges = self.call_edges()
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            for callee, _line in edges.get(k, ()):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen
+
+
+# -- source collection / suite runner ----------------------------------------
+
+def collect_sources(root: str = REPO_ROOT,
+                    package: str = "tpu_engine") -> Dict[str, Tuple[str, str]]:
+    """{dotted module name: (repo-relative file, source)} for every .py
+    under `package`. tools/analyze never scans itself (it lives outside
+    the package), and tests are exercised, not linted."""
+    out: Dict[str, Tuple[str, str]] = {}
+    pkg_root = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            name = rel[:-3].replace(os.sep, ".")
+            if name.endswith(".__init__"):
+                name = name[:-len(".__init__")]
+            with open(path, encoding="utf-8") as f:
+                out[name] = (rel, f.read())
+    return out
+
+
+def build_index(sources: Dict[str, Tuple[str, str]],
+                receiver_aliases: Optional[Dict[str, str]] = None
+                ) -> CodeIndex:
+    modules = {name: ModuleInfo(name, file, src)
+               for name, (file, src) in sources.items()}
+    return CodeIndex(modules, receiver_aliases)
+
+
+def apply_waivers(findings: List[Finding],
+                  index: CodeIndex) -> LintReport:
+    by_file = {m.file: m for m in index.modules.values()}
+    kept: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        mod = by_file.get(f.file)
+        if mod is not None and f.rule in mod.waived_rules_at(f.line):
+            waived.append(f)
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return LintReport(kept, waived)
+
+
+def run_suite(root: str = REPO_ROOT, registry=None,
+              rules: Optional[Set[str]] = None) -> LintReport:
+    """Run all four analyzers over the package and apply inline waivers.
+    `rules`: optional rule-id filter (post-analysis)."""
+    from tools.analyze import counters, flags, hotpath, locks
+    from tools.analyze.registry import ENGINE_REGISTRY
+
+    registry = registry or ENGINE_REGISTRY
+    sources = collect_sources(root, registry.package)
+    index = build_index(sources, registry.receiver_aliases)
+    findings: List[Finding] = []
+    findings += locks.analyze(index, registry)
+    findings += hotpath.analyze(index, registry)
+    findings += counters.analyze(index, registry)
+    findings += flags.analyze(index, registry)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return apply_waivers(findings, index)

@@ -1,0 +1,81 @@
+"""counters == spans analyzer.
+
+The gateway's observability contract (asserted dynamically by
+``tools/fault_injection.py`` since PR 2, extended in PRs 6/7): every
+resilience / failover / affinity DECISION counter bump has a matching
+zero-duration marker span, so ``/stats`` totals are explainable
+per-request in ``/trace/export``. This analyzer makes the contract a
+lint: every ``<family>.bump(...)`` call site must have a span emission
+(``*.tracer.record`` / ``*.sink.stage``) reachable in the same function
+or its (resolvable) callees.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.analyze.core import CodeIndex, Finding, unparse
+
+
+def _receiver_tail(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _emits_span_direct(fi, registry) -> bool:
+    for node, _parents in fi.own_nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        tail = _receiver_tail(f.value)
+        if f.attr == "record" and tail in registry.span_tracer_attrs:
+            return True
+        if f.attr == "stage" and tail in registry.span_sink_attrs:
+            return True
+    return False
+
+
+def analyze(index: CodeIndex, registry) -> List[Finding]:
+    # Which functions (transitively) emit a span.
+    emits: Dict[str, bool] = {}
+    for key, fi in index.functions.items():
+        emits[key] = _emits_span_direct(fi, registry)
+    edges = index.call_edges()
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in edges.items():
+            if emits.get(key):
+                continue
+            if any(emits.get(callee) for callee, _line in outs):
+                emits[key] = True
+                changed = True
+
+    findings: List[Finding] = []
+    for key, fi in index.functions.items():
+        for node, _parents in fi.own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "bump"):
+                continue
+            family = _receiver_tail(f.value)
+            if family not in registry.counter_receivers:
+                continue
+            if emits.get(key):
+                continue
+            counter = unparse(node.args[0])[:40] if node.args else "?"
+            findings.append(Finding(
+                "counter-span", fi.module.file, node.lineno, key,
+                f"{family} counter {counter} bumped with no marker span "
+                "reachable from this function",
+                "emit a zero-duration decision span next to the bump "
+                "(see Gateway._count), or waive with "
+                "`# lint: span-ok <reason>`"))
+    return findings

@@ -1,0 +1,266 @@
+"""Flag-discipline analyzer.
+
+The serving CLI's contract since PR 1: every new capability ships
+default-off, CLI defaults never silently diverge from the config
+dataclass they thread into, and no flag is parsed then dropped. Rules:
+
+- ``flag-drift``: a flag threaded UNCONDITIONALLY into a
+  ``WorkerConfig``/``GatewayConfig`` field whose dataclass default
+  differs from the argparse default — the CLI would silently override
+  the documented config default (or vice versa). Conditional threading
+  (``if args.x is not None: kw[...] = args.x``) is exempt: the config
+  default rules unless the operator speaks.
+- ``flag-default-on``: a ``store_true`` flag landing on a config field
+  whose dataclass default is True (the flag could never turn it on —
+  and the feature would be on by default, violating the wire-compat
+  rule).
+- ``flag-unknown-field``: a kw-dict entry or keyword that names no
+  field on the config class it feeds (typo — the dataclass would raise
+  at runtime, but only on the code path that builds it).
+- ``flag-unwired``: an optional flag whose parsed dest is never read.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import CodeIndex, Finding, unparse
+
+_UNSET = object()
+
+
+@dataclasses.dataclass
+class FlagInfo:
+    option: str
+    dest: str
+    default: object        # _UNSET when argparse gives it none
+    store_bool: bool
+    line: int
+    func: str
+    file: str
+    segment: int = 0       # which ArgumentParser this flag belongs to
+
+
+def _parser_segments(mod) -> List[int]:
+    """Line numbers of ArgumentParser creations — each starts a new
+    parser scope, so `--port` in the gateway command never matches the
+    serve command's threading."""
+    lines = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and unparse(node.func).endswith(
+                "ArgumentParser"):
+            lines.append(node.lineno)
+    return sorted(lines)
+
+
+def _segment_of(lineno: int, segments: List[int]) -> int:
+    import bisect
+    return bisect.bisect_right(segments, lineno)
+
+
+def _flag_dest(call: ast.Call) -> Optional[Tuple[str, str, bool]]:
+    """(option, dest, is_optional) from an add_argument call."""
+    opts = []
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            opts.append(a.value)
+    if not opts:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+            return opts[0], str(kw.value.value), opts[0].startswith("-")
+    longs = [o for o in opts if o.startswith("--")]
+    name = longs[0][2:] if longs else opts[0].lstrip("-")
+    return opts[0], name.replace("-", "_"), opts[0].startswith("-")
+
+
+def _collect_flags(mod) -> List[FlagInfo]:
+    flags: List[FlagInfo] = []
+    segments = _parser_segments(mod)
+    for q, fi in mod.functions.items():
+        for node, _parents in fi.own_nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"):
+                continue
+            parsed = _flag_dest(node)
+            if parsed is None:
+                continue
+            option, dest, optional = parsed
+            if not optional:
+                continue
+            default: object = _UNSET
+            action = None
+            for kw in node.keywords:
+                if kw.arg == "default":
+                    try:
+                        default = ast.literal_eval(kw.value)
+                    except Exception:
+                        default = _UNSET
+                elif kw.arg == "action" and isinstance(kw.value,
+                                                      ast.Constant):
+                    action = kw.value.value
+            store_bool = action in ("store_true", "store_false")
+            if store_bool and default is _UNSET:
+                default = action == "store_false"
+            flags.append(FlagInfo(option, dest, default, store_bool,
+                                  node.lineno, f"{mod.name}:{q}",
+                                  mod.file,
+                                  _segment_of(node.lineno, segments)))
+    return flags
+
+
+def _config_defaults(mod, classes) -> Dict[str, Dict[str, object]]:
+    out: Dict[str, Dict[str, object]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name in classes:
+            fields: Dict[str, object] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and stmt.value is not None:
+                    try:
+                        fields[stmt.target.id] = ast.literal_eval(stmt.value)
+                    except Exception:
+                        fields[stmt.target.id] = _UNSET
+            out[node.name] = fields
+    return out
+
+
+def _threading_map(mod, config_classes):
+    """[(field, dest, conditional, cls_or_None, line, funckey)] from
+    `kw["field"] = args.x` dict fills and `Config(field=args.x)` keyword
+    threading, plus {dict var: config class} links from `Config(**kw)`.
+
+    Only values that are exactly ``args.<dest>`` (or a local assigned
+    exactly from one) count — anything computed is the CLI's business,
+    not a 1:1 flag threading. "Conditional" means guarded on the flag
+    ITSELF (an ancestor ``if`` whose test reads ``args.<dest>``) — the
+    ``if cmd == ...:`` command dispatch does not make threading
+    conditional."""
+    entries: List[tuple] = []
+    dict_links: Dict[str, str] = {}
+
+    def _guarded_on(parents, dest: str, aliases: Dict[str, str]) -> bool:
+        for p in parents:
+            if not isinstance(p, ast.If):
+                continue
+            for n in ast.walk(p.test):
+                if _args_dest(n, aliases) == dest:
+                    return True
+        return False
+
+    for q, fi in mod.functions.items():
+        aliases: Dict[str, str] = {}   # local name -> dest
+        for node, parents in fi.own_nodes():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+                dest = _args_dest(val, aliases)
+                if isinstance(tgt, ast.Name) and dest is not None:
+                    aliases[tgt.id] = dest
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and dest is not None:
+                    cond = _guarded_on(parents, dest, aliases)
+                    entries.append((str(tgt.slice.value), dest, cond,
+                                    tgt.value.id, node.lineno,
+                                    f"{mod.name}:{q}"))
+            elif isinstance(node, ast.Call):
+                fname = node.func.id if isinstance(node.func, ast.Name) \
+                    else None
+                if fname not in config_classes:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is None:   # Config(**kw_dict)
+                        if isinstance(kw.value, ast.Name):
+                            dict_links[kw.value.id] = fname
+                        continue
+                    dest = _args_dest(kw.value, aliases)
+                    if dest is not None:
+                        cond = _guarded_on(parents, dest, aliases)
+                        entries.append((kw.arg, dest, cond, fname,
+                                        node.lineno, f"{mod.name}:{q}"))
+    segments = _parser_segments(mod)
+    resolved = []
+    for field, dest, cond, cls_or_dict, line, func in entries:
+        cls = cls_or_dict if cls_or_dict in config_classes \
+            else dict_links.get(cls_or_dict)
+        resolved.append((field, dest, cond, cls, line, func,
+                         _segment_of(line, segments)))
+    return resolved
+
+
+def _args_dest(node: ast.expr, aliases: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "args":
+        return node.attr
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    return None
+
+
+def analyze(index: CodeIndex, registry) -> List[Finding]:
+    cli = index.modules.get(registry.cli_module)
+    cfg = index.modules.get(registry.config_module)
+    if cli is None or cfg is None:
+        return []
+    findings: List[Finding] = []
+    flags = _collect_flags(cli)
+    by_dest: Dict[Tuple[int, str], List[FlagInfo]] = {}
+    for f in flags:
+        by_dest.setdefault((f.segment, f.dest), []).append(f)
+    defaults = _config_defaults(cfg, registry.config_classes)
+
+    used: Set[str] = set()
+    for q, fi in cli.functions.items():
+        for node, _parents in fi.own_nodes():
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "args":
+                used.add(node.attr)
+
+    for f in flags:
+        if f.dest not in used:
+            findings.append(Finding(
+                "flag-unwired", f.file, f.line, f.func,
+                f"flag {f.option} is parsed but args.{f.dest} is never "
+                "read",
+                "thread it into the config or delete the flag"))
+
+    for field, dest, cond, cls, line, func, segment in _threading_map(
+            cli, set(registry.config_classes)):
+        if cls is None:
+            continue
+        cls_fields = defaults.get(cls, {})
+        if field not in cls_fields:
+            findings.append(Finding(
+                "flag-unknown-field", cli.file, line, func,
+                f"`{field}` threads into {cls} but the dataclass has no "
+                "such field",
+                f"fix the field name or add it to {cls}"))
+            continue
+        cfg_default = cls_fields[field]
+        for flag in by_dest.get((segment, dest), ()):
+            if flag.store_bool and cfg_default is True:
+                findings.append(Finding(
+                    "flag-default-on", cli.file, line, func,
+                    f"{flag.option} (store_true) lands on {cls}.{field} "
+                    "whose default is already True",
+                    "default the field off; the flag turns it on"))
+                continue   # drift on the same pair is the same root cause
+            if cond:
+                continue   # config default rules unless the flag is set
+            if flag.default is _UNSET or cfg_default is _UNSET:
+                continue
+            if flag.default != cfg_default:
+                findings.append(Finding(
+                    "flag-drift", cli.file, line, func,
+                    f"{flag.option} default {flag.default!r} != "
+                    f"{cls}.{field} default {cfg_default!r} "
+                    "(unconditional threading silently overrides)",
+                    "align the defaults or thread conditionally "
+                    "(`if args.x is not None`)"))
+    return findings

@@ -1,0 +1,259 @@
+"""Hot-path trace-leak analyzer.
+
+Finds, in code the compiler actually traces (functions passed to
+``jax.jit`` plus everything they call), the three leaks that silently
+wreck a serving tick:
+
+- ``hot-sync``: a host synchronization inside traced code — ``.item()``,
+  ``.block_until_ready()``, ``jax.device_get``, or ``np.asarray`` /
+  ``np.array`` applied to a traced value. (Host-side tick drivers sync
+  deliberately, once per tick, AFTER the dispatch — those are not
+  traced functions and are not flagged.)
+- ``hot-branch``: a Python ``if``/``while`` on a traced value inside a
+  directly-jitted body. Compile-time flags arrive via closure in this
+  codebase (``controls``, ``stochastic``), so a branch on a *parameter*
+  is almost certainly a bug; parameters named in ``static_argnums`` /
+  ``static_argnames`` (or in the registry's static-name list) are
+  exempt. Nested defs inside a jitted body (scan bodies) inherit the
+  check; transitively-called helpers do not (their params may be static
+  config).
+- ``hot-jit``: ``jax.jit`` reached from a per-tick entry point
+  (scheduler tick/admission path) whose result is not memoized into an
+  attribute — each call would re-trace and re-compile.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import CodeIndex, Finding, FuncInfo, unparse
+
+_SYNC_METHODS = frozenset({"item", "block_until_ready"})
+_SYNC_CALLS = frozenset({"jax.device_get"})
+_NP_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array", "onp.asarray", "onp.array"})
+_SHAPE_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+
+
+def _jit_call(node: ast.Call) -> bool:
+    return unparse(node.func) in ("jax.jit", "jit")
+
+
+def _static_params(call: Optional[ast.Call]) -> Set[object]:
+    """static_argnums / static_argnames from a jax.jit(...) call (also
+    found inside functools.partial(jax.jit, ...) decorators)."""
+    out: Set[object] = set()
+    if call is None:
+        return out
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except Exception:
+                continue
+            if isinstance(val, (list, tuple, set)):
+                out.update(val)
+            else:
+                out.add(val)
+    return out
+
+
+def _find_jit_roots(index: CodeIndex) -> Dict[str, Set[object]]:
+    """{function key: static params} for every function handed to
+    jax.jit — as a call argument, a decorator, or a
+    functools.partial(jax.jit, ...) decorator."""
+    roots: Dict[str, Set[object]] = {}
+    for key, fi in index.functions.items():
+        # Decorators on the function itself.
+        for dec in getattr(fi.node, "decorator_list", ()):
+            if isinstance(dec, ast.Call):
+                f = unparse(dec.func)
+                if f in ("jax.jit", "jit"):
+                    roots.setdefault(key, set()).update(_static_params(dec))
+                elif f in ("functools.partial", "partial") and dec.args \
+                        and unparse(dec.args[0]) in ("jax.jit", "jit"):
+                    roots.setdefault(key, set()).update(_static_params(dec))
+            elif unparse(dec) in ("jax.jit", "jit"):
+                roots.setdefault(key, set())
+        # jax.jit(fn, ...) call sites anywhere in this function.
+        for node, _parents in fi.own_nodes():
+            if isinstance(node, ast.Call) and _jit_call(node) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    target = index.resolve_name(arg.id, fi)
+                    if target is not None:
+                        roots.setdefault(target, set()).update(
+                            _static_params(node))
+    return roots
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does `expr` read a tainted name OUTSIDE a static context (.shape/
+    .dtype/.ndim/.size access, len())? Those reads are trace-static."""
+    hit = [False]
+
+    def visit(node: ast.AST) -> None:
+        if hit[0]:
+            return
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            return  # len(traced) is static under jit
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return  # `x is None`: identity, decided at trace time
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            return  # x.shape / x.dtype: static metadata
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in tainted:
+            hit[0] = True
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hit[0]
+
+
+def _taint(fi: FuncInfo, statics: Set[object]) -> Set[str]:
+    """Tainted (traced-value) names: non-static params plus anything
+    assigned from them (two propagation passes cover the straight-line
+    bodies this codebase writes)."""
+    params = _param_names(fi.node)
+    tainted: Set[str] = set()
+    for i, name in enumerate(params):
+        if i in statics or name in statics:
+            continue
+        tainted.add(name)
+    for _ in range(2):
+        for node, _parents in fi.own_nodes():
+            if isinstance(node, ast.Assign):
+                if _expr_tainted(node.value, tainted):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign):
+                if _expr_tainted(node.value, tainted) and isinstance(
+                        node.target, ast.Name):
+                    tainted.add(node.target.id)
+    return tainted
+
+
+def analyze(index: CodeIndex, registry) -> List[Finding]:
+    findings: List[Finding] = []
+    roots = _find_jit_roots(index)
+
+    # Nested defs inside a jitted body (scan/vmap bodies) inherit
+    # root-ness: their params are traced carries.
+    changed = True
+    while changed:
+        changed = False
+        for key, fi in index.functions.items():
+            if key in roots or fi.container is None:
+                continue
+            if fi.container in roots:
+                roots[key] = set()
+                changed = True
+
+    traced: Set[str] = set(index.reachable_from(roots))
+    traced.update(roots)
+
+    for key in sorted(traced):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        is_root = key in roots
+        statics = set(roots.get(key, set())) | set(
+            registry.hot_static_params)
+        tainted = _taint(fi, statics)
+        for node, parents in fi.own_nodes():
+            if isinstance(node, ast.Call):
+                fname = unparse(node.func)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_METHODS \
+                        and not node.args:
+                    findings.append(Finding(
+                        "hot-sync", fi.module.file, node.lineno, key,
+                        f"`.{node.func.attr}()` inside jit-traced code",
+                        "return the value and sync once on the host side"))
+                elif fname in _SYNC_CALLS:
+                    findings.append(Finding(
+                        "hot-sync", fi.module.file, node.lineno, key,
+                        f"`{fname}` inside jit-traced code",
+                        "move the device->host copy out of the traced fn"))
+                elif fname in _NP_CALLS and node.args and _expr_tainted(
+                        node.args[0], tainted):
+                    findings.append(Finding(
+                        "hot-sync", fi.module.file, node.lineno, key,
+                        f"`{fname}` on a traced value forces a host sync",
+                        "keep the computation in jnp"))
+                elif is_root and fname in ("float", "int", "bool") \
+                        and node.args and _expr_tainted(node.args[0],
+                                                        tainted):
+                    findings.append(Finding(
+                        "hot-sync", fi.module.file, node.lineno, key,
+                        f"`{fname}()` on a traced value forces a host "
+                        "sync at trace time",
+                        "use jnp casts (astype) instead"))
+            elif is_root and isinstance(node, (ast.If, ast.While)):
+                if _expr_tainted(node.test, tainted):
+                    findings.append(Finding(
+                        "hot-branch", fi.module.file, node.lineno, key,
+                        "Python branch on a traced value "
+                        f"(`{unparse(node.test)[:60]}`)",
+                        "use jnp.where / lax.cond, or pass the flag as a "
+                        "compile-time closure/static arg"))
+
+    findings += _analyze_tick_jit(index, registry)
+    return findings
+
+
+# -- hot-jit ------------------------------------------------------------------
+
+def _memoized(node: ast.Call, parents: tuple) -> bool:
+    """Is this jax.jit(...) result stored into an attribute (or an
+    attribute-keyed cache) — the accepted build-once idiom?"""
+    for p in reversed(parents):
+        if isinstance(p, ast.Assign):
+            for tgt in p.targets:
+                t = tgt
+                if isinstance(t, ast.Subscript):
+                    t = t.value
+                if isinstance(t, ast.Attribute):
+                    return True
+        if isinstance(p, ast.Call) and isinstance(p.func, ast.Attribute) \
+                and p.func.attr == "setdefault":
+            base = p.func.value
+            if isinstance(base, ast.Attribute):
+                return True
+    return False
+
+
+def _analyze_tick_jit(index: CodeIndex, registry) -> List[Finding]:
+    findings: List[Finding] = []
+    reach = index.reachable_from(registry.tick_entries)
+    for key in sorted(reach):
+        fi = index.functions.get(key)
+        if fi is None:
+            continue
+        for node, parents in fi.own_nodes():
+            if isinstance(node, ast.Call) and _jit_call(node) \
+                    and not _memoized(node, parents):
+                findings.append(Finding(
+                    "hot-jit", fi.module.file, node.lineno, key,
+                    "jax.jit reached from the per-tick path without "
+                    "memoization (re-traces every call)",
+                    "cache the executable on an attribute keyed by its "
+                    "compile-time shape"))
+    return findings

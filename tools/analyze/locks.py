@@ -1,0 +1,370 @@
+"""Lock-discipline analyzer.
+
+Three rules:
+
+- ``lock-unguarded``: every access to a registered guarded attribute
+  must be dominated by ``with <owning lock>``. Classes marked
+  ``caller_locked`` ("all methods assume the lock is held" — BlockPool,
+  RadixTree) push the obligation to their CALL sites: the analyzer
+  computes, by fixed point over the call graph, which caller-locked
+  functions transitively need the lock, then flags any unguarded call
+  into that set from ordinary code (and any unguarded access in
+  ordinary code directly). ``__init__`` is exempt — the object is not
+  shared yet.
+- ``lock-order`` / ``lock-reentry``: nested ``with`` blocks and
+  calls-under-lock into lock-acquiring functions build the
+  acquisition-order graph; a cycle is a latent deadlock, and so is
+  re-acquiring a non-reentrant lock already held.
+- ``thread-owned``: attributes owned by one thread (the scheduler's row
+  tables) may only be touched by functions reachable from that thread's
+  run loop (or ``__init__``); documented GIL-safe reads elsewhere carry
+  inline ``# lint: lockfree-ok`` waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import CodeIndex, Finding, FuncInfo, unparse
+
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "add", "discard", "update", "setdefault", "sort",
+    "popitem", "move_to_end",
+})
+
+
+def _walk_held(fi: FuncInfo, registry):
+    """Yield (node, held, parents) over the function's own body, where
+    `held` is the frozenset of canonical lock names acquired by
+    enclosing ``with`` statements. Also yields synthetic
+    ("acquire", lock, line, held_before) events for order-graph edges."""
+    events: List[tuple] = []
+
+    def visit(node: ast.AST, held: frozenset, parents: tuple):
+        if isinstance(node, ast.With):
+            h = held
+            for item in node.items:
+                # The item expression evaluates BEFORE its acquisition
+                # (but after earlier items' locks are held).
+                events.append(("node", item.context_expr, h,
+                               parents + (node,)))
+                visit(item.context_expr, h, parents + (node,))
+                lock = registry.canonical_lock(
+                    unparse(item.context_expr), fi.class_name)
+                if lock is not None:
+                    events.append(("acquire", lock, node.lineno, h))
+                    h = h | frozenset([lock])
+            for stmt in node.body:
+                events.append(("node", stmt, h, parents + (node,)))
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # a def under `with` runs LATER, lock-free
+                visit(stmt, h, parents + (node,))
+            return
+        for child in ast.iter_child_nodes(node):
+            events.append(("node", child, held, parents + (node,)))
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue  # nested defs are their own FuncInfos
+            visit(child, held, parents + (node,))
+
+    for child in ast.iter_child_nodes(fi.node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            events.append(("node", child, frozenset(), (fi.node,)))
+            continue
+        events.append(("node", child, frozenset(), (fi.node,)))
+        visit(child, frozenset(), (fi.node,))
+    return events
+
+
+def _is_write(node: ast.Attribute, parents: tuple) -> bool:
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    if not parents:
+        return False
+    parent = parents[-1]
+    # self._ref[i] = x / self._ref[:] = 0 / del self._tables[r]
+    if isinstance(parent, ast.Subscript) and parent.value is node \
+            and isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return True
+    # self._free.append(x) — mutating method call on the attribute.
+    if isinstance(parent, ast.Attribute) and parent.value is node \
+            and parent.attr in _MUTATORS and len(parents) >= 2:
+        gp = parents[-2]
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+def _entry_for(node: ast.Attribute, fi: FuncInfo, registry):
+    recv = unparse(node.value)
+    for entry in registry.guarded:
+        if node.attr not in entry.attrs:
+            continue
+        if recv == "self":
+            if fi.class_name in entry.classes:
+                return entry
+        elif recv in entry.receivers:
+            return entry
+    return None
+
+
+def analyze(index: CodeIndex, registry) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += _analyze_guarded(index, registry)
+    findings += _analyze_order(index, registry)
+    findings += _analyze_thread_owned(index, registry)
+    return findings
+
+
+# -- lock-unguarded -----------------------------------------------------------
+
+def _analyze_guarded(index: CodeIndex, registry) -> List[Finding]:
+    # Per function: unguarded guarded-attr sites, guarded/unguarded call
+    # sites, and lock-acquisition facts.
+    direct: Dict[str, List[tuple]] = {}      # key -> [(lock, line, attr)]
+    calls: Dict[str, List[tuple]] = {}       # key -> [(callee, line, held)]
+    for key, fi in index.functions.items():
+        for kind, *rest in _walk_held(fi, registry):
+            if kind != "node":
+                continue
+            node, held, parents = rest
+            if isinstance(node, ast.Call):
+                callee = index.resolve_call(node, fi)
+                if callee is not None:
+                    calls.setdefault(key, []).append(
+                        (callee, node.lineno, held))
+            if not isinstance(node, ast.Attribute):
+                continue
+            entry = _entry_for(node, fi, registry)
+            if entry is None:
+                continue
+            if entry.mode == "w" and not _is_write(node, parents):
+                continue
+            if entry.lock not in held:
+                direct.setdefault(key, []).append(
+                    (entry.lock, node.lineno, node.attr))
+
+    # Fixed point over caller-locked functions: which of them
+    # (transitively) touch guarded state without acquiring the lock
+    # themselves.
+    requires: Dict[str, Set[str]] = {}       # key -> set of locks
+    for key, sites in direct.items():
+        fi = index.functions[key]
+        if registry.is_caller_locked(fi) and fi.name != "__init__":
+            requires.setdefault(key, set()).update(l for l, _, _ in sites)
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in calls.items():
+            fi = index.functions[key]
+            if not registry.is_caller_locked(fi) or fi.name == "__init__":
+                continue
+            for callee, _line, held in outs:
+                for lock in requires.get(callee, ()):
+                    if lock not in held and lock not in requires.get(
+                            key, set()):
+                        requires.setdefault(key, set()).add(lock)
+                        changed = True
+
+    findings: List[Finding] = []
+    for key, sites in direct.items():
+        fi = index.functions[key]
+        if fi.name == "__init__" or registry.is_caller_locked(fi):
+            continue
+        for lock, line, attr in sites:
+            findings.append(Finding(
+                "lock-unguarded", fi.module.file, line, key,
+                f"`{attr}` accessed without {lock}",
+                f"wrap the access in `with` on {lock}, or add an inline "
+                f"`# lint: lockfree-ok <reason>` if the race is benign"))
+    for key, outs in calls.items():
+        fi = index.functions[key]
+        if fi.name == "__init__" or registry.is_caller_locked(fi):
+            continue
+        for callee, line, held in outs:
+            for lock in sorted(requires.get(callee, ())):
+                if lock not in held:
+                    cname = callee.split(":", 1)[-1]
+                    findings.append(Finding(
+                        "lock-unguarded", fi.module.file, line, key,
+                        f"call to caller-locked `{cname}` without {lock}",
+                        f"hold {lock} across the call"))
+    return findings
+
+
+# -- lock-order / lock-reentry ------------------------------------------------
+
+def _acquires_transitive(index: CodeIndex,
+                         registry) -> Dict[str, Set[str]]:
+    """Locks each function may acquire, including through callees
+    (context-insensitive over-approximation)."""
+    acquires: Dict[str, Set[str]] = {k: set() for k in index.functions}
+    for key, fi in index.functions.items():
+        for kind, *rest in _walk_held(fi, registry):
+            if kind == "acquire":
+                acquires[key].add(rest[0])
+    edges = index.call_edges()
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in edges.items():
+            for callee, _line in outs:
+                extra = acquires.get(callee, set()) - acquires[key]
+                if extra:
+                    acquires[key] |= extra
+                    changed = True
+    return acquires
+
+
+def _analyze_order(index: CodeIndex, registry) -> List[Finding]:
+    acquires = _acquires_transitive(index, registry)
+    # edge (a, b): a held while b acquired; keep one witness per edge.
+    witnesses: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    findings: List[Finding] = []
+    seen_reentry: Set[str] = set()
+    for key, fi in index.functions.items():
+        for kind, *rest in _walk_held(fi, registry):
+            if kind == "acquire":
+                lock, line, held = rest
+                for h in held:
+                    if h == lock:
+                        if lock not in registry.reentrant \
+                                and key not in seen_reentry:
+                            seen_reentry.add(key)
+                            findings.append(Finding(
+                                "lock-reentry", fi.module.file, line, key,
+                                f"{lock} re-acquired while already held "
+                                f"(non-reentrant)",
+                                "restructure so the lock is taken once, "
+                                "or make it an RLock deliberately"))
+                        continue
+                    witnesses.setdefault((h, lock),
+                                         (fi.module.file, line, key))
+            else:
+                node, held, _parents = rest
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                callee = index.resolve_call(node, fi)
+                if callee is None:
+                    continue
+                for lock in acquires.get(callee, ()):
+                    for h in held:
+                        if h == lock:
+                            continue  # re-entry under over-approximation:
+                            # too coarse to report from call sites.
+                        witnesses.setdefault(
+                            (h, lock), (fi.module.file, node.lineno, key))
+
+    # Cycle detection over the witnessed edge set. Each SCC is reduced
+    # to one REAL cycle through actual edges, so the reported path is a
+    # genuine inversion and the finding anchors on a witnessed edge.
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in witnesses:
+        graph.setdefault(a, set()).add(b)
+    for scc in _find_sccs(graph):
+        cycle = _trace_cycle(graph, scc)
+        if not cycle:
+            continue
+        file, line, key = witnesses[(cycle[0], cycle[1])]
+        findings.append(Finding(
+            "lock-order", file, line, key,
+            "lock-order cycle: " + " -> ".join(cycle + [cycle[0]]),
+            "pick one global order for these locks and release before "
+            "acquiring against it"))
+    return findings
+
+
+def _trace_cycle(graph: Dict[str, Set[str]],
+                 scc: List[str]) -> List[str]:
+    """An actual elementary cycle inside the SCC (edges restricted to
+    it) — guaranteed to exist for |SCC| > 1."""
+    nodes = set(scc)
+    path: List[str] = []
+    on_path: Dict[str, int] = {}
+
+    def dfs(v: str):
+        on_path[v] = len(path)
+        path.append(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in nodes:
+                continue
+            if w in on_path:
+                return path[on_path[w]:]
+            found = dfs(w)
+            if found:
+                return found
+        path.pop()
+        del on_path[v]
+        return None
+
+    return dfs(scc[0]) or []
+
+
+def _find_sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components with |SCC| > 1 (plus self-loop
+    SCCs) — one per deadlock family."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on: Set[str] = set()
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in idx:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], idx[w])
+        if low[v] == idx[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1 or v in graph.get(v, ()):
+                sccs.append(sorted(comp))
+    for v in sorted(graph):
+        if v not in idx:
+            strongconnect(v)
+    return sccs
+
+
+# -- thread-owned -------------------------------------------------------------
+
+def _analyze_thread_owned(index: CodeIndex, registry) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in registry.thread_owned:
+        roots = [f"{entry.module}:{q}" for q in entry.entries]
+        allowed = index.reachable_from(roots)
+        allowed.update(r for r in roots)
+        for key, fi in index.functions.items():
+            if fi.module.name != entry.module:
+                continue
+            if fi.class_name != entry.owner_class:
+                continue
+            if fi.name == "__init__" or key in allowed:
+                continue
+            for node, _parents in fi.own_nodes():
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in entry.attrs \
+                        and unparse(node.value) == "self":
+                    findings.append(Finding(
+                        "thread-owned", fi.module.file, node.lineno, key,
+                        f"`{node.attr}` is owned by the {entry.thread} "
+                        f"thread but touched from `{fi.qualname}`",
+                        "move the access onto the owning thread, or waive "
+                        "a documented GIL-safe read with "
+                        "`# lint: lockfree-ok <reason>`"))
+    return findings

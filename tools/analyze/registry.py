@@ -1,0 +1,198 @@
+"""The guarded-state registry: engine-lint's project-specific knowledge.
+
+Everything the analyzers know about tpu_engine that is not derivable
+from the AST lives here — which attributes each lock owns, which
+classes document "caller holds the lock", which receiver expressions
+alias which class, which counter families must pair with marker spans,
+and where the per-tick hot path starts.
+
+Annotating new code (see DESIGN.md "Static analysis"):
+- a new lock-guarded structure -> add a ``GuardedEntry`` (and, if other
+  modules reach it through an alias like ``pool``, a receiver alias +
+  ``LOCK_ALIASES`` row);
+- a class whose methods assume the caller holds the lock -> add
+  ``Class.*`` to ``caller_locked``;
+- a new decision-counter family with marker spans -> add its receiver
+  attribute to ``counter_receivers``;
+- a new scheduler tick/admission path -> add its root to
+  ``tick_entries`` so the per-tick jit rule covers it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardedEntry:
+    """Attributes owned by `lock`. ``mode`` "rw": every access needs the
+    lock; "w": only mutation does (readers tolerate staleness — the
+    double-checked executable caches, GIL-safe stats reads)."""
+    attrs: Tuple[str, ...]
+    lock: str                     # canonical lock name
+    classes: Tuple[str, ...]      # owner classes (for `self.<attr>`)
+    receivers: Tuple[str, ...] = ()  # non-self receiver exprs (aliases)
+    mode: str = "rw"
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadOwnedEntry:
+    """Attributes owned by one thread: touched only by functions
+    reachable from `entries` (the thread's run loop) or __init__."""
+    attrs: Tuple[str, ...]
+    owner_class: str
+    module: str
+    entries: Tuple[str, ...]      # qualified entry methods (thread roots)
+    thread: str                   # human name for messages
+
+
+@dataclasses.dataclass
+class Registry:
+    package: str
+    # (class scope or None, with-expression, canonical lock name)
+    lock_aliases: Tuple[Tuple[Optional[str], str, str], ...]
+    reentrant: frozenset
+    guarded: Tuple[GuardedEntry, ...]
+    thread_owned: Tuple[ThreadOwnedEntry, ...]
+    caller_locked: frozenset      # "Class.*" or "Class.method" patterns
+    receiver_aliases: Dict[str, str]
+    counter_receivers: frozenset  # attr names of decision-counter objects
+    span_tracer_attrs: frozenset  # receiver tails whose .record() is a span
+    span_sink_attrs: frozenset    # receiver tails whose .stage() is a span
+    hot_static_params: frozenset  # param names treated as trace-static
+    tick_entries: Tuple[str, ...]  # per-tick path roots (module:qual)
+    cli_module: str
+    config_module: str
+    config_classes: Tuple[str, ...]
+
+    def canonical_lock(self, expr: str,
+                       class_name: Optional[str]) -> Optional[str]:
+        """Map a `with <expr>` context expression to a canonical lock
+        name. Explicit aliases first (optionally class-scoped), then the
+        naming convention: any self/module attribute ending in "lock"."""
+        for scope, alias, name in self.lock_aliases:
+            if alias == expr and (scope is None or scope == class_name):
+                return name
+        if expr.startswith("self.") and "." not in expr[5:]:
+            attr = expr[5:]
+            if attr.endswith("lock") and class_name:
+                return f"{class_name}.{attr}"
+        if "." not in expr and expr.endswith("lock"):
+            return f"<module>.{expr}"
+        return None
+
+    def is_caller_locked(self, fi) -> bool:
+        if fi.class_name is None:
+            return False
+        return (f"{fi.class_name}.*" in self.caller_locked
+                or f"{fi.class_name}.{fi.name}" in self.caller_locked)
+
+
+# -- the tpu_engine instance --------------------------------------------------
+
+_RECEIVER_ALIASES = {
+    # BlockPool, reached from the scheduler and from RadixTree.
+    "pool": "BlockPool",
+    "self._pool": "BlockPool",
+    # The pool's radix tree, driven under the pool lock.
+    "pool.radix": "RadixTree",
+    "self._pool.radix": "RadixTree",
+    "self.radix": "RadixTree",
+    # Gateway collaborators (lock-order edges).
+    "ring": "ConsistentHash",
+    "self._ring": "ConsistentHash",
+    "breaker": "CircuitBreaker",
+    "self._retry_budget": "RetryBudget",
+    "self._probe_state": "ProbeStateMachine",
+    "self.resilience": "ResilienceCounters",
+    "self.failover": "FailoverCounters",
+    "self.affinity": "AffinityCounters",
+    "self.tracer": "SpanRecorder",
+}
+
+ENGINE_REGISTRY = Registry(
+    package="tpu_engine",
+    lock_aliases=(
+        (None, "self.lock", "BlockPool.lock"),
+        (None, "pool.lock", "BlockPool.lock"),
+        (None, "self._pool.lock", "BlockPool.lock"),
+        # Conditions share their underlying lock: nesting them with it
+        # would self-deadlock, so they must canonicalize together.
+        ("BatchProcessor", "self._cv", "BatchProcessor._lock"),
+        ("AdmissionController", "self._idle", "AdmissionController._lock"),
+    ),
+    reentrant=frozenset({"BlockPool.lock"}),  # RLock: eviction inside alloc
+    guarded=(
+        # Block pool bookkeeping + the pool-ordering dispatch surface.
+        GuardedEntry(
+            attrs=("_free", "_ref", "_host_free", "_host_k", "_host_v",
+                   "radix", "_promoting", "prefix_hit_tokens",
+                   "prefilled_tokens"),
+            lock="BlockPool.lock",
+            classes=("BlockPool",),
+            receivers=("pool", "self._pool")),
+        GuardedEntry(
+            attrs=("caches",),
+            lock="BlockPool.lock",
+            classes=("BlockPool",),
+            receivers=("pool", "self._pool")),
+        # Gateway membership / routing state.
+        GuardedEntry(
+            attrs=("_clients", "_breakers", "_ejected", "_model_rings",
+                   "_untyped", "_latency", "_lane_recent",
+                   "_affinity_assigned", "_hedge_pool", "default_model",
+                   "_total_requests", "_failovers"),
+            lock="Gateway._lock",
+            classes=("Gateway",)),
+        # Breaker state machine.
+        GuardedEntry(
+            attrs=("_state", "_failure_count", "_success_count",
+                   "_last_failure_time"),
+            lock="CircuitBreaker._lock",
+            classes=("CircuitBreaker",)),
+        # Worker request counters.
+        GuardedEntry(
+            attrs=("_total_requests", "_cache_hits"),
+            lock="WorkerNode._counter_lock",
+            classes=("WorkerNode",)),
+        # Scheduler executable caches: double-checked reads are the
+        # documented idiom, so only WRITES must hold the compile lock.
+        GuardedEntry(
+            attrs=("_prefill_exe", "_insert_exe", "_decode_exe",
+                   "_window_exe", "_gather_exe", "_scatter_exe"),
+            lock="ContinuousGenerator._exe_lock",
+            classes=("ContinuousGenerator",),
+            mode="w"),
+    ),
+    thread_owned=(
+        # Scheduler row tables: the decode loop owns them; the prefill
+        # thread and stats() readers must not touch them (documented
+        # GIL-safe reads carry explicit lockfree-ok waivers).
+        ThreadOwnedEntry(
+            attrs=("_tables", "_row_blocks", "_row_req", "_row_emitted",
+                   "_pending"),
+            owner_class="ContinuousGenerator",
+            module="tpu_engine.runtime.scheduler",
+            entries=("ContinuousGenerator._loop",),
+            thread="continuous-decode"),
+    ),
+    # BlockPool/RadixTree methods document "caller holds the pool lock":
+    # the analyzer checks their CALL sites instead of their bodies.
+    caller_locked=frozenset({"BlockPool.*", "RadixTree.*"}),
+    receiver_aliases=_RECEIVER_ALIASES,
+    counter_receivers=frozenset({"resilience", "failover", "affinity"}),
+    span_tracer_attrs=frozenset({"tracer", "recorder"}),
+    span_sink_attrs=frozenset({"sink"}),
+    hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",
+                                 "head", "interpret", "mesh", "spec"}),
+    tick_entries=(
+        "tpu_engine.runtime.scheduler:ContinuousGenerator._loop_body",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator._prefill_loop",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_mixed",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_spec",
+    ),
+    cli_module="tpu_engine.serving.cli",
+    config_module="tpu_engine.utils.config",
+    config_classes=("WorkerConfig", "GatewayConfig"),
+)

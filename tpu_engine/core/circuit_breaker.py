@@ -81,18 +81,23 @@ class CircuitBreaker:
             ):
                 self._state = CircuitState.OPEN
 
+    # The observability properties below read without the lock on
+    # purpose: each is a single reference/int read (atomic under the
+    # GIL), staleness is acceptable for /stats, and taking the lock here
+    # would let a stats scrape contend with the dispatch path.
+
     @property
     def state(self) -> CircuitState:
-        return self._state
+        return self._state  # lint: lockfree-ok atomic enum-ref read for /stats
 
     @property
     def failure_count(self) -> int:
-        return self._failure_count
+        return self._failure_count  # lint: lockfree-ok atomic int read for /stats
 
     @property
     def success_count(self) -> int:
-        return self._success_count
+        return self._success_count  # lint: lockfree-ok atomic int read for /stats
 
     def state_name(self) -> str:
         """String form used by ``GET /stats`` (reference ``gateway.cpp:67-74``)."""
-        return self._state.value
+        return self._state.value  # lint: lockfree-ok atomic enum-ref read for /stats

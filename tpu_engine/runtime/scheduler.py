@@ -1021,8 +1021,9 @@ class ContinuousGenerator:
         busy = self._prefill_busy_since
         age = max(now - self._last_tick,
                   (now - busy) if busy is not None else 0.0)
+        rows = self._row_req  # lint: lockfree-ok GIL-safe scrape snapshot
         out = dict(self._stats, n_slots=self.n_slots,
-                   active=int(sum(r is not None for r in self._row_req)),
+                   active=int(sum(r is not None for r in rows)),
                    last_tick_age_s=round(age, 3),
                    prefix_cache=self._prefix_cache.stats())
         if self._mixed:
@@ -1045,7 +1046,8 @@ class ContinuousGenerator:
             out["spec"] = spec
         if self._paged:
             out["kv_pool"] = self._pool.stats()
-            out["kv_pool"]["pending_admissions"] = len(self._pending)
+            out["kv_pool"]["pending_admissions"] = \
+                len(self._pending)  # lint: lockfree-ok GIL-safe deque len
         return out
 
     def stop(self) -> None:
@@ -1205,7 +1207,8 @@ class ContinuousGenerator:
         pool_starved early completion). Read without the pool lock —
         a ±1-row-stale reserve only shifts WHEN a promotion defers,
         never correctness."""
-        return sum(1 for r in self._row_req if r is not None)
+        rows = self._row_req  # lint: lockfree-ok documented ±1-stale read
+        return sum(1 for r in rows if r is not None)
 
     def _record_swap_in(self, req: _Request, swapped: int,
                         t0: float) -> None:

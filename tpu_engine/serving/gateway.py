@@ -890,10 +890,13 @@ class Gateway:
                     ring, probing = self._ring, True
             else:
                 ring = self._ring
+            # Snapshot the served-model list for the error below while
+            # the lock is still held — iterating the live dict after
+            # release races add_worker/remove_worker.
+            known = sorted(self._model_rings) if ring is None else ()
         if ring is None:
             raise ValueError(            # wire 400, not a lane failure
-                f"unknown model '{mdl}'; serving "
-                f"{sorted(self._model_rings)}")
+                f"unknown model '{mdl}'; serving {known}")
         try:
             primary = ring.get_node(request_id)
         except RuntimeError:  # every lane of this model was removed
@@ -1243,9 +1246,10 @@ class Gateway:
             # total outage. Per-ring, not fleet-wide: one model's lanes
             # all dying must fail open for THAT model even while other
             # models' lanes are healthy.
-            peers = (ring.get_all_nodes() if ring is not None
-                     else list(self._clients))
+            peers = ring.get_all_nodes() if ring is not None else None
             with self._lock:
+                if peers is None:
+                    peers = list(self._clients)
                 all_ejected = all(p in self._ejected for p in peers)
             if not all_ejected:
                 return None
