@@ -1889,6 +1889,259 @@ def run_affinity_ab(model: str = "gpt2-small-test", n_requests: int = 48,
     return results
 
 
+def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
+                    max_new: int = 16, lanes: int = 3,
+                    slots_per_lane: int = 2, block_size: int = 16,
+                    max_seq: int = 128, quick: bool = False) -> dict:
+    """Adaptive overload control A/B (the PR 9 tentpole): mixed-priority
+    Poisson load at ~2x saturation over >= 3 in-process paged mixed-step
+    lanes behind the gateway — overload control ON (priority-tiered
+    gateway+worker admission, staged brownout, load-derived Retry-After)
+    vs OFF (PR 1 behavior: everything admits, deadlines alone decide).
+
+    Both arms carry identical per-request deadlines; the headline is
+    GOODPUT — tokens of requests that completed within their deadline,
+    per second of wall — split by tier. The off arm melts every tier
+    equally (queues grow past the deadline for everyone); the on arm
+    sheds background/batch early and keeps interactive inside its
+    deadline. Bar: on-arm INTERACTIVE goodput >= 1.5x the off arm's,
+    and a below-saturation stream is byte-identical across arms (the
+    control plane must not touch stream content).
+
+    Runs on the CPU mesh (tiny registry model — admission ordering,
+    ladder behavior, and goodput shape are control-plane properties,
+    not model-size properties); on-chip rerun pending like r06-r10."""
+    import queue as _q
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+    from tpu_engine.utils.deadline import ShedError
+    from tpu_engine.utils.tracing import percentile
+
+    _ensure_builtin_models_imported()
+    if quick:
+        n_requests = 42
+    spec = create_model(model, max_seq=max_seq)
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(11)
+    tiers = ["interactive", "batch", "background"]
+    requests = []
+    for i in range(n_requests):
+        requests.append({
+            "request_id": f"ov-{i}",
+            "prompt_tokens": [rnd.randrange(1, 200) for _ in range(12)],
+            "max_new_tokens": max_new,
+            "priority": tiers[i % 3],
+        })
+
+    def make_fleet(overload: bool):
+        # The OFF arm is the PR 1 default: unbounded admission, the
+        # deadline machinery alone decides — exactly the uncontrolled
+        # baseline the tentpole replaces. The ON arm bounds depth,
+        # tiers admission, and runs the brownout ladder.
+        workers = []
+        for i in range(lanes):
+            cfg = WorkerConfig(
+                node_id=f"lane_{i+1}", model=model,
+                gen_max_batch_size=slots_per_lane, gen_step_chunk=8,
+                gen_prefix_cache_mb=0, gen_kv_block_size=block_size,
+                gen_kv_blocks=24, gen_mixed_step=True,
+                gen_mixed_token_budget=16,
+                # ON arm: admitted == decodable now (depth = decode
+                # slots) — a queued-but-doomed admission is exactly the
+                # goodput leak the control plane exists to close.
+                max_queue_depth=slots_per_lane if overload else 0,
+                priority_admission=overload, brownout=overload,
+                brownout_interval_s=0.15)
+            engine = InferenceEngine(spec, params=params, dtype="float32")
+            workers.append(WorkerNode(cfg, engine=engine))
+        gw = Gateway(workers, GatewayConfig(
+            overload_control=overload,
+            overload_max_inflight=(2 * lanes * slots_per_lane
+                                   if overload else 0)))
+        return workers, gw
+
+    def consume(gw, req, deadline_ms, out):
+        t0 = time.perf_counter()
+        toks, ttft, ok, shed = [], None, False, False
+        try:
+            for frame in gw.route_generate_stream(
+                    dict(req, deadline_ms=deadline_ms)):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    ok = "error" not in evt
+                    break
+                if ttft is None and evt.get("tokens"):
+                    ttft = time.perf_counter() - t0
+                toks.extend(evt.get("tokens", ()))
+        except ShedError:
+            shed = True
+        except Exception:
+            pass
+        out.put((req["request_id"], req["priority"], ok, shed, ttft,
+                 len(toks), (time.perf_counter() - t0) * 1e3))
+
+    def run_arm(overload: bool, rate_hz: float, deadline_ms: float):
+        workers, gw = make_fleet(overload)
+        try:
+            for w in workers:  # warm the compile set off the clock
+                w.handle_generate({"request_id": f"warm-{w.node_id}",
+                                   "prompt_tokens": [1, 2, 3, 4],
+                                   "max_new_tokens": 2})
+            out: "_q.Queue" = _q.Queue()
+            gaps = [rnd.expovariate(rate_hz) for _ in requests]
+            threads = []
+            t0 = time.perf_counter()
+            for req, gap in zip(requests, gaps):
+                time.sleep(gap)
+                th = threading.Thread(target=consume,
+                                      args=(gw, req, deadline_ms, out),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=600)
+            wall = time.perf_counter() - t0
+            by_tier = {t: {"offered": 0, "good": 0, "shed": 0,
+                           "missed": 0, "good_tokens": 0, "ttfts": []}
+                       for t in tiers}
+            while not out.empty():
+                rid, tier, ok, shed, ttft, n_toks, lat_ms = out.get()
+                d = by_tier[tier]
+                d["offered"] += 1
+                if ok and lat_ms <= deadline_ms:
+                    d["good"] += 1
+                    d["good_tokens"] += n_toks
+                    if ttft is not None:
+                        d["ttfts"].append(ttft)
+                elif shed:
+                    d["shed"] += 1
+                else:
+                    d["missed"] += 1
+            arm = {"overload_control": overload, "wall_s": round(wall, 3),
+                   "by_tier": {}}
+            for t in tiers:
+                d = by_tier[t]
+                d["ttfts"].sort()
+                arm["by_tier"][t] = {
+                    "offered": d["offered"], "good": d["good"],
+                    "shed": d["shed"], "missed": d["missed"],
+                    "goodput_tokens_per_s": round(
+                        d["good_tokens"] / wall, 3),
+                    "ttft_p99_ms": round(
+                        1e3 * (percentile(d["ttfts"], 99) or 0), 2),
+                }
+            st = gw.get_stats()
+            if overload:
+                arm["gateway_overload"] = st.get("overload")
+                arm["brownout"] = {
+                    w.node_id: w.get_health().get("brownout")
+                    for w in workers}
+            else:
+                arm["overload_block_absent"] = "overload" not in st
+            return arm
+        finally:
+            gw.stop()
+            for w in workers:
+                w.stop()
+
+    # Calibration: a full-concurrency burst on a warm uncontrolled
+    # fleet measures what the HOST actually sustains (sequential singles
+    # understate concurrent service on a shared-CPU mesh). Capacity =
+    # completed/wall; the deadline is twice the burst's mean latency —
+    # an at-capacity request makes it comfortably, one queued behind 2x
+    # overload does not.
+    workers, gw = make_fleet(False)
+    try:
+        for w in workers:
+            w.handle_generate({"request_id": f"cal-warm-{w.node_id}",
+                               "prompt_tokens": [1, 2, 3, 4],
+                               "max_new_tokens": 2})
+        n_cal = 2 * lanes * slots_per_lane
+        lats: list = []
+        lat_lock = threading.Lock()
+
+        def cal_one(i):
+            t1 = time.perf_counter()
+            gw.route_generate({"request_id": f"cal-{i}",
+                               "prompt_tokens": [5, 9, 3, 7],
+                               "max_new_tokens": max_new})
+            with lat_lock:
+                lats.append(time.perf_counter() - t1)
+
+        t0 = time.perf_counter()
+        cal_threads = [threading.Thread(target=cal_one, args=(i,),
+                                        daemon=True)
+                       for i in range(n_cal)]
+        for th in cal_threads:
+            th.start()
+        for th in cal_threads:
+            th.join(timeout=600)
+        cal_wall = time.perf_counter() - t0
+    finally:
+        gw.stop()
+        for w in workers:
+            w.stop()
+    svc_s = sum(lats) / max(1, len(lats))
+    capacity_hz = len(lats) / max(cal_wall, 1e-3)
+    rate_hz = 2.0 * capacity_hz
+    deadline_ms = max(400.0, 2.5 * svc_s * 1e3)
+
+    # Below-saturation identity: one idle-fleet stream per arm must be
+    # byte-identical (overload control must never touch stream bytes).
+    ident_req = {"request_id": "ident", "prompt_tokens": [5, 9, 3, 7],
+                 "max_new_tokens": max_new, "priority": "background"}
+    ident = {}
+    for overload in (False, True):
+        workers, gw = make_fleet(overload)
+        try:
+            frames = list(gw.route_generate_stream(dict(ident_req)))
+            toks = []
+            for f in frames:
+                evt = _parse_sse(f)
+                if evt and not evt.get("done"):
+                    toks.extend(evt.get("tokens", ()))
+            ident[overload] = toks
+        finally:
+            gw.stop()
+            for w in workers:
+                w.stop()
+
+    results = {"model": model, "lanes": lanes,
+               "slots_per_lane": slots_per_lane,
+               "n_requests": n_requests, "max_new": max_new,
+               "calibrated_service_s": round(svc_s, 3),
+               "offered_rate_hz": round(rate_hz, 3),
+               "estimated_capacity_hz": round(capacity_hz, 3),
+               "deadline_ms": round(deadline_ms, 1),
+               "streams_identical_below_saturation":
+                   bool(ident[False]) and ident[False] == ident[True]}
+    off = run_arm(False, rate_hz, deadline_ms)
+    record_partial("overload_off", off)
+    on = run_arm(True, rate_hz, deadline_ms)
+    record_partial("overload_on", on)
+    results["overload_off"], results["overload_on"] = off, on
+    on_hi = on["by_tier"]["interactive"]["goodput_tokens_per_s"]
+    off_hi = off["by_tier"]["interactive"]["goodput_tokens_per_s"]
+    results["interactive_goodput_gain"] = round(
+        on_hi / max(1e-9, off_hi), 2) if off_hi or on_hi else None
+    results["checks_passed"] = bool(
+        results["streams_identical_below_saturation"]
+        and off["overload_block_absent"]
+        and on_hi >= 1.5 * off_hi
+        and on_hi > 0)
+    return results
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -2028,7 +2281,7 @@ def _main() -> int:
                              "spec-ab", "spec-batch-ab", "mixed",
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
-                             "crash-ab", "affinity-ab"],
+                             "crash-ab", "affinity-ab", "overload-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -2062,7 +2315,8 @@ def _main() -> int:
         args.model = "gpt2"
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
-    if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab")
+    if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab",
+                          "overload-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -2138,6 +2392,21 @@ def _main() -> int:
             "unit": "fraction",
             "vs_baseline": result["failover_off"][
                 "stream_completion_rate"],
+            **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "overload-ab":
+        # Adaptive overload control A/B: in-process lanes on the host
+        # backend (admission ordering and goodput under saturation are
+        # the variables under test, not the chip).
+        result = run_overload_ab(model=args.model, quick=args.quick)
+        record_partial("overload_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "overload_interactive_goodput_gain",
+            "value": result["interactive_goodput_gain"], "unit": "x",
+            "vs_baseline": 1.5,
             **result,
         })
         return 0 if result["checks_passed"] else 1

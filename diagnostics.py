@@ -24,7 +24,8 @@ import sys
 OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
-#             --spec-parity step 9, --failover step 10, --lint step 11
+#             --spec-parity step 9, --failover step 10, --overload
+#             step 11, --lint step 12
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -89,8 +90,14 @@ def main() -> int:
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
+    ap.add_argument("--overload", action="store_true",
+                    help="step 11: overload-control state of the live "
+                         "system — the gateway's /stats overload block "
+                         "(in-flight gauge, tier/rate-limit sheds, "
+                         "pressure) and every lane's current brownout "
+                         "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 11: engine-lint static-analysis suite "
+                    help="step 12: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -98,7 +105,7 @@ def main() -> int:
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
               + int(args.spec_parity) + int(args.failover)
-              + int(args.lint))
+              + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -319,7 +326,56 @@ def main() -> int:
                 if p.poll() is None:
                     p.terminate()
 
-    # 11 (--lint): the engine-lint suite, in-process — the same gate
+    # 11 (--overload): overload-control state, live — the gateway's
+    # /stats overload block and each lane's brownout ladder stage. Works
+    # whether or not the flags are on: a defaults-off deployment reports
+    # "overload control off" (the additive blocks are absent), which is
+    # itself the wire-compat check in one line.
+    if args.overload:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.failover) + 1)
+        try:
+            status, stats = _get(gw, "/stats")
+            ov = stats.get("overload")
+            parts = []
+            if ov is None:
+                parts.append("gateway overload control off")
+            else:
+                parts.append(
+                    f"inflight {ov.get('inflight')}"
+                    + (f"/{ov['max_inflight']}" if "max_inflight" in ov
+                       else "")
+                    + f", pressure {ov.get('pressure')}, "
+                    f"sheds tier={ov.get('shed_tier')} "
+                    f"depth={ov.get('shed_depth')} "
+                    f"rate={ov.get('rate_limited')}")
+            # Brownout stage per lane: direct worker /health, or the
+            # combined front's per-lane breakdown.
+            lanes = {}
+            if workers:
+                for w in workers:
+                    try:
+                        _, h = _get(w, "/health")
+                        lanes[h.get("node_id", w)] = h.get("brownout")
+                    except Exception:
+                        lanes[w] = None
+            else:
+                _, h = _get(gw, "/health")
+                for node, lane_h in (h.get("lanes") or {}).items():
+                    lanes[node] = lane_h.get("brownout")
+            if any(b for b in lanes.values()):
+                parts.append("brownout " + ", ".join(
+                    f"{node}:{(b or {}).get('stage_name', 'off')}"
+                    f"[{(b or {}).get('stage', '-')}]"
+                    for node, b in sorted(lanes.items())))
+            else:
+                parts.append("brownout off on all lanes")
+            step(n, "overload control state", status == 200,
+                 "(" + "; ".join(parts) + ")")
+        except Exception as exc:
+            step(n, "overload control state", False, f"({exc})")
+
+    # 12 (--lint): the engine-lint suite, in-process — the same gate
     # tier-1 runs (tests/test_engine_lint.py), surfaced here so an
     # operator can check a working tree before pushing.
     if args.lint:

@@ -108,6 +108,11 @@ _RECEIVER_ALIASES = {
     "self.resilience": "ResilienceCounters",
     "self.failover": "FailoverCounters",
     "self.affinity": "AffinityCounters",
+    "self.overload": "OverloadCounters",
+    "self._tenant_bucket": "TenantRateLimiter",
+    "self._shed_stats": "SheddingStats",
+    "self._aimd": "AIMDLimit",
+    "self._brownout": "BrownoutController",
     "self.tracer": "SpanRecorder",
 }
 
@@ -137,14 +142,35 @@ ENGINE_REGISTRY = Registry(
             lock="BlockPool.lock",
             classes=("BlockPool",),
             receivers=("pool", "self._pool")),
-        # Gateway membership / routing state.
+        # Gateway membership / routing state (+ the overload-control
+        # in-flight gauge the tier fractions admit against).
         GuardedEntry(
             attrs=("_clients", "_breakers", "_ejected", "_model_rings",
                    "_untyped", "_latency", "_lane_recent",
                    "_affinity_assigned", "_hedge_pool", "default_model",
-                   "_total_requests", "_failovers"),
+                   "_total_requests", "_failovers", "_inflight"),
             lock="Gateway._lock",
             classes=("Gateway",)),
+        # Overload control (serving/overload.py): per-tenant token
+        # buckets, the AIMD limit state, the brownout ladder state, and
+        # the gateway shed-rate window — each class owns one lock.
+        GuardedEntry(
+            attrs=("_buckets",),
+            lock="TenantRateLimiter._lock",
+            classes=("TenantRateLimiter",)),
+        GuardedEntry(
+            attrs=("_limit", "_last_decrease", "_increases", "_decreases"),
+            lock="AIMDLimit._lock",
+            classes=("AIMDLimit",)),
+        GuardedEntry(
+            attrs=("_stage", "_over", "_under", "_escalations",
+                   "_restores", "_pressure", "_binding"),
+            lock="BrownoutController._lock",
+            classes=("BrownoutController",)),
+        GuardedEntry(
+            attrs=("_sheds", "_requests"),
+            lock="SheddingStats._lock",
+            classes=("SheddingStats",)),
         # Breaker state machine.
         GuardedEntry(
             attrs=("_state", "_failure_count", "_success_count",
@@ -179,9 +205,12 @@ ENGINE_REGISTRY = Registry(
     ),
     # BlockPool/RadixTree methods document "caller holds the pool lock":
     # the analyzer checks their CALL sites instead of their bodies.
-    caller_locked=frozenset({"BlockPool.*", "RadixTree.*"}),
+    caller_locked=frozenset({"BlockPool.*", "RadixTree.*",
+                             "TenantRateLimiter._evict_idle",
+                             "SheddingStats._gc"}),
     receiver_aliases=_RECEIVER_ALIASES,
-    counter_receivers=frozenset({"resilience", "failover", "affinity"}),
+    counter_receivers=frozenset({"resilience", "failover", "affinity",
+                                 "overload"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

@@ -1122,6 +1122,266 @@ def run_mixed_standalone() -> int:
             proc.kill()
 
 
+def launch_overload_server(attempts: int = 3):
+    """Spawn the combined server the --overload scenario drives: 3
+    continuous paged mixed-step lanes with EVERY overload knob on —
+    gateway tier admission + tenant buckets + load-derived Retry-After,
+    worker priority admission, and the staged brownout controller with
+    a tight control interval so the ladder moves within the run."""
+    from tpu_engine.utils.net import launch_with_retry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("TPU_ENGINE_PLATFORM", "cpu")
+
+    def spawn(port: int):
+        cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "serve",
+               "--model", "gpt2-small-test", "--lanes", "3",
+               "--port", str(port),
+               "--kv-block-size", "16", "--kv-blocks", "24",
+               "--mixed-step", "--mixed-token-budget", "16",
+               "--spec-k", "2",
+               "--max-queue-depth", "4",
+               "--default-deadline-ms", "30000",
+               "--overload-control", "--overload-max-inflight", "12",
+               "--tenant-rate", "1", "--tenant-burst", "3",
+               "--priority-admission",
+               "--brownout", "--brownout-clamp-tokens", "4",
+               "--native-front", "off"]
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=sys.stderr, stderr=sys.stderr)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChildProcessError(
+                    f"server exited rc={proc.returncode} before ready")
+            try:
+                status, _ = _call(port, "GET", "/stats", timeout=2.0)
+                if status == 200:
+                    return proc
+            except OSError:
+                pass
+            time.sleep(0.5)
+        proc.terminate()
+        raise TimeoutError("server never became ready")
+
+    return launch_with_retry(spawn, attempts=attempts)
+
+
+def _combined_pools_clean(port: int, timeout_s: float = 60.0):
+    """Poll combined /stats until every lane's scheduler is idle and all
+    KV blocks are accounted for (free list + radix-held) — the
+    zero-leak check after an overload storm."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _, stats = _call(port, "GET", "/stats", timeout=5.0)
+        except OSError:
+            time.sleep(0.3)
+            continue
+        pools = stats.get("kv_pool") or {}
+        mixed = stats.get("mixed") or {}
+        last = pools
+        if pools and all(
+                p["blocks_free"] + p["radix_nodes"] >= p["blocks_total"]
+                for p in pools.values()) and all(
+                (m.get("active") or 0) == 0 for m in mixed.values()):
+            return last
+        time.sleep(0.3)
+    return None
+
+
+def overload_phase(port: int, checks: list) -> dict:
+    """Mixed-priority Poisson load past saturation against a 3-lane
+    fleet with full overload control on. Asserts: low-tier requests shed
+    first (shed rate strictly ordered background > interactive), every
+    completed interactive request lands inside its deadline (p99), the
+    brownout ladder engages during the storm and clears after it
+    (escalations == restores > 0, every transition span-matched),
+    gateway overload counters == overload marker spans, and zero KV
+    blocks leak."""
+    import random
+    import threading
+
+    rng = random.Random(7)
+    deadline_ms = 25_000.0
+    tiers = ["interactive", "batch", "background"]
+    reqs = []
+    for i in range(42):
+        tier = tiers[i % 3]
+        reqs.append({
+            "request_id": f"ov_{tier}_{i}",
+            "prompt_tokens": [5, 9, 3, (i % 7) + 2],
+            "max_new_tokens": 8,
+            "priority": tier,
+            # One flooding tenant shares a 1 req/s bucket; the rest are
+            # distinct tenants — the bucket must punish only the flood.
+            # The flood rides the BACKGROUND slice (i % 3 == 2), so its
+            # rate-limit 503s can never inflate interactive's shed rate
+            # and muddy the lowest-tier-first assertion.
+            "tenant": "flood" if i % 3 == 2 else f"t{i}",
+            "deadline_ms": deadline_ms,
+        })
+
+    results = {}
+    res_lock = threading.Lock()
+
+    def fire(req):
+        t0 = time.perf_counter()
+        try:
+            status, body = _call(port, "POST", "/generate", req,
+                                 timeout=120.0)
+        except OSError as exc:
+            status, body = -1, {"error": str(exc)}
+        with res_lock:
+            results[req["request_id"]] = (
+                status, (time.perf_counter() - t0) * 1e3, body)
+
+    # Brownout stage observer: sample every lane's ladder while the
+    # storm runs — the engage/clear evidence.
+    stages = {}
+    stop_obs = threading.Event()
+
+    def observe():
+        while not stop_obs.is_set():
+            try:
+                _, h = _call(port, "GET", "/health", timeout=5.0)
+                for node, lane in (h.get("lanes") or {}).items():
+                    bo = lane.get("brownout") or {}
+                    stages.setdefault(node, []).append(bo.get("stage", 0))
+            except OSError:
+                pass
+            stop_obs.wait(0.15)
+
+    obs = threading.Thread(target=observe, daemon=True)
+    obs.start()
+    threads = []
+    for req in reqs:
+        t = threading.Thread(target=fire, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(rng.expovariate(12.0))  # ~12 arrivals/s >> capacity
+    for t in threads:
+        t.join(timeout=300)
+    # Let the ladder walk back down before sampling the final state.
+    drain_deadline = time.monotonic() + 30
+    while time.monotonic() < drain_deadline:
+        _, h = _call(port, "GET", "/health", timeout=5.0)
+        lanes = h.get("lanes") or {}
+        if all((l.get("brownout") or {}).get("stage", 0) == 0
+               for l in lanes.values()):
+            break
+        time.sleep(0.3)
+    stop_obs.set()
+    obs.join(timeout=5)
+
+    by_tier = {t: {"ok": 0, "shed": 0, "other": 0, "lat_ms": []}
+               for t in tiers}
+    for rid, (status, lat_ms, body) in results.items():
+        tier = rid.split("_")[1]
+        if status == 200:
+            by_tier[tier]["ok"] += 1
+            by_tier[tier]["lat_ms"].append(lat_ms)
+        elif status == 503:
+            by_tier[tier]["shed"] += 1
+        else:
+            by_tier[tier]["other"] += 1
+
+    def shed_rate(t):
+        d = by_tier[t]
+        n = d["ok"] + d["shed"] + d["other"]
+        return d["shed"] / max(1, n)
+
+    inter = by_tier["interactive"]
+    lat = sorted(inter["lat_ms"])
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else None
+
+    _, stats = _call(port, "GET", "/stats")
+    _, health = _call(port, "GET", "/health")
+    ov = stats.get("overload") or {}
+    lanes = health.get("lanes") or {}
+    bo = {node: lane.get("brownout") or {} for node, lane in lanes.items()}
+    max_stage = {node: max(s) if s else 0 for node, s in stages.items()}
+
+    # counters == spans: every gateway overload decision and every
+    # brownout transition has its marker span in /trace/export.
+    _, export = _call(port, "GET", "/trace/export")
+    events = [e for e in export.get("traceEvents", [])
+              if e.get("ph") == "X" and e.get("name") == "overload"]
+    gw_spans = sum(1 for e in events
+                   if "decision" in (e.get("args") or {}))
+    bo_spans = sum(1 for e in events
+                   if "action" in (e.get("args") or {}))
+    gw_count = (ov.get("rate_limited", 0) + ov.get("shed_tier", 0)
+                + ov.get("shed_depth", 0))
+    bo_count = sum(b.get("escalations", 0) + b.get("restores", 0)
+                   for b in bo.values())
+
+    checks.append(("every request resolved (no hangs/errors)",
+                   len(results) == len(reqs)
+                   and all(d["other"] == 0 for d in by_tier.values())))
+    checks.append(("overload sheds observed (fleet was saturated)",
+                   sum(d["shed"] for d in by_tier.values()) > 0))
+    checks.append(("low tier sheds first (background > interactive)",
+                   shed_rate("background") > shed_rate("interactive")))
+    checks.append(("interactive goodput survives (completions > 0)",
+                   inter["ok"] > 0))
+    checks.append(("interactive p99 under its deadline",
+                   p99 is not None and p99 < deadline_ms))
+    checks.append(("flooding tenant rate-limited",
+                   ov.get("rate_limited", 0) > 0))
+    checks.append(("brownout engaged during the storm (some lane)",
+                   any(m >= 1 for m in max_stage.values())))
+    checks.append(("brownout cleared after the storm (all lanes stage 0)",
+                   all(b.get("stage", 1) == 0 for b in bo.values())
+                   and bool(bo)))
+    checks.append(("brownout escalations == restores (ladder walked "
+                   "back down in order)",
+                   bo_count > 0 and all(
+                       b.get("escalations", 0) == b.get("restores", -1)
+                       for b in bo.values())))
+    checks.append(("gateway overload counters == overload marker spans",
+                   gw_count == gw_spans))
+    checks.append(("brownout transitions == overload spans on lanes",
+                   bo_count == bo_spans))
+    pools = _combined_pools_clean(port)
+    checks.append(("zero KV blocks leaked after the storm",
+                   pools is not None))
+    return {
+        "by_tier": {t: {"ok": d["ok"], "shed": d["shed"],
+                        "other": d["other"],
+                        "shed_rate": round(shed_rate(t), 3)}
+                    for t, d in by_tier.items()},
+        "interactive_p99_ms": round(p99, 1) if p99 is not None else None,
+        "deadline_ms": deadline_ms,
+        "gateway_overload": ov,
+        "brownout": bo,
+        "brownout_max_stage_observed": max_stage,
+        "spans": {"gateway": gw_spans, "brownout": bo_spans},
+        "kv_pools_after": pools,
+    }
+
+
+def run_overload_standalone() -> int:
+    port, proc = launch_overload_server()
+    checks: list = []
+    try:
+        report = {"mode": "overload-standalone", "port": port,
+                  "phases": {"overload": overload_phase(port, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=8000)
@@ -1170,7 +1430,19 @@ def main() -> int:
                          "completes byte-identically with zero device or "
                          "host blocks leaked on the survivors; ignores "
                          "the other flags")
+    ap.add_argument("--overload", action="store_true",
+                    help="standalone overload-control scenario: spawns a "
+                         "3-lane combined server with every overload "
+                         "knob on, drives mixed-priority Poisson load "
+                         "past saturation, and asserts low-tier "
+                         "requests shed first, interactive p99 stays "
+                         "under its deadline, the brownout ladder "
+                         "engages and clears in order, counters == "
+                         "marker spans, and zero KV blocks leak; "
+                         "ignores the other flags")
     args = ap.parse_args()
+    if args.overload:
+        return run_overload_standalone()
     if args.mixed:
         return run_mixed_standalone()
     if args.spec:

@@ -463,6 +463,17 @@ class ContinuousGenerator:
         # `mixed_step` spans carrying prefill_tokens/decode_rows attrs.
         self.tracer = None
         self.trace_node = "scheduler"
+        # Staged brownout degradations (set_brownout; driven by the
+        # serving worker's overload control loop, DESIGN.md "Overload
+        # control"). Plain attribute writes from the control thread,
+        # read per tick/lookup by the decode and prefill threads —
+        # floats/bools are GIL-atomic, and a one-tick-stale read only
+        # shifts WHEN a degradation engages, never correctness. All
+        # three degrade WORK SHAPE, not stream content: greedy streams
+        # stay byte-identical under every stage.
+        self._bo_budget_frac = 1.0   # mixed-step token budget multiplier
+        self._bo_spec_off = False    # suspend speculative drafting
+        self._bo_defer_swap = False  # defer host-tier swap-ins
         # Liveness: stamped at the top of every decode-loop iteration.
         # The loop iterates continuously even when idle (bounded admission
         # waits), so a growing age means the loop is WEDGED — inside a
@@ -1016,6 +1027,31 @@ class ContinuousGenerator:
             with self._pool.lock:
                 self._pool.radix.clear()
 
+    def set_brownout(self, budget_frac: float = 1.0,
+                     suspend_spec: bool = False,
+                     defer_swap_in: bool = False) -> None:
+        """Apply one brownout stage's degradations (idempotent; restore
+        = call with the defaults). ``budget_frac`` scales the mixed-step
+        per-tick token budget (the compiled chunk cap is untouched, so
+        no stage ever compiles a new executable width);
+        ``suspend_spec`` stops the drafter proposing (verify windows
+        collapse to plain q_len-1 rows through the same compiled
+        dispatch — greedy streams byte-identical); ``defer_swap_in``
+        makes radix hits on demoted prefixes stop at the resident
+        prefix (counted ``swap_in_deferred``) instead of promoting."""
+        self._bo_budget_frac = min(1.0, max(0.05, float(budget_frac)))
+        self._bo_spec_off = bool(suspend_spec)
+        self._bo_defer_swap = bool(defer_swap_in)
+
+    def _effective_mixed_budget(self) -> int:
+        """The per-tick token budget currently in force: the configured
+        budget scaled by the brownout fraction (floored at 1 so the
+        budget rule's admission-progress guarantee survives)."""
+        f = self._bo_budget_frac
+        if f >= 1.0:
+            return self._mixed_budget
+        return max(1, int(self._mixed_budget * f))
+
     def stats(self) -> dict:
         now = time.monotonic()
         busy = self._prefill_busy_since
@@ -1048,6 +1084,13 @@ class ContinuousGenerator:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = \
                 len(self._pending)  # lint: lockfree-ok GIL-safe deque len
+        # Additive, present only while a brownout degradation is engaged
+        # (defaults-off stats bytes unchanged).
+        if (self._bo_budget_frac < 1.0 or self._bo_spec_off
+                or self._bo_defer_swap):
+            out["brownout"] = {"budget_frac": self._bo_budget_frac,
+                               "spec_suspended": self._bo_spec_off,
+                               "swap_in_deferred": self._bo_defer_swap}
         return out
 
     def stop(self) -> None:
@@ -1210,6 +1253,17 @@ class ContinuousGenerator:
         rows = self._row_req  # lint: lockfree-ok documented ±1-stale read
         return sum(1 for r in rows if r is not None)
 
+    def _swap_reserve(self) -> int:
+        """The promote_reserve a radix lookup passes: the live-row
+        reserve, or — under brownout swap-in deferral — the whole pool,
+        which no promotion can satisfy, so every demoted hit stops at
+        the resident prefix and counts ``swap_in_deferred`` (the
+        degradation stays visible in the same counter the reserve rule
+        already uses)."""
+        if self._bo_defer_swap:
+            return self._pool.num_blocks
+        return self._promote_reserve()
+
     def _record_swap_in(self, req: _Request, swapped: int,
                         t0: float) -> None:
         """One ``swap_in`` stage span per lookup that promoted demoted
@@ -1246,7 +1300,7 @@ class ContinuousGenerator:
             if self._prefix_sharing:
                 si0 = pool.swap_ins
                 matched = pool.radix.lookup(          # pins for this row
-                    prompt, promote_reserve=self._promote_reserve())
+                    prompt, promote_reserve=self._swap_reserve())
                 swapped = pool.swap_ins - si0
         m_tok = len(matched) * bs
         self._record_swap_in(req, swapped, t0)
@@ -1332,7 +1386,7 @@ class ContinuousGenerator:
             if self._prefix_sharing:
                 si0 = pool.swap_ins
                 matched = pool.radix.lookup(          # pins for this row
-                    prompt, promote_reserve=self._promote_reserve())
+                    prompt, promote_reserve=self._swap_reserve())
                 swapped = pool.swap_ins - si0
         self._record_swap_in(req, swapped, t0)
         if req.sink is not None:
@@ -1918,7 +1972,7 @@ class ContinuousGenerator:
                 prefill_rows.append(r)
             else:
                 n_decode += 1
-        budget_left = max(1, self._mixed_budget - n_decode)
+        budget_left = max(1, self._effective_mixed_budget() - n_decode)
         chunk = np.zeros((B,), np.int32)
         for r in prefill_rows:
             remaining = max(self._row_L[r], 1) - self._row_w0[r]
@@ -2068,7 +2122,7 @@ class ContinuousGenerator:
             # Mixed budget rule unchanged: decode rows count 1 each (the
             # verify window RE-DERIVES tokens, it does not widen the
             # budgeted stream), remainder over admitting rows.
-            budget_left = max(1, self._mixed_budget - n_decode)
+            budget_left = max(1, self._effective_mixed_budget() - n_decode)
             for r in prefill_rows:
                 remaining = max(self._row_L[r], 1) - self._row_w0[r]
                 c = min(remaining, self._chunk_cap, budget_left)
@@ -2081,8 +2135,11 @@ class ContinuousGenerator:
         drafts: List[List[int]] = [[] for _ in range(B)]
         proposed = 0
         for r, req in enumerate(self._row_req):
-            if (req is None or self._done[r]
+            if (req is None or self._done[r] or self._bo_spec_off
                     or (self._mixed and self._prefilling[r])):
+                # Brownout spec suspension: no proposals — every row
+                # rides q_len 1 through the same compiled dispatch
+                # (greedy streams byte-identical, drafter work skipped).
                 continue
             kcap = min(self._spec_k,
                        req.max_new - len(self._row_emitted[r]) - 1,

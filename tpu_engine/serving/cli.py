@@ -111,6 +111,19 @@ def main(argv=None) -> int:
                                  "reads unhealthy when the loop has not "
                                  "ticked for this long (0/unset = report "
                                  "age only)")
+        parser.add_argument("--priority-admission", action="store_true",
+                            help="shed lowest-priority-tier first under "
+                                 "depth pressure (requests carry "
+                                 "priority: interactive|batch|background)")
+        parser.add_argument("--adaptive-depth", action="store_true",
+                            help="AIMD adaptive concurrency limit driven "
+                                 "by observed latency vs the "
+                                 "sliding-window baseline")
+        parser.add_argument("--brownout", action="store_true",
+                            help="staged brownout controller: degrade "
+                                 "gracefully (budget shrink, spec off, "
+                                 "swap-in deferral, low-tier clamp) "
+                                 "before shedding")
         args = parser.parse_args(rest)
         port = args.port
         node_id = args.node_id or f"worker_{port}"
@@ -150,6 +163,12 @@ def main(argv=None) -> int:
             gen_kw["gen_prefill_chunk"] = args.prefill_chunk
         if args.scheduler_stall_s is not None:
             gen_kw["scheduler_stall_s"] = args.scheduler_stall_s
+        if args.priority_admission:
+            gen_kw["priority_admission"] = True
+        if args.adaptive_depth:
+            gen_kw["adaptive_depth"] = True
+        if args.brownout:
+            gen_kw["brownout"] = True
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
                            model_path=model_path, **gen_kw)
@@ -210,8 +229,26 @@ def main(argv=None) -> int:
                                  "it is this many recent dispatches hotter "
                                  "than its least-loaded peer (0 = always "
                                  "honor affinity)")
+        parser.add_argument("--overload-control", action="store_true",
+                            help="priority-tiered gateway admission "
+                                 "(lowest tier sheds first as "
+                                 "--overload-max-inflight fills) + "
+                                 "load-derived Retry-After on sheds")
+        parser.add_argument("--overload-max-inflight", type=int,
+                            default=None,
+                            help="gateway in-flight gauge for tier "
+                                 "admission (0 = no gauge)")
+        parser.add_argument("--tenant-rate", type=float, default=None,
+                            help="per-tenant token-bucket rate limit "
+                                 "(requests/s; 0 = off)")
         args = parser.parse_args(rest)
         gw_kw = {}
+        if args.overload_control:
+            gw_kw["overload_control"] = True
+        if args.overload_max_inflight is not None:
+            gw_kw["overload_max_inflight"] = args.overload_max_inflight
+        if args.tenant_rate is not None:
+            gw_kw["tenant_rate"] = args.tenant_rate
         if args.retry_budget is not None:
             gw_kw["retry_budget_ratio"] = args.retry_budget
         if args.prefix_affinity:
@@ -303,6 +340,57 @@ def main(argv=None) -> int:
                             help="per-lane admission cap: concurrent "
                                  "requests beyond this shed 503 "
                                  "(default 0 = unbounded)")
+        # -- adaptive overload control (DESIGN.md "Overload control";
+        # every knob defaults off = behavior above unchanged) ------------
+        parser.add_argument("--overload-control", action="store_true",
+                            help="gateway overload control: "
+                                 "priority-tiered admission (requests "
+                                 "carry priority: interactive | batch | "
+                                 "background; lowest tier sheds first "
+                                 "as --overload-max-inflight fills) and "
+                                 "load-derived Retry-After on sheds")
+        parser.add_argument("--overload-max-inflight", type=int,
+                            default=None,
+                            help="gateway in-flight gauge the tier "
+                                 "fractions admit against (background "
+                                 "sheds at 70%%, batch at 85%%, "
+                                 "interactive at 100%%; 0 = no gauge)")
+        parser.add_argument("--tenant-rate", type=float, default=None,
+                            help="per-tenant token bucket: each tenant "
+                                 "(request \"tenant\" key) sustains this "
+                                 "many requests/s; excess sheds 503 with "
+                                 "the bucket's refill time as "
+                                 "Retry-After (0 = off)")
+        parser.add_argument("--tenant-burst", type=float, default=None,
+                            help="token-bucket depth per tenant "
+                                 "(default 0 = auto: 2x rate)")
+        parser.add_argument("--priority-admission", action="store_true",
+                            help="worker lanes shed lowest-priority-tier "
+                                 "first under depth pressure (tier "
+                                 "fractions of the lane's concurrency "
+                                 "limit)")
+        parser.add_argument("--adaptive-depth", action="store_true",
+                            help="AIMD adaptive concurrency limit per "
+                                 "lane: replaces the static "
+                                 "--max-queue-depth cap with a limit "
+                                 "driven by observed latency vs the "
+                                 "sliding-window baseline")
+        parser.add_argument("--brownout", action="store_true",
+                            help="staged brownout: a per-lane control "
+                                 "loop reads saturation signals (tick "
+                                 "age, queue depth, pool starvation, "
+                                 "deadline misses) and degrades "
+                                 "gracefully — shrink the mixed token "
+                                 "budget, suspend speculation, defer "
+                                 "host-tier swap-ins, clamp low-tier "
+                                 "token budgets — before any shed, "
+                                 "restoring in reverse as pressure "
+                                 "clears")
+        parser.add_argument("--brownout-clamp-tokens", type=int,
+                            default=None,
+                            help="stage-4 max_new_tokens ceiling for "
+                                 "below-top-tier generate requests "
+                                 "(default 32)")
         parser.add_argument("--failover-streams", action="store_true",
                             help="crash-tolerant streaming: journal "
                                  "/generate/stream token events and resume "
@@ -469,6 +557,14 @@ def main(argv=None) -> int:
             gw_kw["failover_streams"] = True
         if args.health_probe_interval is not None:
             gw_kw["health_probe_interval_s"] = args.health_probe_interval
+        if args.overload_control:
+            gw_kw["overload_control"] = True
+        if args.overload_max_inflight is not None:
+            gw_kw["overload_max_inflight"] = args.overload_max_inflight
+        if args.tenant_rate is not None:
+            gw_kw["tenant_rate"] = args.tenant_rate
+        if args.tenant_burst is not None:
+            gw_kw["tenant_burst"] = args.tenant_burst
         if args.prefix_affinity:
             gw_kw["prefix_affinity"] = True
             # Fingerprint granularity defaults to the lanes' actual block
@@ -511,6 +607,14 @@ def main(argv=None) -> int:
             bb_kw["max_queue_depth"] = args.max_queue_depth
         if args.scheduler_stall_s is not None:
             bb_kw["scheduler_stall_s"] = args.scheduler_stall_s
+        if args.priority_admission:
+            bb_kw["priority_admission"] = True
+        if args.adaptive_depth:
+            bb_kw["adaptive_depth"] = True
+        if args.brownout:
+            bb_kw["brownout"] = True
+        if args.brownout_clamp_tokens is not None:
+            bb_kw["brownout_clamp_tokens"] = args.brownout_clamp_tokens
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,

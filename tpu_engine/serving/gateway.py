@@ -54,6 +54,16 @@ from tpu_engine.serving.clients import (
     WorkerError,
 )
 from tpu_engine.serving.http import sse_event
+from tpu_engine.serving.overload import (
+    OverloadCounters,
+    SheddingStats,
+    TenantRateLimiter,
+    TIER_ADMIT_FRAC,
+    TIER_NAMES,
+    load_retry_after,
+    parse_priority,
+    tier_limit,
+)
 from tpu_engine.serving.resilience import (
     AffinityCounters,
     FailoverCounters,
@@ -187,6 +197,22 @@ class Gateway:
         self.affinity = AffinityCounters()
         self._affinity_assigned: Dict[str, int] = {}
         self._lane_recent: Dict[str, object] = {}  # lane -> deque[ts]
+        # Adaptive overload control (DESIGN.md "Overload control"):
+        # priority-tiered admission against the in-flight gauge, the
+        # per-tenant token bucket, and the load-derived Retry-After.
+        # Every decision counted in the additive /stats `overload` block
+        # with a matching `overload` marker span.
+        self.overload = OverloadCounters()
+        self._tenant_bucket: Optional[TenantRateLimiter] = (
+            TenantRateLimiter(self.config.tenant_rate,
+                              self.config.tenant_burst)
+            if self.config.tenant_rate > 0 else None)
+        # In-flight requests currently inside the routing layer — the
+        # gauge the tier fractions admit against (guarded by _lock).
+        self._inflight = 0
+        # Recent shed rate: the pressure source for load_retry_after
+        # when no in-flight gauge is configured.
+        self._shed_stats = SheddingStats()
         self._ejected: set = set()
         self._probe_state = ProbeStateMachine(
             self.config.health_probe_failures)
@@ -807,8 +833,18 @@ class Gateway:
         ``out_info``: optional dict the dispatch layer fills with
         ``{"lane": name}`` on success — the resume journal needs to know
         which lane served a stream to skip it on the next attempt."""
+        # In-flight gauge + shed-rate window (overload control only — a
+        # defaults-only gateway pays nothing): the gauge covers the
+        # request's whole residency — blocking ops until their response,
+        # streams until their event iterator finishes (the decrement is
+        # handed to a wrapper below), so a stream-heavy fleet's gauge
+        # actually fills and tier admission/pressure stay live.
+        overload_on = (self.config.overload_control
+                       or self._tenant_bucket is not None)
         with self._lock:
             self._total_requests += 1
+            if overload_on:
+                self._inflight += 1
         self._retry_budget.record_request()
         # Anonymous requests get a stable server-side request_id (minted
         # once, forwarded to the lane, echoed in the response) instead of
@@ -822,10 +858,17 @@ class Gateway:
         trace = _RouteTrace(request_id, TraceContext.from_request(payload))
         t0 = time.perf_counter()
         start = time.time()
+        handed_off = False
         try:
             result = self._route_inner(payload, op, request_id, trace,
                                        skip=skip, out_info=out_info)
             trace.outcome = "ok"
+            if (overload_on and op == "generate_stream"
+                    and hasattr(result, "__iter__")):
+                # The stream occupies the gauge until its iterator
+                # settles; the wrapper owns the decrement from here.
+                result = self._inflight_watched(result)
+                handed_off = True
             return result
         except ShedError as exc:
             trace.outcome = exc.kind
@@ -834,6 +877,13 @@ class Gateway:
             trace.outcome = "error"
             raise
         finally:
+            if overload_on:
+                if not handed_off:
+                    with self._lock:
+                        self._inflight -= 1
+                # Congestion refusals (not deadline expiries, not faults)
+                # feed the shed-rate pressure window.
+                self._shed_stats.record(trace.outcome == "overloaded")
             self.tracer.record(
                 request_id, "route", "gateway",
                 (time.perf_counter() - t0) * 1e6,
@@ -870,6 +920,12 @@ class Gateway:
                 "deadline exceeded at gateway admission"))
             exc.stage = "gateway_admission"
             raise exc
+        # Overload control (default off): per-tenant rate limiting and
+        # priority-tiered admission against the in-flight gauge — the
+        # lowest tier sheds first, and every refusal carries a
+        # load-derived Retry-After.
+        if self._tenant_bucket is not None or self.config.overload_control:
+            self._overload_admit(payload, trace)
         # "model" restricts routing AND failover to that model's sub-ring;
         # without the field, multi-model gateways use the deterministic
         # default model, single-model gateways the global ring.
@@ -932,9 +988,108 @@ class Gateway:
         return result
 
     def _shed(self, exc):
-        """Stamp a shed-class exception with the configured Retry-After."""
-        exc.retry_after_s = self.config.shed_retry_after_s
+        """Stamp a shed-class exception with the Retry-After hint: the
+        configured constant, or — with overload control on — that base
+        scaled by measured pressure (monotone: the more saturated the
+        fleet, the longer clients are told to stay away)."""
+        if self.config.overload_control:
+            exc.retry_after_s = load_retry_after(
+                self.config.shed_retry_after_s, self._overload_pressure())
+        else:
+            exc.retry_after_s = self.config.shed_retry_after_s
         return exc
+
+    # -- overload control ------------------------------------------------------
+
+    def _inflight_watched(self, it):
+        """Relay a stream iterator unchanged, decrementing the in-flight
+        gauge exactly once when it finishes (exhaustion, error, or the
+        client closing early)."""
+        def watched():
+            try:
+                yield from it
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+        return watched()
+
+    def _overload_pressure(self) -> float:
+        """Measured congestion in [0, inf): the in-flight gauge's fill
+        fraction when one is configured, else the recent shed rate —
+        either way 0 when idle and growing with actual refusal risk."""
+        if self.config.overload_max_inflight > 0:
+            with self._lock:
+                inflight = self._inflight
+            return inflight / self.config.overload_max_inflight
+        return self._shed_stats.pressure()
+
+    def _overload_count(self, trace: Optional[_RouteTrace], decision: str,
+                        **attrs) -> None:
+        """Bump an overload counter AND drop a zero-duration ``overload``
+        marker span under the request's route span (same counters==spans
+        discipline as the resilience/affinity markers; fault_injection
+        --overload asserts the two agree)."""
+        self.overload.bump(decision)
+        if trace is not None:
+            child = trace.ctx.child()
+            self.tracer.record(
+                trace.request_id, "overload", "gateway", 0,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=trace.ctx.span_id, start_ts=time.time(),
+                attrs={"decision": decision, **attrs})
+
+    def _overload_admit(self, payload: dict,
+                        trace: Optional[_RouteTrace]) -> None:
+        """Gateway overload admission, cheapest check first. Order
+        matters: the tenant bucket refuses a flooding tenant even while
+        the fleet has headroom (fairness is not a congestion question);
+        tier admission then sheds lowest-tier-first as the in-flight
+        gauge fills, and only a gauge at its full limit refuses
+        top-tier work."""
+        cfg = self.config
+        if self._tenant_bucket is not None:
+            tenant = str(payload.get("tenant", "default"))
+            ok, wait = self._tenant_bucket.allow(tenant)
+            if not ok:
+                self._overload_count(trace, "rate_limited", tenant=tenant)
+                exc = self._shed(Overloaded(
+                    f"tenant '{tenant}' over its rate limit "
+                    f"({cfg.tenant_rate:g} req/s)"))
+                # The bucket knows its actual refill time; never suggest
+                # retrying sooner than a token can exist.
+                exc.retry_after_s = max(exc.retry_after_s, wait)
+                exc.cause = "rate_limit"
+                exc.stage = "gateway_admission"
+                raise exc
+        if not cfg.overload_control:
+            return
+        # Unknown value -> wire 400 whenever the master switch is on,
+        # gauge or no gauge — a typo'd priority must never silently ride
+        # as routable traffic (MIGRATION.md documents the contract).
+        tier = parse_priority(payload)
+        limit = cfg.overload_max_inflight
+        if limit <= 0:
+            return  # no gauge: tier admission off, validation only
+        with self._lock:
+            inflight = self._inflight  # includes this request
+        if inflight > limit:
+            self._overload_count(trace, "shed_depth",
+                                 tier=TIER_NAMES[tier])
+            exc = self._shed(Overloaded(
+                f"gateway at max in-flight {limit}"))
+            exc.cause = "depth"
+            exc.stage = "gateway_admission"
+            raise exc
+        if (tier < len(TIER_ADMIT_FRAC) - 1
+                and inflight > tier_limit(limit, tier)):
+            self._overload_count(trace, "shed_tier",
+                                 tier=TIER_NAMES[tier])
+            exc = self._shed(Overloaded(
+                f"gateway shedding priority tier '{TIER_NAMES[tier]}' "
+                f"at {inflight}/{limit} in flight"))
+            exc.cause = "tier"
+            exc.stage = "gateway_admission"
+            raise exc
 
     @staticmethod
     def _with_deadline(payload: dict, deadline: Optional[Deadline]) -> dict:
@@ -1373,4 +1528,17 @@ class Gateway:
             with self._lock:
                 aff["assigned"] = dict(self._affinity_assigned)
             out["affinity"] = aff
+        # Additive "overload" block (adaptive overload control), same
+        # gating discipline: present only once configured or exercised.
+        if (self.config.overload_control or self._tenant_bucket is not None
+                or self.overload.any_nonzero()):
+            ov = self.overload.as_dict()
+            ov["pressure"] = round(self._overload_pressure(), 4)
+            with self._lock:
+                ov["inflight"] = self._inflight
+            if self.config.overload_max_inflight > 0:
+                ov["max_inflight"] = self.config.overload_max_inflight
+            if self._tenant_bucket is not None:
+                ov["tenants"] = self._tenant_bucket.tenants()
+            out["overload"] = ov
         return out

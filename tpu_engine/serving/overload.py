@@ -1,0 +1,374 @@
+"""Adaptive overload control: the decisions that keep goodput flat when
+offered load exceeds capacity (DESIGN.md "Overload control").
+
+The PR 1 resilience layer gave the engine binary, static overload
+answers: a fixed ``max_queue_depth``, a constant ``Retry-After``, and
+saturation that melts every tenant and every request class equally. This
+module adds the production-serving pieces — all default-off, all
+wire-compatible at defaults:
+
+- **Priority tiers** (``parse_priority``): requests carry an optional
+  ``"priority"`` field (``interactive`` > ``batch`` > ``background``);
+  under pressure the gateway and worker admission controllers shed
+  lowest-tier-first (each tier admits only up to its fraction of the
+  concurrency limit, the top tier up to the full limit).
+- **Per-tenant token bucket** (``TenantRateLimiter``): one tenant's
+  burst cannot starve the fleet — excess sheds at the gateway with a
+  Retry-After derived from the bucket's actual refill time.
+- **AIMD concurrency limit** (``AIMDLimit``): replaces the static depth
+  cap with a limit driven by observed latency vs the sliding-window
+  baseline — additive increase while latency tracks the baseline,
+  multiplicative decrease when it blows past ``tolerance`` x baseline
+  (the classic congestion-control shape: probe up, back off fast).
+- **Load-derived Retry-After** (``load_retry_after``): shed responses
+  tell clients how long to back off as a monotone function of measured
+  pressure instead of a constant.
+- **Staged brownout** (``BrownoutController``): a small control loop
+  reads saturation signals that already exist (decode-loop tick age,
+  admission queue depth, pool starvation, deadline-miss rate) and walks
+  a degradation ladder with hysteresis — shrink the mixed token budget,
+  suspend speculative decoding, defer host-tier swap-ins, clamp low-tier
+  token budgets — cheapening the work the engine keeps BEFORE any shed
+  fires, and restoring in reverse as pressure clears.
+
+Pure decision logic lives here (unit-testable, no threads of its own);
+the gateway and worker own the wiring and the control loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, Optional, Tuple
+
+from tpu_engine.serving.resilience import (
+    LatencyTracker,
+    ResilienceCounters,
+    tier_cap,
+)
+
+# -- priority tiers -----------------------------------------------------------
+
+# Higher number = higher priority = shed LAST. The per-tier admission
+# fraction says how much of the concurrency limit each tier may consume:
+# background sheds once the lane/gateway is 70% full, batch at 85%, and
+# interactive only at the full limit — the lowest tier always sheds
+# first, and headroom for interactive traffic survives a batch flood.
+PRIORITY_TIERS: Dict[str, int] = {"background": 0, "batch": 1,
+                                  "interactive": 2}
+TIER_NAMES: Tuple[str, ...] = ("background", "batch", "interactive")
+TOP_TIER: int = PRIORITY_TIERS["interactive"]
+TIER_ADMIT_FRAC: Tuple[float, ...] = (0.70, 0.85, 1.0)
+
+
+def parse_priority(payload: dict, default: str = "interactive") -> int:
+    """The request's priority tier. Absent field -> ``default`` (old
+    clients are never implicitly deprioritized below new traffic). An
+    unknown value is a client error (ValueError -> wire 400), never a
+    silent default — a typo'd ``"prority"`` IS silently the default,
+    which is exactly the additive-field contract (MIGRATION.md)."""
+    raw = payload.get("priority", default)
+    tier = PRIORITY_TIERS.get(str(raw))
+    if tier is None:
+        raise ValueError(
+            f"priority must be one of {sorted(PRIORITY_TIERS)}, got {raw!r}")
+    return tier
+
+
+def tier_limit(limit: int, tier: int) -> int:
+    """Admitted-depth ceiling for `tier` under a concurrency `limit` —
+    ``resilience.tier_cap`` (the single definition of the fraction-floor
+    rule) applied to the standard tier table."""
+    return tier_cap(limit, TIER_ADMIT_FRAC[max(0, min(tier, TOP_TIER))])
+
+
+def load_retry_after(base_s: float, pressure: float,
+                     max_s: float = 30.0) -> float:
+    """Suggested client back-off under measured ``pressure`` (0 = idle,
+    1 = at the concurrency limit, >1 = over it): ``base * (1 + pressure)``
+    clamped to ``max_s`` — monotone in pressure, never below the
+    configured base, so the herd spreads out exactly when the fleet
+    needs it to (the constant the PR 1 gateway sent did not)."""
+    p = max(0.0, float(pressure))
+    return min(float(max_s), float(base_s) * (1.0 + p))
+
+
+# -- per-tenant token bucket --------------------------------------------------
+
+class TenantRateLimiter:
+    """Per-tenant token buckets: ``rate`` requests/s sustained,
+    ``burst`` tokens of depth (0 = auto: 2x rate, min 1). ``allow``
+    refills lazily from monotonic time, so idle tenants cost nothing;
+    the tenant map is bounded by evicting buckets idle longer than
+    ``idle_evict_s`` (a full bucket holds no state worth keeping).
+
+    Fairness property: tenant A exhausting its bucket never consumes
+    tenant B's tokens — the whole point of per-tenant keys."""
+
+    def __init__(self, rate: float, burst: float = 0.0,
+                 idle_evict_s: float = 300.0):
+        self.rate = max(1e-6, float(rate))
+        self.burst = float(burst) if burst > 0 else max(1.0, 2.0 * self.rate)
+        self.idle_evict_s = float(idle_evict_s)
+        self._buckets: Dict[str, list] = {}  # tenant -> [tokens, last_ts]
+        self._lock = threading.Lock()
+
+    def allow(self, tenant: str) -> Tuple[bool, float]:
+        """Try to draw one token for `tenant`. Returns ``(admitted,
+        retry_after_s)`` — the retry hint is the time until the bucket
+        refills one token (0.0 when admitted)."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = [self.burst, now]
+                if len(self._buckets) % 64 == 0:
+                    self._evict_idle(now)
+            tokens = min(self.burst, b[0] + (now - b[1]) * self.rate)
+            b[1] = now
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                return True, 0.0
+            b[0] = tokens
+            return False, (1.0 - tokens) / self.rate
+
+    def _evict_idle(self, now: float) -> None:
+        """Caller holds the lock. Drop tenants idle past the horizon —
+        their buckets are full again, so forgetting them is lossless."""
+        horizon = now - self.idle_evict_s
+        for t in [t for t, b in self._buckets.items() if b[1] < horizon]:
+            del self._buckets[t]
+
+    def tenants(self) -> int:
+        with self._lock:
+            return len(self._buckets)
+
+
+# -- AIMD adaptive concurrency ------------------------------------------------
+
+class AIMDLimit:
+    """Adaptive concurrency limit (additive-increase /
+    multiplicative-decrease) driven by observed request latency vs the
+    sliding-window baseline: while latency stays within ``tolerance`` x
+    the window's lower quartile, the limit probes up by ``+1/limit`` per
+    observation (one slot per limit's worth of requests — the classic
+    AIMD cadence); a latency past the tolerance band backs the limit off
+    multiplicatively (at most once per ``cooldown_s``, so one congested
+    burst costs one decrease, not a collapse to ``min_limit``).
+
+    The baseline is the window's 0.1-quantile, not the mean: under
+    overload the window fills with inflated samples, and a low quantile
+    keeps the baseline anchored to what the lane can do when it is NOT
+    queueing (poisoning the baseline requires ~90% of a whole window to
+    be congested)."""
+
+    def __init__(self, min_limit: int = 1, max_limit: int = 64,
+                 start: Optional[int] = None, tolerance: float = 2.0,
+                 decrease: float = 0.7, window: int = 256,
+                 min_samples: int = 10, cooldown_s: float = 1.0):
+        self.min_limit = max(1, int(min_limit))
+        self.max_limit = max(self.min_limit, int(max_limit))
+        self.tolerance = max(1.0, float(tolerance))
+        self.decrease = min(0.99, max(0.1, float(decrease)))
+        self.min_samples = max(2, int(min_samples))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._tracker = LatencyTracker(window)
+        self._limit = float(min(self.max_limit,
+                                max(self.min_limit,
+                                    start if start is not None
+                                    else (self.min_limit
+                                          + self.max_limit) // 2)))
+        self._last_decrease = 0.0
+        self._increases = 0
+        self._decreases = 0
+        self._lock = threading.Lock()
+
+    def observe(self, latency_s: float) -> None:
+        baseline = self._tracker.quantile(0.1)
+        n = len(self._tracker)
+        self._tracker.record(latency_s)
+        if baseline is None or n < self.min_samples:
+            return
+        with self._lock:
+            if latency_s > self.tolerance * baseline:
+                now = time.monotonic()
+                if now - self._last_decrease >= self.cooldown_s:
+                    self._limit = max(float(self.min_limit),
+                                      self._limit * self.decrease)
+                    self._last_decrease = now
+                    self._decreases += 1
+            else:
+                self._limit = min(float(self.max_limit),
+                                  self._limit + 1.0 / max(1.0, self._limit))
+                self._increases += 1
+
+    @property
+    def limit(self) -> int:
+        with self._lock:
+            return int(self._limit)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"limit": int(self._limit),
+                    "min": self.min_limit, "max": self.max_limit,
+                    "increases": self._increases,
+                    "decreases": self._decreases}
+
+
+# -- staged brownout ----------------------------------------------------------
+
+# The degradation ladder, in engagement order. Each stage KEEPS the
+# previous stages' measures; restore walks back in reverse:
+#   1 budget     — shrink the mixed-step per-tick token budget (admission
+#                  work yields tick time back to in-flight decode rows);
+#   2 spec_off   — suspend speculative drafting (verify windows stop
+#                  burning device compute on rejected tails);
+#   3 swap_defer — defer host-tier swap-ins (radix hits on demoted
+#                  prefixes recompute instead of contending for blocks);
+#   4 clamp      — clamp max_new_tokens for below-top-tier requests.
+BROWNOUT_STAGES: Tuple[str, ...] = ("normal", "budget", "spec_off",
+                                    "swap_defer", "clamp")
+BROWNOUT_MAX_STAGE: int = len(BROWNOUT_STAGES) - 1
+# Mixed-step token budget multiplier while stage >= 1.
+BROWNOUT_BUDGET_FRAC: float = 0.5
+
+
+class BrownoutController:
+    """The ladder's state machine. ``evaluate`` takes a dict of named
+    saturation components, each already normalized so 1.0 means "at the
+    red line" (tick age / stall threshold, admitted depth / limit, a
+    pool-starvation or deadline-miss indicator); pressure is their max —
+    ONE saturated signal is saturation, and a max stays interpretable
+    (stats reports which component is binding).
+
+    Hysteresis: escalate one stage after ``up_hold`` consecutive
+    evaluations at/above ``high``; restore one stage after ``down_hold``
+    consecutive evaluations at/below ``low``. Anything in between
+    resets both runs and holds the stage — pressure oscillating inside
+    the (low, high) band can never flap the ladder."""
+
+    def __init__(self, high: float = 0.85, low: float = 0.5,
+                 up_hold: int = 2, down_hold: int = 4,
+                 max_stage: int = BROWNOUT_MAX_STAGE):
+        if not 0.0 <= low < high:
+            raise ValueError(f"need 0 <= low < high, got low={low} "
+                             f"high={high}")
+        self.high = float(high)
+        self.low = float(low)
+        self.up_hold = max(1, int(up_hold))
+        self.down_hold = max(1, int(down_hold))
+        self.max_stage = max(1, min(int(max_stage), BROWNOUT_MAX_STAGE))
+        self._stage = 0
+        self._over = 0
+        self._under = 0
+        self._escalations = 0
+        self._restores = 0
+        self._pressure = 0.0
+        self._binding = ""
+        self._lock = threading.Lock()
+
+    def evaluate(self, components: Dict[str, float]) -> Optional[str]:
+        """Feed one control-loop sample; returns "escalate" / "restore"
+        when the stage moved (the caller applies the new stage and drops
+        the matching marker span), else None."""
+        pressure, binding = 0.0, ""
+        for name, v in components.items():
+            v = max(0.0, float(v))
+            if v > pressure:
+                pressure, binding = v, name
+        with self._lock:
+            self._pressure = pressure
+            self._binding = binding
+            if pressure >= self.high:
+                self._under = 0
+                self._over += 1
+                if self._over >= self.up_hold and self._stage < self.max_stage:
+                    self._stage += 1
+                    self._over = 0
+                    self._escalations += 1
+                    return "escalate"
+            elif pressure <= self.low:
+                self._over = 0
+                self._under += 1
+                if self._under >= self.down_hold and self._stage > 0:
+                    self._stage -= 1
+                    self._under = 0
+                    self._restores += 1
+                    return "restore"
+            else:
+                # Inside the hysteresis band: hold the stage, reset both
+                # runs — consecutive means consecutive.
+                self._over = 0
+                self._under = 0
+            return None
+
+    @property
+    def stage(self) -> int:
+        with self._lock:
+            return self._stage
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"stage": self._stage,
+                    "stage_name": BROWNOUT_STAGES[self._stage],
+                    "pressure": round(self._pressure, 4),
+                    "binding_signal": self._binding,
+                    "escalations": self._escalations,
+                    "restores": self._restores}
+
+
+# -- counters -----------------------------------------------------------------
+
+class OverloadCounters(ResilienceCounters):
+    """Every gateway overload-control decision, counted — the additive
+    ``/stats`` ``overload`` block and the ``tpu_engine_overload_*``
+    Prometheus family. Each bump has a matching zero-duration
+    ``overload`` marker span under the request's route span
+    (``tools/fault_injection.py --overload`` asserts counters == spans):
+
+    - ``rate_limited`` — the tenant's token bucket refused the request;
+    - ``shed_tier`` — a below-top-tier request refused because the
+      gateway's in-flight gauge crossed its tier's admission fraction
+      (lowest-tier-first shedding);
+    - ``shed_depth`` — the gauge is at the FULL limit, so even top-tier
+      requests shed (the last line, after every brownout stage and every
+      lower tier already gave way).
+    """
+
+    FIELDS = ("rate_limited", "shed_tier", "shed_depth")
+
+
+class SheddingStats:
+    """Sliding-window shed-rate estimator feeding the gateway's
+    load-derived Retry-After when no in-flight gauge is configured:
+    pressure = sheds / max(1, requests) over the window — crude, but
+    monotone in actual refusals, which is all the back-off hint needs."""
+
+    def __init__(self, window_s: float = 10.0):
+        self.window_s = float(window_s)
+        self._requests: Deque[float] = collections.deque()
+        self._sheds: Deque[float] = collections.deque()
+        self._lock = threading.Lock()
+
+    def _gc(self, now: float) -> None:
+        horizon = now - self.window_s
+        for dq in (self._requests, self._sheds):
+            while dq and dq[0] < horizon:
+                dq.popleft()
+
+    def record(self, shed: bool) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._gc(now)
+            self._requests.append(now)
+            if shed:
+                self._sheds.append(now)
+
+    def pressure(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._gc(now)
+            if not self._requests:
+                return 0.0
+            return len(self._sheds) / len(self._requests)

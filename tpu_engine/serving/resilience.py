@@ -41,6 +41,16 @@ from typing import Deque, Optional
 from tpu_engine.utils.deadline import Deadline, DeadlineExceeded, Overloaded
 
 
+def tier_cap(limit: int, frac: float) -> int:
+    """THE tier-admission rule, defined once: a tier may occupy up to
+    its fraction of the concurrency limit, floored at 1 slot so a tiny
+    limit never zeroes a whole class outright (the full-limit check
+    still rules). Shared by the worker AdmissionController below and
+    the gateway's in-flight gauge (via overload.tier_limit) — the two
+    layers must shed at the same thresholds for the same tier."""
+    return max(1, int(limit * frac))
+
+
 def backoff_delay(attempt: int, base_ms: float, max_ms: float,
                   jitter: float = 0.5,
                   rng: Optional[random.Random] = None) -> float:
@@ -263,11 +273,25 @@ class AdmissionController:
     and ``DeadlineExceeded`` when the deadline already passed; callers
     MUST pair a successful admit with ``release()``. ``check_deadline``
     adds the estimate-aware early rejection for the miss path.
+
+    Overload-control extensions (serving/overload.py; both default off):
+    ``tier_fracs`` switches on priority-tiered admission — tier t admits
+    only while depth < fracs[t] * limit, so the lowest tier sheds first
+    under pressure; ``limiter`` (an ``AIMDLimit``) replaces the static
+    ``max_depth`` with the adaptive concurrency limit. Every
+    overload-class shed still counts into ``shed_overloaded`` (the
+    wire-compat total) AND into its per-cause field
+    (``shed_depth`` / ``shed_tier`` / ``shed_adaptive``), and the raised
+    ``Overloaded`` carries a ``cause`` attribute so upstream counters
+    can attribute it without string matching.
     """
 
-    def __init__(self, max_depth: int = 0, node_id: str = "?"):
+    def __init__(self, max_depth: int = 0, node_id: str = "?",
+                 tier_fracs: Optional[tuple] = None, limiter=None):
         self.max_depth = max(0, int(max_depth))
         self.node_id = node_id
+        self._tier_fracs = tier_fracs
+        self.limiter = limiter
         self._depth = 0
         self._draining = False
         self._lock = threading.Lock()
@@ -275,6 +299,11 @@ class AdmissionController:
         self.shed_overloaded = 0
         self.shed_deadline = 0
         self.shed_draining = 0
+        # Per-cause split of shed_overloaded (the old total stays the
+        # sum): static depth cap, priority-tier fraction, adaptive limit.
+        self.shed_depth = 0
+        self.shed_tier = 0
+        self.shed_adaptive = 0
 
     # -- drain (lame-duck) ----------------------------------------------------
 
@@ -304,17 +333,52 @@ class AdmissionController:
 
     # -- admission ------------------------------------------------------------
 
-    def admit(self, deadline: Optional[Deadline] = None) -> None:
+    def effective_limit(self) -> int:
+        """The concurrency limit currently in force: the adaptive
+        limiter's when configured, else the static cap (0 = unbounded)."""
+        if self.limiter is not None:
+            return self.limiter.limit
+        return self.max_depth
+
+    def admit(self, deadline: Optional[Deadline] = None,
+              tier: Optional[int] = None) -> None:
+        """``tier``: the request's priority tier (highest = len(fracs)-1);
+        None (or no tier_fracs configured) admits against the full limit
+        — the pre-overload-control behavior."""
+        limit = self.effective_limit()
         with self._lock:
             if self._draining:
                 self.shed_draining += 1
                 raise Overloaded(
                     f"lane {self.node_id} is draining (lame-duck)")
-            if self.max_depth and self._depth >= self.max_depth:
+            if limit and self._depth >= limit:
                 self.shed_overloaded += 1
-                raise Overloaded(
-                    f"lane {self.node_id} at max queue depth "
-                    f"{self.max_depth}")
+                if self.limiter is not None:
+                    self.shed_adaptive += 1
+                    exc = Overloaded(
+                        f"lane {self.node_id} at adaptive queue depth "
+                        f"limit {limit}")
+                    exc.cause = "adaptive"
+                else:
+                    self.shed_depth += 1
+                    exc = Overloaded(
+                        f"lane {self.node_id} at max queue depth "
+                        f"{self.max_depth}")
+                    exc.cause = "depth"
+                raise exc
+            if (limit and tier is not None and self._tier_fracs
+                    and 0 <= tier < len(self._tier_fracs) - 1):
+                # Below-top tiers admit only up to their fraction of the
+                # limit (floored at 1 slot): lowest-tier-first shedding.
+                cap = tier_cap(limit, self._tier_fracs[tier])
+                if self._depth >= cap:
+                    self.shed_overloaded += 1
+                    self.shed_tier += 1
+                    exc = Overloaded(
+                        f"lane {self.node_id} shedding priority tier "
+                        f"{tier} at depth {self._depth}/{limit}")
+                    exc.cause = "tier"
+                    raise exc
             if deadline is not None and deadline.expired():
                 self.shed_deadline += 1
                 raise DeadlineExceeded("deadline exceeded at admission")
@@ -364,11 +428,13 @@ class AdmissionController:
         """Whether this controller has anything to report — gates the
         additive /health block (schema untouched at defaults)."""
         return bool(self.max_depth or self._draining or self.shed_overloaded
-                    or self.shed_deadline or self.shed_draining)
+                    or self.shed_deadline or self.shed_draining
+                    or self._tier_fracs is not None
+                    or self.limiter is not None)
 
     def as_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "draining": self._draining,
                 "queue_depth": self._depth,
                 "max_queue_depth": self.max_depth,
@@ -376,3 +442,14 @@ class AdmissionController:
                 "shed_deadline": self.shed_deadline,
                 "shed_draining": self.shed_draining,
             }
+            # Per-cause breakdown, additive and gated on the overload
+            # features: a plain max_queue_depth deployment's /health
+            # block keeps its exact pre-overload-control key set, and
+            # shed_overloaded stays the sum of the causes either way.
+            if self._tier_fracs is not None or self.limiter is not None:
+                out["shed_depth"] = self.shed_depth
+                out["shed_tier"] = self.shed_tier
+                out["shed_adaptive"] = self.shed_adaptive
+                if self.limiter is not None:
+                    out["adaptive"] = self.limiter.as_dict()
+            return out

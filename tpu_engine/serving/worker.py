@@ -36,6 +36,15 @@ import numpy as np
 from tpu_engine.core.lru_cache import LRUCache
 from tpu_engine.runtime.batch_processor import BatchProcessor
 from tpu_engine.serving.http import sse_event
+from tpu_engine.serving.overload import (
+    AIMDLimit,
+    BROWNOUT_BUDGET_FRAC,
+    BROWNOUT_STAGES,
+    BrownoutController,
+    TIER_ADMIT_FRAC,
+    TOP_TIER,
+    parse_priority,
+)
 from tpu_engine.serving.resilience import AdmissionController
 from tpu_engine.utils.config import WorkerConfig
 from tpu_engine.utils.deadline import (
@@ -397,8 +406,36 @@ class WorkerNode:
         self._fault_listeners: list = []
         # Resilience: bounded queue depth + drain (lame-duck) mode.
         # max_queue_depth=0 keeps admission unbounded (reference behavior).
-        self._admission = AdmissionController(self.config.max_queue_depth,
-                                              self.node_id)
+        # Overload control (default off): the AIMD limiter replaces the
+        # static cap with a latency-driven limit, and tier fractions
+        # shed lowest-priority-first under depth pressure.
+        # Start from the operator's static cap when one is configured —
+        # the adaptive limit REPLACES max_queue_depth, so it must begin
+        # where the operator's judgment left off, not at an arbitrary
+        # midpoint.
+        self._aimd = (AIMDLimit(max_limit=self.config.adaptive_depth_max,
+                                start=self.config.max_queue_depth or None)
+                      if self.config.adaptive_depth else None)
+        self._tiered = bool(self.config.priority_admission)
+        self._admission = AdmissionController(
+            self.config.max_queue_depth, self.node_id,
+            tier_fracs=TIER_ADMIT_FRAC if self._tiered else None,
+            limiter=self._aimd)
+        # Staged brownout (default off): a control loop reads saturation
+        # signals every brownout_interval_s and walks the degradation
+        # ladder (DESIGN.md "Overload control"); each transition drops an
+        # `overload` marker span so escalations+restores == spans.
+        self._brownout: Optional[BrownoutController] = None
+        self._brownout_clamps = 0
+        self._brownout_prev = {"starved": 0, "missed": 0}
+        self._brownout_stop = threading.Event()
+        self._brownout_thread: Optional[threading.Thread] = None
+        if self.config.brownout:
+            self._brownout = BrownoutController()
+            self._brownout_thread = threading.Thread(
+                target=self._brownout_loop,
+                name=f"{self.node_id}-brownout", daemon=True)
+            self._brownout_thread.start()
         # EWMA of recent miss-path per-request service time (µs), feeding
         # deadline-aware early rejection: a request whose remaining budget
         # cannot cover the typical miss is shed before it occupies a
@@ -603,9 +640,11 @@ class WorkerNode:
             raise ValueError(
                 f"model '{self.config.model}' does not support scoring")
         deadline = Deadline.from_request(request)
+        tier = self._request_tier(request)
         with self._traced_request(request, "score") as span:
             with self._admitted(deadline, trace=(span.ctx,
-                                                 span.request_id)):
+                                                 span.request_id),
+                                tier=tier):
                 return self._score_admitted(request, deadline)
 
     def _score_admitted(self, request: dict,
@@ -745,6 +784,119 @@ class WorkerNode:
         if self._injected_latency_s > 0:
             time.sleep(self._injected_latency_s)
 
+    # -- overload control (priority tiers + staged brownout) -------------------
+
+    def _request_tier(self, request: dict) -> Optional[int]:
+        """The request's priority tier when an overload feature reads it
+        (tiered admission or brownout clamping); None otherwise — with
+        both off, the ``priority`` field is ignored entirely, additive
+        and wire-compatible (MIGRATION.md). An unknown value with a
+        feature ON is a 400, same contract as every validated field."""
+        if not self._tiered and self._brownout is None:
+            return None
+        return parse_priority(request)
+
+    def _brownout_clamp(self, max_new: int, tier: Optional[int]) -> int:
+        """Stage-4 degradation: below-top-tier generate requests get
+        their token budget clamped — the cheapest way to keep serving a
+        low tier at all once every earlier stage is engaged. Top-tier
+        work is never clamped."""
+        bo = self._brownout
+        if (bo is None or tier is None or tier >= TOP_TIER
+                or bo.stage < BROWNOUT_STAGES.index("clamp")):
+            return max_new
+        clamp = max(1, int(self.config.brownout_clamp_tokens))
+        if max_new > clamp:
+            self._brownout_clamps += 1  # GIL-safe info counter
+            return clamp
+        return max_new
+
+    def _brownout_signals(self) -> dict:
+        """Collect the saturation components for one control-loop
+        evaluation, each normalized so 1.0 = at the red line. All
+        signals already exist — this only reads them."""
+        comps = {}
+        adm = self._admission
+        limit = adm.effective_limit()
+        # Queue pressure: admitted depth vs the concurrency limit, or —
+        # unbounded lanes — vs twice the decode batch (the point where
+        # queued work can no longer all be in a batch).
+        nominal = limit or 2 * max(1, self.config.gen_max_batch_size)
+        comps["queue_depth"] = adm.depth / nominal
+        missed = adm.shed_deadline
+        gen = self.generator
+        st = None
+        if gen is not None and hasattr(gen, "stats"):
+            try:
+                st = gen.stats()
+            except Exception:
+                st = None
+        if st:
+            # Decode-loop tick age vs the stall threshold (default red
+            # line 2 s when none is configured): a loop spending whole
+            # seconds inside one dispatch is saturated long before it is
+            # wedged.
+            age = st.get("last_tick_age_s")
+            stall = float(self.config.scheduler_stall_s or 0.0) or 2.0
+            if age is not None:
+                comps["tick_age"] = age / stall
+            kv = st.get("kv_pool") or {}
+            if kv:
+                # Pool starvation events and deferred admissions: rows
+                # already competing for blocks.
+                comps["pool_pending"] = (kv.get("pending_admissions", 0)
+                                         / max(1, self.n_gen_slots()))
+                starved = st.get("pool_starved", 0)
+                if starved > self._brownout_prev["starved"]:
+                    comps["pool_starved"] = 1.0
+                self._brownout_prev["starved"] = starved
+            missed += st.get("deadline_cancelled", 0)
+        # Deadline misses since the last evaluation: work is already
+        # arriving dead — the clearest "past the red line" signal.
+        if missed > self._brownout_prev["missed"]:
+            comps["deadline_miss"] = 1.0
+        self._brownout_prev["missed"] = missed
+        return comps
+
+    def n_gen_slots(self) -> int:
+        return max(1, int(self.config.gen_max_batch_size))
+
+    def _apply_brownout(self, action: str, comps: dict) -> None:
+        """Apply the controller's current stage to the lane and drop the
+        matching ``overload`` marker span (one per transition — the
+        escalations+restores counters and these spans must agree;
+        fault_injection --overload asserts it)."""
+        stage = self._brownout.stage
+        gen = self.generator
+        if gen is not None and hasattr(gen, "set_brownout"):
+            gen.set_brownout(
+                budget_frac=BROWNOUT_BUDGET_FRAC if stage >= 1 else 1.0,
+                suspend_spec=stage >= 2,
+                defer_swap_in=stage >= 3)
+        ctx = TraceContext.root(f"brownout:{self.node_id}").child()
+        binding = max(comps, key=comps.get) if comps else ""
+        self.tracer.record(
+            "brownout", "overload", self.node_id, 0,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+            start_ts=time.time(),
+            attrs={"action": action, "stage": stage,
+                   "stage_name": BROWNOUT_STAGES[stage],
+                   "binding_signal": binding})
+
+    def _brownout_loop(self) -> None:
+        """The control loop: read signals, walk the ladder, apply. Stage
+        changes are the only side effects; a failed evaluation skips the
+        sample (the loop must degrade the LANE, never kill it)."""
+        interval = max(0.05, float(self.config.brownout_interval_s))
+        while not self._brownout_stop.wait(interval):
+            try:
+                comps = self._brownout_signals()
+                action = self._brownout.evaluate(comps)
+                if action is not None:
+                    self._apply_brownout(action, comps)
+            except Exception:
+                continue  # a torn stats read is a skipped sample
+
     @contextlib.contextmanager
     def _traced_request(self, request: dict, op: str):
         """Worker-root span scope shared by the blocking request paths
@@ -776,12 +928,18 @@ class WorkerNode:
                 start_ts=start, attrs=span.attrs)
 
     @contextlib.contextmanager
-    def _admitted(self, deadline, trace=None):
+    def _admitted(self, deadline, trace=None, tier=None):
         """Admission scope shared by every blocking request path: admit
-        (drain/depth/expired-deadline can shed -> wire 503), apply the
-        slow-lane fault, and ALWAYS release. The streaming path manages
-        release by hand — its in-flight window is the iterator's life,
-        not this frame's.
+        (drain/depth/tier/expired-deadline can shed -> wire 503), apply
+        the slow-lane fault, and ALWAYS release. The streaming path
+        manages release by hand — its in-flight window is the iterator's
+        life, not this frame's.
+
+        ``tier``: the request's priority tier for tiered admission (None
+        = untiered, the pre-overload-control behavior). A request that
+        completes normally feeds its wall time to the AIMD limiter —
+        latency observed WITH queueing included, which is exactly the
+        congestion signal the limit adapts to.
 
         ``trace``: optional (TraceContext, request_id) — records an
         ``admission`` stage span (child of the worker root) whose duration
@@ -804,17 +962,21 @@ class WorkerNode:
                 attrs={"outcome": outcome})
 
         try:
-            self._admission.admit(deadline)
+            self._admission.admit(deadline, tier=tier)
         except ShedError as exc:
             exc.stage = exc.stage or "worker_admission"
             _span(exc.kind)
             raise
+        ok = False
         try:
             self._maybe_slow()
             _span("admitted")
             yield
+            ok = True
         finally:
             self._admission.release()
+            if ok and self._aimd is not None:
+                self._aimd.observe(time.perf_counter() - t0)
 
     # -- drain (lame-duck) -----------------------------------------------------
 
@@ -873,13 +1035,15 @@ class WorkerNode:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
         deadline = Deadline.from_request(request)
+        tier = self._request_tier(request)
         with self._traced_request(request, "infer") as span:
             # Resilience: admission BEFORE the request counts — a shed
             # request never skews the reference-exact /health counters,
             # only its own (additive) admission block. Expired/overloaded/
             # draining raise here and surface as 503 + Retry-After.
             with self._admitted(deadline, trace=(span.ctx,
-                                                 span.request_id)):
+                                                 span.request_id),
+                                tier=tier):
                 with self._counter_lock:
                     self._total_requests += 1
                 out = self._infer_admitted(request, deadline, span.ctx)
@@ -1122,21 +1286,25 @@ class WorkerNode:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
         self._check_model(request)
         deadline = Deadline.from_request(request)
+        tier = self._request_tier(request)
         with self._traced_request(request, "generate") as span:
             with self._admitted(deadline, trace=(span.ctx,
-                                                 span.request_id)):
+                                                 span.request_id),
+                                tier=tier):
                 return self._generate_admitted(request, deadline,
-                                               span.ctx)
+                                               span.ctx, tier=tier)
 
     def _generate_admitted(self, request: dict,
                            deadline: Optional[Deadline],
-                           tctx: TraceContext) -> dict:
+                           tctx: TraceContext,
+                           tier: Optional[int] = None) -> dict:
         with self._counter_lock:
             self._total_requests += 1
         item = _GenItem(
             request_id=request["request_id"],
             prompt=[int(t) for t in request["prompt_tokens"]],
-            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            max_new_tokens=self._brownout_clamp(
+                int(request.get("max_new_tokens", 32)), tier),
             eos_id=int(request.get("eos_id", -1)),
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)),
@@ -1226,7 +1394,9 @@ class WorkerNode:
         # scheduler paths).
         request_id = request["request_id"]
         prompt = [int(t) for t in request["prompt_tokens"]]
-        max_new = int(request.get("max_new_tokens", 32))
+        tier = self._request_tier(request)
+        max_new = self._brownout_clamp(
+            int(request.get("max_new_tokens", 32)), tier)
         eos_id = int(request.get("eos_id", -1))
         temperature = float(request.get("temperature", 0.0))
         seed = int(request.get("seed", 0))
@@ -1260,6 +1430,10 @@ class WorkerNode:
                       "beam_width": beam_width,
                       "length_penalty": length_penalty,
                       "min_p": min_p_val}
+        if "priority" in request:
+            # Tiered admission / brownout clamping must see the tier on
+            # the one-shot path's inner handle_generate too.
+            normalized["priority"] = request["priority"]
         if deadline is not None:
             # Forward the REMAINING budget (deadline propagation).
             normalized["deadline_ms"] = max(0.0, deadline.remaining_ms())
@@ -1269,7 +1443,7 @@ class WorkerNode:
             # path below); released immediately — handle_generate admits
             # for real on first iteration, and a shed that slips into the
             # gap still surfaces as the stream's terminal error event.
-            self._admission.admit(deadline)
+            self._admission.admit(deadline, tier=tier)
             self._admission.release()
             one_shot_parent = TraceContext.from_request(request)
             one_shot_ctx = (one_shot_parent.child()
@@ -1295,7 +1469,8 @@ class WorkerNode:
         tctx = (parent.child() if parent is not None
                 else TraceContext.root(request_id))
         t_start_wall = time.time()
-        self._admission.admit(deadline)
+        t_admit = time.perf_counter()
+        self._admission.admit(deadline, tier=tier)
         try:
             self._maybe_slow()
             with self._counter_lock:
@@ -1314,6 +1489,7 @@ class WorkerNode:
 
         def events():
             sent = 0  # tokens relayed to the client so far (resume offset)
+            completed = False
             try:
                 while True:
                     try:
@@ -1342,11 +1518,17 @@ class WorkerNode:
                     parent_id=(parent.span_id if parent is not None
                                else None),
                     start_ts=t_start_wall)
+                completed = True
                 yield sse_event({"done": True, "request_id": request_id,
                                  "tokens": tokens, "node_id": self.node_id,
                                  "generate_time_us": elapsed_us})
             finally:
                 self._admission.release()
+                # Streams feed the AIMD window too (admit -> clean
+                # finish) — on a stream-only lane the limit must still
+                # see the latency it exists to react to.
+                if completed and self._aimd is not None:
+                    self._aimd.observe(time.perf_counter() - t_admit)
         return events()
 
     @staticmethod
@@ -1496,9 +1678,19 @@ class WorkerNode:
             adm = self._admission.as_dict()
             adm["deadline_dropped"] = dropped
             out["admission"] = adm
+        # Additive, gated on the flag: the staged brownout controller's
+        # current stage, pressure, and transition counters.
+        if self._brownout is not None:
+            bo = self._brownout.as_dict()
+            bo["clamped_requests"] = self._brownout_clamps
+            out["brownout"] = bo
         return out
 
     def stop(self) -> None:
+        self._brownout_stop.set()
+        if self._brownout_thread is not None:
+            self._brownout_thread.join(timeout=5)
+            self._brownout_thread = None
         self.batch_processor.stop()
         if getattr(self, "_score_proc", None) is not None:
             self._score_proc.stop()

@@ -126,6 +126,34 @@ class WorkerConfig:
     # requests on this lane; excess is shed with 503 + Retry-After instead
     # of queueing unboundedly. 0 = unbounded (reference behavior).
     max_queue_depth: int = 0
+    # -- overload control (serving/overload.py; DESIGN.md "Overload
+    # control"). All default off: with defaults, admission behavior and
+    # wire schemas are byte-identical to the layer above. ----------------
+    # Priority-tiered admission (--priority-admission): requests may
+    # carry "priority": interactive | batch | background; under depth
+    # pressure each tier admits only up to its fraction of the
+    # concurrency limit (background 70%, batch 85%, interactive 100%),
+    # so the lowest tier always sheds first. Off = the field is ignored.
+    priority_admission: bool = False
+    # AIMD adaptive concurrency (--adaptive-depth): replace the static
+    # max_queue_depth cap with a limit driven by observed latency vs the
+    # sliding-window baseline — additive increase while latency tracks
+    # the baseline, multiplicative decrease past 2x it. Bounded above by
+    # adaptive_depth_max.
+    adaptive_depth: bool = False
+    adaptive_depth_max: int = 64
+    # Staged brownout (--brownout): a control loop reads saturation
+    # signals (decode-loop tick age, admission depth vs limit, pool
+    # starvation, deadline-miss rate) every brownout_interval_s and
+    # walks the degradation ladder with hysteresis — shrink the mixed
+    # token budget, suspend speculative drafting, defer host-tier
+    # swap-ins, clamp low-tier token budgets — BEFORE any shed fires,
+    # restoring in reverse as pressure clears.
+    brownout: bool = False
+    brownout_interval_s: float = 0.25
+    # Stage-4 ("clamp") max_new_tokens ceiling for below-top-tier
+    # generate requests.
+    brownout_clamp_tokens: int = 32
     # Tracing ring-buffer capacity (spans kept per lane, utils.tracing).
     # On by default — recording is lock-guarded ring writes, ~1 µs/span.
     # 0 disables span recording AND the /metrics stage histograms.
@@ -239,6 +267,29 @@ class GatewayConfig:
     # 0 (default) = always honor affinity.
     affinity_max_imbalance: int = 0
     affinity_window_s: float = 10.0
+
+    # -- adaptive overload control (serving/overload.py; DESIGN.md
+    # "Overload control"). All default off: with defaults, routing
+    # behavior and wire schemas are byte-identical to the layers above.
+
+    # Master switch (--overload-control): priority-tiered gateway
+    # admission against the in-flight gauge below, plus load-derived
+    # Retry-After on every shed (base shed_retry_after_s scaled by
+    # measured pressure instead of the constant).
+    overload_control: bool = False
+    # Gateway-wide concurrent-request gauge the tier fractions apply to
+    # (background sheds at 70% of it, batch at 85%, interactive at
+    # 100%). 0 = no gauge: tier admission is off and Retry-After derives
+    # from the recent shed rate instead.
+    overload_max_inflight: int = 0
+    # Per-tenant token-bucket rate limiter (--tenant-rate): requests
+    # carry an optional "tenant" key; each tenant sustains this many
+    # requests/s (burst below) and excess sheds 503 + the bucket's
+    # actual refill time — one tenant's burst cannot starve the fleet.
+    # 0 = off. Independent of overload_control (rate fairness is useful
+    # alone).
+    tenant_rate: float = 0.0
+    tenant_burst: float = 0.0           # bucket depth (0 = auto: 2x rate)
 
     # Tracing ring-buffer capacity for the gateway's own spans (route +
     # per-attempt children + resilience decision markers). 0 disables.

@@ -337,13 +337,38 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "Concurrently admitted requests on the lane",
            [(node(h), a.get("queue_depth")) for h, a in adm])
     metric("tpu_engine_shed_total", "counter",
-           "Requests shed by lane admission control, by reason",
+           "Requests shed by lane admission control, by reason "
+           "(overloaded = depth + tier + adaptive, the wire-compat total)",
            [({**node(h), "reason": r}, a.get(f"shed_{r}"))
             for h, a in adm
-            for r in ("overloaded", "deadline", "draining")])
+            for r in ("overloaded", "deadline", "draining",
+                      "depth", "tier", "adaptive")])
     metric("tpu_engine_deadline_dropped_total", "counter",
            "Queued requests dropped at batch formation (deadline expired)",
            [(node(h), a.get("deadline_dropped")) for h, a in adm])
+    metric("tpu_engine_adaptive_depth_limit", "gauge",
+           "AIMD adaptive concurrency limit currently in force",
+           [(node(h), (a.get("adaptive") or {}).get("limit"))
+            for h, a in adm])
+
+    # Staged brownout (worker --brownout): the degradation ladder's
+    # current stage and transition counters.
+    bo = [(h, h.get("brownout")) for h in healths if h.get("brownout")]
+    metric("tpu_engine_brownout_stage", "gauge",
+           "Brownout ladder stage (0 = normal .. 4 = low-tier clamp)",
+           [(node(h), b.get("stage")) for h, b in bo])
+    metric("tpu_engine_brownout_pressure", "gauge",
+           "Max normalized saturation signal at the last evaluation",
+           [(node(h), b.get("pressure")) for h, b in bo])
+    metric("tpu_engine_brownout_escalations_total", "counter",
+           "Brownout ladder escalations",
+           [(node(h), b.get("escalations")) for h, b in bo])
+    metric("tpu_engine_brownout_restores_total", "counter",
+           "Brownout ladder restores",
+           [(node(h), b.get("restores")) for h, b in bo])
+    metric("tpu_engine_brownout_clamped_total", "counter",
+           "Below-top-tier requests whose token budget was clamped",
+           [(node(h), b.get("clamped_requests")) for h, b in bo])
 
     if stats:
         metric("tpu_engine_gateway_requests_total", "counter",
@@ -441,6 +466,31 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
                    [({"node": lane}, n)
                     for lane, n in sorted(
                         (aff.get("assigned") or {}).items())])
+        ovl = stats.get("overload")
+        if ovl:
+            # Adaptive overload control (the /stats "overload" block;
+            # present once configured or first exercised).
+            for key, help_text in (
+                    ("rate_limited",
+                     "Requests refused by a tenant's token bucket"),
+                    ("shed_tier",
+                     "Below-top-tier requests shed by gateway tier "
+                     "admission (lowest tier first)"),
+                    ("shed_depth",
+                     "Requests shed with the gateway in-flight gauge at "
+                     "its full limit")):
+                metric(f"tpu_engine_overload_{key}_total", "counter",
+                       help_text, [({}, ovl.get(key))])
+            metric("tpu_engine_overload_inflight", "gauge",
+                   "Requests currently inside the gateway routing layer",
+                   [({}, ovl.get("inflight"))])
+            metric("tpu_engine_overload_pressure", "gauge",
+                   "Measured congestion feeding the load-derived "
+                   "Retry-After",
+                   [({}, ovl.get("pressure"))])
+            metric("tpu_engine_overload_tenants", "gauge",
+                   "Tenants with live token buckets",
+                   [({}, ovl.get("tenants"))])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
     if named_hists:
