@@ -1112,6 +1112,166 @@ def run_paged_ab(model: str = "gpt2-small-test", n_requests: int = 16,
     return results
 
 
+def run_quant_ab(model: str = "gpt2-small-test", n_requests: int = 24,
+                 max_new: int = 96, shared_prefix_len: int = 32,
+                 prompt_tail: int = 6,
+                 dtype: str = "bfloat16", block_size: int = 16,
+                 bf16_rows: int = 3, max_seq: int = 256,
+                 model_kwargs: Optional[dict] = None) -> dict:
+    """bf16 vs int8 KV block pool at EQUAL KV byte budget (the
+    --kv-quantize tentpole A/B, in the paged-ab shape). Three arms, all
+    paged with radix prefix sharing ON and a shared-prefix burst so the
+    prefix-skip machinery stays engaged:
+
+    - **bf16** (defaults-off): today's pool, sized to ``bf16_rows`` rows
+      of max_seq. Run twice — the repeat must be byte-identical (the
+      defaults-off arm IS pre-quantization behavior) and its /stats
+      kv_pool must carry no `quantized` key.
+    - **int8**: the same KV bytes as a quantized pool — about 2x the
+      blocks (payload halves; the per-slot f32 scales cost 4/(D+4) of
+      the win, so ~1.88x at d_head 64) — with its slot count sized to
+      what those blocks hold at this workload's row footprint. Run
+      twice — quantized greedy streams must be deterministic across
+      repeats. The headline is peak concurrently-admitted rows:
+      capacity_gain = int8 peak / bf16 peak, bar >= 1.8x.
+
+    The default model override (d_model 128, n_heads 2) gives the tiny
+    test config a SERVING-SHAPED d_head of 64 — at the test model's
+    native d_head 16 the scale overhead would mask the byte win that
+    real models (d_head 64-128) actually see; the on-chip campaign runs
+    the same A/B against gpt2 (d_head 64) on the device."""
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    _ensure_builtin_models_imported()
+    if model_kwargs is None:
+        model_kwargs = ({"d_model": 128, "n_heads": 2}
+                        if model == "gpt2-small-test" else {})
+    spec = create_model(model, max_seq=max_seq, **model_kwargs)
+    params = spec.init(jax.random.PRNGKey(0))
+    cfg = spec.config
+    # Small decode chunks: rows live many chunks, so the burst's
+    # steady-state concurrency is bound by SLOT capacity (the thing the
+    # A/B measures), not by the serial admission rate of the host mesh.
+    step_chunk = 2
+    width = -(-max_seq // block_size)
+    bf16_blocks = bf16_rows * width + 1
+    # Equal BYTE budget, not equal block count: the quantized pool gets
+    # however many int8+scale blocks fit in the bf16 arm's KV bytes —
+    # sized by the POOL'S OWN layout formulas, never a re-derivation.
+    import jax.numpy as jnp
+
+    from tpu_engine.runtime.kv_blocks import (dense_block_bytes,
+                                              quant_block_bytes)
+
+    dense_bpb = dense_block_bytes(
+        cfg, block_size,
+        {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype])
+    quant_bpb = quant_block_bytes(cfg, block_size)
+    budget_bytes = (bf16_blocks - 1) * dense_bpb
+    quant_blocks = budget_bytes // quant_bpb + 1
+    prompt_len = shared_prefix_len + prompt_tail
+    per_row_blocks = -(-(prompt_len + max_new + step_chunk) // block_size)
+    bf16_slots = max(1, (bf16_blocks - 1) // per_row_blocks)
+    quant_slots = max(1, (quant_blocks - 1) // per_row_blocks)
+    rnd = random.Random(7)
+    shared = [rnd.randrange(1, 200) for _ in range(shared_prefix_len)]
+    prompts = [shared + [rnd.randrange(1, 200) for _ in range(prompt_tail)]
+               for _ in range(n_requests)]
+
+    def run_burst(gen, new_tokens):
+        peak = [0]
+        stop_flag = threading.Event()
+
+        def sampler():
+            while not stop_flag.is_set():
+                peak[0] = max(peak[0], gen.stats()["active"])
+                time.sleep(0.002)
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        futs = [gen.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        outs = [f.result(600) for f in futs]
+        wall = time.perf_counter() - t0
+        stop_flag.set()
+        th.join(timeout=1)
+        toks = sum(len(o) for o in outs)
+        return outs, {"requests": len(prompts), "wall_s": round(wall, 3),
+                      "tokens": toks,
+                      "tokens_per_s": round(toks / wall, 2) if wall else 0.0,
+                      "peak_concurrent_rows": peak[0]}
+
+    def run_arm(quantize: str, n_slots: int, kv_blocks: int):
+        gen = ContinuousGenerator(
+            spec, params=params, dtype=dtype, n_slots=n_slots,
+            step_chunk=step_chunk, max_seq=max_seq,
+            kv_block_size=block_size, kv_blocks=kv_blocks,
+            kv_quantize=quantize)
+        try:
+            # Warm compiles + the resumed mid-prompt window widths so the
+            # timed bursts measure steady state, not one-time XLA work.
+            gen.generate([prompts[0]], max_new_tokens=2)
+            gen.generate([shared + [1, 2, 3]], max_new_tokens=2)
+            streams1, r1 = run_burst(gen, max_new)
+            streams2, r2 = run_burst(gen, max_new)
+            pool = gen.stats()["kv_pool"]
+            r1["repeat_identical"] = streams1 == streams2
+            r1["kv_pool"] = {k: pool[k] for k in
+                             ("blocks_total", "block_size",
+                              "prefix_savings_frac", "radix_hits")
+                             if k in pool}
+            for k in ("quantized", "bytes_per_block",
+                      "dense_bytes_per_block", "capacity_multiplier"):
+                if k in pool:
+                    r1["kv_pool"][k] = pool[k]
+            r1["stats_has_quantized_key"] = "quantized" in pool
+            r1["peak_concurrent_rows"] = max(r1["peak_concurrent_rows"],
+                                             r2["peak_concurrent_rows"])
+        finally:
+            gen.stop()
+        return streams1, r1
+
+    results = {"model": model, "model_kwargs": model_kwargs,
+               "max_seq": max_seq, "block_size": block_size,
+               "dtype": dtype, "d_head": cfg.d_head,
+               "kv_byte_budget": int(budget_bytes),
+               "bf16": {"kv_blocks": bf16_blocks, "n_slots": bf16_slots},
+               "int8": {"kv_blocks": int(quant_blocks),
+                        "n_slots": quant_slots}}
+    bf16_streams, bf16_r = run_arm("", bf16_slots, bf16_blocks)
+    results["bf16"].update(bf16_r)
+    record_partial("quant_ab_bf16", results["bf16"])
+    int8_streams, int8_r = run_arm("int8", quant_slots, int(quant_blocks))
+    results["int8"].update(int8_r)
+    record_partial("quant_ab_int8", results["int8"])
+
+    results["capacity_gain"] = round(
+        results["int8"]["peak_concurrent_rows"]
+        / max(1, results["bf16"]["peak_concurrent_rows"]), 2)
+    agree = [a == b for a, b in zip(int8_streams, bf16_streams)]
+    tok_agree = [sum(x == y for x, y in zip(a, b)) / max(1, len(a))
+                 for a, b in zip(int8_streams, bf16_streams)]
+    results["streams_identical_to_bf16_frac"] = round(
+        sum(agree) / len(agree), 3)
+    results["token_agreement_frac"] = round(
+        sum(tok_agree) / len(tok_agree), 4)
+    results["checks_passed"] = bool(
+        results["capacity_gain"] >= 1.8
+        and results["int8"]["repeat_identical"]          # deterministic
+        and results["bf16"]["repeat_identical"]          # defaults-off
+        and not results["bf16"]["stats_has_quantized_key"]
+        and results["int8"]["stats_has_quantized_key"]
+        and results["bf16"]["kv_pool"]["prefix_savings_frac"] > 0
+        and results["int8"]["kv_pool"]["prefix_savings_frac"] > 0)
+    return results
+
+
 def run_mixed_ab(model: str = "gpt2-small-test", n_short: int = 12,
                  n_long: int = 4, max_new: int = 40, long_max_new: int = 4,
                  short_prompt_len: int = 8, long_prompt_len: int = 440,
@@ -2281,7 +2441,8 @@ def _main() -> int:
                              "spec-ab", "spec-batch-ab", "mixed",
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
-                             "crash-ab", "affinity-ab", "overload-ab"],
+                             "crash-ab", "affinity-ab", "overload-ab",
+                             "quant-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -2316,7 +2477,7 @@ def _main() -> int:
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
     if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab",
-                          "overload-ab")
+                          "overload-ab", "quant-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -2506,6 +2667,20 @@ def _main() -> int:
                 result["prefill_token_savings_frac"], **result,
         })
         return 0
+
+    if args.scenario == "quant-ab":
+        result = run_quant_ab(
+            model=args.model,
+            n_requests=12 if args.quick else 24,
+            max_new=48 if args.quick else 96)
+        record_partial("quant_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "kv_quant_capacity_gain",
+            "value": result["capacity_gain"], "unit": "x",
+            "vs_baseline": None, "model": args.model, **result,
+        })
+        return 0 if result["checks_passed"] else 1
 
     if args.scenario == "mixed-ab":
         result = run_mixed_ab(

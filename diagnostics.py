@@ -24,8 +24,8 @@ import sys
 OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
-#             --spec-parity step 9, --failover step 10, --overload
-#             step 11, --lint step 12
+#             --spec-parity step 9, --quant-parity step 10, --failover
+#             step 11, --overload step 12, --lint step 13
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -83,28 +83,35 @@ def main() -> int:
                          "undrafted decode rows, k+1 verify windows, "
                          "and block-boundary prefill chunks in one "
                          "batch vs the XLA gather reference")
+    ap.add_argument("--quant-parity", action="store_true",
+                    help="step 10: QUANTIZED (int8 block pool) paged-"
+                         "attention kernels vs their dequantizing XLA "
+                         "gather references — the fused-dequant decode "
+                         "and ragged read paths behind --kv-quantize "
+                         "(the on-chip gate before serving int8 KV)")
     ap.add_argument("--failover", action="store_true",
-                    help="step 10: one scripted kill/resume against a "
+                    help="step 11: one scripted kill/resume against a "
                          "local worker pair (spawned here): kill -9 the "
                          "stream's lane mid-generation and print the "
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
     ap.add_argument("--overload", action="store_true",
-                    help="step 11: overload-control state of the live "
+                    help="step 12: overload-control state of the live "
                          "system — the gateway's /stats overload block "
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 12: engine-lint static-analysis suite "
+                    help="step 13: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
                          "prints the per-rule finding summary")
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-              + int(args.spec_parity) + int(args.failover)
+              + int(args.spec_parity) + int(args.quant_parity)
+              + int(args.failover)
               + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
@@ -249,6 +256,32 @@ def main() -> int:
             step(n, "speculative verify-window kernel parity", False,
                  f"({exc})")
 
+    # 10 (--quant-parity): the QUANTIZED read paths behind --kv-quantize
+    # int8 — the fused-dequant Pallas kernels (decode + ragged) against
+    # the dequantizing XLA gather references. The one-time-write
+    # exactness story holds only if the kernel's in-VMEM dequant matches
+    # the reference's gathered dequant, so this is the on-chip gate
+    # before enabling int8 KV on a device.
+    if args.quant_parity:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + 1)
+        try:
+            from tpu_engine.ops.paged_attention import (
+                quant_parity_check,
+                quant_ragged_parity_check,
+            )
+
+            decode = max(quant_parity_check(),
+                         quant_parity_check(n_heads=8, n_kv_heads=2,
+                                            d_head=64, block_size=16,
+                                            n_blocks=33, table_len=8))
+            ragged = quant_ragged_parity_check(q_lens=(1, 7, 16, 17))
+            step(n, "quantized (int8) kernel parity",
+                 decode < 2e-4 and ragged < 2e-4,
+                 f"(max|Δ| decode {decode:.2e}, ragged {ragged:.2e})")
+        except Exception as exc:
+            step(n, "quantized (int8) kernel parity", False, f"({exc})")
+
     # 10 (--failover): one scripted kill/resume against a local worker
     # pair — the journal splice, live, in one line: spawn two standalone
     # workers, stream through a failover-enabled gateway, kill -9 the
@@ -256,7 +289,7 @@ def main() -> int:
     # unkilled blocking control.
     if args.failover:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-             + int(args.spec_parity) + 1)
+             + int(args.spec_parity) + int(args.quant_parity) + 1)
         procs = []
         try:
             import signal
@@ -333,7 +366,8 @@ def main() -> int:
     # itself the wire-compat check in one line.
     if args.overload:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-             + int(args.spec_parity) + int(args.failover) + 1)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.failover) + 1)
         try:
             status, stats = _get(gw, "/stats")
             ov = stats.get("overload")

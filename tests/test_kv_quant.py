@@ -1,0 +1,388 @@
+"""Quantized KV blocks (runtime.kv_blocks quantize="int8" + scheduler
+kv_quantize + ops.paged_attention quant read paths).
+
+Contracts under test:
+- the ONE-TIME-QUANTIZE invariant: a token's int8 payload and f32 scale
+  are written once, at block write; COW `ensure_writable`, host-tier
+  demotion/swap-in, and radix re-adoption move those bytes BIT-EXACTLY
+  (no cumulative requantization drift anywhere in the lifecycle);
+- `quantize_kv` granularity: one scale per (layer, slot, kv-head)
+  vector, round-trip error bounded by half an int8 lsb per vector;
+- quantized greedy streams are DETERMINISTIC run-to-run (every
+  scheduler mode: two-path, mixed, mixed+spec) and agree closely with
+  the bf16 pool's streams at serving shapes — but are not required to
+  be byte-identical to bf16 (MIGRATION.md);
+- kernel-vs-reference parity in int8 mode (fused-dequant Pallas kernel
+  vs the dequantizing XLA gather, decode and ragged variants);
+- defaults-off wire/schema byte-compat: an unquantized pool's stats
+  carry no quantized keys, and the quantized fields are additive;
+- zero-leak accounting INCLUDING scale slots: host scale slots pair 1:1
+  with demoted nodes across churn and recovery;
+- loud misconfiguration: kv_quantize without the paged cache (scheduler
+  and worker layers), unsupported modes, and the weight-quantization x
+  TP-sharding combination (training.shard_params_tp).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.models.registry import (
+    _ensure_builtin_models_imported,
+    create_model,
+)
+from tpu_engine.ops.attention import KVCache
+from tpu_engine.ops.quant import dequantize_kv, quantize_kv
+from tpu_engine.runtime.kv_blocks import BlockPool
+from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+_ensure_builtin_models_imported()
+
+BS = 16
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return create_model("gpt2-small-test", max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def params(spec):
+    return spec.init(jax.random.PRNGKey(0))
+
+
+def _pool(spec, blocks=6, host=0):
+    return BlockPool(spec.config, blocks, BS, jnp.float32,
+                     host_blocks=host, quantize="int8")
+
+
+def _fill_block(pool, bid, seed):
+    """Quantize a recognizable random payload into block `bid` via the
+    production write helper and return its (int8 k, int8 v, f32 ks,
+    f32 vs) device bytes."""
+    rng = np.random.default_rng(seed)
+    shape = (pool.cfg.n_layers, pool.block_size, pool.cfg.kv_heads,
+             pool.cfg.d_head)
+    qk, sk = quantize_kv(jnp.asarray(rng.normal(size=shape), jnp.float32))
+    qv, sv = quantize_kv(jnp.asarray(-rng.normal(size=shape), jnp.float32))
+    pool.caches = KVCache(pool.caches.k.at[:, bid].set(qk),
+                          pool.caches.v.at[:, bid].set(qv))
+    pool.scales = KVCache(pool.scales.k.at[:, bid].set(sk),
+                          pool.scales.v.at[:, bid].set(sv))
+    return _block_bytes(pool, bid)
+
+
+def _block_bytes(pool, bid):
+    return tuple(np.asarray(a[:, bid]) for a in
+                 (pool.caches.k, pool.caches.v,
+                  pool.scales.k, pool.scales.v))
+
+
+# -- quantize_kv granularity --------------------------------------------------
+
+def test_quantize_kv_roundtrip_bound_and_shapes():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 4, 2, 8)) * 5.0, jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    back = dequantize_kv(q, s)
+    amax = np.max(np.abs(np.asarray(x)), axis=-1)
+    # Symmetric round-to-nearest: error <= scale/2 = amax/254 per vector.
+    err = np.max(np.abs(np.asarray(back) - np.asarray(x)), axis=-1)
+    assert np.all(err <= amax / 254.0 + 1e-7)
+    # All-zero vectors take scale 1.0 and dequantize to exact zeros.
+    qz, sz = quantize_kv(jnp.zeros((2, 4)))
+    assert np.all(np.asarray(sz) == 1.0)
+    assert np.all(np.asarray(dequantize_kv(qz, sz)) == 0.0)
+
+
+# -- one-time-quantize invariant: every movement is a verbatim copy ----------
+
+def test_cow_copies_int8_and_scale_bitexact(spec):
+    pool = _pool(spec)
+    bid = pool.alloc(1)[0]
+    before = _fill_block(pool, bid, seed=1)
+    pool.retain(bid)  # second reference forces the copy
+    new_id, copied = pool.ensure_writable(bid)
+    assert copied and new_id != bid
+    after = _block_bytes(pool, new_id)
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b)  # bit-exact clone, no requantization
+    assert pool.cow_copies == 1
+    pool.release(bid)
+    pool.release(new_id)
+
+
+def test_demote_promote_roundtrip_bitexact_quant(spec):
+    pool = _pool(spec, blocks=6, host=4)
+    ids = pool.alloc(2)
+    snaps = [_fill_block(pool, bid, seed=10 + j)
+             for j, bid in enumerate(ids)]
+    prompt = list(range(2 * BS))
+    pool.radix.insert(prompt, ids)
+    pool.release_many(ids)
+    assert pool.radix.evict(2) == 2
+    host = pool.stats()["host"]
+    assert host["blocks_used"] == 2
+    assert host["scale_slots_used"] == 2 and host["scale_slots_leaked"] == 0
+    got = pool.radix.lookup(prompt, promote_reserve=0)
+    assert len(got) == 2 and pool.swap_ins == 2
+    for j, bid in enumerate(got):
+        for a, b in zip(snaps[j], _block_bytes(pool, bid)):
+            assert np.array_equal(a, b)  # int8 + scale round trip verbatim
+    assert pool.stats()["host"]["scale_slots_used"] == 0
+    pool.release_many(got)
+
+
+def test_insert_readopt_frees_scale_slot(spec):
+    pool = _pool(spec, blocks=6, host=4)
+    ids = pool.alloc(1)
+    _fill_block(pool, ids[0], seed=2)
+    prompt = list(range(BS))
+    pool.radix.insert(prompt, ids)
+    pool.release_many(ids)
+    pool.radix.evict(1)
+    assert pool.stats()["host"]["scale_slots_used"] == 1
+    # A newcomer recomputed the same prefix: re-adoption frees the host
+    # payload AND scale slot together.
+    fresh = pool.alloc(1)
+    _fill_block(pool, fresh[0], seed=2)
+    pool.radix.insert(prompt, fresh)
+    host = pool.stats()["host"]
+    assert host["blocks_used"] == 0 and host["scale_slots_used"] == 0
+    assert host["scale_slots_leaked"] == 0
+    pool.release_many(fresh)
+
+
+# -- kernel parity ------------------------------------------------------------
+
+def test_quant_kernel_parity_decode(monkeypatch):
+    from tpu_engine.ops.paged_attention import quant_parity_check
+
+    monkeypatch.setenv("TPU_ENGINE_PAGED", "1")  # force the Pallas kernel
+    assert quant_parity_check() < 2e-4
+    assert quant_parity_check(n_heads=8, n_kv_heads=2, d_head=64,
+                              block_size=16, n_blocks=33,
+                              table_len=8) < 2e-4
+
+
+def test_quant_kernel_parity_ragged(monkeypatch):
+    from tpu_engine.ops.paged_attention import quant_ragged_parity_check
+
+    monkeypatch.setenv("TPU_ENGINE_PAGED", "1")
+    assert quant_ragged_parity_check() < 2e-4
+    assert quant_ragged_parity_check(
+        q_lens=(1, 3, 16, 17), n_heads=8, n_kv_heads=2, d_head=32,
+        block_size=16, n_blocks=33, table_len=8) < 2e-4
+
+
+# -- scheduler end-to-end -----------------------------------------------------
+
+_PROMPTS = [[5, 9, 3, 7], [7, 2], list(range(1, 20)), [42] * 9]
+
+
+def _gen(spec, params, quantize, **kw):
+    base = dict(dtype="float32", n_slots=4, step_chunk=4, max_seq=128,
+                kv_block_size=BS, kv_blocks=30, kv_quantize=quantize)
+    base.update(kw)
+    return ContinuousGenerator(spec, params=params, **base)
+
+
+@pytest.mark.parametrize("mode_kw", [
+    {},                                     # two-path paged
+    {"mixed_step": True},                   # mixed stepping
+    {"mixed_step": True, "spec_k": 2},      # mixed + speculation
+], ids=["two-path", "mixed", "mixed-spec"])
+def test_quant_streams_deterministic_and_agree_with_bf16(
+        spec, params, mode_kw):
+    g = _gen(spec, params, "int8", **mode_kw)
+    try:
+        run1 = g.generate(_PROMPTS, max_new_tokens=16)
+        run2 = g.generate(_PROMPTS, max_new_tokens=16)
+    finally:
+        g.stop()
+    assert run1 == run2  # deterministic run-to-run
+    ref = _gen(spec, params, "", **mode_kw)
+    try:
+        base = ref.generate(_PROMPTS, max_new_tokens=16)
+    finally:
+        ref.stop()
+    # int8 KV rounding may eventually fork a greedy stream, but at
+    # serving shapes the agreement stays high and first tokens (prefill
+    # logits are computed before any quantized read in two-path mode;
+    # one chunk deep elsewhere) essentially always match.
+    per_tok = [sum(x == y for x, y in zip(a, b)) / max(1, len(a))
+               for a, b in zip(run1, base)]
+    assert sum(per_tok) / len(per_tok) >= 0.75
+    assert all(a[0] == b[0] for a, b in zip(run1, base))
+
+
+def test_quant_seeded_sampling_deterministic(spec, params):
+    g = _gen(spec, params, "int8")
+    try:
+        r1 = g.generate(_PROMPTS[:2], max_new_tokens=12, temperature=0.8,
+                        seed=7)
+        r2 = g.generate(_PROMPTS[:2], max_new_tokens=12, temperature=0.8,
+                        seed=7)
+        assert r1 == r2
+    finally:
+        g.stop()
+
+
+def test_quant_radix_sharing_stream_identity(spec, params):
+    """A radix-hit admission (dequantized gather + resumed prefill over
+    the shared int8 blocks) must emit the same stream as the cold
+    admission that wrote those blocks — the write-once bytes serve both."""
+    shared = [(j * 11) % 90 + 1 for j in range(2 * BS)]
+    prompt = shared + [3, 1]
+    g = _gen(spec, params, "int8", prefill_chunk=BS)
+    try:
+        cold = g.generate([prompt], max_new_tokens=12)[0]
+        assert g.stats()["kv_pool"]["radix_hits"] == 0
+        warm = g.generate([prompt], max_new_tokens=12)[0]
+        st = g.stats()["kv_pool"]
+        assert st["radix_hits"] >= 1 and st["prefix_hit_tokens"] > 0
+        assert warm == cold
+    finally:
+        g.stop()
+
+
+def test_quant_zero_leak_accounting_including_scale_slots(spec, params):
+    g = _gen(spec, params, "int8", n_slots=2, kv_blocks=12,
+             kv_host_blocks=6)
+    try:
+        rng = np.random.default_rng(5)
+        for _ in range(4):
+            prompts = [[int(t) for t in rng.integers(1, 200, 40)]
+                       for _ in range(2)]
+            g.generate(prompts, max_new_tokens=4)
+        st = g.stats()["kv_pool"]
+        host = st["host"]
+        assert host["demotions"] > 0  # the churn actually tiered
+        with g._pool.lock:
+            demoted = g._pool._demoted_nodes()
+        assert host["blocks_used"] == demoted
+        assert host["scale_slots_used"] == host["blocks_used"]
+        assert host["scale_slots_leaked"] == 0
+        # Device accounting: idle pool fully explained by free + tree
+        # residents (demoted nodes hold host slots, not device blocks).
+        assert (st["blocks_free"] + st["radix_nodes"] - host["blocks_used"]
+                >= st["blocks_total"])
+    finally:
+        g.stop()
+
+
+def test_quant_recover_rebuilds_scales(spec, params):
+    g = _gen(spec, params, "int8", n_slots=2, kv_blocks=12)
+    try:
+        g.generate([[5, 9, 3]], max_new_tokens=4)
+        g._recover(RuntimeError("injected device loss"))
+        st = g.stats()["kv_pool"]
+        assert st["blocks_free"] == st["blocks_total"]
+        assert g.stats().get("recover_invariant_violations", 0) == 0
+        # Scales were rebuilt with the pool: serving continues and the
+        # fresh pool dequantizes unwritten slots to exact zeros.
+        assert np.all(np.asarray(g._pool.scales.k) == 1.0)
+        out = g.generate([[5, 9, 3]], max_new_tokens=4)[0]
+        assert len(out) == 4
+    finally:
+        g.stop()
+
+
+# -- defaults-off byte-compat -------------------------------------------------
+
+def test_defaults_off_schema_byte_compat(spec, params):
+    g = _gen(spec, params, "")
+    try:
+        g.generate([[5, 9, 3]], max_new_tokens=2)
+        pool = g.stats()["kv_pool"]
+        for key in ("quantized", "bytes_per_block",
+                    "dense_bytes_per_block", "capacity_multiplier"):
+            assert key not in pool
+    finally:
+        g.stop()
+    tiered = _gen(spec, params, "", n_slots=2, kv_blocks=12,
+                  kv_host_blocks=6)
+    try:
+        tiered.generate([[5, 9, 3]], max_new_tokens=2)
+        host = tiered.stats()["kv_pool"]["host"]
+        assert "scale_slots_used" not in host
+        assert "scale_slots_leaked" not in host
+    finally:
+        tiered.stop()
+    from tpu_engine.utils.config import WorkerConfig
+
+    assert WorkerConfig(node_id="x", model="m").gen_kv_quantize == ""
+
+
+def test_quant_stats_fields_present(spec, params):
+    g = _gen(spec, params, "int8")
+    try:
+        pool = g.stats()["kv_pool"]
+        assert pool["quantized"] == "int8"
+        cfg = spec.config
+        slot_heads = cfg.n_layers * BS * cfg.kv_heads
+        assert pool["bytes_per_block"] == 2 * slot_heads * (cfg.d_head + 4)
+        assert pool["dense_bytes_per_block"] == (
+            2 * slot_heads * cfg.d_head * 4)  # float32 pool baseline
+        assert pool["capacity_multiplier"] == pytest.approx(
+            pool["dense_bytes_per_block"] / pool["bytes_per_block"],
+            abs=1e-3)
+    finally:
+        g.stop()
+
+
+# -- loud misconfiguration ----------------------------------------------------
+
+def test_misconfiguration_is_loud(spec, params):
+    with pytest.raises(ValueError, match="kv_quantize requires"):
+        ContinuousGenerator(spec, params=params, dtype="float32",
+                            n_slots=2, max_seq=128, kv_quantize="int8")
+    with pytest.raises(ValueError, match="unsupported KV quantize"):
+        BlockPool(spec.config, 4, BS, jnp.float32, quantize="fp4")
+
+
+def test_worker_guard_and_metrics_exposure(spec, params):
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import WorkerConfig
+
+    with pytest.raises(RuntimeError, match="kv-quantize"):
+        WorkerNode(WorkerConfig(node_id="bad", model="gpt2-small-test",
+                                gen_kv_quantize="int8"),
+                   engine=InferenceEngine("gpt2-small-test", params=params,
+                                          dtype="float32"))
+    w = WorkerNode(WorkerConfig(node_id="q", model="gpt2-small-test",
+                                gen_kv_block_size=BS, gen_kv_blocks=12,
+                                gen_kv_quantize="int8"),
+                   engine=InferenceEngine("gpt2-small-test", params=params,
+                                          dtype="float32"))
+    try:
+        w.handle_generate({"request_id": "h1",
+                           "prompt_tokens": [5, 9, 3],
+                           "max_new_tokens": 2})
+        pool = w.get_health()["generator"]["kv_pool"]
+        assert pool["quantized"] == "int8"
+        from tpu_engine.utils.metrics import render_prometheus
+
+        body = render_prometheus([w.get_health()]).decode()
+        assert 'tpu_engine_kv_quant_info{node="q",mode="int8"} 1' in body
+        assert "tpu_engine_kv_quant_bytes_per_block" in body
+        assert "tpu_engine_kv_quant_capacity_multiplier" in body
+    finally:
+        w.stop()
+
+
+def test_tp_sharding_refuses_quantized_trees(spec, params):
+    from jax.sharding import Mesh
+
+    from tpu_engine.ops.quant import quantize_params
+    from tpu_engine.training.train import shard_params_tp
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("model",))
+    # Full-precision trees still shard.
+    shard_params_tp(params, mesh, "model")
+    with pytest.raises(RuntimeError, match="weight-quantized"):
+        shard_params_tp(quantize_params(params), mesh, "model")

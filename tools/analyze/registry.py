@@ -129,16 +129,22 @@ ENGINE_REGISTRY = Registry(
     ),
     reentrant=frozenset({"BlockPool.lock"}),  # RLock: eviction inside alloc
     guarded=(
-        # Block pool bookkeeping + the pool-ordering dispatch surface.
+        # Block pool bookkeeping + the pool-ordering dispatch surface
+        # (the quantized pool's host scale slots pair 1:1 with the host
+        # payload slots and move under the same lock).
         GuardedEntry(
             attrs=("_free", "_ref", "_host_free", "_host_k", "_host_v",
+                   "_host_ks", "_host_vs",
                    "radix", "_promoting", "prefix_hit_tokens",
                    "prefilled_tokens"),
             lock="BlockPool.lock",
             classes=("BlockPool",),
             receivers=("pool", "self._pool")),
+        # Donated dispatch surfaces: the payload pool and (quantized
+        # mode) its per-slot scale arrays — every write replaces them
+        # under the pool lock so gathers order against donations.
         GuardedEntry(
-            attrs=("caches",),
+            attrs=("caches", "scales"),
             lock="BlockPool.lock",
             classes=("BlockPool",),
             receivers=("pool", "self._pool")),

@@ -60,12 +60,23 @@ A final pass repeats the kill with failover DISABLED and asserts today's
 behavior is unchanged: the victim stream truncates, and /stats carries no
 failover block.
 
+``--quant`` runs the STANDALONE quantized-KV chaos scenario (DESIGN.md
+"Quantized KV blocks"): three ``--kv-quantize int8`` host-tiered workers;
+it proves the int8 lifecycle live (churn demotes quantized blocks with
+their scale slots paired 1:1, a re-hit swaps the verbatim int8+scale
+bytes back in, swap_in counters == swap_in spans), then kill -9s the
+lane holding quantized AND demoted-quantized blocks mid-stream and
+asserts the PR 6 resume splices byte-identically on another quantized
+lane with zero device-block, host-block, or scale-slot leaks on the
+survivors.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
   python3 tools/fault_injection.py --mixed
   python3 tools/fault_injection.py --spec
   python3 tools/fault_injection.py --crash
+  python3 tools/fault_injection.py --quant
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -1083,6 +1094,187 @@ def run_offload_standalone() -> int:
                 proc.kill()
 
 
+def quant_phase(ports, procs, checks: list) -> dict:
+    """Quantized-pool chaos (--quant): every lane serves a --kv-quantize
+    int8 paged pool with the host tier on. Prove the quantized lifecycle
+    live, then kill -9 the lane HOLDING QUANTIZED (and demoted-quantized)
+    blocks mid-stream: the PR 6 resume must still splice byte-identically
+    on another quantized lane, survivors must leak zero device blocks,
+    zero host blocks AND zero scale slots, and the victim's swap-in
+    counters must match its swap_in spans before it dies
+    (counters == spans on the quantized path too)."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2,
+                               prefix_affinity=True,
+                               affinity_block_size=16))
+    shared = [(j * 13) % 90 + 1 for j in range(32)]  # two full blocks
+
+    # Every lane must actually serve the int8 pool (the loud-misconfig
+    # guard means a silently-bf16 lane would be a wiring bug).
+    quantized = {}
+    for p in ports:
+        _, health = _call(p, "GET", "/health", timeout=10)
+        quantized[p] = (health.get("generator", {}).get("kv_pool", {})
+                        .get("quantized"))
+    checks.append(("quant: every lane serves an int8 pool",
+                   all(v == "int8" for v in quantized.values())))
+
+    # Affinity makes the victim deterministic: the lane owning the
+    # shared prefix's fingerprint serves every shared-prefix request.
+    fp = gw._affinity_fingerprint({"prompt_tokens": shared})
+    victim_lane = gw._ring.get_node(fp)
+    victim_port = next(p for p in ports if victim_lane.endswith(f":{p}"))
+    victim_idx = ports.index(victim_port)
+    survivor_ports = [p for p in ports if p != victim_port]
+
+    for p in ports:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+    status, _ = _call(
+        victim_port, "POST", "/generate",
+        {"request_id": "prime", "prompt_tokens": shared + [5, 6],
+         "max_new_tokens": 4}, timeout=600)
+    checks.append(("quant: shared prefix primed on victim", status == 200))
+
+    # Churn the tiny pool until quantized blocks demote to the host tier
+    # — int8 payload + scale vectors must travel (and account) together.
+    rnd = random.Random(3)
+    for i in range(6):
+        filler = [rnd.randrange(1, 200) for _ in range(72)]
+        _call(victim_port, "POST", "/generate",
+              {"request_id": f"churn{i}", "prompt_tokens": filler,
+               "max_new_tokens": 2}, timeout=600)
+    _, health = _call(victim_port, "GET", "/health", timeout=10)
+    pool = health["generator"]["kv_pool"]
+    host = pool.get("host") or {}
+    checks.append(("quant: churn demoted quantized blocks "
+                   f"(demotions={host.get('demotions', 0)})",
+                   host.get("demotions", 0) > 0))
+    # scale_slots_leaked is the REAL pairing invariant (host slots used
+    # minus an actual radix walk of demoted nodes, computed pool-side
+    # under the lock) — it must exist on a quantized tier and stay 0.
+    checks.append(("quant: demoted scale slots pair with radix nodes "
+                   f"(used={host.get('scale_slots_used')}, "
+                   f"leaked={host.get('scale_slots_leaked')})",
+                   host.get("scale_slots_used") is not None
+                   and host.get("scale_slots_leaked") == 0))
+
+    # Re-hit: the demoted QUANTIZED prefix must swap back in (verbatim
+    # int8+scale — the resumed stream must match the pre-demotion one).
+    si0 = host.get("swap_ins", 0)
+    rehit = gw.route_generate(
+        {"request_id": "rehit", "prompt_tokens": shared + [9, 9],
+         "max_new_tokens": 4})
+    _, health = _call(victim_port, "GET", "/health", timeout=10)
+    pool = health["generator"]["kv_pool"]
+    host = pool.get("host") or {}
+    checks.append(("quant: re-hit swapped the int8 prefix back in "
+                   f"(swap_ins {si0}->{host.get('swap_ins', 0)})",
+                   host.get("swap_ins", 0) > si0
+                   and rehit["node_id"] == f"w{victim_idx}"))
+
+    # counters == spans on the quantized swap-in path: every swap_in
+    # event the victim's pool counted has a matching `swap_in` stage
+    # span in its trace ring.
+    _, export = _call(victim_port, "GET", "/trace/export", timeout=10)
+    swap_spans = sum(1 for e in export.get("traceEvents", [])
+                     if e.get("ph") == "X" and e.get("name") == "swap_in")
+    checks.append(("quant: swap_in counters == swap_in spans "
+                   f"({host.get('swap_in_events', 0)} vs {swap_spans})",
+                   host.get("swap_in_events", 0) == swap_spans))
+
+    # Mid-stream kill while the victim holds quantized + demoted-
+    # quantized blocks: the resume must splice byte-identically on a
+    # surviving quantized lane (quantized streams are deterministic, so
+    # the PR 6 replay contract holds exactly as in bf16 mode). A burst
+    # of shared-prefix streams — all affinity-routed to the victim —
+    # SATURATES the lane (admission queueing + full decode batches), so
+    # some stream is provably mid-generation long enough for the kill
+    # to land even on a fast host where one short stream would finish
+    # between monitor polls (the tiny test model caps streams at ~30
+    # tokens; wall time, not token count, is what widens the window).
+    reqs = [{"request_id": f"quant_stream_{i}",
+             "prompt_tokens": shared + [2 + i],
+             "max_new_tokens": 30} for i in range(14)]
+    rids = {r["request_id"] for r in reqs}
+    control = control_oracle(survivor_ports[0], reqs)
+
+    def kill_victim():
+        procs[victim_idx].send_signal(signal.SIGKILL)
+        procs[victim_idx].wait(timeout=10)
+
+    results, killed = drive_streams_with_kill(
+        gw, reqs, rids, kill_victim, random.Random(5))
+    checks.append(("quant: victim (holding quantized blocks) killed "
+                   "mid-stream", killed))
+    identical = all(
+        stream_completed(results[rid][1])
+        and results[rid][0] == control[rid]
+        and results[rid][1].get("tokens") == control[rid]
+        for rid in rids)
+    resumes = sum(int((results[rid][1] or {}).get("resumed", 0))
+                  for rid in rids)
+    final = results[reqs[0]["request_id"]][1]
+    checks.append(("quant: every stream completed byte-identically "
+                   f"(resumes={resumes})", identical and resumes > 0))
+
+    # Survivors: fresh availability + zero device/host/scale-slot leaks.
+    status, _ = _call(survivor_ports[0], "POST", "/generate",
+                      {"request_id": "post", "prompt_tokens": [4, 2],
+                       "max_new_tokens": 4}, timeout=600)
+    checks.append(("quant: post-kill availability", status == 200))
+    leak_free = {}
+    for p in survivor_ports:
+        pool = _worker_pool_clean_tiered(p)
+        scale_ok = (pool is not None
+                    and (pool.get("host") or {}).get(
+                        "scale_slots_leaked", 0) == 0)
+        leak_free[p] = bool(pool is not None and scale_ok)
+        checks.append((f"quant: zero device+host block and scale-slot "
+                       f"leaks on survivor :{p}", leak_free[p]))
+    fo = gw.get_stats().get("failover", {})
+    gw.stop()
+    return {"victim_port": victim_port, "killed": killed,
+            "stream_identical": identical,
+            "resumed": (final or {}).get("resumed", 0),
+            "victim_demotions": host.get("demotions", 0),
+            "victim_swap_ins": host.get("swap_ins", 0),
+            "swap_in_spans": swap_spans,
+            "failover": fo, "survivors_leak_free": leak_free}
+
+
+def run_quant_standalone() -> int:
+    ports, procs = launch_worker_procs(
+        3, extra_args=("--kv-blocks", "20", "--kv-host-blocks", "16",
+                       "--kv-quantize", "int8"))
+    checks: list = []
+    try:
+        report = {"mode": "quant-standalone", "worker_ports": ports,
+                  "phases": {"quant": quant_phase(ports, procs, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_crash_standalone() -> int:
     ports, procs = launch_worker_procs(3)
     checks: list = []
@@ -1430,6 +1622,17 @@ def main() -> int:
                          "completes byte-identically with zero device or "
                          "host blocks leaked on the survivors; ignores "
                          "the other flags")
+    ap.add_argument("--quant", action="store_true",
+                    help="standalone quantized-KV scenario: spawns three "
+                         "--kv-quantize int8 host-tiered workers, proves "
+                         "the int8 demote/swap-in lifecycle live "
+                         "(scale slots pair with host slots, swap_in "
+                         "counters == spans), then kill -9s the lane "
+                         "holding quantized and demoted-quantized blocks "
+                         "mid-stream and asserts the PR 6 resume "
+                         "completes byte-identically with zero device, "
+                         "host, or scale-slot leaks on the survivors; "
+                         "ignores the other flags")
     ap.add_argument("--overload", action="store_true",
                     help="standalone overload-control scenario: spawns a "
                          "3-lane combined server with every overload "
@@ -1441,6 +1644,8 @@ def main() -> int:
                          "marker spans, and zero KV blocks leak; "
                          "ignores the other flags")
     args = ap.parse_args()
+    if args.quant:
+        return run_quant_standalone()
     if args.overload:
         return run_overload_standalone()
     if args.mixed:

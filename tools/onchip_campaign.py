@@ -363,6 +363,41 @@ def main() -> int:
             prefill_chunk=64 if q else 256, dtype="bfloat16")
         return res
 
+    @stage(artifact, out, "kv_quant")
+    def _kv_quant():
+        # Quantized KV blocks on-chip: (a) Mosaic compile + exactness of
+        # the fused-dequant kernels (decode + ragged) vs the
+        # dequantizing XLA gather references — the CPU rounds only ever
+        # ran the interpreter; (b) the equal-KV-byte-budget bf16-vs-int8
+        # capacity A/B (BENCH_r12 ran it on the CPU mesh, stamped
+        # on-chip pending like r06-r11) against the real chip, where the
+        # int8 DMA bytes are the actual bandwidth win.
+        import jax.numpy as jnp
+
+        from tpu_engine.ops.paged_attention import (
+            quant_parity_check,
+            quant_ragged_parity_check,
+        )
+
+        res = {"kernel_parity": {
+            "decode_f32_max_abs_diff": quant_parity_check(
+                block_size=16, n_blocks=33, table_len=8, d_head=64),
+            "decode_bf16_q_max_abs_diff": quant_parity_check(
+                dtype=jnp.bfloat16, block_size=16, n_blocks=33,
+                table_len=8, d_head=64),
+            "decode_gqa_max_abs_diff": quant_parity_check(
+                n_heads=8, n_kv_heads=2, d_head=64, block_size=16,
+                n_blocks=33, table_len=8),
+            "ragged_f32_max_abs_diff": quant_ragged_parity_check(
+                q_lens=(1, 7, 16, 17), block_size=16, n_blocks=33,
+                table_len=8, d_head=64),
+        }}
+        res["ab"] = bench.run_quant_ab(
+            model=model, n_requests=12 if q else 24,
+            max_new=48 if q else 96,
+            model_kwargs={} if model != "gpt2-small-test" else None)
+        return res
+
     @stage(artifact, out, "affinity")
     def _affinity():
         # Prefix-affinity routing + host KV tier on-chip: the fleet
@@ -376,7 +411,7 @@ def main() -> int:
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
                _decode_int8, _flash, _flash_tiling, _paged, _mixed,
-               _spec_cont, _spec, _affinity,
+               _spec_cont, _spec, _kv_quant, _affinity,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

@@ -446,8 +446,21 @@ def _block_decode_rows_paged(bp, h, cache_kv, tables, pos_vec,
     column i — the alignment radix sharing needs), so pos_vec IS the
     logical position. The new token's K/V is scattered into its block
     BEFORE the attention read (write-before-attend, like every other
-    decode path)."""
-    ck, cv = cache_kv
+    decode path).
+
+    QUANTIZED pool (cache_kv = (ck, cv, ks, vs) with int8 payloads and
+    per-slot f32 scales): the new token's K/V quantizes HERE, exactly
+    once — its own (kv-head) vectors get their own scales, so the write
+    never touches (or is constrained by) neighbours already in the block
+    — and ``attn_fn`` must be a quantized read path
+    (ops.paged_attention.default_quant_paged_attention)."""
+    quantized = len(cache_kv) == 4
+    if quantized:
+        from tpu_engine.ops.quant import quantize_kv
+
+        ck, cv, ks, vs = cache_kv
+    else:
+        ck, cv = cache_kv
     bs = ck.shape[1]
     b = h.shape[0]
     x = _norm(bp["ln1"], h, cfg)
@@ -456,17 +469,30 @@ def _block_decode_rows_paged(bp, h, cache_kv, tables, pos_vec,
     rows = jnp.arange(b)
     blk = tables[rows, pos_vec // bs]
     off = pos_vec % bs
-    ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
-    cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
-    a = attn_fn(q, ck, cv, tables, pos_vec)  # grouped, unexpanded
+    if quantized:
+        qk, sk = quantize_kv(k[:, 0])     # (B, H_kv, D) -> int8 + (B, H_kv)
+        qv, sv = quantize_kv(v[:, 0])
+        ck = ck.at[blk, off].set(qk)
+        cv = cv.at[blk, off].set(qv)
+        ks = ks.at[blk, off].set(sk)
+        vs = vs.at[blk, off].set(sv)
+        a = attn_fn(q, ck, cv, ks, vs, tables, pos_vec)
+    else:
+        ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
+        a = attn_fn(q, ck, cv, tables, pos_vec)  # grouped, unexpanded
+    a = a.astype(dtype)
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, 1, -1), dtype=dtype)
     h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
+    if quantized:
+        return h.astype(dtype), (ck, cv, ks, vs)
     return h.astype(dtype), (ck, cv)
 
 
 def transformer_decode_rows_paged(params, token_t, caches: KVCache, tables,
                                   pos_vec, cfg: TransformerConfig, *,
-                                  dtype=jnp.bfloat16, attn_fn=None):
+                                  dtype=jnp.bfloat16, attn_fn=None,
+                                  scales: Optional[KVCache] = None):
     """`transformer_decode_rows` over a block pool instead of per-row
     cache stripes. caches: (L, NB, bs, H_kv, D) pool pair; tables:
     (B, nb) int32 per-row block tables (0 = the reserved null block —
@@ -474,11 +500,20 @@ def transformer_decode_rows_paged(params, token_t, caches: KVCache, tables,
     rows: no start_vec). ``attn_fn`` defaults to
     `ops.paged_attention.default_paged_attention()` — the Pallas kernel
     on TPU, the XLA gather reference elsewhere. Returns
-    (logits (B, vocab), caches)."""
-    if attn_fn is None:
-        from tpu_engine.ops.paged_attention import default_paged_attention
+    (logits (B, vocab), caches).
 
-        attn_fn = default_paged_attention()
+    ``scales`` (a KVCache pair of (L, NB, bs, H_kv) f32 arrays) switches
+    to the QUANTIZED pool: payloads are int8, the new token quantizes at
+    its write, and the return grows to (logits, caches, scales).
+    ``attn_fn`` then defaults to the quantized read path."""
+    if attn_fn is None:
+        from tpu_engine.ops.paged_attention import (
+            default_paged_attention,
+            default_quant_paged_attention,
+        )
+
+        attn_fn = (default_quant_paged_attention() if scales is not None
+                   else default_paged_attention())
     if cfg.sliding_window is not None:
         # Band masking is not plumbed through the paged read path yet;
         # failing loudly beats silently attending the full context.
@@ -493,16 +528,24 @@ def transformer_decode_rows_paged(params, token_t, caches: KVCache, tables,
     h = h.astype(dtype)
 
     def body(carry, layer):
-        bp, ck, cv = layer
-        h, (ck, cv) = _block_decode_rows_paged(
-            bp, carry, (ck, cv), tables, pos_vec, cfg, dtype=dtype,
+        bp, *kv = layer
+        h, kv = _block_decode_rows_paged(
+            bp, carry, tuple(kv), tables, pos_vec, cfg, dtype=dtype,
             attn_fn=attn_fn)
-        return h, (ck, cv)
+        return h, kv
 
-    h, (k_new, v_new) = jax.lax.scan(body, h,
-                                     (params["blocks"], caches.k, caches.v))
+    if scales is not None:
+        h, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            body, h, (params["blocks"], caches.k, caches.v,
+                      scales.k, scales.v))
+    else:
+        h, (k_new, v_new) = jax.lax.scan(
+            body, h, (params["blocks"], caches.k, caches.v))
     h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
+    if scales is not None:
+        return (logits[:, 0], KVCache(k_new, v_new),
+                KVCache(ks_new, vs_new))
     return logits[:, 0], KVCache(k_new, v_new)
 
 
@@ -514,7 +557,13 @@ def _block_step_rows_ragged(bp, h, cache_kv, tables, pos0, qlen,
     K/V scatter into the rows' pool blocks BEFORE the attention read
     (write-before-attend); padding slots (i >= qlen) scatter into the
     null block and their outputs are garbage the scheduler ignores."""
-    ck, cv = cache_kv
+    quantized = len(cache_kv) == 4
+    if quantized:
+        from tpu_engine.ops.quant import quantize_kv
+
+        ck, cv, ks, vs = cache_kv
+    else:
+        ck, cv = cache_kv
     bs = ck.shape[1]
     b, w = h.shape[:2]
     x = _norm(bp["ln1"], h, cfg)
@@ -527,18 +576,34 @@ def _block_step_rows_ragged(bp, h, cache_kv, tables, pos0, qlen,
     blk = tables[rows, cols // bs]
     blk = jnp.where(offs < qlen[:, None], blk, 0)  # padding -> null block
     off = cols % bs
-    ck = ck.at[blk, off].set(k.astype(ck.dtype))
-    cv = cv.at[blk, off].set(v.astype(cv.dtype))
-    a = attn_fn(q, ck, cv, tables, pos0, qlen)  # grouped, unexpanded
+    if quantized:
+        # Prefill-chunk / decode-append slots quantize at THIS write —
+        # one int8 vector + f32 scale per (slot, kv-head), exactly once;
+        # padding slots' vectors (and scales) dump into the null block.
+        qk, sk = quantize_kv(k)           # (B, W, H_kv, D) + (B, W, H_kv)
+        qv, sv = quantize_kv(v)
+        ck = ck.at[blk, off].set(qk)
+        cv = cv.at[blk, off].set(qv)
+        ks = ks.at[blk, off].set(sk)
+        vs = vs.at[blk, off].set(sv)
+        a = attn_fn(q, ck, cv, ks, vs, tables, pos0, qlen)
+    else:
+        ck = ck.at[blk, off].set(k.astype(ck.dtype))
+        cv = cv.at[blk, off].set(v.astype(cv.dtype))
+        a = attn_fn(q, ck, cv, tables, pos0, qlen)  # grouped, unexpanded
+    a = a.astype(dtype)
     h = h + nn.dense(bp["attn"]["wo"], a.reshape(b, w, -1), dtype=dtype)
     h = h + _mlp(bp["mlp"], _norm(bp["ln2"], h, cfg), dtype, cfg)
+    if quantized:
+        return h.astype(dtype), (ck, cv, ks, vs)
     return h.astype(dtype), (ck, cv)
 
 
 def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
                                  pos0, qlen, cfg: TransformerConfig, *,
                                  dtype=jnp.bfloat16, attn_fn=None,
-                                 sample_slot=None, sample_width: int = 1):
+                                 sample_slot=None, sample_width: int = 1,
+                                 scales: Optional[KVCache] = None):
     """The mixed prefill+decode primitive (runtime.scheduler
     --mixed-step): one ragged batch where each row consumes qlen[b] >= 0
     new tokens, writing their KV straight into the row's pool blocks in
@@ -562,11 +627,20 @@ def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
     only sample once still pay a (B*S, d)x(d, vocab) head, not
     (B*W, d)x(d, vocab). Returns (logits (B, vocab), caches) — or
     (B, sample_width, vocab) when sample_width > 1, or (B, W, vocab)
-    when ``sample_slot`` is None."""
-    if attn_fn is None:
-        from tpu_engine.ops.paged_attention import default_ragged_attention
+    when ``sample_slot`` is None.
 
-        attn_fn = default_ragged_attention()
+    ``scales`` (KVCache of (L, NB, bs, H_kv) f32) switches to the
+    QUANTIZED int8 pool — new-token KV quantizes at its in-dispatch
+    write, the default ``attn_fn`` becomes the quantized ragged read
+    path, and the caches return grows to (..., caches, scales)."""
+    if attn_fn is None:
+        from tpu_engine.ops.paged_attention import (
+            default_quant_ragged_attention,
+            default_ragged_attention,
+        )
+
+        attn_fn = (default_quant_ragged_attention() if scales is not None
+                   else default_ragged_attention())
     if cfg.sliding_window is not None:
         raise NotImplementedError(
             "sliding_window models are not supported by the paged KV "
@@ -580,24 +654,30 @@ def transformer_step_rows_ragged(params, tokens, caches: KVCache, tables,
     h = h.astype(dtype)
 
     def body(carry, layer):
-        bp, ck, cv = layer
-        h, (ck, cv) = _block_step_rows_ragged(
-            bp, carry, (ck, cv), tables, pos0, qlen, cfg, dtype=dtype,
+        bp, *kv = layer
+        h, kv = _block_step_rows_ragged(
+            bp, carry, tuple(kv), tables, pos0, qlen, cfg, dtype=dtype,
             attn_fn=attn_fn)
-        return h, (ck, cv)
+        return h, kv
 
-    h, (k_new, v_new) = jax.lax.scan(body, h,
-                                     (params["blocks"], caches.k, caches.v))
+    if scales is not None:
+        h, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            body, h, (params["blocks"], caches.k, caches.v,
+                      scales.k, scales.v))
+        new_scales = KVCache(ks_new, vs_new)
+    else:
+        h, (k_new, v_new) = jax.lax.scan(
+            body, h, (params["blocks"], caches.k, caches.v))
     if sample_slot is not None:
         slots = jnp.minimum(sample_slot[:, None]
                             + jnp.arange(sample_width)[None, :], w - 1)
         h = h[jnp.arange(b)[:, None], slots]          # (B, S, d)
     h = _norm(params["ln_f"], h, cfg)
     logits = nn.dense(params["head"], h, dtype=dtype).astype(jnp.float32)
-    if sample_slot is not None:
-        if sample_width == 1:
-            return logits[:, 0], KVCache(k_new, v_new)
-        return logits, KVCache(k_new, v_new)
+    if sample_slot is not None and sample_width == 1:
+        logits = logits[:, 0]
+    if scales is not None:
+        return logits, KVCache(k_new, v_new), new_scales
     return logits, KVCache(k_new, v_new)
 
 

@@ -342,6 +342,271 @@ def ragged_paged_attention(q, k_pool, v_pool, tables, pos0, qlen, *,
                         pos0, lengths, interpret=bool(interpret))
 
 
+# -- quantized (int8 block pool) variants -------------------------------------
+#
+# The quantized pool (runtime.kv_blocks, --kv-quantize int8) stores block
+# payloads int8 with one f32 scale per (block slot, kv-head) vector per
+# layer. The attention read side applies the scales with the same
+# exactness argument as ops.quant's weight path:
+#
+#     q · (Kq_j * s_j)  ==  (q · Kq_j) * s_j      (score column j)
+#     sum_j p_j (Vq_j * t_j)  ==  sum_j (p_j t_j) Vq_j
+#
+# so K's scales multiply the score COLUMNS after QK^T and V's scales fold
+# into P before the PV matmul — the dequantized block never materializes
+# in HBM (the kernel converts int8 -> f32 in VMEM per streamed block; the
+# XLA reference dequantizes its gathered copy). Rounding error therefore
+# comes only from the one-time int8 write at block-fill time.
+
+
+def quant_paged_attention_reference(q, k_pool, v_pool, k_scale, v_scale,
+                                    tables, pos_vec):
+    """`paged_attention_reference` over the int8 pool. k_pool/v_pool:
+    (NB, bs, H_kv, D) int8; k_scale/v_scale: (NB, bs, H_kv) f32. The
+    gathered view dequantizes to f32 (exact: int8 * f32 scale), then the
+    identical dense attention math runs."""
+    from tpu_engine.ops.quant import dequantize_kv
+
+    bs = k_pool.shape[1]
+    b, nb = tables.shape
+    kk = dequantize_kv(k_pool[tables], k_scale[tables])
+    vv = dequantize_kv(v_pool[tables], v_scale[tables])
+    kk = kk.reshape(b, nb * bs, kk.shape[3], kk.shape[4])
+    vv = vv.reshape(b, nb * bs, vv.shape[3], vv.shape[4])
+    kpos = jnp.arange(nb * bs)[None, :]
+    valid = (kpos <= pos_vec[:, None]).astype(jnp.int32)
+    return dot_product_attention(q, kk, vv, mask=valid)
+
+
+def quant_ragged_paged_attention_reference(q, k_pool, v_pool, k_scale,
+                                           v_scale, tables, pos0, qlen):
+    """`ragged_paged_attention_reference` over the int8 pool (same
+    contract; padding slots produce garbage the caller ignores)."""
+    from tpu_engine.ops.quant import dequantize_kv
+
+    del qlen
+    bs = k_pool.shape[1]
+    b, w = q.shape[:2]
+    nb = tables.shape[1]
+    kk = dequantize_kv(k_pool[tables], k_scale[tables]).reshape(
+        b, nb * bs, k_pool.shape[2], k_pool.shape[3])
+    vv = dequantize_kv(v_pool[tables], v_scale[tables]).reshape(
+        b, nb * bs, v_pool.shape[2], v_pool.shape[3])
+    kpos = jnp.arange(nb * bs)
+    qpos = pos0[:, None] + jnp.arange(w)[None, :]              # (B, W)
+    valid = (kpos[None, None, :] <= qpos[:, :, None]).astype(jnp.int32)
+    return dot_product_attention(q, kk, vv, mask=valid)
+
+
+def _quant_fold(q, k, v, ks, vs, kpos_mask, m_sc, l_sc, acc_sc, *,
+                scale: float):
+    """Shared fused-dequant flash fold for both quantized kernels: one
+    int8 K/V block + its f32 scale vectors -> running accumulators.
+    q: (R, D); k/v: (bs, D) int8; ks/vs: (bs,); kpos_mask: (R, bs) bool.
+    int8 payloads convert to f32 in VMEM (values exactly representable);
+    K scales multiply the score columns, V scales fold into P."""
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s = s * (ks[None, :] * scale)                     # (R, bs)
+    s = jnp.where(kpos_mask, s, _NEG_INF)
+    m = m_sc[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - safe_m[:, None])
+    corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - safe_m))
+    l_sc[...] = l_sc[...] * corr + jnp.sum(p, axis=-1)
+    acc_sc[...] = acc_sc[...] * corr[:, None] + jax.lax.dot_general(
+        p * vs[None, :], v.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_sc[...] = m_new
+
+
+def _quant_paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc, *,
+                        block_size: int, scale: float):
+    """`_paged_kernel` plus per-block scale inputs (ks/vs: (1, bs, 1) —
+    the same table-driven index map picks the block's scale vectors)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * block_size < length)
+    def _live_block():
+        q = q_ref[0, 0]                    # (G, D)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_size), 1)
+        _quant_fold(q, k_ref[0, :, 0, :], v_ref[0, :, 0, :],
+                    ks_ref[0, :, 0], vs_ref[0, :, 0], kpos < length,
+                    m_sc, l_sc, acc_sc, scale=scale)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quant_paged_call(q, k_pool, v_pool, k_scale, v_scale, tables, lengths,
+                      *, interpret: bool):
+    b, _, h, d = q.shape
+    _, bs, h_kv, _ = k_pool.shape
+    nb = tables.shape[1]
+    g = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    qh = q[:, 0].reshape(b, h_kv, g, d)
+    kernel = functools.partial(_quant_paged_kernel, block_size=bs,
+                               scale=scale)
+    blk = lambda b, h, j, tables, lengths: (tables[b, j], 0, h, 0)  # noqa: E731
+    sblk = lambda b, h, j, tables, lengths: (tables[b, j], 0, h)  # noqa: E731
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,        # tables, lengths
+            grid=(b, h_kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b, h, j, tables, lengths: (b, h, 0, 0)),
+                pl.BlockSpec((1, bs, 1, d), blk),
+                pl.BlockSpec((1, bs, 1, d), blk),
+                pl.BlockSpec((1, bs, 1), sblk),
+                pl.BlockSpec((1, bs, 1), sblk),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, d),
+                lambda b, h, j, tables, lengths: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g,), jnp.float32),
+                pltpu.VMEM((g,), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, g, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, lengths, qh, k_pool, v_pool, k_scale, v_scale)
+    return out.reshape(b, 1, h, d)
+
+
+def quant_paged_attention(q, k_pool, v_pool, k_scale, v_scale, tables,
+                          pos_vec, *, interpret=None):
+    """Pallas-kernel drop-in for `quant_paged_attention_reference` (same
+    signature/contract): the block DMA is int8 + a scale vector — about
+    half the bf16 bytes per block — and dequant happens in VMEM."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lengths = jnp.asarray(pos_vec, jnp.int32) + 1
+    return _quant_paged_call(q, k_pool, v_pool, k_scale, v_scale,
+                             jnp.asarray(tables, jnp.int32), lengths,
+                             interpret=bool(interpret))
+
+
+def _quant_ragged_kernel(tables_ref, pos0_ref, lengths_ref, q_ref, k_ref,
+                         v_ref, ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc,
+                         *, block_size: int, scale: float, group: int):
+    """`_ragged_kernel` plus per-block scale inputs — causal masking
+    within the new-token window, fused dequant per streamed block."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    length = lengths_ref[b]   # pos0 + qlen: cols the row's queries can see
+    pos0 = pos0_ref[b]
+
+    @pl.when(j * block_size < length)
+    def _live_block():
+        q = q_ref[0, 0]                    # (W*G, D)
+        shape = (q.shape[0], block_size)
+        kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        qpos = pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0) // group
+        _quant_fold(q, k_ref[0, :, 0, :], v_ref[0, :, 0, :],
+                    ks_ref[0, :, 0], vs_ref[0, :, 0], kpos <= qpos,
+                    m_sc, l_sc, acc_sc, scale=scale)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quant_ragged_call(q, k_pool, v_pool, k_scale, v_scale, tables, pos0,
+                       lengths, *, interpret: bool):
+    b, w, h, d = q.shape
+    _, bs, h_kv, _ = k_pool.shape
+    nb = tables.shape[1]
+    g = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    qh = (q.reshape(b, w, h_kv, g, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, h_kv, w * g, d))
+    kernel = functools.partial(_quant_ragged_kernel, block_size=bs,
+                               scale=scale, group=g)
+    blk = lambda b, h, j, tables, pos0, lengths: (tables[b, j], 0, h, 0)  # noqa: E731
+    sblk = lambda b, h, j, tables, pos0, lengths: (tables[b, j], 0, h)  # noqa: E731
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,        # tables, pos0, lengths
+            grid=(b, h_kv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, w * g, d),
+                             lambda b, h, j, tables, pos0, lengths:
+                             (b, h, 0, 0)),
+                pl.BlockSpec((1, bs, 1, d), blk),
+                pl.BlockSpec((1, bs, 1, d), blk),
+                pl.BlockSpec((1, bs, 1), sblk),
+                pl.BlockSpec((1, bs, 1), sblk),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, w * g, d),
+                lambda b, h, j, tables, pos0, lengths: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((w * g,), jnp.float32),
+                pltpu.VMEM((w * g,), jnp.float32),
+                pltpu.VMEM((w * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, w * g, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, pos0, lengths, qh, k_pool, v_pool, k_scale, v_scale)
+    return (out.reshape(b, h_kv, w, g, d).transpose(0, 2, 1, 3, 4)
+            .reshape(b, w, h, d))
+
+
+def quant_ragged_paged_attention(q, k_pool, v_pool, k_scale, v_scale,
+                                 tables, pos0, qlen, *, interpret=None):
+    """Pallas-kernel drop-in for `quant_ragged_paged_attention_reference`
+    (same signature/contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pos0 = jnp.asarray(pos0, jnp.int32)
+    lengths = pos0 + jnp.asarray(qlen, jnp.int32)
+    return _quant_ragged_call(q, k_pool, v_pool, k_scale, v_scale,
+                              jnp.asarray(tables, jnp.int32), pos0,
+                              lengths, interpret=bool(interpret))
+
+
 _PAGED_CACHE = {}
 
 
@@ -377,6 +642,19 @@ def default_ragged_attention():
     `default_paged_attention` governs both read paths."""
     return _select_impl("ragged", ragged_paged_attention,
                         ragged_paged_attention_reference)
+
+
+def default_quant_paged_attention():
+    """Quantized decode-path selection (int8 pool, --kv-quantize) — the
+    same `TPU_ENGINE_PAGED` knob and rule as the bf16 paths."""
+    return _select_impl("quant_paged", quant_paged_attention,
+                        quant_paged_attention_reference)
+
+
+def default_quant_ragged_attention():
+    """Quantized ragged-path selection — one rule for all four paths."""
+    return _select_impl("quant_ragged", quant_ragged_paged_attention,
+                        quant_ragged_paged_attention_reference)
 
 
 def parity_check(batch: int = 2, n_heads: int = 4, n_kv_heads: int = 2,
@@ -445,6 +723,84 @@ def ragged_parity_check(q_lens=(1, 7, 16, 17), n_heads: int = 4,
                                            pos0, qlen)
     diff = jnp.abs(ours.astype(jnp.float32) - ref.astype(jnp.float32))
     valid = (jnp.arange(w)[None, :] < qlen[:, None])  # padding slots: ignored
+    return float(jnp.max(jnp.where(valid[:, :, None, None], diff, 0.0)))
+
+
+def _random_quant_pool(rng_key, n_blocks, block_size, n_kv_heads, d_head,
+                       seed):
+    """A random int8 pool + f32 scales built by quantizing a random f32
+    pool with the ONE production write path (ops.quant.quantize_kv) —
+    parity inputs carry exactly the value distribution serving writes."""
+    from tpu_engine.ops.quant import quantize_kv
+
+    keys = jax.random.split(rng_key, 2)
+    shape = (n_blocks, block_size, n_kv_heads, d_head)
+    k_pool, k_scale = quantize_kv(jax.random.normal(keys[0], shape))
+    v_pool, v_scale = quantize_kv(jax.random.normal(keys[1], shape))
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def quant_parity_check(batch: int = 2, n_heads: int = 4, n_kv_heads: int = 2,
+                       d_head: int = 8, block_size: int = 16,
+                       n_blocks: int = 9, table_len: int = 4,
+                       dtype=jnp.float32, seed: int = 0) -> float:
+    """`parity_check` for the QUANTIZED decode path: max |kernel -
+    reference| over a random int8 pool/table/length workload. Shared by
+    tests/test_kv_quant.py, diagnostics.py --quant-parity, and the
+    on-chip campaign's `kv_quant` stage."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2)
+    q = jax.random.normal(keys[0], (batch, 1, n_heads, d_head), dtype)
+    k_pool, v_pool, k_scale, v_scale = _random_quant_pool(
+        keys[1], n_blocks, block_size, n_kv_heads, d_head, seed)
+    tables = np.zeros((batch, table_len), np.int32)
+    pos = np.zeros((batch,), np.int32)
+    for r in range(batch):
+        tables[r] = 1 + rng.permutation(n_blocks - 1)[:table_len]
+        pos[r] = int(rng.integers(0, table_len * block_size))
+    tables = jnp.asarray(tables)
+    pos = jnp.asarray(pos)
+    ours = quant_paged_attention(q, k_pool, v_pool, k_scale, v_scale,
+                                 tables, pos)
+    ref = quant_paged_attention_reference(q, k_pool, v_pool, k_scale,
+                                          v_scale, tables, pos)
+    return float(jnp.max(jnp.abs(ours.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+
+
+def quant_ragged_parity_check(q_lens=(1, 7, 16, 17), n_heads: int = 4,
+                              n_kv_heads: int = 2, d_head: int = 8,
+                              block_size: int = 16, n_blocks: int = 33,
+                              table_len: int = 6, dtype=jnp.float32,
+                              seed: int = 0) -> float:
+    """`ragged_parity_check` for the QUANTIZED ragged path (mixed decode
+    + prefill-chunk rows over the int8 pool, the --kv-quantize
+    --mixed-step serving shape)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    batch = len(q_lens)
+    w = max(q_lens)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2)
+    q = jax.random.normal(keys[0], (batch, w, n_heads, d_head), dtype)
+    k_pool, v_pool, k_scale, v_scale = _random_quant_pool(
+        keys[1], n_blocks, block_size, n_kv_heads, d_head, seed)
+    tables = np.zeros((batch, table_len), np.int32)
+    pos0 = np.zeros((batch,), np.int32)
+    for r, ql in enumerate(q_lens):
+        tables[r] = 1 + rng.permutation(n_blocks - 1)[:table_len]
+        pos0[r] = int(rng.integers(0, table_len * block_size - ql + 1))
+    tables = jnp.asarray(tables)
+    qlen = jnp.asarray(np.asarray(q_lens, np.int32))
+    pos0 = jnp.asarray(pos0)
+    ours = quant_ragged_paged_attention(q, k_pool, v_pool, k_scale,
+                                        v_scale, tables, pos0, qlen)
+    ref = quant_ragged_paged_attention_reference(
+        q, k_pool, v_pool, k_scale, v_scale, tables, pos0, qlen)
+    diff = jnp.abs(ours.astype(jnp.float32) - ref.astype(jnp.float32))
+    valid = (jnp.arange(w)[None, :] < qlen[:, None])
     return float(jnp.max(jnp.where(valid[:, :, None, None], diff, 0.0)))
 
 

@@ -19,9 +19,19 @@ scales, and `lax.scan` slices both per layer.
 
 Scope: dicts holding a 2-D/3-D dense "kernel" or 4-D conv "kernel".
 Norm/bias/embedding params stay f32 (quality-sensitive, not
-bandwidth-relevant). Tensor-parallel sharding rules match on the "kernel"
-path name and therefore leave quantized trees replicated — use one or the
-other per deployment (documented in training.shard_params_tp).
+bandwidth-relevant). Tensor-parallel sharding rules target full-precision
+kernels and would leave quantized trees replicated — use one or the
+other per deployment; `training.shard_params_tp` now REFUSES quantized
+trees with a RuntimeError instead of silently replicating.
+
+`quantize_kv`/`dequantize_kv` extend the same exact-rescaling discipline
+to the KV axis: the paged block pool (runtime.kv_blocks, --kv-quantize
+int8) stores block payloads int8 with one f32 scale per (layer, block
+slot, kv-head) vector, quantized exactly once at block write, and
+ops.paged_attention applies the scales inside the attention read (score
+columns after QK^T, P columns before PV) — algebraically the same
+factor-out-the-scale argument as the weight path, so rounding error
+comes only from the one-time int8 write.
 """
 
 from __future__ import annotations
@@ -68,8 +78,43 @@ def dequantize_kernel(kernel_q, scale):
     return kernel_q.astype(jnp.float32) * jnp.expand_dims(scale, axes)
 
 
+def quantize_kv(x):
+    """KV-cache payload quantization: x (..., D) -> (int8 (..., D),
+    f32 scale (...)). Symmetric round-to-nearest onto [-127, 127] with
+    one scale per leading-index VECTOR (the head_dim axis reduces) — for
+    the paged block pool that is one scale per (layer, block slot,
+    kv-head), so a single-token decode append quantizes ONLY its own
+    vector and never perturbs (or is perturbed by) neighbours already in
+    the block. The write-once discipline (runtime.kv_blocks) depends on
+    this granularity: a per-block scale would force either clipping
+    later outliers or requantizing earlier tokens on every append."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.round(xf / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of `quantize_kv` (exact up to the requested output dtype:
+    int8 values and their f32 scales multiply exactly in f32)."""
+    return (q.astype(jnp.float32)
+            * jnp.asarray(scale, jnp.float32)[..., None]).astype(dtype)
+
+
 def is_quantized(params) -> bool:
     return isinstance(params, dict) and "kernel_q" in params
+
+
+def tree_is_quantized(params) -> bool:
+    """True when ANY subtree carries weight-quantized kernels — the
+    guard predicate for paths that silently mishandle int8 trees (TP
+    sharding: rules leave quantized kernels replicated)."""
+    if not isinstance(params, dict):
+        return False
+    if "kernel_q" in params or "wi_q" in params:
+        return True
+    return any(tree_is_quantized(v) for v in params.values())
 
 
 def quantize_params(params):

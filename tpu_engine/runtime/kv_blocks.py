@@ -40,6 +40,24 @@ vLLM-style layout, kept TPU-native:
   have refcount 1 (tree-only) are evicted until enough blocks free. A
   block referenced by any live row is structurally unevictable — its
   refcount is ≥ 2 while a tree node points at it.
+- **Quantized block payloads** (``quantize="int8"``): the pool tensors
+  store int8 instead of bf16, with one f32 scale per (layer, block
+  slot, kv-head) vector held in matching ``(L, num_blocks, bs, H_kv)``
+  arrays that live beside the free-list under the SAME pool lock,
+  refcount lifecycle, COW, radix sharing, and generation stamps.
+  Quantization happens exactly ONCE, at block write (admission scatter
+  / in-dispatch prefill-chunk and decode-append writes in
+  models.transformer); every later movement — COW ``ensure_writable``,
+  radix re-adoption, host-tier demotion and swap-in — copies int8 +
+  scale verbatim, so there is no cumulative requantization drift and a
+  demote/promote round trip stays bit-exact. The per-slot scale
+  granularity is what makes write-once possible: a single-token decode
+  append quantizes only its own vector (a per-block scale would force
+  clipping or requantizing neighbours). ``ops.paged_attention``'s
+  quantized read paths apply the scales inside the kernel (fused
+  dequant), so HBM traffic is int8 — about half the bf16 bytes per
+  block, which is the ~2x capacity multiplier (and the host tier's 2x
+  swap-bandwidth win) on the same memory budget.
 - **Hierarchical host tier** (``host_blocks`` > 0): instead of
   destroying a cold radix leaf, eviction DEMOTES its block to a pinned
   host-RAM buffer — the node stays in the tree, keyed and matchable,
@@ -72,6 +90,21 @@ import numpy as np
 
 from tpu_engine.models.transformer import TransformerConfig
 from tpu_engine.ops.attention import KVCache
+
+
+def dense_block_bytes(cfg: TransformerConfig, block_size: int, dtype) -> int:
+    """HBM bytes one K+V block costs at a full-precision `dtype` — the
+    single source of the pool-layout formula (BlockPool.stats() and the
+    bench's equal-byte-budget sizing must never disagree)."""
+    return int(2 * cfg.n_layers * block_size * cfg.kv_heads
+               * cfg.d_head * jnp.dtype(dtype).itemsize)
+
+
+def quant_block_bytes(cfg: TransformerConfig, block_size: int) -> int:
+    """Bytes of one quantized block: int8 K+V payload plus the f32 scale
+    per (layer, slot, kv-head) vector — `2·L·bs·H_kv·(D + 4)`."""
+    slot_heads = cfg.n_layers * block_size * cfg.kv_heads
+    return int(2 * slot_heads * (cfg.d_head + 4))
 
 
 class PoolExhausted(RuntimeError):
@@ -267,13 +300,21 @@ class BlockPool:
 
     def __init__(self, cfg: TransformerConfig, num_blocks: int,
                  block_size: int, dtype=jnp.bfloat16, device=None,
-                 host_blocks: int = 0):
+                 host_blocks: int = 0, quantize: str = ""):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        if quantize not in ("", "int8"):
+            raise ValueError(f"unsupported KV quantize mode {quantize!r} "
+                             "(only 'int8')")
         self.cfg = cfg
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        self._dtype = dtype
+        # `io_dtype` is the pool's COMPUTE dtype — what gathers dequantize
+        # to and what an unquantized pool stores; `_dtype` is the actual
+        # payload storage dtype (int8 under quantization).
+        self.quantized = quantize == "int8"
+        self.io_dtype = dtype
+        self._dtype = jnp.int8 if self.quantized else dtype
         self._device = device
         # One lock for bookkeeping AND pool-touching dispatch ordering
         # (module docstring). RLock: eviction runs inside alloc.
@@ -282,6 +323,12 @@ class BlockPool:
         # void (the refcount table was rebuilt wholesale) — holders must
         # compare generations instead of releasing stale ids.
         self.generation = 0
+        # Quantized mode: per-(layer, block slot, kv-head) f32 scales in a
+        # KVCache pair of (L, NB, bs, H_kv) arrays. They live beside the
+        # free-list under the pool lock, move verbatim with their blocks
+        # (COW / demote / promote), and are donated through every
+        # pool-writing dispatch exactly like the payload tensors.
+        self.scales: Optional[KVCache] = None
         self.caches = self._init_device()
         self._ref = np.zeros((self.num_blocks,), np.int32)
         self._ref[0] = 1  # null block: permanently pinned, never allocated
@@ -296,12 +343,21 @@ class BlockPool:
         self._host_k = self._host_v = None
         self._host_free: List[int] = []
         self._promoting: Optional[_RadixNode] = None
+        self._host_ks = self._host_vs = None
         if self.host_blocks > 0:
             hshape = (self.host_blocks, cfg.n_layers, self.block_size,
                       cfg.kv_heads, cfg.d_head)
-            hdtype = jnp.zeros((), dtype).dtype  # numpy-compatible dtype
+            hdtype = jnp.zeros((), self._dtype).dtype  # numpy-compat dtype
             self._host_k = np.zeros(hshape, hdtype)
             self._host_v = np.zeros(hshape, hdtype)
+            if self.quantized:
+                # Scale slots pair 1:1 with host payload slots — a
+                # demoted block's int8 bytes and its scale vectors travel
+                # (and free) together, so the round trip is bit-exact.
+                sshape = (self.host_blocks, cfg.n_layers, self.block_size,
+                          cfg.kv_heads)
+                self._host_ks = np.zeros(sshape, np.float32)
+                self._host_vs = np.zeros(sshape, np.float32)
             self._host_free = list(range(self.host_blocks - 1, -1, -1))
         # Counters for /stats, /metrics, and the paged/affinity benches.
         self.prefix_hit_tokens = 0
@@ -324,6 +380,14 @@ class BlockPool:
                          jnp.zeros(shape, self._dtype))
         if self._device is not None:
             caches = jax.device_put(caches, self._device)
+        if self.quantized:
+            # Scale 1.0 everywhere: unwritten (and null-block) slots
+            # dequantize to exact zeros, like a fresh bf16 pool.
+            scales = KVCache(jnp.ones(shape[:-1], jnp.float32),
+                             jnp.ones(shape[:-1], jnp.float32))
+            if self._device is not None:
+                scales = jax.device_put(scales, self._device)
+            self.scales = scales
         return caches
 
     # -- bookkeeping (hold self.lock) -----------------------------------------
@@ -398,7 +462,7 @@ class BlockPool:
         if self._ref[block_id] <= 1:
             return block_id, False
         if self._copy_exe is None:
-            def copy_block(caches, src, dst):
+            def copy_pair(caches, src, dst):
                 k = jax.lax.dynamic_slice_in_dim(caches.k, src, 1, axis=1)
                 v = jax.lax.dynamic_slice_in_dim(caches.v, src, 1, axis=1)
                 return KVCache(
@@ -407,10 +471,24 @@ class BlockPool:
                     jax.lax.dynamic_update_slice_in_dim(caches.v, v, dst,
                                                         axis=1))
 
-            self._copy_exe = jax.jit(copy_block, donate_argnums=(0,))
+            if self.quantized:
+                # COW moves int8 payload AND scales verbatim — the copy
+                # is a bit-exact clone, never a requantization.
+                def copy_block(caches, scales, src, dst):
+                    return (copy_pair(caches, src, dst),
+                            copy_pair(scales, src, dst))
+
+                self._copy_exe = jax.jit(copy_block, donate_argnums=(0, 1))
+            else:
+                self._copy_exe = jax.jit(copy_pair, donate_argnums=(0,))
         new_id = self.alloc(1)[0]
-        self.caches = self._copy_exe(self.caches,
-                                     jnp.int32(block_id), jnp.int32(new_id))
+        if self.quantized:
+            self.caches, self.scales = self._copy_exe(
+                self.caches, self.scales,
+                jnp.int32(block_id), jnp.int32(new_id))
+        else:
+            self.caches = self._copy_exe(self.caches, jnp.int32(block_id),
+                                         jnp.int32(new_id))
         self.release(block_id)
         self.cow_copies += 1
         return new_id, True
@@ -443,6 +521,13 @@ class BlockPool:
         bid = leaf.block_id
         self._host_k[slot] = np.asarray(jax.device_get(self.caches.k[:, bid]))
         self._host_v[slot] = np.asarray(jax.device_get(self.caches.v[:, bid]))
+        if self.quantized:
+            # int8 payload + f32 scales move verbatim: the demoted copy
+            # is bit-identical, never requantized.
+            self._host_ks[slot] = np.asarray(
+                jax.device_get(self.scales.k[:, bid]))
+            self._host_vs[slot] = np.asarray(
+                jax.device_get(self.scales.v[:, bid]))
         self.release(bid)
         leaf.block_id = -1
         leaf.host_slot = slot
@@ -478,23 +563,39 @@ class BlockPool:
         if len(self._free) < need:
             return False
         if self._promote_exe is None:
-            def promote_block(caches, hk, hv, dst):
+            def write_pair(caches, hk, hv, dst):
                 return KVCache(
                     jax.lax.dynamic_update_slice_in_dim(
                         caches.k, hk[None].swapaxes(0, 1), dst, axis=1),
                     jax.lax.dynamic_update_slice_in_dim(
                         caches.v, hv[None].swapaxes(0, 1), dst, axis=1))
 
-            self._promote_exe = jax.jit(promote_block, donate_argnums=(0,))
+            if self.quantized:
+                # Swap-in writes int8 payload AND scales verbatim — the
+                # promoted block is bit-identical to what was demoted.
+                def promote_block(caches, scales, hk, hv, hks, hvs, dst):
+                    return (write_pair(caches, hk, hv, dst),
+                            write_pair(scales, hks, hvs, dst))
+
+                self._promote_exe = jax.jit(promote_block,
+                                            donate_argnums=(0, 1))
+            else:
+                self._promote_exe = jax.jit(write_pair, donate_argnums=(0,))
         bid = self._free.pop()
         self._ref[bid] = 1  # the tree's own reference
-        hk = jnp.asarray(self._host_k[node.host_slot])
-        hv = jnp.asarray(self._host_v[node.host_slot])
+        host = [self._host_k[node.host_slot], self._host_v[node.host_slot]]
+        if self.quantized:
+            host += [self._host_ks[node.host_slot],
+                     self._host_vs[node.host_slot]]
+        host = [jnp.asarray(h) for h in host]
         if self._device is not None:
-            hk = jax.device_put(hk, self._device)
-            hv = jax.device_put(hv, self._device)
-        self.caches = self._promote_exe(self.caches, hk, hv,
-                                        jnp.int32(bid))
+            host = [jax.device_put(h, self._device) for h in host]
+        if self.quantized:
+            self.caches, self.scales = self._promote_exe(
+                self.caches, self.scales, *host, jnp.int32(bid))
+        else:
+            self.caches = self._promote_exe(self.caches, *host,
+                                            jnp.int32(bid))
         self._host_free.append(node.host_slot)
         node.host_slot = -1
         node.block_id = bid
@@ -518,6 +619,29 @@ class BlockPool:
         if self.host_blocks > 0:
             self._host_free = list(range(self.host_blocks - 1, -1, -1))
 
+    def bytes_per_block(self) -> int:
+        """HBM bytes ONE block costs in this pool's layout: K+V payload
+        at the storage dtype, plus (quantized) the per-slot f32 scales."""
+        if self.quantized:
+            return quant_block_bytes(self.cfg, self.block_size)
+        return dense_block_bytes(self.cfg, self.block_size, self._dtype)
+
+    def dense_bytes_per_block(self) -> int:
+        """What the SAME block would cost unquantized (at io_dtype) — the
+        equal-byte-budget baseline the quant-ab bench sizes pools by."""
+        return dense_block_bytes(self.cfg, self.block_size, self.io_dtype)
+
+    def _demoted_nodes(self) -> int:
+        """Radix nodes currently holding a host slot (caller holds the
+        lock) — the pairing side of the host scale-slot leak check."""
+        n, stack = 0, [self.radix.root]
+        while stack:
+            node = stack.pop()
+            for c in node.children.values():
+                stack.append(c)
+                n += int(c.demoted)
+        return n
+
     def stats(self) -> dict:
         with self.lock:
             shared = int(np.sum(self._ref[1:] > 1))
@@ -539,10 +663,20 @@ class BlockPool:
                 "radix_lookups": self.radix_lookups,
                 "radix_hits": self.radix_hits,
             }
+            if self.quantized:
+                # Additive, present ONLY in quantized pools (defaults-off
+                # /stats and /health bytes stay byte-identical).
+                bpb = self.bytes_per_block()
+                dense = self.dense_bytes_per_block()
+                out["quantized"] = "int8"
+                out["bytes_per_block"] = bpb
+                out["dense_bytes_per_block"] = dense
+                out["capacity_multiplier"] = round(dense / bpb, 3)
             if self.host_blocks > 0:
+                used = self.host_blocks - len(self._host_free)
                 out["host"] = {
                     "blocks_total": self.host_blocks,
-                    "blocks_used": self.host_blocks - len(self._host_free),
+                    "blocks_used": used,
                     "demotions": self.demotions,
                     "swap_ins": self.swap_ins,
                     "swap_in_events": self.swap_in_events,
@@ -550,6 +684,14 @@ class BlockPool:
                     "host_evictions": self.host_evictions,
                     "swapped_in_tokens": self.swapped_in_tokens,
                 }
+                if self.quantized:
+                    # Scale slots pair 1:1 with payload slots: a slot
+                    # counted used with no demoted node referencing it
+                    # (or vice versa) is a leak — fault_injection --quant
+                    # asserts this stays 0 across kill -9 survivors.
+                    out["host"]["scale_slots_used"] = used
+                    out["host"]["scale_slots_leaked"] = (
+                        used - self._demoted_nodes())
             return out
 
 
@@ -577,3 +719,36 @@ def scatter_blocks(caches, row_k, row_v, ids):
     rk = row_k.reshape(L, nb, bs, h, d).astype(caches.k.dtype)
     rv = row_v.reshape(L, nb, bs, h, d).astype(caches.v.dtype)
     return KVCache(caches.k.at[:, ids].set(rk), caches.v.at[:, ids].set(rv))
+
+
+def gather_blocks_quant(pool_k, pool_v, k_scale, v_scale, ids, *, dtype):
+    """`gather_blocks` for the int8 pool: dequantize the gathered blocks
+    (payload * per-slot scale) into a `dtype` row-cache view the prefill
+    windows can consume. The pool bytes themselves are untouched — only
+    this row's dense view is full-precision."""
+    from tpu_engine.ops.quant import dequantize_kv
+
+    L, _, bs, h, d = pool_k.shape
+    nb = ids.shape[0]
+    k = dequantize_kv(pool_k[:, ids], k_scale[:, ids], dtype)
+    v = dequantize_kv(pool_v[:, ids], v_scale[:, ids], dtype)
+    return KVCache(k.reshape(L, 1, nb * bs, h, d),
+                   v.reshape(L, 1, nb * bs, h, d))
+
+
+def scatter_blocks_quant(caches, scales, row_k, row_v, ids):
+    """`scatter_blocks` for the int8 pool: quantize the prefilled row
+    cache ONCE — one symmetric int8 vector + f32 scale per (layer, slot,
+    kv-head) — and write payload and scales together. This is the single
+    place a two-path admission's prompt KV is ever quantized; every later
+    movement copies these bytes verbatim. Donate `caches` AND `scales`."""
+    from tpu_engine.ops.quant import quantize_kv
+
+    L, nb = caches.k.shape[0], ids.shape[0]
+    bs, h, d = caches.k.shape[2], caches.k.shape[3], caches.k.shape[4]
+    qk, sk = quantize_kv(row_k.reshape(L, nb, bs, h, d))
+    qv, sv = quantize_kv(row_v.reshape(L, nb, bs, h, d))
+    return (KVCache(caches.k.at[:, ids].set(qk),
+                    caches.v.at[:, ids].set(qv)),
+            KVCache(scales.k.at[:, ids].set(sk),
+                    scales.v.at[:, ids].set(sv)))

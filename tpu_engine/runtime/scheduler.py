@@ -41,6 +41,7 @@ Generator.generate.
 from __future__ import annotations
 
 import collections
+import functools
 import queue
 import threading
 import time
@@ -74,7 +75,9 @@ from tpu_engine.runtime.kv_blocks import (
     BlockPool,
     PoolExhausted,
     gather_blocks,
+    gather_blocks_quant,
     scatter_blocks,
+    scatter_blocks_quant,
 )
 from tpu_engine.utils.deadline import Deadline, DeadlineExceeded
 from tpu_engine.utils.metrics import LatencyHistogram
@@ -192,6 +195,7 @@ class ContinuousGenerator:
         kv_block_size: int = 0,
         kv_blocks: int = 0,
         kv_host_blocks: int = 0,
+        kv_quantize: str = "",
         prefix_sharing: bool = True,
         mixed_step: bool = False,
         mixed_token_budget: int = 0,
@@ -209,6 +213,20 @@ class ContinuousGenerator:
         resumes prefill mid-prompt. 0 (default) keeps the dense cache:
         behavior, compiled executables, and streams are exactly the
         pre-paging scheduler's.
+
+        `kv_quantize` "int8" (paged mode only) stores block payloads
+        int8 with per-(layer, block slot, kv-head) f32 scales — about
+        half the KV bytes per block, so the same HBM holds ~2x the
+        blocks (runtime.kv_blocks "Quantized block payloads"). Tokens
+        quantize exactly once, at their block write (admission scatter,
+        in-dispatch prefill chunks, decode appends); COW, radix
+        re-adoption, and host-tier demotion/swap-in copy int8 + scale
+        verbatim; both attention read paths (ops.paged_attention quant
+        variants) apply the scales inside the read, so rounding error
+        comes only from the one-time write. Quantized greedy streams
+        are deterministic run-to-run but NOT byte-identical to the bf16
+        pool (MIGRATION.md); "" (default) keeps today's full-precision
+        pool byte-identical.
 
         `kv_host_blocks` > 0 (paged mode with prefix sharing) adds the
         HIERARCHICAL HOST TIER under the device pool: LRU eviction
@@ -291,6 +309,10 @@ class ContinuousGenerator:
         if int(kv_host_blocks) > 0 and not self._paged:
             raise ValueError("kv_host_blocks requires the paged KV cache "
                              "(set kv_block_size > 0)")
+        self._quant = bool(kv_quantize)
+        if self._quant and not self._paged:
+            raise ValueError("kv_quantize requires the paged KV cache "
+                             "(set kv_block_size > 0)")
         self._caches = None
         self._pool: Optional[BlockPool] = None
         if self._paged:
@@ -314,7 +336,8 @@ class ContinuousGenerator:
                 raise ValueError("kv_host_blocks requires prefix_sharing "
                                  "(the host tier holds radix entries)")
             self._pool = BlockPool(self.cfg, nb, bs, self._dtype, device,
-                                   host_blocks=int(kv_host_blocks))
+                                   host_blocks=int(kv_host_blocks),
+                                   quantize=str(kv_quantize))
             self._tables = np.zeros((self.n_slots, width), np.int32)
             self._row_blocks: List[List[int]] = [[] for _ in
                                                  range(self.n_slots)]
@@ -655,24 +678,36 @@ class ContinuousGenerator:
         """Prefix gather for one bucket width: (pool, nb block ids) ->
         the row's (L, 1, nb*bs, H, D) cache view. Read-only on the pool
         — dispatched by the prefill thread under the pool lock so it
-        orders before the decode thread's donating chunk."""
+        orders before the decode thread's donating chunk. Quantized
+        pools dequantize the gathered view (int8 * scale) into the
+        compute dtype; the pool bytes themselves are untouched."""
         exe = self._gather_exe.get(nb)
         if exe is None:
             with self._exe_lock:
-                exe = self._gather_exe.setdefault(
-                    nb, jax.jit(gather_blocks))
+                if self._quant:
+                    fn = functools.partial(gather_blocks_quant,
+                                           dtype=self._dtype)
+                else:
+                    fn = gather_blocks
+                exe = self._gather_exe.setdefault(nb, jax.jit(fn))
         return exe
 
     def _scatter(self, nb: int):
         """Admission scatter for one bucket width: write a prefilled row
         cache into its allocated pool blocks (null-block entries absorb
         radix-matched positions). Donates the pool — decode-thread only,
-        under the pool lock."""
+        under the pool lock. Quantized pools quantize HERE, exactly once
+        per written slot, and donate the scale arrays alongside."""
         exe = self._scatter_exe.get(nb)
         if exe is None:
             with self._exe_lock:
-                exe = self._scatter_exe.setdefault(
-                    nb, jax.jit(scatter_blocks, donate_argnums=(0,)))
+                if self._quant:
+                    exe = self._scatter_exe.setdefault(
+                        nb, jax.jit(scatter_blocks_quant,
+                                    donate_argnums=(0, 1)))
+                else:
+                    exe = self._scatter_exe.setdefault(
+                        nb, jax.jit(scatter_blocks, donate_argnums=(0,)))
         return exe
 
     def _decode_paged(self, controls: bool):
@@ -688,27 +723,40 @@ class ContinuousGenerator:
             if ("paged", controls) not in self._decode_exe:
                 from tpu_engine.ops.paged_attention import (
                     default_paged_attention,
+                    default_quant_paged_attention,
                 )
 
                 cfg, dtype, chunk = self.cfg, self._dtype, self._step_chunk
-                attn_fn = default_paged_attention()
+                quant = self._quant
+                attn_fn = (default_quant_paged_attention() if quant
+                           else default_paged_attention())
                 max_col = self.max_seq - 1
 
-                def decode_chunk(params, caches, tables, tok, pos, done,
-                                 seeds, temps, topps, topks, minps,
-                                 eos_vec, counts=None, pens=None,
-                                 stops=None):
+                def chunk_scan(params, caches, scales, tables, tok, pos,
+                               done, seeds, temps, topps, topks, minps,
+                               eos_vec, counts, pens, stops):
                     rows = jnp.arange(tok.shape[0])
 
                     def body(carry, _):
-                        if controls:
+                        scales = counts = None
+                        if quant and controls:
+                            caches, scales, tok, pos, done, counts = carry
+                        elif quant:
+                            caches, scales, tok, pos, done = carry
+                        elif controls:
                             caches, tok, pos, done, counts = carry
                         else:
                             caches, tok, pos, done = carry
-                            counts = None
-                        logits, caches = transformer_decode_rows_paged(
-                            params, tok, caches, tables, pos, cfg,
-                            dtype=dtype, attn_fn=attn_fn)
+                        if quant:
+                            logits, caches, scales = \
+                                transformer_decode_rows_paged(
+                                    params, tok, caches, tables, pos, cfg,
+                                    dtype=dtype, attn_fn=attn_fn,
+                                    scales=scales)
+                        else:
+                            logits, caches = transformer_decode_rows_paged(
+                                params, tok, caches, tables, pos, cfg,
+                                dtype=dtype, attn_fn=attn_fn)
                         if controls:
                             logits = apply_repetition_penalty(
                                 logits, counts, pens)
@@ -724,23 +772,62 @@ class ContinuousGenerator:
                                                   axis=1)
                         pos = jnp.where(done, pos,
                                         jnp.minimum(pos + 1, max_col))
+                        state = (caches,) + ((scales,) if quant else ())
+                        state += (nxt, pos, done)
                         if controls:
-                            return (caches, nxt, pos, done, counts), nxt
-                        return (caches, nxt, pos, done), nxt
+                            state += (counts,)
+                        return state, nxt
 
+                    state = (caches,) + ((scales,) if quant else ())
+                    state += (tok, pos, done)
                     if controls:
-                        (caches, tok, pos, done, counts), toks = \
-                            jax.lax.scan(body,
-                                         (caches, tok, pos, done, counts),
-                                         None, length=chunk)
-                        return caches, tok, pos, done, counts, toks.T
-                    (caches, tok, pos, done), toks = jax.lax.scan(
-                        body, (caches, tok, pos, done), None, length=chunk)
-                    return caches, tok, pos, done, toks.T
+                        state += (counts,)
+                    state, toks = jax.lax.scan(body, state, None,
+                                               length=chunk)
+                    return state + (toks.T,)
 
+                # Donation-friendly positional signatures: the quantized
+                # variant threads (and donates) the scale arrays right
+                # after the payload pool; counts donates when controls.
+                if quant and controls:
+                    def decode_chunk(params, caches, scales, tables, tok,
+                                     pos, done, seeds, temps, topps,
+                                     topks, minps, eos_vec, counts, pens,
+                                     stops):
+                        return chunk_scan(params, caches, scales, tables,
+                                          tok, pos, done, seeds, temps,
+                                          topps, topks, minps, eos_vec,
+                                          counts, pens, stops)
+                    donate = (1, 2, 13)
+                elif quant:
+                    def decode_chunk(params, caches, scales, tables, tok,
+                                     pos, done, seeds, temps, topps,
+                                     topks, minps, eos_vec):
+                        return chunk_scan(params, caches, scales, tables,
+                                          tok, pos, done, seeds, temps,
+                                          topps, topks, minps, eos_vec,
+                                          None, None, None)
+                    donate = (1, 2)
+                elif controls:
+                    def decode_chunk(params, caches, tables, tok, pos,
+                                     done, seeds, temps, topps, topks,
+                                     minps, eos_vec, counts, pens, stops):
+                        return chunk_scan(params, caches, None, tables,
+                                          tok, pos, done, seeds, temps,
+                                          topps, topks, minps, eos_vec,
+                                          counts, pens, stops)
+                    donate = (1, 12)
+                else:
+                    def decode_chunk(params, caches, tables, tok, pos,
+                                     done, seeds, temps, topps, topks,
+                                     minps, eos_vec):
+                        return chunk_scan(params, caches, None, tables,
+                                          tok, pos, done, seeds, temps,
+                                          topps, topks, minps, eos_vec,
+                                          None, None, None)
+                    donate = (1,)
                 self._decode_exe[("paged", controls)] = jax.jit(
-                    decode_chunk,
-                    donate_argnums=(1, 12) if controls else (1,))
+                    decode_chunk, donate_argnums=donate)
             return self._decode_exe[("paged", controls)]
 
     def _mixed_step_exe(self, width: int, controls: bool):
@@ -761,22 +848,32 @@ class ContinuousGenerator:
         with self._exe_lock:
             if key not in self._decode_exe:
                 from tpu_engine.ops.paged_attention import (
+                    default_quant_ragged_attention,
                     default_ragged_attention,
                 )
 
                 cfg, dtype = self.cfg, self._dtype
-                attn_fn = default_ragged_attention()
+                quant = self._quant
+                attn_fn = (default_quant_ragged_attention() if quant
+                           else default_ragged_attention())
 
-                def mixed_step(params, caches, tables, tokens, pos0, qlen,
-                               sample_slot, fold_pos, active, done,
-                               seeds, temps, topps, topks, minps, eos_vec,
-                               counts=None, pens=None, stops=None):
+                def step_core(params, caches, scales, tables, tokens,
+                              pos0, qlen, sample_slot, fold_pos, active,
+                              done, seeds, temps, topps, topks, minps,
+                              eos_vec, counts, pens, stops):
                     # sample_slot gathers the hidden state BEFORE the LM
                     # head: one (B, vocab) projection per tick, not W.
-                    logits, caches = transformer_step_rows_ragged(
-                        params, tokens, caches, tables, pos0, qlen, cfg,
-                        dtype=dtype, attn_fn=attn_fn,
-                        sample_slot=sample_slot)
+                    if quant:
+                        logits, caches, scales = \
+                            transformer_step_rows_ragged(
+                                params, tokens, caches, tables, pos0,
+                                qlen, cfg, dtype=dtype, attn_fn=attn_fn,
+                                sample_slot=sample_slot, scales=scales)
+                    else:
+                        logits, caches = transformer_step_rows_ragged(
+                            params, tokens, caches, tables, pos0, qlen,
+                            cfg, dtype=dtype, attn_fn=attn_fn,
+                            sample_slot=sample_slot)
                     rows = jnp.arange(tokens.shape[0])
                     if controls:
                         logits = apply_repetition_penalty(logits, counts,
@@ -792,13 +889,38 @@ class ContinuousGenerator:
                     if controls:
                         done = done | (live & jnp.any(
                             nxt[:, None] == stops, axis=1))
+                    out = (caches,) + ((scales,) if quant else ())
+                    out += (nxt, done)
                     if controls:
-                        return caches, nxt, done, counts
-                    return caches, nxt, done
+                        out += (counts,)
+                    return out
 
-                self._decode_exe[key] = jax.jit(
-                    mixed_step,
-                    donate_argnums=(1, 16) if controls else (1,))
+                if quant:
+                    def mixed_step(params, caches, scales, tables, tokens,
+                                   pos0, qlen, sample_slot, fold_pos,
+                                   active, done, seeds, temps, topps,
+                                   topks, minps, eos_vec, counts=None,
+                                   pens=None, stops=None):
+                        return step_core(params, caches, scales, tables,
+                                         tokens, pos0, qlen, sample_slot,
+                                         fold_pos, active, done, seeds,
+                                         temps, topps, topks, minps,
+                                         eos_vec, counts, pens, stops)
+                    donate = (1, 2, 17) if controls else (1, 2)
+                else:
+                    def mixed_step(params, caches, tables, tokens, pos0,
+                                   qlen, sample_slot, fold_pos, active,
+                                   done, seeds, temps, topps, topks,
+                                   minps, eos_vec, counts=None, pens=None,
+                                   stops=None):
+                        return step_core(params, caches, None, tables,
+                                         tokens, pos0, qlen, sample_slot,
+                                         fold_pos, active, done, seeds,
+                                         temps, topps, topks, minps,
+                                         eos_vec, counts, pens, stops)
+                    donate = (1, 16) if controls else (1,)
+                self._decode_exe[key] = jax.jit(mixed_step,
+                                                donate_argnums=donate)
             return self._decode_exe[key]
 
     def _spec_step_exe(self, width: int, controls: bool,
@@ -840,6 +962,7 @@ class ContinuousGenerator:
         with self._exe_lock:
             if key not in self._decode_exe:
                 from tpu_engine.ops.paged_attention import (
+                    default_quant_ragged_attention,
                     default_ragged_attention,
                 )
                 from tpu_engine.runtime.speculative import (
@@ -850,17 +973,27 @@ class ContinuousGenerator:
                 )
 
                 cfg, dtype = self.cfg, self._dtype
-                attn_fn = default_ragged_attention()
+                quant = self._quant
+                attn_fn = (default_quant_ragged_attention() if quant
+                           else default_ragged_attention())
                 S = self._spec_k + 1
 
-                def spec_step(params, caches, tables, tokens, pos0, qlen,
-                              sample_slot, fold0, n_draft, stoch, active,
-                              done, seeds, temps, topps, topks, minps,
-                              eos_vec, counts=None, pens=None, stops=None):
-                    logits, caches = transformer_step_rows_ragged(
-                        params, tokens, caches, tables, pos0, qlen, cfg,
-                        dtype=dtype, attn_fn=attn_fn,
-                        sample_slot=sample_slot, sample_width=S)
+                def spec_core(params, caches, scales, tables, tokens,
+                              pos0, qlen, sample_slot, fold0, n_draft,
+                              stoch, active, done, seeds, temps, topps,
+                              topks, minps, eos_vec, counts, pens, stops):
+                    if quant:
+                        logits, caches, scales = \
+                            transformer_step_rows_ragged(
+                                params, tokens, caches, tables, pos0,
+                                qlen, cfg, dtype=dtype, attn_fn=attn_fn,
+                                sample_slot=sample_slot, sample_width=S,
+                                scales=scales)
+                    else:
+                        logits, caches = transformer_step_rows_ragged(
+                            params, tokens, caches, tables, pos0, qlen,
+                            cfg, dtype=dtype, attn_fn=attn_fn,
+                            sample_slot=sample_slot, sample_width=S)
                     b, w = tokens.shape
                     rows = jnp.arange(b)
                     run_counts = counts
@@ -928,14 +1061,40 @@ class ContinuousGenerator:
                         new_done = new_done | stop_j
                         alive = alive & ~stop_j & chain
                     out = jnp.stack(emitted, axis=1)          # (B, S)
+                    res = (caches,) + ((scales,) if quant else ())
+                    res += (out, n_emit, n_acc, new_done)
                     if controls:
-                        return (caches, out, n_emit, n_acc, new_done,
-                                run_counts)
-                    return caches, out, n_emit, n_acc, new_done
+                        res += (run_counts,)
+                    return res
 
-                self._decode_exe[key] = jax.jit(
-                    spec_step,
-                    donate_argnums=(1, 18) if controls else (1,))
+                if quant:
+                    def spec_step(params, caches, scales, tables, tokens,
+                                  pos0, qlen, sample_slot, fold0, n_draft,
+                                  stoch, active, done, seeds, temps,
+                                  topps, topks, minps, eos_vec,
+                                  counts=None, pens=None, stops=None):
+                        return spec_core(params, caches, scales, tables,
+                                         tokens, pos0, qlen, sample_slot,
+                                         fold0, n_draft, stoch, active,
+                                         done, seeds, temps, topps, topks,
+                                         minps, eos_vec, counts, pens,
+                                         stops)
+                    donate = (1, 2, 19) if controls else (1, 2)
+                else:
+                    def spec_step(params, caches, tables, tokens, pos0,
+                                  qlen, sample_slot, fold0, n_draft,
+                                  stoch, active, done, seeds, temps,
+                                  topps, topks, minps, eos_vec,
+                                  counts=None, pens=None, stops=None):
+                        return spec_core(params, caches, None, tables,
+                                         tokens, pos0, qlen, sample_slot,
+                                         fold0, n_draft, stoch, active,
+                                         done, seeds, temps, topps, topks,
+                                         minps, eos_vec, counts, pens,
+                                         stops)
+                    donate = (1, 18) if controls else (1,)
+                self._decode_exe[key] = jax.jit(spec_step,
+                                                donate_argnums=donate)
             return self._decode_exe[key]
 
     @staticmethod
@@ -1312,8 +1471,17 @@ class ContinuousGenerator:
                 ids = np.zeros((pb // bs,), np.int32)
                 ids[:len(matched)] = matched
                 with pool.lock:  # dispatch-order fence vs pool donation
-                    row_caches = self._gather(pb // bs)(
-                        pool.caches.k, pool.caches.v, jnp.asarray(ids))
+                    if self._quant:
+                        # Dequantized view of the shared prefix for the
+                        # resumed prefill windows; the pool bytes stay
+                        # int8 — no requantization ever happens.
+                        row_caches = self._gather(pb // bs)(
+                            pool.caches.k, pool.caches.v,
+                            pool.scales.k, pool.scales.v,
+                            jnp.asarray(ids))
+                    else:
+                        row_caches = self._gather(pb // bs)(
+                            pool.caches.k, pool.caches.v, jnp.asarray(ids))
                 self._count_admission_dispatch()
             else:
                 row_caches = init_caches(self.cfg, 1, pb, self._dtype)
@@ -1517,8 +1685,17 @@ class ContinuousGenerator:
                 raise
             if copied:
                 table[first_col // bs] = wid
-            pool.caches = self._scatter(nb_bucket)(
-                pool.caches, row_caches.k, row_caches.v, jnp.asarray(ids))
+            if self._quant:
+                # The ONE place this row's prompt KV quantizes (fresh
+                # blocks only — matched slots scatter into the null
+                # block, so shared int8 bytes are never rewritten).
+                pool.caches, pool.scales = self._scatter(nb_bucket)(
+                    pool.caches, pool.scales, row_caches.k, row_caches.v,
+                    jnp.asarray(ids))
+            else:
+                pool.caches = self._scatter(nb_bucket)(
+                    pool.caches, row_caches.k, row_caches.v,
+                    jnp.asarray(ids))
             if self._prefix_sharing:
                 pool.radix.insert(prompt, table)
         self._count_admission_dispatch()
@@ -2019,7 +2196,10 @@ class ContinuousGenerator:
 
         # ONE dispatch, under the pool lock (it donates the pool buffers).
         with pool.lock:
-            common = (self.params, pool.caches, jnp.asarray(self._tables),
+            pool_args = (pool.caches,)
+            if self._quant:
+                pool_args += (pool.scales,)
+            common = (self.params, *pool_args, jnp.asarray(self._tables),
                       jnp.asarray(tokens), jnp.asarray(pos0),
                       jnp.asarray(qlen), jnp.asarray(sample_slot),
                       jnp.asarray(fold_pos), jnp.asarray(active),
@@ -2028,13 +2208,21 @@ class ContinuousGenerator:
                       jnp.asarray(self._topks), jnp.asarray(self._minps),
                       jnp.asarray(eos_vec))
             if controls:
-                pool.caches, nxt, done, self._counts = self._mixed_step_exe(
-                    width, True)(*common, self._ensure_counts(),
-                                 jnp.asarray(self._pens),
-                                 jnp.asarray(self._stops))
+                out = self._mixed_step_exe(width, True)(
+                    *common, self._ensure_counts(),
+                    jnp.asarray(self._pens), jnp.asarray(self._stops))
             else:
-                pool.caches, nxt, done = self._mixed_step_exe(
-                    width, False)(*common)
+                out = self._mixed_step_exe(width, False)(*common)
+            pool.caches = out[0]
+            if self._quant:
+                pool.scales = out[1]
+                out = out[2:]
+            else:
+                out = out[1:]
+            if controls:
+                nxt, done, self._counts = out
+            else:
+                nxt, done = out
         start_host_copies(nxt, done)
         nxt = np.array(nxt)
         done_new = np.array(done)
@@ -2214,7 +2402,10 @@ class ContinuousGenerator:
 
         # ONE dispatch, under the pool lock (it donates the pool buffers).
         with pool.lock:
-            common = (self.params, pool.caches, jnp.asarray(self._tables),
+            pool_args = (pool.caches,)
+            if self._quant:
+                pool_args += (pool.scales,)
+            common = (self.params, *pool_args, jnp.asarray(self._tables),
                       jnp.asarray(tokens), jnp.asarray(pos0),
                       jnp.asarray(qlen), jnp.asarray(sample_slot),
                       jnp.asarray(fold0), jnp.asarray(n_draft),
@@ -2224,15 +2415,21 @@ class ContinuousGenerator:
                       jnp.asarray(self._topks), jnp.asarray(self._minps),
                       jnp.asarray(eos_vec))
             if controls:
-                (pool.caches, emitted, n_emit, n_acc, done,
-                 self._counts) = self._spec_step_exe(
-                    width, True, stochastic)(
+                out = self._spec_step_exe(width, True, stochastic)(
                     *common, self._ensure_counts(),
                     jnp.asarray(self._pens), jnp.asarray(self._stops))
             else:
-                (pool.caches, emitted, n_emit, n_acc,
-                 done) = self._spec_step_exe(
-                    width, False, stochastic)(*common)
+                out = self._spec_step_exe(width, False, stochastic)(*common)
+            pool.caches = out[0]
+            if self._quant:
+                pool.scales = out[1]
+                out = out[2:]
+            else:
+                out = out[1:]
+            if controls:
+                emitted, n_emit, n_acc, done, self._counts = out
+            else:
+                emitted, n_emit, n_acc, done = out
         start_host_copies(emitted, n_emit, n_acc, done)
         emitted_h = np.array(emitted)
         n_emit_h = np.array(n_emit)
@@ -2448,7 +2645,10 @@ class ContinuousGenerator:
                     # Pool-donating dispatch under the pool lock so the
                     # prefill thread's prefix gathers order before it.
                     with self._pool.lock:
-                        common = (self.params, self._pool.caches,
+                        pool_args = (self._pool.caches,)
+                        if self._quant:
+                            pool_args += (self._pool.scales,)
+                        common = (self.params, *pool_args,
                                   jnp.asarray(self._tables),
                                   jnp.asarray(self._tok),
                                   jnp.asarray(self._pos),
@@ -2460,14 +2660,22 @@ class ContinuousGenerator:
                                   jnp.asarray(self._minps),
                                   jnp.asarray(eos_vec))
                         if controls:
-                            (self._pool.caches, tok, pos, done,
-                             self._counts, toks) = self._decode_paged(True)(
+                            out = self._decode_paged(True)(
                                 *common, self._ensure_counts(),
                                 jnp.asarray(self._pens),
                                 jnp.asarray(self._stops))
                         else:
-                            (self._pool.caches, tok, pos, done,
-                             toks) = self._decode_paged(False)(*common)
+                            out = self._decode_paged(False)(*common)
+                        self._pool.caches = out[0]
+                        if self._quant:
+                            self._pool.scales = out[1]
+                            out = out[2:]
+                        else:
+                            out = out[1:]
+                        if controls:
+                            tok, pos, done, self._counts, toks = out
+                        else:
+                            tok, pos, done, toks = out
                 elif controls:
                     (self._caches, tok, pos, done, self._counts,
                      toks) = self._decode(True)(

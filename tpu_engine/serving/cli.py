@@ -81,7 +81,8 @@ def main(argv=None) -> int:
         if not rest:
             print("Usage: worker_node <port> <node_id> [model_path] "
                   "[--kv-block-size N] [--kv-blocks N] "
-                  "[--kv-host-blocks N] [--step-chunk N] "
+                  "[--kv-host-blocks N] [--kv-quantize int8] "
+                  "[--step-chunk N] "
                   "[--prefill-chunk N] [--scheduler-stall-s S]")
             return 1
         parser = argparse.ArgumentParser(prog="worker_node")
@@ -102,6 +103,12 @@ def main(argv=None) -> int:
                                  "host blocks and swap them back in on a "
                                  "radix hit instead of recomputing "
                                  "(0/unset = off)")
+        parser.add_argument("--kv-quantize", default=None,
+                            choices=("int8",),
+                            help="store paged KV block payloads int8 with "
+                                 "per-(slot, kv-head) f32 scales — ~2x "
+                                 "blocks on the same HBM; requires "
+                                 "--kv-block-size (unset = bf16 pool)")
         parser.add_argument("--step-chunk", type=int, default=None,
                             help="decode chunk length per dispatch")
         parser.add_argument("--prefill-chunk", type=int, default=None,
@@ -157,6 +164,8 @@ def main(argv=None) -> int:
             gen_kw["gen_kv_blocks"] = args.kv_blocks
         if args.kv_host_blocks is not None:
             gen_kw["gen_kv_host_blocks"] = args.kv_host_blocks
+        if args.kv_quantize is not None:
+            gen_kw["gen_kv_quantize"] = args.kv_quantize
         if args.step_chunk is not None:
             gen_kw["gen_step_chunk"] = args.step_chunk
         if args.prefill_chunk is not None:
@@ -475,6 +484,18 @@ def main(argv=None) -> int:
                                  "becomes prefix-cache capacity "
                                  "(bench.py --scenario affinity-ab). "
                                  "0 = off")
+        parser.add_argument("--kv-quantize", default="",
+                            choices=("", "int8"),
+                            help="quantized KV blocks (needs "
+                                 "--kv-block-size): store block payloads "
+                                 "int8 with per-(slot, kv-head) f32 "
+                                 "scales, quantized once at block write "
+                                 "and dequantized inside the paged "
+                                 "attention read — ~2x blocks on the same "
+                                 "HBM (bench.py --scenario quant-ab). "
+                                 "Greedy streams stay deterministic but "
+                                 "are not byte-identical to the bf16 "
+                                 "pool. Default off = today's pool")
         parser.add_argument("--prefix-affinity", action="store_true",
                             help="gateway: route /generate(+/stream) on a "
                                  "block-aligned prompt-prefix fingerprint "
@@ -625,6 +646,7 @@ def main(argv=None) -> int:
                                      gen_kv_block_size=args.kv_block_size,
                                      gen_kv_blocks=args.kv_blocks,
                                      gen_kv_host_blocks=args.kv_host_blocks,
+                                     gen_kv_quantize=args.kv_quantize,
                                      gen_prefix_sharing=(
                                          args.prefix_sharing == "on"),
                                      gen_mixed_step=args.mixed_step,

@@ -179,7 +179,12 @@ class AIMDLimit:
                                     start if start is not None
                                     else (self.min_limit
                                           + self.max_limit) // 2)))
-        self._last_decrease = 0.0
+        # -inf, not 0.0: time.monotonic() counts from BOOT, so a zero
+        # sentinel would block the first decrease for cooldown_s after a
+        # host restart (a congested burst inside that window could never
+        # shrink the limit — and the cooldown it "honored" never
+        # happened). No decrease has occurred yet, so none is pending.
+        self._last_decrease = -float("inf")
         self._increases = 0
         self._decreases = 0
         self._lock = threading.Lock()

@@ -313,6 +313,19 @@ class WorkerNode:
                 "--kv-host-blocks requires the continuous scheduler with "
                 "the paged KV cache and prefix sharing on "
                 "(--kv-block-size > 0, --prefix-sharing on)")
+        if self.config.gen_kv_quantize and (
+                not self._continuous
+                or self.config.gen_kv_block_size <= 0):
+            # Same loud contract: an operator who asked for the 2x KV
+            # capacity multiplier must never get a lane that quietly
+            # serves the full-precision (half-capacity) pool instead.
+            raise RuntimeError(
+                "--kv-quantize requires the continuous scheduler with "
+                "the paged KV cache (--kv-block-size > 0)")
+        if self.config.gen_kv_quantize not in ("", "int8"):
+            raise RuntimeError(
+                f"--kv-quantize must be 'int8', got "
+                f"{self.config.gen_kv_quantize!r}")
         if getattr(self.engine.spec, "config", None) is not None:
             try:
                 if self._speculative:
@@ -345,6 +358,7 @@ class WorkerNode:
                         kv_block_size=self.config.gen_kv_block_size,
                         kv_blocks=self.config.gen_kv_blocks,
                         kv_host_blocks=self.config.gen_kv_host_blocks,
+                        kv_quantize=self.config.gen_kv_quantize,
                         prefix_sharing=self.config.gen_prefix_sharing,
                         mixed_step=self.config.gen_mixed_step,
                         mixed_token_budget=(

@@ -83,7 +83,22 @@ def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
     XLA then runs each dense as a local matmul producing the local shard of
     the features — the all-gather (or reduce-scatter in the backward pass)
     is inserted automatically where a replicated tensor is needed.
+
+    Weight-quantized trees (ops.quant) are REFUSED loudly: the sharding
+    rules were written for full-precision "kernel" leaves, and an int8
+    ``kernel_q`` with its per-output-channel ``kernel_scale`` would shard
+    along mismatched axes (or silently replicate) — the documented
+    contract is one or the other per deployment.
     """
+    from tpu_engine.ops.quant import tree_is_quantized
+
+    if tree_is_quantized(params):
+        raise RuntimeError(
+            "shard_params_tp cannot place a weight-quantized param tree "
+            "(ops.quant kernel_q/wi_q leaves): the TP sharding rules "
+            "target full-precision kernels and would leave quantized "
+            "trees replicated or mis-sharded. Use int8 quantization OR "
+            "tensor-parallel sharding per deployment, not both.")
     msize = mesh.shape[axis]
 
     def spec_for(leaf):

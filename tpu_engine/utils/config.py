@@ -91,6 +91,16 @@ class WorkerConfig:
     # instead of recomputing its prefill — host RAM becomes prefix-cache
     # capacity. 0 (default) = no tier (evictions destroy, as before).
     gen_kv_host_blocks: int = 0
+    # Quantized KV blocks (paged mode only; --kv-quantize): "int8" stores
+    # block payloads int8 with per-(layer, slot, kv-head) f32 scales —
+    # roughly half the KV bytes per block, so the same HBM budget holds
+    # ~2x the blocks (and the host tier gets the same capacity +
+    # swap-bandwidth multiplier). Tokens quantize exactly once at block
+    # write; COW / radix re-adoption / demotion / swap-in copy int8 +
+    # scale verbatim. Quantized greedy streams are deterministic but not
+    # byte-identical to the bf16 pool (MIGRATION.md). "" (default) =
+    # today's full-precision pool, byte-identical.
+    gen_kv_quantize: str = ""
     # Block-level radix prefix sharing (paged mode only): shared system
     # prompts skip their prefill compute and share KV blocks
     # copy-on-write. Off = paging without sharing.

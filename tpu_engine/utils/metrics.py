@@ -234,6 +234,27 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "Radix lookups that matched at least one full block",
            [(node(h), p.get("radix_hits")) for h, p in kv])
 
+    # Quantized KV blocks (--kv-quantize int8): capacity-economics gauges
+    # for the int8 pool — bytes per block vs the full-precision layout
+    # and the resulting block-count multiplier at equal HBM.
+    kq = [(h, p) for h, p in kv
+          if isinstance(p, dict) and p.get("quantized")]
+    metric("tpu_engine_kv_quant_info", "gauge",
+           "Quantized KV pool present (mode label carries the format)",
+           [({**node(h), "mode": str(p.get("quantized"))}, 1)
+            for h, p in kq])
+    metric("tpu_engine_kv_quant_bytes_per_block", "gauge",
+           "HBM bytes per block in the quantized pool (int8 payload "
+           "+ f32 scales)",
+           [(node(h), p.get("bytes_per_block")) for h, p in kq])
+    metric("tpu_engine_kv_quant_dense_bytes_per_block", "gauge",
+           "Bytes the same block would cost at the full-precision dtype",
+           [(node(h), p.get("dense_bytes_per_block")) for h, p in kq])
+    metric("tpu_engine_kv_quant_capacity_multiplier", "gauge",
+           "Blocks the quantized pool fits per full-precision block at "
+           "equal HBM",
+           [(node(h), p.get("capacity_multiplier")) for h, p in kq])
+
     # Hierarchical host-RAM KV tier (--kv-host-blocks): demotions keep
     # cold prefixes resident in host RAM; swap-ins resurrect them on a
     # radix hit instead of recomputing prefill.
@@ -260,6 +281,10 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
     metric("tpu_engine_kv_swapped_in_tokens_total", "counter",
            "Prompt tokens served by host-tier swap-in instead of prefill",
            [(node(h), t.get("swapped_in_tokens")) for h, t in kvh])
+    metric("tpu_engine_kv_quant_scale_slots_leaked", "gauge",
+           "Host scale slots not paired with a demoted radix node "
+           "(quantized pools; must stay 0)",
+           [(node(h), t.get("scale_slots_leaked")) for h, t in kvh])
 
     # Mixed prefill+decode stepping (continuous scheduler --mixed-step):
     # one ragged dispatch per tick — ticks and dispatches are counted at
