@@ -1819,6 +1819,240 @@ def run_crash_ab(n_streams: int = 12, max_new: int = 48,
                 proc.kill()
 
 
+def run_drain_ab(n_streams: int = 10, max_new: int = 48,
+                 model: str = "gpt2-small-test") -> dict:
+    """Live stream migration A/B (DESIGN.md "Live stream migration"):
+    drain a LOADED lane mid-stream with ``--migrate-streams`` ON (KV
+    block handoff: export the row's chain + state, import on another
+    lane, zero re-prefilled tokens) vs OFF (today's shed + PR 6 replay:
+    full re-prefill of prompt ⧺ emitted on the resume lane).
+
+    Both arms model the rolling-restart reality: the lane is drained
+    and its PROCESS IS KILLED shortly after (the maintenance window
+    closes — a fleet cannot wait out its longest stream). With
+    migration on, remove_worker has already evacuated every journaled
+    stream by then (the kill finds nothing to lose); without it, the
+    kill truncates the still-running lame-duck streams and PR 6 replays
+    them — full re-prefill of prompt ⧺ emitted on the resume lane.
+
+    Four standalone worker processes are spawned once; each arm routes
+    across three through an in-process gateway and drains+kills that
+    arm's victim the moment a victim-primary stream is provably
+    mid-flight. Reported per arm:
+
+    - stream_completion_rate / identical_rate vs an unkilled blocking
+      control (greedy AND seeded — the splice determinism rule);
+    - reprefill_tokens: tokens_replayed (re-prefixed into resume
+      prompts — the replay arm's prefill burden) plus the survivors'
+      measured prefilled_tokens delta across the drain window;
+    - migrated_rows / imported_rows (ON arm: >= 1, fallbacks 0);
+    - post-drain TTFT and ITL p50/p99 over short probe streams fired
+      after the drain settles (the fleet is 2/3 its size either way;
+      migration must not leave it slower than replay did).
+
+    The A/B criterion: the migrate arm completes 100% byte-identical
+    with ZERO replay tokens (migrated rows re-prefill nothing); the
+    replay arm completes too (failover is on in both arms) but pays
+    tokens_replayed > 0 of re-prefix prefill."""
+    import random
+    import signal
+    import threading
+
+    from tools.fault_injection import (
+        _call,
+        control_oracle,
+        drive_streams_with_kill,
+        launch_worker_procs,
+        rid_for_lane,
+        tally_streams,
+        victim_lane_for_port,
+    )
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+    from tpu_engine.utils.tracing import percentile
+
+    ports, procs = launch_worker_procs(
+        4, extra_args=("--kv-blocks", "48"))
+
+    def lane_prefilled(port: int) -> int:
+        try:
+            _, health = _call(port, "GET", "/health", timeout=30)
+            return ((health.get("generator") or {})
+                    .get("kv_pool") or {}).get("prefilled_tokens", 0)
+        except Exception:
+            return 0
+
+    try:
+        def run_arm(indices, victim_idx, migrate: bool) -> dict:
+            gw = Gateway(
+                [f"127.0.0.1:{ports[i]}" for i in indices],
+                GatewayConfig(
+                    failover_streams=True,
+                    migrate_streams=migrate,
+                    migrate_timeout_s=60.0,
+                    health_probe_interval_s=0.25,
+                    health_probe_failures=2))
+            try:
+                lanes = gw.worker_names()
+                victim_lane = victim_lane_for_port(lanes,
+                                                   ports[victim_idx])
+                survivor_ports = [ports[i] for i in indices
+                                  if ports[i] != ports[victim_idx]]
+                requests = []
+                for k in range(n_streams):
+                    lane = (victim_lane if k % 3 == 0
+                            else lanes[k % len(lanes)])
+                    params = ({} if k % 2 == 0
+                              else {"temperature": 0.9, "seed": 700 + k})
+                    tag = f"{'mig' if migrate else 'rep'}{k}"
+                    # Victim streams run LONG (4x) so every one is
+                    # still mid-flight when the drain+kill sequence
+                    # lands — the case migration exists for
+                    # (kill_when="all" below waits for that).
+                    requests.append({
+                        "request_id": rid_for_lane(gw._ring, lane, tag),
+                        "prompt_tokens": [(k * 11 + j) % 90 + 1
+                                          for j in range(5 + k % 4)],
+                        "max_new_tokens": (max_new * 4
+                                           if lane == victim_lane
+                                           else max_new),
+                        **params})
+                victim_rids = {r["request_id"] for r in requests
+                               if gw._ring.get_node(r["request_id"])
+                               == victim_lane}
+                control = control_oracle(ports[indices[0]], requests)
+
+                def survivors_imported() -> int:
+                    total = 0
+                    for p in survivor_ports:
+                        try:
+                            _, health = _call(p, "GET", "/health",
+                                              timeout=30)
+                        except Exception:
+                            continue
+                        gmig = ((health.get("generator") or {})
+                                .get("migration") or {})
+                        total += gmig.get("imported_rows", 0)
+                    return total
+
+                pre_prefill = {"v": None}
+                imported_before = survivors_imported()
+
+                def drain_and_kill():
+                    # Snapshot the survivors' prefill counters at the
+                    # drain instant: everything they prefill AFTER this
+                    # is resume/migration burden (admissions were all
+                    # dispatched before the drain window closes).
+                    pre_prefill["v"] = sum(lane_prefilled(p)
+                                           for p in survivor_ports)
+                    gw.remove_worker(victim_lane, drain=True)
+                    # The maintenance window closes: the process goes
+                    # away either way, IMMEDIATELY after the drain call
+                    # returns. Migrate mode has evacuated every
+                    # journaled stream by then (remove_worker blocks on
+                    # the transfers and handoff pickup); without it the
+                    # kill truncates the still-running lame-duck
+                    # streams and the journal replays them.
+                    procs[victim_idx].send_signal(signal.SIGKILL)
+                    procs[victim_idx].wait(timeout=10)
+
+                results, drained = drive_streams_with_kill(
+                    gw, requests, victim_rids, drain_and_kill,
+                    random.Random(3 if migrate else 4),
+                    arrival_rate=30.0, kill_when="all")
+                post_prefill = sum(lane_prefilled(p)
+                                   for p in survivor_ports)
+                complete, identical, resumed = tally_streams(
+                    results, control)
+                stats = gw.get_stats()
+                fo = stats.get("failover", {})
+                mig = stats.get("migration", {})
+                imported_rows = survivors_imported() - imported_before
+
+                # Post-drain latency probes: short streams on the
+                # shrunken fleet; TTFT + inter-token gaps client-side.
+                ttfts, gaps = [], []
+                for i in range(8):
+                    t0 = time.perf_counter()
+                    last = None
+                    for frame in gw.route_generate_stream(
+                            {"request_id": f"probe_{migrate}_{i}",
+                             "prompt_tokens": [7, i + 1, 3],
+                             "max_new_tokens": 12}):
+                        evt = _parse_sse(frame)
+                        if not evt or "tokens" not in evt \
+                                or evt.get("done"):
+                            continue
+                        now = time.perf_counter()
+                        if last is None:
+                            ttfts.append(now - t0)
+                        else:
+                            gaps.append(now - last)
+                        last = now
+                return {
+                    "migrate": migrate, "streams": len(requests),
+                    "victim_primary_streams": len(victim_rids),
+                    "drained_mid_stream": drained,
+                    "completed": complete,
+                    "stream_completion_rate": round(
+                        complete / len(requests), 3),
+                    "identical": identical,
+                    "identical_rate": round(
+                        identical / len(requests), 3),
+                    "resumed_streams": resumed,
+                    "migrated_streams": mig.get("streams_migrated", 0),
+                    "migration_fallbacks": mig.get(
+                        "migration_fallbacks", 0),
+                    "imported_rows": imported_rows,
+                    "reprefill_tokens_replayed": fo.get(
+                        "tokens_replayed", 0),
+                    "reprefill_tokens_measured": (
+                        post_prefill - pre_prefill["v"]
+                        if pre_prefill["v"] is not None else None),
+                    "post_drain_ttft_ms": {
+                        "p50": round(1e3 * (percentile(ttfts, 50) or 0),
+                                     1),
+                        "p99": round(1e3 * (percentile(ttfts, 99) or 0),
+                                     1)},
+                    "post_drain_itl_ms": {
+                        "p50": round(1e3 * (percentile(gaps, 50) or 0),
+                                     1),
+                        "p99": round(1e3 * (percentile(gaps, 99) or 0),
+                                     1)},
+                }
+            finally:
+                gw.stop()
+
+        on = run_arm([0, 1, 2], 1, True)
+        record_partial("drain_migrate", on)
+        off = run_arm([0, 2, 3], 3, False)
+        record_partial("drain_replay", off)
+        results = {"model": model, "n_streams_per_arm": n_streams,
+                   "migrate_on": on, "replay_off": off}
+        results["checks_passed"] = bool(
+            on["drained_mid_stream"] and off["drained_mid_stream"]
+            and on["stream_completion_rate"] == 1.0
+            and on["identical_rate"] == 1.0
+            and on["migrated_streams"] >= 1
+            and on["migration_fallbacks"] == 0
+            and on["reprefill_tokens_replayed"] == 0
+            and on["imported_rows"] >= 1
+            and off["stream_completion_rate"] == 1.0
+            and off["identical_rate"] == 1.0
+            and off["resumed_streams"] >= 1
+            and off["reprefill_tokens_replayed"] > 0)
+        return results
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_affinity_ab(model: str = "gpt2-small-test", n_requests: int = 48,
                     n_tenants: int = 8, prefix_len: int = 96,
                     suffix_len: int = 8, max_new: int = 8,
@@ -2441,8 +2675,8 @@ def _main() -> int:
                              "spec-ab", "spec-batch-ab", "mixed",
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
-                             "crash-ab", "affinity-ab", "overload-ab",
-                             "quant-ab"],
+                             "crash-ab", "drain-ab", "affinity-ab",
+                             "overload-ab", "quant-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -2553,6 +2787,23 @@ def _main() -> int:
             "unit": "fraction",
             "vs_baseline": result["failover_off"][
                 "stream_completion_rate"],
+            **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "drain-ab":
+        # Live stream migration A/B: worker processes on the host
+        # backend (the drain semantics are the variable under test, not
+        # the chip).
+        result = run_drain_ab(n_streams=8 if args.quick else 10)
+        record_partial("drain_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "drain_migrated_reprefill_tokens",
+            "value": result["migrate_on"]["reprefill_tokens_replayed"],
+            "unit": "tokens",
+            "vs_baseline": result["replay_off"][
+                "reprefill_tokens_replayed"],
             **result,
         })
         return 0 if result["checks_passed"] else 1

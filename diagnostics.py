@@ -25,7 +25,8 @@ OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
 #             --spec-parity step 9, --quant-parity step 10, --failover
-#             step 11, --overload step 12, --lint step 13
+#             step 11, --migrate step 12, --overload step 13,
+#             --lint step 14
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -96,14 +97,22 @@ def main() -> int:
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
+    ap.add_argument("--migrate", action="store_true",
+                    help="step 12: one scripted migrate-mode drain "
+                         "against a local worker pair (spawned here): "
+                         "drain the stream's lane mid-generation with "
+                         "--migrate-streams semantics and print the "
+                         "spliced-vs-control diff plus the migration "
+                         "counters — the KV-handoff smoke without the "
+                         "full fault_injection --migrate chaos run")
     ap.add_argument("--overload", action="store_true",
-                    help="step 12: overload-control state of the live "
+                    help="step 13: overload-control state of the live "
                          "system — the gateway's /stats overload block "
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 13: engine-lint static-analysis suite "
+                    help="step 14: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -111,7 +120,7 @@ def main() -> int:
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
               + int(args.spec_parity) + int(args.quant_parity)
-              + int(args.failover)
+              + int(args.failover) + int(args.migrate)
               + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
@@ -359,6 +368,86 @@ def main() -> int:
                 if p.poll() is None:
                     p.terminate()
 
+    # (--migrate): one scripted migrate-mode drain against a local
+    # worker pair — the KV block handoff, live, in one line: stream
+    # through a migrate-enabled gateway, remove the serving lane with
+    # drain=True, and diff the spliced stream against an unkilled
+    # blocking control (zero re-prefilled tokens expected).
+    if args.migrate:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.failover) + 1)
+        procs = []
+        try:
+            import threading
+
+            from tools.fault_injection import (
+                _call,
+                launch_worker_procs,
+                rid_for_lane,
+            )
+            from tpu_engine.serving.gateway import Gateway, _parse_sse
+            from tpu_engine.utils.config import GatewayConfig
+
+            ports, procs = launch_worker_procs(2)
+            gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                         GatewayConfig(failover_streams=True,
+                                       migrate_streams=True,
+                                       migrate_timeout_s=60.0))
+            victim_lane = next(l for l in gw.worker_names()
+                               if str(ports[0]) in l)
+            rid = rid_for_lane(gw._ring, victim_lane, "mg")
+            req = {"request_id": rid, "prompt_tokens": [5, 9, 3, 17],
+                   "max_new_tokens": 24, "temperature": 0.9, "seed": 7}
+            _, ctl = _call(ports[1], "POST", "/generate",
+                           dict(req, request_id="ctl"), timeout=600)
+            control = ctl["tokens"]
+            toks, final = [], {}
+
+            def consume():
+                for frame in gw.route_generate_stream(dict(req)):
+                    evt = _parse_sse(frame)
+                    if evt and evt.get("done"):
+                        final.update(evt)
+                        break
+                    if evt and "tokens" in evt:
+                        toks.extend(evt["tokens"])
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            import time as _time
+
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and len(toks) < 2:
+                _time.sleep(0.02)
+            gw.remove_worker(victim_lane, drain=True)
+            t.join(timeout=300)
+            mig = gw.get_stats().get("migration", {})
+            gw.stop()
+            spliced = final.get("tokens")
+            if spliced == control and toks == control:
+                detail = (f"(identical: {len(control)} tokens, "
+                          f"migrated={mig.get('streams_migrated')}, "
+                          f"fallbacks={mig.get('migration_fallbacks')}, "
+                          f"tokens_migrated="
+                          f"{mig.get('tokens_migrated')})")
+                ok = mig.get("streams_migrated", 0) >= 1
+            else:
+                div = next((i for i, (a, b) in enumerate(
+                    zip(spliced or [], control))
+                    if a != b), min(len(spliced or []), len(control)))
+                detail = (f"(DIVERGED at token {div}: "
+                          f"spliced={spliced} control={control})")
+                ok = False
+            step(n, "migrate-mode drain splice vs control", ok, detail)
+        except Exception as exc:
+            step(n, "migrate-mode drain splice vs control", False,
+                 f"({exc})")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
     # 11 (--overload): overload-control state, live — the gateway's
     # /stats overload block and each lane's brownout ladder stage. Works
     # whether or not the flags are on: a defaults-off deployment reports
@@ -367,7 +456,7 @@ def main() -> int:
     if args.overload:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.failover) + 1)
+             + int(args.failover) + int(args.migrate) + 1)
         try:
             status, stats = _get(gw, "/stats")
             ov = stats.get("overload")

@@ -1,6 +1,7 @@
 """Prometheus /metrics endpoint (utils.metrics)."""
 
 import http.client
+import time
 
 import pytest
 
@@ -128,8 +129,20 @@ def test_stage_histograms_over_http():
                      body='{"request_id":"h1","input_data":[4.0,5.0]}',
                      headers={"Content-Type": "application/json"})
         conn.getresponse().read()
-        conn.request("GET", "/metrics")
-        body = conn.getresponse().read().decode()
+        # The batch observer records queue_wait/batch_form AFTER the
+        # request's future resolves (on the dispatch thread), so an
+        # immediate scrape can beat the spans — poll briefly.
+        deadline = time.monotonic() + 10.0
+        while True:
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read().decode()
+            if ('stage="queue_wait"' in body
+                    and 'stage="device_compute"' in body
+                    and 'stage="batch_form"' in body):
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
         conn.close()
         for stage in ("queue_wait", "batch_form", "device_compute"):
             pat = re.compile(

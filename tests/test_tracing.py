@@ -340,8 +340,19 @@ def test_trace_summary_schema_over_http():
                      body='{"request_id":"s1","input_data":[1.0]}',
                      headers={"Content-Type": "application/json"})
         conn.getresponse().read()
-        conn.request("GET", "/trace")
-        trace = json.loads(conn.getresponse().read())
+        # The batch observer records queue_wait/batch_form AFTER the
+        # request's future resolves (dispatch thread) — poll briefly so
+        # an immediate scrape can't race the stage spans.
+        node = workers[0].node_id
+        deadline = time.monotonic() + 10.0
+        while True:
+            conn.request("GET", "/trace")
+            trace = json.loads(conn.getresponse().read())
+            stages = trace.get("stages", {}).get(node, {})
+            if ("queue_wait" in stages and "device_compute" in stages) \
+                    or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
         conn.close()
         assert set(trace) >= {"summary", "recent"}  # original keys
         node = workers[0].node_id

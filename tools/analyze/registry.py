@@ -109,6 +109,7 @@ _RECEIVER_ALIASES = {
     "self.failover": "FailoverCounters",
     "self.affinity": "AffinityCounters",
     "self.overload": "OverloadCounters",
+    "self.migration": "MigrationCounters",
     "self._tenant_bucket": "TenantRateLimiter",
     "self._shed_stats": "SheddingStats",
     "self._aimd": "AIMDLimit",
@@ -154,9 +155,16 @@ ENGINE_REGISTRY = Registry(
             attrs=("_clients", "_breakers", "_ejected", "_model_rings",
                    "_untyped", "_latency", "_lane_recent",
                    "_affinity_assigned", "_hedge_pool", "default_model",
-                   "_total_requests", "_failovers", "_inflight"),
+                   "_total_requests", "_failovers", "_inflight",
+                   "_streams"),
             lock="Gateway._lock",
             classes=("Gateway",)),
+        # Live-stream-migration handoff slot: the orchestrator/relay
+        # exchange resolves exactly once under the record's own lock.
+        GuardedEntry(
+            attrs=("_it", "_dest", "_error", "_abandoned"),
+            lock="_StreamRecord._hlock",
+            classes=("_StreamRecord",)),
         # Overload control (serving/overload.py): per-tenant token
         # buckets, the AIMD limit state, the brownout ladder state, and
         # the gateway shed-rate window — each class owns one lock.
@@ -216,7 +224,7 @@ ENGINE_REGISTRY = Registry(
                              "SheddingStats._gc"}),
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
-                                 "overload"}),
+                                 "overload", "migration"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

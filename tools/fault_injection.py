@@ -645,15 +645,20 @@ def _worker_pool_clean(port: int, timeout_s: float = 30.0):
 
 def drive_streams_with_kill(gw, requests, victim_rids, kill, rng,
                             arrival_rate: float = 8.0,
-                            kill_window_s: float = 120.0):
+                            kill_window_s: float = 120.0,
+                            kill_when: str = "any"):
     """The shared chaos drive (also used by ``bench.py --scenario
-    crash-ab``): fire each request as a /generate/stream through ``gw``
-    at Poisson arrivals, invoke ``kill()`` once, the moment a
-    victim-primary stream is provably mid-generation (>= 3 tokens
-    relayed, not yet finished), then join. Returns (results, killed)
-    where results[rid] = (streamed_tokens, final_event) — final_event is
-    None for a truncated stream and {"harness_exception": ...} when the
-    iterator raised."""
+    crash-ab`` / ``drain-ab``): fire each request as a /generate/stream
+    through ``gw`` at Poisson arrivals, invoke ``kill()`` once, the
+    moment victim-primary streams are provably mid-generation (>= 3
+    tokens relayed, not yet finished), then join. ``kill_when="any"``
+    (default) fires on the FIRST such stream — the crash scenarios'
+    shape; ``"all"`` waits until EVERY victim stream is mid-generation
+    (or already finished) — the drain scenarios' shape, where the
+    interesting case is a lane full of in-flight streams, not one.
+    Returns (results, killed) where results[rid] = (streamed_tokens,
+    final_event) — final_event is None for a truncated stream and
+    {"harness_exception": ...} when the iterator raised."""
     import threading
 
     from tpu_engine.serving.gateway import _parse_sse
@@ -693,7 +698,11 @@ def drive_streams_with_kill(gw, requests, victim_rids, kill, rng,
         with lock:
             live = [r for r in victim_rids
                     if progress[r] >= 3 and r not in results]
-        if live:
+            settled = [r for r in victim_rids if r in results]
+        fire = (bool(live) if kill_when == "any"
+                else live and len(live) + len(settled)
+                == len(victim_rids))
+        if fire:
             kill()
             killed = True
             break
@@ -1069,6 +1078,294 @@ def offload_phase(ports, procs, checks: list) -> dict:
             "victim_demotions_at_churn": host.get("demotions", 0),
             "victim_swap_ins": host.get("swap_ins", 0),
             "failover": fo, "survivors_leak_free": leak_free}
+
+
+def _migration_counters_match_spans(gw) -> bool:
+    from tpu_engine.serving.resilience import MigrationCounters
+
+    mig = gw.get_stats().get("migration", {})
+    expect = sum(mig.get(f, 0) for f in MigrationCounters.SPAN_FIELDS)
+    spans = [s for s in gw.tracer.snapshot() if s["op"] == "migration"]
+    return len(spans) == expect
+
+
+def migrate_phase(ports, procs, checks: list) -> dict:
+    """Live-stream-migration chaos (--migrate). Phase A: drain a lane
+    MID-STREAM under Poisson load with migrate mode on — every stream
+    (the migrated ones included) must complete byte-identical to an
+    unkilled control with ZERO replay traffic and zero device/host
+    block leaks on every pool, the DRAINED lane's included (it is
+    alive; its exported rows must have released everything). Phase B:
+    kill -9 the continuation's DESTINATION before the transfer — the
+    fallback ladder must land on the PR 6 replay resume and still
+    complete the stream byte-identically. Counters == migration marker
+    spans throughout."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _StreamRecord
+    from tpu_engine.utils.config import GatewayConfig
+    from tpu_engine.utils.tracing import TraceContext
+
+    # ---- Phase A: migrate-mode drain under load -------------------------
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports[:3]],
+                 GatewayConfig(failover_streams=True,
+                               migrate_streams=True,
+                               migrate_timeout_s=60.0,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    victim_lane = victim_lane_for_port(lanes, ports[1])
+
+    requests = []
+    for k in range(10):
+        lane = victim_lane if k % 3 == 0 else lanes[k % len(lanes)]
+        params = {}
+        if k % 3 == 1:
+            params = {"temperature": 0.9, "seed": 500 + k}
+        elif k % 3 == 2:
+            params = {"temperature": 0.8, "seed": 600 + k,
+                      "repetition_penalty": 1.3, "stop_tokens": [7],
+                      "top_p": 0.9}
+        # Victim streams run LONG so every one is still mid-flight when
+        # the drain lands (kill_when="all" below waits for that).
+        requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"mg{k}"),
+            "prompt_tokens": [(k * 5 + j) % 90 + 1
+                              for j in range(6 + k % 5)],
+            "max_new_tokens": 150 if lane == victim_lane else 24,
+            **params})
+    victim_rids = {r["request_id"] for r in requests
+                   if gw._ring.get_node(r["request_id"]) == victim_lane}
+    try:
+        control = control_oracle(ports[0], requests)
+    except RuntimeError as exc:
+        checks.append(("migrate: control generate", False))
+        return {"error": str(exc)}
+    for p in ports[1:3]:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+
+    def drain_victim():
+        gw.remove_worker(victim_lane, drain=True)
+
+    results, drained = drive_streams_with_kill(
+        gw, requests, victim_rids, drain_victim, random.Random(7),
+        arrival_rate=30.0, kill_when="all")
+    checks.append(("migrate: victim drained mid-stream", drained))
+    complete, identical, _resumed = tally_streams(results, control)
+    checks.append(("migrate: all streams completed "
+                   f"({complete}/{len(requests)})",
+                   complete == len(requests)))
+    checks.append(("migrate: all streams byte-identical to control "
+                   f"({identical}/{len(requests)})",
+                   identical == len(requests)))
+    stats = gw.get_stats()
+    mig = stats.get("migration", {})
+    fo = stats.get("failover", {})
+    checks.append(("migrate: streams migrated >= 1 "
+                   f"({mig.get('streams_migrated', 0)})",
+                   mig.get("streams_migrated", 0) >= 1))
+    checks.append(("migrate: zero replay fallbacks in a clean drain",
+                   mig.get("migration_fallbacks", 0) == 0))
+    checks.append(("migrate: zero tokens replayed (no re-prefill)",
+                   fo.get("tokens_replayed", 0) == 0))
+    checks.append(("migrate: counters == migration spans",
+                   _migration_counters_match_spans(gw)))
+    # Zero leaks EVERYWHERE — the drained lane is alive and must have
+    # released every exported row's blocks too.
+    leak_free = {}
+    imported_rows = 0
+    for p in ports[:3]:
+        pool = _worker_pool_clean_tiered(p)
+        leak_free[p] = pool is not None
+        checks.append((f"migrate: zero device+host blocks leaked on :{p}",
+                       pool is not None))
+        _, health = _call(p, "GET", "/health", timeout=10)
+        gmig = (health.get("generator") or {}).get("migration") or {}
+        imported_rows += gmig.get("imported_rows", 0)
+        checks.append((f"migrate: no imports rejected on :{p}",
+                       gmig.get("import_rejected", 0) == 0))
+    checks.append(("migrate: destinations adopted rows "
+                   f"({imported_rows})", imported_rows >= 1))
+    gw.stop()
+    phase_a = {"streams": len(requests), "complete": complete,
+               "identical": identical,
+               "victim_primary_streams": len(victim_rids),
+               "migration": mig, "failover": fo,
+               "leak_free": leak_free,
+               "imported_rows": imported_rows}
+
+    # ---- Phase B: destination killed before the transfer ----------------
+    gw2 = Gateway([f"127.0.0.1:{p}" for p in (ports[0], ports[2],
+                                              ports[3])],
+                  GatewayConfig(failover_streams=True,
+                                migrate_streams=True,
+                                migrate_timeout_s=60.0))
+    lanes2 = gw2.worker_names()
+    source_lane = victim_lane_for_port(lanes2, ports[3])
+    rid = rid_for_lane(gw2._ring, source_lane, "mgb")
+    req = {"request_id": rid,
+           "prompt_tokens": [9, 4, 1, 8, 3], "max_new_tokens": 48}
+    control_b = control_oracle(ports[0], [req])
+    # The EXACT destination the orchestrator will pick (same preference
+    # order), so the kill provably lands on the continuation's target.
+    probe_rec = _StreamRecord(rid, req, None,
+                              TraceContext.root(rid), source_lane)
+    dest_lane = gw2._pick_migration_dest(probe_rec, source_lane)
+    dest_port = next(p for p in ports if dest_lane.endswith(f":{p}"))
+    dest_idx = ports.index(dest_port)
+
+    def kill_dest_then_drain():
+        procs[dest_idx].send_signal(signal.SIGKILL)
+        procs[dest_idx].wait(timeout=10)
+        gw2.remove_worker(source_lane, drain=True)
+
+    results_b, fired = drive_streams_with_kill(
+        gw2, [req], {rid}, kill_dest_then_drain, random.Random(8))
+    toks, final = results_b[rid]
+    ok_b = (stream_completed(final) and toks == control_b[rid]
+            and final.get("tokens") == control_b[rid])
+    checks.append(("migrate: dest killed, drain fired mid-stream",
+                   fired))
+    checks.append(("migrate: replay fallback completed the stream "
+                   "byte-identically", ok_b))
+    mig2 = gw2.get_stats().get("migration", {})
+    fell_back = (mig2.get("migration_fallbacks", 0)
+                 + mig2.get("import_dispatch_failed", 0)
+                 + mig2.get("export_refusals", 0)) >= 1
+    checks.append(("migrate: dest death attributed to the fallback "
+                   "ladder", fell_back))
+    checks.append(("migrate: phase-B counters == migration spans",
+                   _migration_counters_match_spans(gw2)))
+    # Survivors = the phase-B ring minus the KILLED destination (the
+    # drained source is alive and must be leak-free too: its exported
+    # row released everything even though the transfer died).
+    for p in (ports[0], ports[2], ports[3]):
+        if p == dest_port:
+            continue
+        pool = _worker_pool_clean_tiered(p)
+        checks.append((f"migrate: zero blocks leaked on survivor :{p}",
+                       pool is not None))
+    gw2.stop()
+    return {"phase_a": phase_a,
+            "phase_b": {"source": source_lane, "dest": dest_lane,
+                        "completed_identical": ok_b,
+                        "migration": mig2,
+                        "resumed": (final or {}).get("resumed", 0)}}
+
+
+def migrate_quant_phase(checks: list) -> dict:
+    """Phase C (in-process): a QUANTIZED fleet's drain — int8 payload +
+    scale slots cross the wire verbatim, the continuation equals the
+    uninterrupted quantized control, and zero device/host block or
+    scale-slot leaks on every pool."""
+    import threading
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+    workers = [WorkerNode(WorkerConfig(
+        node_id=f"q{i}", model="gpt2-small-test", dtype="float32",
+        gen_scheduler="continuous", gen_step_chunk=2,
+        gen_kv_block_size=16, gen_kv_blocks=40, gen_kv_host_blocks=8,
+        gen_kv_quantize="int8", gen_prefill_chunk=16,
+        gen_max_batch_size=4)) for i in range(3)]
+    p0 = workers[0].engine.params
+    for w in workers[1:]:
+        w.apply_weights(p0)
+    gw = Gateway(list(workers),
+                 GatewayConfig(failover_streams=True,
+                               migrate_streams=True,
+                               migrate_timeout_s=60.0))
+    try:
+        prompt = [5, 9, 3, 17, 4, 22, 8]
+        control = workers[2].handle_generate(
+            {"request_id": "qctl", "prompt_tokens": prompt,
+             "max_new_tokens": 32})["tokens"]
+        rid = next(f"qm{i}" for i in range(4000)
+                   if gw._ring.get_node(f"qm{i}") == "q0")
+        toks, final = [], [None]
+        armed = threading.Event()
+
+        def consume():
+            for frame in gw.route_generate_stream(
+                    {"request_id": rid, "prompt_tokens": prompt,
+                     "max_new_tokens": 32}):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    final[0] = evt
+                    break
+                if "tokens" in evt:
+                    toks.extend(evt["tokens"])
+                    if len(toks) >= 3:
+                        armed.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        armed.wait(300)
+        gw.remove_worker("q0", drain=True)
+        t.join(timeout=300)
+        ok = (final[0] is not None and "error" not in final[0]
+              and toks == control and final[0]["tokens"] == control)
+        checks.append(("migrate: quantized drain stream identical to "
+                       "quantized control", ok))
+        mig = gw.get_stats().get("migration", {})
+        checks.append(("migrate: quantized stream migrated (not "
+                       "replayed)", mig.get("streams_migrated", 0) >= 1
+                       and mig.get("migration_fallbacks", 0) == 0))
+        leaks_ok = True
+        for w in workers:
+            st = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = w.generator.stats()
+                kp = st["kv_pool"]
+                host = kp.get("host") or {}
+                used = host.get("blocks_used", 0)
+                if (st["active"] == 0
+                        and kp["blocks_free"] + kp["radix_nodes"] - used
+                        >= kp["blocks_total"]
+                        and host.get("scale_slots_leaked", 0) == 0):
+                    break
+                time.sleep(0.3)
+            else:
+                leaks_ok = False
+        checks.append(("migrate: zero device/host/scale-slot leaks on "
+                       "every quantized pool", leaks_ok))
+        return {"identical": ok, "migration": mig}
+    finally:
+        gw.stop()
+        for w in workers:
+            w.stop()
+
+
+def run_migrate_standalone() -> int:
+    ports, procs = launch_worker_procs(
+        4, extra_args=("--kv-blocks", "40", "--kv-host-blocks", "8"))
+    checks: list = []
+    try:
+        phases = {"migrate": migrate_phase(ports, procs, checks)}
+        phases["quantized"] = migrate_quant_phase(checks)
+        report = {"mode": "migrate-standalone", "worker_ports": ports,
+                  "phases": phases}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
 
 def run_offload_standalone() -> int:
@@ -1633,6 +1930,20 @@ def main() -> int:
                          "completes byte-identically with zero device, "
                          "host, or scale-slot leaks on the survivors; "
                          "ignores the other flags")
+    ap.add_argument("--migrate", action="store_true",
+                    help="standalone live-stream-migration scenario: "
+                         "spawns four host-tiered worker processes, "
+                         "drains a lane MID-STREAM under Poisson load "
+                         "with --migrate-streams semantics (every "
+                         "stream completes byte-identical with zero "
+                         "replay traffic and zero block leaks — the "
+                         "drained lane's pool included), then kill -9s "
+                         "the continuation's DESTINATION and asserts "
+                         "the replay fallback still completes the "
+                         "stream, plus an in-process QUANTIZED drain "
+                         "(int8+scale chains verbatim, zero scale-slot "
+                         "leaks); counters == migration spans "
+                         "throughout; ignores the other flags")
     ap.add_argument("--overload", action="store_true",
                     help="standalone overload-control scenario: spawns a "
                          "3-lane combined server with every overload "
@@ -1644,6 +1955,8 @@ def main() -> int:
                          "marker spans, and zero KV blocks leak; "
                          "ignores the other flags")
     args = ap.parse_args()
+    if args.migrate:
+        return run_migrate_standalone()
     if args.quant:
         return run_quant_standalone()
     if args.overload:

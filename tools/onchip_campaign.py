@@ -407,11 +407,22 @@ def main() -> int:
         # and swap-in-vs-recompute margin are device properties).
         return bench.run_affinity_ab(model=model, quick=bool(q))
 
+    @stage(artifact, out, "migration")
+    def _migration():
+        # Live stream migration on-chip: the migrate-vs-replay drain A/B
+        # (BENCH_r13 ran it on the CPU mesh, stamped on-chip pending
+        # like r06-r12). Splice identity is backend-independent, but the
+        # export device_get / import device_put hop and the post-drain
+        # TTFT/ITL penalty are DEVICE properties — HBM readback
+        # bandwidth bounds how fast a loaded lane can evacuate.
+        return bench.run_drain_ab(n_streams=6 if q else 10,
+                                  max_new=24 if q else 48)
+
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
                _decode_int8, _flash, _flash_tiling, _paged, _mixed,
-               _spec_cont, _spec, _kv_quant, _affinity,
+               _spec_cont, _spec, _kv_quant, _affinity, _migration,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

@@ -75,13 +75,24 @@ vLLM-style layout, kept TPU-native:
   own LRU demoted leaves. The copies are verbatim dtype-preserving
   moves, so a demote/promote round trip is bit-exact.
 
+- **Chain export/import** (``export_chain`` / ``import_chain``): a
+  row's block chain serialized as a JSON-safe wire dict — verbatim
+  dtype-preserving payload bytes per block (int8 + scale travel
+  together, never requantized; demoted nodes export straight from
+  their pinned host buffers, no swap-in), a crc32 checksum over the
+  whole chain, and the source pool's generation stamp. This is the
+  "serialize blocks over the wire" primitive of ROADMAP open item 4;
+  live stream migration (DESIGN.md) is its first consumer.
+
 `runtime.scheduler.ContinuousGenerator(kv_block_size=...)` drives this;
 `ops.paged_attention` is the matching attention read path.
 """
 
 from __future__ import annotations
 
+import base64
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -253,6 +264,22 @@ class RadixTree:
                     out.append(c)
         return out
 
+    def chain_nodes(self, tokens: Sequence[int]) -> List["_RadixNode"]:
+        """Longest-prefix node chain for ``tokens`` WITHOUT promoting,
+        pinning, or stamping anything — a demoted node simply stays in
+        the chain (its KV is read from the host tier). The export side
+        of migration uses this to serialize a cached prefix exactly as
+        it sits, device or host, with zero swap-in traffic."""
+        out: List[_RadixNode] = []
+        node = self.root
+        for key in self._full_blocks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
     def evict(self, n_blocks: int) -> int:
         """Free up to ``n_blocks`` pool blocks by demoting (host tier
         configured) or dropping LRU leaves whose blocks nothing but the
@@ -336,6 +363,7 @@ class BlockPool:
         self.radix = RadixTree(self)
         self._copy_exe = None
         self._promote_exe = None
+        self._import_exe: Dict[int, object] = {}  # {n_blocks: chain write}
         # Hierarchical host tier (module docstring): pinned host buffers
         # for demoted radix blocks. Dtype matches the device pool exactly
         # so a demote/promote round trip is bit-identical.
@@ -602,6 +630,204 @@ class BlockPool:
         self.swap_ins += 1
         self.swapped_in_tokens += self.block_size
         return True
+
+    # -- chain export/import (live stream migration; hold self.lock) ----------
+    #
+    # The wire format open item 4 needs ("page tables + block pool —
+    # serialize blocks over the wire"): one JSON-safe dict per row chain,
+    # dtype-preserving payload bytes per block (bf16 verbatim; quantized
+    # pools ship int8 payload + the f32 scale vectors verbatim, so the
+    # write-once rule survives the wire — an imported block is
+    # bit-identical to the exported one, never requantized), a crc32
+    # checksum over every payload byte in chain order, and the source
+    # pool's generation stamp. DESIGN.md "Live stream migration".
+
+    def _export_device_arrays(self, bids: Sequence[int]) -> List[np.ndarray]:
+        """Device blocks ``bids`` -> host arrays [k, v(, ks, vs)], each
+        shaped (L, n, ...) — ONE gather + transfer per tensor, not one
+        per block: export runs on the decode thread under the pool
+        lock, and a long chain must not stall every other live row for
+        2·n (4·n quantized) round trips. The reads order after every
+        donation that produced the blocks' bytes (same-lock rule)."""
+        ids = jnp.asarray(np.asarray(bids, np.int32))
+        out = [np.asarray(jax.device_get(self.caches.k[:, ids])),
+               np.asarray(jax.device_get(self.caches.v[:, ids]))]
+        if self.quantized:
+            out += [np.asarray(jax.device_get(self.scales.k[:, ids])),
+                    np.asarray(jax.device_get(self.scales.v[:, ids]))]
+        return out
+
+    def _export_host_arrays(self, slot: int) -> List[np.ndarray]:
+        """A DEMOTED node's block, straight from its pinned host buffers
+        — no swap-in, no device traffic (the demoted copy is bit-exact
+        by the host-tier contract)."""
+        out = [np.array(self._host_k[slot]), np.array(self._host_v[slot])]
+        if self.quantized:
+            out += [np.array(self._host_ks[slot]),
+                    np.array(self._host_vs[slot])]
+        return out
+
+    def export_chain(self, sources: Sequence) -> dict:
+        """Serialize a block chain. Each source is a device block id
+        (int) or a ``_RadixNode`` (demoted nodes export from the host
+        tier; resident ones from their device block). Returns the
+        JSON-safe wire dict; ``import_chain`` on any same-geometry pool
+        reproduces the exact bytes (tested bit-exact for bf16, int8 +
+        scale, and host-demoted chains)."""
+        # Resolve each source to (device block id | host slot), then read
+        # ALL device blocks in one batched gather+transfer per tensor.
+        resolved = []
+        dev_ids: List[int] = []
+        for src in sources:
+            if isinstance(src, _RadixNode) and src.demoted:
+                resolved.append(("host", src.host_slot))
+            else:
+                bid = src.block_id if isinstance(src, _RadixNode) \
+                    else int(src)
+                resolved.append(("dev", len(dev_ids)))
+                dev_ids.append(bid)
+        dev = self._export_device_arrays(dev_ids) if dev_ids else None
+        blocks = []
+        crc = 0
+        for kind, idx in resolved:
+            if kind == "host":
+                arrays = self._export_host_arrays(idx)
+            else:
+                arrays = [a[:, idx] for a in dev]
+            entry = {}
+            for name, arr in zip(("k", "v", "ks", "vs"), arrays):
+                raw = arr.tobytes()
+                crc = zlib.crc32(raw, crc)
+                entry[name] = base64.b64encode(raw).decode("ascii")
+            blocks.append(entry)
+        return {
+            "version": 1,
+            "dtype": str(jnp.dtype(self._dtype)),
+            "quantized": self.quantized,
+            "block_size": self.block_size,
+            "n_layers": self.cfg.n_layers,
+            "kv_heads": self.cfg.kv_heads,
+            "d_head": self.cfg.d_head,
+            "blocks": blocks,
+            "checksum": crc,
+            "generation": self.generation,
+        }
+
+    def chain_compatible(self, chain: dict) -> Optional[str]:
+        """None when ``chain`` can be imported into THIS pool verbatim;
+        else a human-readable reason. Geometry AND storage dtype must
+        match exactly — a cross-dtype import would have to requantize,
+        which the write-once rule forbids. Also validates every entry's
+        STRUCTURE (required keys, exact decoded payload lengths): a
+        chain whose checksum is self-consistent over truncated bytes
+        must be refused HERE, on the import's validation path — never
+        crash the decode thread mid-admission (a decode-thread failure
+        recovers the whole pool and kills every live row on the lane)."""
+        want = {"dtype": str(jnp.dtype(self._dtype)),
+                "quantized": self.quantized,
+                "block_size": self.block_size,
+                "n_layers": self.cfg.n_layers,
+                "kv_heads": self.cfg.kv_heads,
+                "d_head": self.cfg.d_head}
+        for key, val in want.items():
+            if chain.get(key) != val:
+                return (f"chain {key}={chain.get(key)!r} does not match "
+                        f"destination pool {key}={val!r}")
+        slots = self.cfg.n_layers * self.block_size * self.cfg.kv_heads
+        payload_len = slots * self.cfg.d_head \
+            * jnp.zeros((), self._dtype).dtype.itemsize
+        want_lens = {"k": payload_len, "v": payload_len}
+        if self.quantized:
+            want_lens.update({"ks": slots * 4, "vs": slots * 4})
+        blocks = chain.get("blocks")
+        if not isinstance(blocks, (list, tuple)):
+            return "chain carries no block list"
+        for i, entry in enumerate(blocks):
+            if not isinstance(entry, dict):
+                return f"chain block {i} is not an object"
+            for name, want_len in want_lens.items():
+                raw = entry.get(name)
+                if not isinstance(raw, str):
+                    return f"chain block {i} is missing {name!r}"
+                try:
+                    n = len(base64.b64decode(raw, validate=True))
+                except Exception:
+                    return f"chain block {i} {name!r} is not base64"
+                if n != want_len:
+                    return (f"chain block {i} {name!r} holds {n} bytes, "
+                            f"expected {want_len}")
+        return None
+
+    @staticmethod
+    def verify_chain(chain: dict) -> bool:
+        """Recompute the chain checksum over the decoded payload bytes —
+        the destination's first gate, BEFORE any block is allocated."""
+        crc = 0
+        try:
+            for entry in chain["blocks"]:
+                for name in ("k", "v", "ks", "vs"):
+                    if name in entry:
+                        crc = zlib.crc32(
+                            base64.b64decode(entry[name]), crc)
+            return crc == int(chain["checksum"])
+        except Exception:
+            return False
+
+    def _chain_block_arrays(self, chain: dict, entry: dict):
+        """One wire block -> host arrays shaped for a device write."""
+        shape = (self.cfg.n_layers, self.block_size, self.cfg.kv_heads,
+                 self.cfg.d_head)
+        dt = jnp.zeros((), self._dtype).dtype
+        out = [np.frombuffer(base64.b64decode(entry["k"]),
+                             dtype=dt).reshape(shape),
+               np.frombuffer(base64.b64decode(entry["v"]),
+                             dtype=dt).reshape(shape)]
+        if self.quantized:
+            out += [np.frombuffer(base64.b64decode(entry[name]),
+                                  dtype=np.float32).reshape(shape[:-1])
+                    for name in ("ks", "vs")]
+        return out
+
+    def import_chain(self, chain: dict, entries: Sequence[dict],
+                     ids: Sequence[int]) -> None:
+        """Write wire blocks ``entries`` into already-allocated device
+        blocks ``ids`` VERBATIM (one jitted batched write, donating the
+        pool like every other pool-writing dispatch). int8 payloads and
+        scale vectors land untouched — the one rule that keeps a
+        migrated quantized stream deterministic. Caller holds the lock
+        and has verified checksum + compatibility."""
+        if not ids:
+            return
+        n = len(ids)
+        if self._import_exe.get(n) is None:
+            if self.quantized:
+                def write_n(caches, scales, ks, vs, kss, vss, dst):
+                    return (KVCache(caches.k.at[:, dst].set(ks),
+                                    caches.v.at[:, dst].set(vs)),
+                            KVCache(scales.k.at[:, dst].set(kss),
+                                    scales.v.at[:, dst].set(vss)))
+
+                self._import_exe[n] = jax.jit(write_n,
+                                              donate_argnums=(0, 1))
+            else:
+                def write_n(caches, ks, vs, dst):
+                    return KVCache(caches.k.at[:, dst].set(ks),
+                                   caches.v.at[:, dst].set(vs))
+
+                self._import_exe[n] = jax.jit(write_n, donate_argnums=(0,))
+        per = [self._chain_block_arrays(chain, e) for e in entries]
+        # (n, L, bs, H, D) -> (L, n, bs, H, D): the pool's block axis.
+        stacked = [np.stack([p[i] for p in per]).swapaxes(0, 1)
+                   for i in range(len(per[0]))]
+        host = [jnp.asarray(a) for a in stacked]
+        if self._device is not None:
+            host = [jax.device_put(a, self._device) for a in host]
+        dst = jnp.asarray(np.asarray(ids, np.int32))
+        if self.quantized:
+            self.caches, self.scales = self._import_exe[n](
+                self.caches, self.scales, *host, dst)
+        else:
+            self.caches = self._import_exe[n](self.caches, *host, dst)
 
     def reset(self) -> None:
         """Post-device-failure recovery: the donated pool buffers may be

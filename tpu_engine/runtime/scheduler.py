@@ -117,12 +117,47 @@ class _Request:
     sink: Optional[object] = None
     t_submit: float = 0.0
     t_admit: float = 0.0
+    # Migration: `tag` names the row for export_row (the worker passes
+    # request_id); `migrate` holds an import chain snapshot — the row
+    # resumes mid-stream from another lane's exported state instead of
+    # prefilling (DESIGN.md "Live stream migration").
+    tag: Optional[str] = None
+    migrate: Optional[dict] = None
 
 
 class _StaleAdmission(RuntimeError):
     """A prefilled item's pool pins/gather predate a pool rebuild
     (device recovery): the single request fails, the scheduler keeps
     serving (no second recovery)."""
+
+
+class StreamMigratedAway(RuntimeError):
+    """A live row was exported to another lane (export_row): its local
+    stream ends HERE, and this exception resolves the local future. The
+    gateway's migration orchestrator splices the destination's
+    continuation; a client talking to the worker directly can resume
+    manually from ``tokens_emitted`` (the same contract as the PR 6
+    retryable error events — `migrated` marks the cause)."""
+
+    def __init__(self, message: str, tokens_emitted: int):
+        super().__init__(message)
+        self.retryable = True
+        self.migrated = True
+        self.tokens_emitted = int(tokens_emitted)
+
+
+class ImportRefused(RuntimeError):
+    """A migration import the destination could not honor — checksum
+    mismatch, incompatible pool geometry, or the pool cannot hold the
+    chain while keeping the live-row reserve free. RETRYABLE by
+    construction: the stream's journal falls back to the PR 6 replay
+    resume, which needs nothing from this lane. ``import_refused``
+    rides the terminal error event so the gateway attributes the
+    fallback to the MIGRATION (counter honesty), not to a lane fault
+    (no breaker penalty — the lane is healthy, the transfer wasn't)."""
+
+    retryable = True
+    import_refused = True
 
 
 class _PrefixCache:
@@ -372,6 +407,12 @@ class ContinuousGenerator:
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # Live stream migration: (tag, Future) export commands enqueued
+        # by worker threads, served by the decode loop between ticks —
+        # the quiesce point (no dispatch is in flight, the row's host
+        # state and pool blocks are mutually consistent). queue.Queue:
+        # its own lock, no registry entry needed.
+        self._migrate_q: "queue.Queue[tuple]" = queue.Queue()
         # Prefilled requests ready for row insertion: (req, row_caches,
         # first_tok, pb, L). The prefill thread fills this so admission work
         # (prompt forward + first-token sample, with its host sync) never
@@ -1118,7 +1159,7 @@ class ContinuousGenerator:
                repetition_penalty: float = 1.0, stop_tokens=None,
                min_p: float = 0.0, stream=None,
                deadline: Optional[Deadline] = None,
-               sink=None) -> Future:
+               sink=None, tag: Optional[str] = None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
@@ -1151,9 +1192,184 @@ class ContinuousGenerator:
                        clamp_top_k(top_k), rep_penalty=pens[0],
                        stop_tokens=stops[0], min_p=float(min_p),
                        stream=stream, deadline=deadline, sink=sink,
-                       t_submit=time.perf_counter())
+                       t_submit=time.perf_counter(),
+                       tag=str(tag) if tag is not None else None)
         self._queue.put(req)
         return req.future
+
+    # -- live stream migration (DESIGN.md "Live stream migration") -------------
+
+    def export_row(self, tag: str, timeout_s: float = 10.0) -> dict:
+        """Quiesce and export ONE live row by its submit() tag: snapshot
+        the stream state (emitted tokens, sampling key position, penalty
+        counts' inputs, stop ids, remaining budget) plus its KV block
+        chain (kv_blocks.export_chain — dtype-preserving, checksummed,
+        generation-stamped), then END the local stream with a
+        ``StreamMigratedAway`` terminal (retryable, ``migrated`` marked).
+        The command runs on the DECODE thread between ticks — the
+        quiesce point: no dispatch is in flight, so host row state and
+        pool bytes are mutually consistent without pausing the lane.
+        Thread-safe; returns ``{"ok": True, ...snapshot...}`` or
+        ``{"ok": False, "reason": ...}`` (mid-prefill rows, finished
+        rows, unknown tags — the caller falls back to the replay
+        resume, which these cases cost nothing extra)."""
+        if not self._paged:
+            return {"ok": False,
+                    "reason": "migration requires the paged KV cache"}
+        if not self._running:
+            return {"ok": False, "reason": "scheduler stopped"}
+        fut: Future = Future()
+        self._migrate_q.put((str(tag), fut))
+        try:
+            return fut.result(timeout=timeout_s)
+        except Exception as exc:
+            return {"ok": False, "reason": f"export failed: {exc}"}
+
+    def submit_import(self, snapshot: dict, stream=None,
+                      deadline: Optional[Deadline] = None, sink=None,
+                      tag: Optional[str] = None) -> Future:
+        """Adopt an exported row MID-STREAM: the chain's KV bytes enter
+        free blocks verbatim (radix re-adopt where this lane already
+        caches a prompt prefix) and decoding resumes at the exported
+        position — ZERO re-prefilled tokens. Byte-identity with an
+        uninterrupted run follows from the same positional-fold argument
+        as the PR 6 replay resume (sampling keys fold on absolute
+        position; penalties/stops recompute from prompt ⧺ emitted) plus
+        the verbatim KV bytes. Raises ValueError on a malformed snapshot
+        (wire 400, before any stream commits); recoverable refusals —
+        checksum, geometry, pool pressure — resolve the future with
+        ``ImportRefused`` (retryable → the gateway's replay fallback)."""
+        if not self._running:
+            raise RuntimeError("scheduler stopped")
+        if not self._paged:
+            raise ValueError("migration import requires the paged KV "
+                             "cache (kv_block_size > 0)")
+        if not isinstance(snapshot, dict):
+            raise ValueError("migration snapshot must be an object")
+        missing = [k for k in ("prompt", "emitted", "pos", "tok",
+                               "max_new", "chain") if k not in snapshot]
+        if missing:
+            raise ValueError(f"migration snapshot missing {missing}")
+        stop_list = [int(t) for t in snapshot.get("stop_tokens", ())]
+        pens, stops = expand_stopping_params(
+            1, float(snapshot.get("repetition_penalty", 1.0)),
+            [stop_list] if stop_list else None)
+        emitted = [int(t) for t in snapshot["emitted"]]
+        req = _Request(
+            [int(t) for t in snapshot["prompt"]],
+            int(snapshot["max_new"]), int(snapshot.get("eos_id", -1)),
+            float(snapshot.get("temperature", 0.0)),
+            int(snapshot.get("seed", 0)),
+            float(snapshot.get("top_p", 1.0)),
+            clamp_top_k(snapshot.get("top_k", 0)),
+            rep_penalty=pens[0], stop_tokens=stops[0],
+            min_p=float(snapshot.get("min_p", 0.0)),
+            stream=stream, deadline=deadline, sink=sink,
+            t_submit=time.perf_counter(),
+            tag=str(tag) if tag is not None else None)
+        req.migrate = snapshot
+        # Tokens the source already delivered: the continuation stream
+        # pushes only what comes AFTER them.
+        req.streamed = min(int(snapshot.get("streamed", len(emitted))),
+                           len(emitted))
+        self._queue.put(req)
+        return req.future
+
+    def _migration_stats(self) -> dict:
+        """The additive ``migration`` stats block, created on first
+        touch (defaults-off /stats and /health bytes stay identical).
+        All bumps hold ``_stats_lock``: exports/imports land on the
+        decode thread but checksum rejections on the prefill thread."""
+        m = self._stats.get("migration")
+        if m is None:
+            m = self._stats["migration"] = {
+                "exported_rows": 0, "exported_tokens": 0,
+                "imported_rows": 0, "imported_tokens": 0,
+                "imported_chain_tokens": 0, "import_rejected": 0,
+                "export_refused": 0,
+            }
+        return m
+
+    def _bump_migration(self, field: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._migration_stats()[field] += n
+
+    def _serve_exports(self) -> None:
+        """Drain pending export commands — called by the decode loop at
+        the top of every iteration (the tick boundary)."""
+        while True:
+            try:
+                tag, fut = self._migrate_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                result = self._do_export(tag)
+            except Exception as exc:  # never kill the loop over an export
+                result = {"ok": False, "reason": f"export failed: {exc}"}
+            if not fut.done():
+                fut.set_result(result)
+
+    def _do_export(self, tag: str) -> dict:
+        """Decode-thread half of export_row (the row is quiescent by
+        construction here). On success the row is GONE from this lane:
+        stream flushed + ended with StreamMigratedAway, blocks released
+        (radix-shared prefix blocks survive in the tree), slot freed."""
+        row = next((r for r, req in enumerate(self._row_req)
+                    if req is not None and req.tag == tag), None)
+        if row is None:
+            return {"ok": False, "reason": "no live row with this tag"}
+        req = self._row_req[row]
+        if self._mixed and self._prefilling[row]:
+            # Nothing emitted yet — a replay resume re-prefills exactly
+            # what an import would have to ship; refusing is free.
+            self._bump_migration("export_refused")
+            return {"ok": False, "reason": "row is mid-prefill"}
+        if self._done[row]:
+            self._bump_migration("export_refused")
+            return {"ok": False, "reason": "row already finishing"}
+        pool = self._pool
+        bs = pool.block_size
+        pos = int(self._pos[row])
+        n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
+        with pool.lock:
+            chain = pool.export_chain(self._row_blocks[row][:n_chain])
+        # The bucket-truncated prompt is what the row's 0-aligned
+        # columns actually hold (same formula as admission).
+        pb = next((b for b in self._prompt_buckets
+                   if b >= len(req.prompt)), self._prompt_buckets[-1])
+        prompt = req.prompt[-pb:]
+        emitted = list(self._row_emitted[row])
+        # Flush everything visible BEFORE the terminal, so the relayed
+        # stream and the snapshot agree on the resume offset.
+        self._push_stream(row, req)
+        snap = {
+            "ok": True, "tag": tag,
+            "prompt": [int(t) for t in prompt],
+            "emitted": [int(t) for t in emitted],
+            "streamed": int(req.streamed),
+            "pos": pos, "tok": int(self._tok[row]),
+            "max_new": int(req.max_new), "eos_id": int(req.eos_id),
+            "temperature": float(req.temperature), "seed": int(req.seed),
+            "top_p": float(req.top_p), "top_k": int(req.top_k),
+            "min_p": float(req.min_p),
+            "repetition_penalty": float(req.rep_penalty),
+            "stop_tokens": [int(t) for t in req.stop_tokens],
+            "chain": chain,
+        }
+        exc = StreamMigratedAway(
+            f"stream migrated off this lane after {req.streamed} tokens",
+            tokens_emitted=req.streamed)
+        self._fail_request(req, exc)
+        self._row_req[row] = None
+        self._row_emitted[row] = []
+        self._done[row] = True
+        self._release_row_blocks(row)
+        self._clear_mixed_row(row)
+        with self._stats_lock:
+            m = self._migration_stats()
+            m["exported_rows"] += 1
+            m["exported_tokens"] += len(emitted)
+        return snap
 
     def generate(self, prompts, max_new_tokens: int = 32, eos_id: int = -1,
                  temperature=0.0, seed=0, top_p=1.0, top_k=0,
@@ -1243,6 +1459,10 @@ class ContinuousGenerator:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = \
                 len(self._pending)  # lint: lockfree-ok GIL-safe deque len
+        if "migration" in self._stats:
+            # Snapshot, not the live nested dict (same rule as "mixed").
+            with self._stats_lock:
+                out["migration"] = dict(self._stats["migration"])
         # Additive, present only while a brownout degradation is engaged
         # (defaults-off stats bytes unchanged).
         if (self._bo_budget_frac < 1.0 or self._bo_spec_off
@@ -1569,8 +1789,71 @@ class ContinuousGenerator:
             row_counts = token_counts([prompt], 1, self.cfg.vocab)
         return (req, None, None, pb, L, row_counts, matched, prompt, gen)
 
+    def _run_prefill_import(self, req: _Request):
+        """Import-side batch formation (prefill thread): the checksum
+        and geometry gates run here — off the decode thread, before any
+        block is allocated — then the radix lookup: a prompt prefix this
+        lane already caches is RE-ADOPTED (pinned; demoted matches swap
+        in through the existing promotion machinery) and only the rest
+        of the chain ships bytes at admission. No prefill dispatch ever
+        runs for an import — that is the whole point. Returns the same
+        9-tuple shape as the other paged formation paths so every
+        downstream path (deadline drop, discard, shutdown) works
+        unchanged."""
+        pool = self._pool
+        snap = req.migrate
+        chain = snap.get("chain")
+        reason = None
+        if not isinstance(chain, dict) or "blocks" not in chain:
+            reason = "snapshot carries no block chain"
+        if reason is None:
+            reason = pool.chain_compatible(chain)
+        if reason is None and not pool.verify_chain(chain):
+            reason = "chain checksum mismatch"
+        prompt = req.prompt
+        bs = pool.block_size
+        pos = int(snap["pos"])
+        n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
+        if reason is None and pos > self.max_seq - 1:
+            reason = (f"row position {pos} exceeds this lane's max_seq "
+                      f"{self.max_seq}")
+        if reason is None and len(chain["blocks"]) < n_chain:
+            reason = (f"chain holds {len(chain['blocks'])} blocks but "
+                      f"the row spans {n_chain}")
+        if reason is not None:
+            self._bump_migration("import_rejected")
+            raise ImportRefused(f"migration import rejected: {reason}")
+        matched: List[int] = []
+        swapped = 0
+        t0 = time.perf_counter()
+        with pool.lock:
+            gen = pool.generation
+            if self._prefix_sharing:
+                si0 = pool.swap_ins
+                matched = pool.radix.lookup(
+                    prompt, promote_reserve=self._swap_reserve())
+                swapped = pool.swap_ins - si0
+                # The tree indexes full PROMPT blocks only, so a match
+                # can never extend past the chain — clamp as a backstop
+                # (extra pins released, never leaked).
+                if len(matched) > n_chain:
+                    pool.release_many(matched[n_chain:])
+                    matched = matched[:n_chain]
+        self._record_swap_in(req, swapped, t0)
+        row_counts = None
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            # Penalty counts replay from the FULL context — prompt plus
+            # every emitted token — exactly what the source's counts
+            # held (each sampled token joined its row's counts once).
+            ctx = prompt + [int(t) for t in snap["emitted"]]
+            row_counts = token_counts([ctx], 1, self.cfg.vocab)
+        return (req, None, None, n_chain * bs, len(prompt), row_counts,
+                matched, prompt, gen)
+
     def _run_prefill(self, req: _Request):
         if self._paged:
+            if req.migrate is not None:
+                return self._run_prefill_import(req)
             if self._mixed:
                 return self._run_prefill_mixed(req)
             return self._run_prefill_paged(req)
@@ -1782,6 +2065,97 @@ class ContinuousGenerator:
         self._done[row] = False
         self._stats["admitted"] += 1
 
+    def _admit_import(self, item, row: int) -> None:
+        """Decode-thread half of a migration import: allocate blocks for
+        the chain plus the decode horizon (matched prefix blocks enter
+        pinned), write the wire bytes VERBATIM into the fresh blocks
+        (one batched donation under the pool lock), index the prompt in
+        the radix tree, and restore the row's exact host state — pos,
+        pending token, sampling vectors, emitted list. The next tick
+        decodes it like any other row. Raises PoolExhausted when the
+        pool cannot hold the chain while keeping the live-row reserve
+        free (nothing consumed; the caller fails the import RETRYABLE —
+        imports are never parked, their transfer window is bounded)."""
+        (req, _rc, _ft, _pbx, L, row_counts, matched, prompt, gen) = item
+        pool = self._pool
+        bs = pool.block_size
+        snap = req.migrate
+        chain = snap["chain"]
+        emitted = [int(t) for t in snap["emitted"]]
+        pos = min(int(snap["pos"]), self.max_seq - 1)
+        n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
+        m = len(matched)
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        with pool.lock:
+            if gen != pool.generation:
+                raise _StaleAdmission(
+                    "kv pool was rebuilt during this import")
+            cols = min(pos + self._decode_horizon + 1, self.max_seq)
+            need = max(n_chain, (cols - 1) // bs + 1)
+            # The live-row reserve rule: adopting a migrated stream must
+            # never starve rows already decoding here (same rank order
+            # as host-tier promotion — a refusal falls back to the
+            # replay resume, which admits like any new request).
+            reserve = self._promote_reserve()
+            if not pool.can_alloc(need - m + reserve):
+                raise PoolExhausted(
+                    f"import needs {need - m} blocks + {reserve} "
+                    f"reserve; {pool.free_blocks} free of "
+                    f"{pool.num_blocks - 1}")
+            fresh = pool.alloc(need - m)
+            table = list(matched) + fresh
+            try:
+                wid, copied = pool.ensure_writable(table[pos // bs])
+            except PoolExhausted:
+                pool.release_many(fresh)
+                raise
+            if copied:
+                table[pos // bs] = wid
+            # Verbatim adoption of the unmatched chain tail: int8 +
+            # scale or bf16 bytes land exactly as exported — zero
+            # re-prefilled tokens, zero requantization.
+            pool.import_chain(chain, chain["blocks"][m:n_chain],
+                              fresh[:n_chain - m])
+            if self._prefix_sharing:
+                pool.radix.insert(prompt, table)
+            pool.prefix_hit_tokens += m * bs
+        self._count_admission_dispatch()
+        self._tables[row, :] = 0
+        self._tables[row, :len(table)] = table
+        self._row_blocks[row] = table
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("kv_import", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           blocks=len(table), shared_blocks=m,
+                           imported_blocks=n_chain - m)
+        if row_counts is not None:
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._set_row_params(req, row, pos=pos, start=0)
+        self._tok[row] = int(snap["tok"])
+        self._done[row] = False
+        self._row_emitted[row] = emitted
+        if self._mixed:
+            self._prefilling[row] = False
+            self._row_prompt[row] = None
+            self._row_L[row] = L
+            self._row_w0[row] = 0
+        if self._mixed or self._spec:
+            self._row_prompt_toks[row] = prompt
+        # No TTFT sample (the first token happened on the source lane);
+        # ITL resumes from now — the migration gap shows up client-side.
+        self._row_last_emit[row] = time.perf_counter()
+        self._stats["admitted"] += 1
+        with self._stats_lock:
+            mig = self._migration_stats()
+            mig["imported_rows"] += 1
+            mig["imported_tokens"] += len(emitted)
+            mig["imported_chain_tokens"] += (n_chain - m) * bs
+        self._push_stream(row, req)
+        self._maybe_complete(row)
+
     def _release_row_blocks(self, row: int) -> None:
         """Return a freed row's block references to the pool (blocks the
         radix tree also references survive at refcount >= 1)."""
@@ -1840,6 +2214,9 @@ class ContinuousGenerator:
         """Decode-thread half of admission: splice the prefilled KV block
         into the shared cache and initialise the row's host-side state."""
         if self._paged:
+            if item[0].migrate is not None:
+                self._admit_import(item, row)
+                return
             if self._mixed:
                 self._admit_mixed(item, row)
             else:
@@ -2032,6 +2409,15 @@ class ContinuousGenerator:
                 if item is not None:
                     self._discard_item(item)
                     self._fail_request(item[0], exc)
+            # Pending export commands: answer, never strand the caller.
+            while True:
+                try:
+                    _tag, fut = self._migrate_q.get_nowait()
+                except queue.Empty:
+                    break
+                if not fut.done():
+                    fut.set_result({"ok": False,
+                                    "reason": "scheduler stopped"})
 
     def _ensure_capacity_paged(self) -> None:
         """Pre-chunk block growth: every live row must own blocks through
@@ -2523,6 +2909,10 @@ class ContinuousGenerator:
             # space (an admitted row must never be starved mid-stream by
             # a newcomer).
             if self._paged:
+                # Export commands run FIRST: between ticks the row is
+                # quiescent, and an export ahead of admissions can never
+                # observe a half-admitted batch.
+                self._serve_exports()
                 self._ensure_capacity_paged()
             # Admit as many prefilled requests as there are free rows —
             # deferred (pool-pressure) admissions first, in arrival
@@ -2559,6 +2949,18 @@ class ContinuousGenerator:
                         self._pending.popleft()
                     admitted_any = True
                 except PoolExhausted as exc:
+                    if req.migrate is not None:
+                        # Imports are never parked: their transfer runs
+                        # under a bounded timeout, and the replay
+                        # fallback needs nothing from this lane. Fail
+                        # RETRYABLE, release the radix pins, move on.
+                        if from_pending:
+                            self._pending.popleft()
+                        self._discard_item(item)
+                        self._bump_migration("import_rejected")
+                        self._fail_request(req, ImportRefused(
+                            f"migration import refused: {exc}"))
+                        continue
                     # No blocks even after eviction. A request larger
                     # than the whole pool can never admit — fail it;
                     # otherwise park it until completions free blocks.

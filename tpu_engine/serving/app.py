@@ -84,6 +84,11 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
                      "draining": worker.draining}
 
     server.route("POST", "/admin/drain", _admin_drain)
+    # Live stream migration (DESIGN.md): export one live stream's row —
+    # the gateway's migrate-mode drain drives this per stream; the
+    # continuation rides /generate/stream with a `migrate_import` body.
+    server.route("POST", "/admin/migrate",
+                 lambda body: (200, worker.handle_migrate_export(body or {})))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -372,6 +377,13 @@ def serve_combined(
             return 404, {"error": f"unknown node '{node}'"}
         for w in targets:
             if action == "drain":
+                if body.get("remove") and gateway.config.migrate_streams:
+                    # Migrate-mode graceful removal: remove_worker owns
+                    # the whole ladder — bounded drain, per-stream KV
+                    # handoff, then ring removal (DESIGN.md "Live
+                    # stream migration").
+                    gateway.remove_worker(w.node_id, drain=True)
+                    continue
                 w.drain()
                 if body.get("remove"):
                     # Already drained above — plain ring removal (the

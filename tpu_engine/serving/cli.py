@@ -212,6 +212,24 @@ def main(argv=None) -> int:
                                  "eject lanes from routing after 3 "
                                  "consecutive failures, restoring them on "
                                  "recovery (seconds; 0 = off)")
+        parser.add_argument("--migrate-streams", action="store_true",
+                            help="live stream migration: graceful removal "
+                                 "(remove_worker drain) EXPORTS each "
+                                 "in-flight /generate/stream's KV block "
+                                 "chain + state off the draining lane and "
+                                 "resumes it mid-stream on another lane "
+                                 "with zero re-prefilled tokens (any "
+                                 "failure falls back to the replay "
+                                 "resume; implies the stream journal)")
+        parser.add_argument("--migrate-timeout", type=float, default=None,
+                            help="per-stream migration transfer budget in "
+                                 "seconds, clamped to the stream's "
+                                 "original deadline (default 30)")
+        parser.add_argument("--drain-timeout", type=float, default=None,
+                            help="graceful-drain acknowledgment bound in "
+                                 "seconds: a wedged lane's drain call is "
+                                 "abandoned (counted) and removal "
+                                 "proceeds (default 10)")
         parser.add_argument("--retry-budget", type=float, default=None,
                             help="global retry budget: failover retries "
                                  "(stream resumes included) capped at this "
@@ -260,6 +278,12 @@ def main(argv=None) -> int:
             gw_kw["tenant_rate"] = args.tenant_rate
         if args.retry_budget is not None:
             gw_kw["retry_budget_ratio"] = args.retry_budget
+        if args.migrate_streams:
+            gw_kw["migrate_streams"] = True
+        if args.migrate_timeout is not None:
+            gw_kw["migrate_timeout_s"] = args.migrate_timeout
+        if args.drain_timeout is not None:
+            gw_kw["drain_timeout_s"] = args.drain_timeout
         if args.prefix_affinity:
             gw_kw["prefix_affinity"] = True
         if args.affinity_block_size is not None:
@@ -407,6 +431,23 @@ def main(argv=None) -> int:
                                  "ring lane (prompt + emitted tokens, "
                                  "budget offset), splicing one seamless "
                                  "byte-identical stream")
+        parser.add_argument("--migrate-streams", action="store_true",
+                            help="live stream migration: graceful lane "
+                                 "removal exports each in-flight stream's "
+                                 "KV block chain + state and resumes it "
+                                 "mid-stream on another lane with zero "
+                                 "re-prefilled tokens (failures fall back "
+                                 "to the replay resume; implies the "
+                                 "stream journal)")
+        parser.add_argument("--migrate-timeout", type=float, default=None,
+                            help="per-stream migration transfer budget in "
+                                 "seconds, clamped to the stream's "
+                                 "original deadline (default 30)")
+        parser.add_argument("--drain-timeout", type=float, default=None,
+                            help="graceful-drain acknowledgment bound in "
+                                 "seconds: a wedged lane's drain call is "
+                                 "abandoned (counted) and removal "
+                                 "proceeds (default 10)")
         parser.add_argument("--health-probe-interval", type=float,
                             default=None,
                             help="proactive lane health prober: probe each "
@@ -576,6 +617,12 @@ def main(argv=None) -> int:
             gw_kw["hedge_min_ms"] = args.hedge_min_ms
         if args.failover_streams:
             gw_kw["failover_streams"] = True
+        if args.migrate_streams:
+            gw_kw["migrate_streams"] = True
+        if args.migrate_timeout is not None:
+            gw_kw["migrate_timeout_s"] = args.migrate_timeout
+        if args.drain_timeout is not None:
+            gw_kw["drain_timeout_s"] = args.drain_timeout
         if args.health_probe_interval is not None:
             gw_kw["health_probe_interval_s"] = args.health_probe_interval
         if args.overload_control:

@@ -96,6 +96,21 @@ class LocalWorkerClient:
         return {"ok": True, "node_id": self.worker.node_id,
                 "draining": True}
 
+    def migrate(self, payload: dict, timeout_s: Optional[float] = None) -> dict:
+        """Export one live stream's row for migration (in-process: the
+        worker's quiesce-and-snapshot runs directly; ``timeout_s`` rides
+        in the payload for the scheduler's export wait)."""
+        if timeout_s is not None:
+            payload = {**payload, "timeout_s": timeout_s}
+        try:
+            return self.worker.handle_migrate_export(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except ShedError:
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def health(self) -> dict:
         return self.worker.get_health()
 
@@ -366,6 +381,18 @@ class HttpWorkerClient:
 
     def drain(self) -> dict:
         return self._request("POST", "/admin/drain", {"action": "drain"})
+
+    def migrate(self, payload: dict,
+                timeout_s: Optional[float] = None) -> dict:
+        """POST /admin/migrate: export one live stream's row. The chain
+        payload can be large and the export waits for a tick boundary,
+        so the socket timeout is the caller's per-transfer budget (the
+        generation timeout when none given)."""
+        if timeout_s is not None:
+            payload = {**payload, "timeout_s": max(0.5, timeout_s - 0.5)}
+        return self._request("POST", "/admin/migrate", payload,
+                             timeout_s=(timeout_s if timeout_s is not None
+                                        else self._gen_timeout))
 
     def health(self) -> dict:
         return self._request("GET", "/health")

@@ -68,6 +68,7 @@ from tpu_engine.serving.resilience import (
     AffinityCounters,
     FailoverCounters,
     LatencyTracker,
+    MigrationCounters,
     ProbeStateMachine,
     ResilienceCounters,
     RetryBudget,
@@ -119,6 +120,109 @@ def _parse_sse(frame: bytes) -> Optional[dict]:
     except Exception:
         return None
     return evt if isinstance(evt, dict) else None
+
+
+class _StreamRecord:
+    """One journaled /generate/stream's migration state: which lane
+    currently serves it, and the one-shot handoff slot the drain
+    orchestrator fills (continuation iterator + destination lane) for
+    the RELAY thread to splice. The handoff is an exchange with three
+    terminal states — offered, failed, abandoned — resolved exactly
+    once under ``_hlock``: an orchestrator whose offer loses the race
+    against the relay's timeout must dispose of its continuation
+    iterator itself (the relay has already moved on to the replay
+    fallback)."""
+
+    __slots__ = ("request_id", "payload", "deadline", "ctx", "lane",
+                 "_hlock", "_ready", "_it", "_dest", "_error",
+                 "_abandoned")
+
+    def __init__(self, request_id: str, payload: dict, deadline, ctx,
+                 lane: Optional[str]):
+        self.request_id = request_id
+        self.payload = payload
+        self.deadline = deadline
+        self.ctx = ctx
+        self.lane = lane
+        self._hlock = threading.Lock()
+        self._ready = threading.Event()
+        self._it = None
+        self._dest: Optional[str] = None
+        self._error: Optional[str] = None
+        self._abandoned = False
+
+    def offer(self, it, dest: str) -> bool:
+        """Orchestrator: hand the continuation to the relay. False when
+        the relay already abandoned the wait — the caller must dispose
+        of ``it``."""
+        with self._hlock:
+            if self._abandoned or self._ready.is_set():
+                return False
+            self._it, self._dest = it, dest
+            self._ready.set()
+            return True
+
+    def fail(self, reason: str) -> None:
+        """Orchestrator: no continuation is coming — the relay falls
+        back to the replay resume."""
+        with self._hlock:
+            if not self._abandoned and not self._ready.is_set():
+                self._error = reason
+                self._ready.set()
+
+    def await_handoff(self, timeout_s: float):
+        """Relay: block for the orchestrator's verdict. Returns
+        (iterator, dest_lane) on success, None on failure or timeout —
+        after None the slot is ABANDONED (a late offer is refused) and
+        re-armed for a possible later migration. An offer that raced in
+        between the Event timeout and this lock acquisition still WINS
+        (the continuation exists — dropping it here would leak a live
+        iterator and duplicate the decode on the replay lane)."""
+        ok = self._ready.wait(timeout=max(0.0, timeout_s))
+        with self._hlock:
+            if self._it is not None:
+                # Offered — possibly a hair after the wait timed out,
+                # but before the relay could abandon: take it.
+                out = (self._it, self._dest)
+                self._abandoned = False
+            else:
+                out = None
+                # Timed out with nothing offered: refuse late offers
+                # (the orchestrator disposes). A FAILED handoff is
+                # consumed, not abandoned.
+                self._abandoned = not ok and self._error is None
+            # Re-arm: this stream may be migrated again later.
+            self._ready.clear()
+            self._it = self._dest = self._error = None
+            return out
+
+    def rearm(self) -> None:
+        """Relay: clear a stale abandonment before the next migration
+        window (called when a new segment starts relaying)."""
+        with self._hlock:
+            if not self._ready.is_set():
+                self._abandoned = False
+
+    def pending_offer(self) -> bool:
+        """True while an OFFERED continuation sits unconsumed — the
+        drain orchestrator waits these out before returning (the caller
+        is about to kill the source process; a relay that has not yet
+        taken its handoff would read a dead socket first and replay)."""
+        with self._hlock:
+            return self._ready.is_set() and self._it is not None
+
+    def take_unconsumed(self):
+        """Stream teardown: pop an offered-but-never-consumed
+        continuation (the relay ended another way) so the caller can
+        dispose of it — an orphan iterator would pin the destination's
+        admission depth."""
+        with self._hlock:
+            if self._ready.is_set() and self._it is not None:
+                it = self._it
+                self._it = self._dest = self._error = None
+                self._ready.clear()
+                return it
+            return None
 
 
 class _RouteTrace:
@@ -191,6 +295,12 @@ class Gateway:
         # "Crash-tolerant streaming"): stream-resume and prober decisions
         # counted here, lanes the prober ejected excluded from dispatch.
         self.failover = FailoverCounters()
+        # Live stream migration (DESIGN.md "Live stream migration"):
+        # per-stream KV handoff on migrate-mode drain. Decisions counted
+        # here (each with a `migration` marker span); the active-stream
+        # registry the drain orchestrator walks lives under self._lock.
+        self.migration = MigrationCounters()
+        self._streams: Dict[str, _StreamRecord] = {}
         # Prefix-affinity routing (DESIGN.md "Prefix-affinity routing"):
         # decisions counted here; per-lane assignment totals and the
         # recent-dispatch window (imbalance signal) under self._lock.
@@ -360,16 +470,32 @@ class Gateway:
         """Remove a lane from every ring. ``drain=True`` = graceful
         (lame-duck) removal: the lane refuses NEW admissions first — so a
         request racing the ring update sheds with 503 instead of failing —
-        while in-flight work runs to completion off-ring. The default
+        while in-flight work runs to completion off-ring. The drain call
+        is BOUNDED (``drain_timeout_s``): a wedged lane's acknowledgment
+        must never hang a membership change — the failure is counted
+        (``drain_failures``) and removal proceeds. With
+        ``migrate_streams`` on, every journaled in-flight stream on the
+        lane is then EXPORTED and resumed mid-stream elsewhere (zero
+        re-prefilled tokens) before the lane leaves the rings; any
+        per-stream failure falls back to the replay resume. The default
         stays the abrupt removal existing callers expect."""
         if drain:
             with self._lock:
                 client = self._clients.get(name)
             if client is not None and hasattr(client, "drain"):
+                fut = self._pool().submit(client.drain)
                 try:
-                    client.drain()
-                except Exception:
-                    pass  # unreachable lane: plain removal is all we have
+                    fut.result(timeout=self.config.drain_timeout_s)
+                except Exception as exc:
+                    # Wedged or unreachable lane: count it, drop the
+                    # marker span, and carry on — plain removal is all
+                    # we have (the abandoned call finishes or dies on
+                    # its pool thread).
+                    self._migration_count(None, "drain_failures",
+                                          lane=name,
+                                          error=str(exc)[:120])
+            if self.config.migrate_streams:
+                self._migrate_lane_streams(name, client)
         self._ring.remove_node(name)
         with self._lock:
             rings = dict(self._model_rings)
@@ -433,11 +559,14 @@ class Gateway:
         prefix), splicing the continuation so the client sees one
         seamless, byte-identical stream — the request is bound to the
         fleet, not to the lane that happened to start it."""
-        if not self.config.failover_streams:
+        if not (self.config.failover_streams
+                or self.config.migrate_streams):
             info: dict = {}
             it = self._route(payload, op="generate_stream",
                              out_info=info)
             return self._breaker_watched(it, info.get("lane"))
+        # migrate_streams implies the journal: the replay resume IS the
+        # migration fallback ladder's last rung (MIGRATION.md).
         return self._stream_with_failover(payload)
 
     def _breaker_watched(self, it, lane: Optional[str]):
@@ -549,6 +678,17 @@ class Gateway:
         # shed/400/no-workers raise here, before the 200 SSE commits.
         first = self._route(payload, op="generate_stream", out_info=info)
         cfg = self.config
+        # Migrate mode: register the stream so a migrate-mode drain can
+        # find it (which lane serves it, its payload and deadline) and
+        # hand the relay a continuation. Registered only AFTER the first
+        # segment admitted — a stream that never started has nothing to
+        # migrate.
+        record: Optional[_StreamRecord] = None
+        if cfg.migrate_streams:
+            record = _StreamRecord(request_id, payload, deadline, ctx,
+                                   info.get("lane"))
+            with self._lock:
+                self._streams[request_id] = record
 
         def terminal_error(reason: str, retryable: bool,
                            emitted: List[int]) -> bytes:
@@ -559,7 +699,7 @@ class Gateway:
                 "tokens_emitted": len(emitted),
                 "tokens": list(emitted)})
 
-        def spliced():
+        def spliced_inner():
             emitted: List[int] = []
             it = first
             lane = info.get("lane")
@@ -570,6 +710,7 @@ class Gateway:
                 # expiries don't (the healthy-lane rule).
                 failure: Optional[tuple] = None
                 finished = False
+                migrated_evt = False
                 try:
                     try:
                         for frame in it:
@@ -595,11 +736,31 @@ class Gateway:
                                 # (absent = not retryable — never resume
                                 # blind); a `shed` marker means a HEALTHY
                                 # lane refused (drain/overload) — resume
-                                # without a breaker penalty.
+                                # without a breaker penalty. A `migrated`
+                                # marker means the row was EXPORTED: the
+                                # drain orchestrator is (or was) moving
+                                # it — await the handoff below instead
+                                # of replay-resuming blind.
                                 retr = bool(evt.get("retryable", False))
+                                migrated_evt = bool(evt.get("migrated"))
+                                if (evt.get("import_refused")
+                                        and record is not None):
+                                    # The spliced continuation's import
+                                    # was refused post-dispatch
+                                    # (checksum / geometry / pool
+                                    # pressure): attribute the replay
+                                    # fallback to the MIGRATION — the
+                                    # destination lane is healthy.
+                                    self._migration_count(
+                                        record, "migration_fallbacks",
+                                        lane=lane,
+                                        cause="import_refused")
                                 failure = (str(evt.get("error")), retr,
-                                           retr and not evt.get("shed",
-                                                                False))
+                                           retr
+                                           and not evt.get("shed", False)
+                                           and not migrated_evt
+                                           and not evt.get(
+                                               "import_refused", False))
                             else:
                                 # Clean terminal: rewrite the summary to
                                 # the FULL spliced stream (a resumed
@@ -650,6 +811,34 @@ class Gateway:
                 if finished:
                     return
                 reason, retryable, lane_fault = failure
+                if migrated_evt and record is not None:
+                    # The row was EXPORTED off its lane: await the drain
+                    # orchestrator's continuation (bounded by the
+                    # transfer budget AND the stream's original
+                    # deadline) and splice it — the client sees one
+                    # seamless stream with zero re-prefilled tokens.
+                    # Any failure — export refused, destination full or
+                    # dead, checksum mismatch, timeout — falls through
+                    # to the replay resume below: the fallback ladder's
+                    # last rung needs nothing from either side.
+                    wait_s = cfg.migrate_timeout_s + 5.0
+                    if deadline is not None:
+                        wait_s = min(wait_s,
+                                     max(0.0, deadline.remaining_s()))
+                    handoff = record.await_handoff(wait_s)
+                    if handoff is not None:
+                        it, new_lane = handoff
+                        lane = new_lane
+                        record.lane = new_lane
+                        self._migration_count(record, "streams_migrated",
+                                              lane=new_lane)
+                        self.migration.bump("tokens_migrated",
+                                            len(emitted))
+                        continue
+                    self._migration_count(record, "migration_fallbacks",
+                                          lane=lane)
+                    reason = f"migration fell back to replay ({reason})"
+                    retryable = True
                 self.failover.bump("stream_failures")
                 if lane_fault:
                     # Admission recorded a breaker SUCCESS for this lane;
@@ -711,7 +900,220 @@ class Gateway:
                 lane = nxt_info.get("lane")
                 self._resume_span(request_id, ctx, resumes, replayed,
                                   "ok", lane)
+                if record is not None:
+                    # The replay segment owns the stream now: a LATER
+                    # migrate-mode drain of its lane must find it, and
+                    # a stale abandoned handoff must not refuse it.
+                    record.lane = lane
+                    record.rearm()
+
+        def spliced():
+            try:
+                yield from spliced_inner()
+            finally:
+                if record is not None:
+                    with self._lock:
+                        if self._streams.get(request_id) is record:
+                            del self._streams[request_id]
+                    # An offered continuation the relay never consumed
+                    # (the stream ended another way — e.g. the source's
+                    # terminal frames were lost to a kill before the
+                    # migrated marker arrived): dispose of it, or the
+                    # destination's admission depth stays pinned.
+                    orphan = record.take_unconsumed()
+                    if orphan is not None:
+                        self._dispose_iter(orphan)
         return spliced()
+
+    # -- live stream migration (DESIGN.md "Live stream migration") ------------
+
+    def _migration_count(self, record: Optional[_StreamRecord],
+                         decision: str, **attrs) -> None:
+        """Bump a migration counter AND drop a zero-duration
+        ``migration`` marker span — parented under the stream's request
+        trace when there is one (same counters==spans discipline as the
+        resilience/failover/affinity markers; fault_injection --migrate
+        asserts the two agree)."""
+        self.migration.bump(decision)
+        if record is not None:
+            child = record.ctx.child()
+            rid, parent = record.request_id, record.ctx.span_id
+        else:
+            child = TraceContext.root(f"migration:{decision}").child()
+            rid, parent = "migration", None
+        self.tracer.record(
+            rid, "migration", "gateway", 0,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=parent, start_ts=time.time(),
+            attrs={"decision": decision, **attrs})
+
+    def active_streams(self) -> Dict[str, str]:
+        """{request_id: serving lane} for every journaled stream the
+        migrate registry currently tracks (tests + diagnostics)."""
+        with self._lock:
+            return {rid: rec.lane or "?"
+                    for rid, rec in self._streams.items()}
+
+    def _migrate_lane_streams(self, name: str, client) -> None:
+        """Export every journaled stream the draining lane serves and
+        resume each on another lane — concurrently, each under the
+        stream's ORIGINAL deadline with a per-transfer timeout. Returns
+        once every migration settled (or the overall bound passed);
+        per-stream failures have already armed the replay fallback."""
+        with self._lock:
+            records = [r for r in self._streams.values()
+                       if r.lane == name]
+        if not records:
+            return
+        futs = [self._pool().submit(self._migrate_stream, rec, name,
+                                    client)
+                for rec in records]
+        concurrent.futures.wait(
+            futs, timeout=self.config.migrate_timeout_s * 2.0 + 10.0)
+        # Don't return while a relay has not yet TAKEN its offered
+        # continuation: the caller's next step is typically killing the
+        # source process (rolling restart), and an unconsumed handoff
+        # would lose that race — the relay would hit the dead socket
+        # before the migrated terminal and replay instead of splicing.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = [r for r in records
+                        if self._streams.get(r.request_id) is r]
+            if not any(r.pending_offer() for r in live):
+                break
+            time.sleep(0.05)
+
+    def _migrate_stream(self, record: _StreamRecord, source: str,
+                        client) -> None:
+        """One stream's migration: export off the source (ends the
+        source's stream with a ``migrated`` terminal), pick the
+        destination by the affinity fingerprint, dispatch the
+        continuation, and offer it to the relay thread. EVERY failure
+        resolves the handoff as failed — the relay's replay resume
+        completes the stream from the journal, which needs nothing from
+        either side (both sides' partial state is self-cleaning: export
+        releases the source row; a refused import releases its pins and
+        fresh blocks before raising)."""
+        rid = record.request_id
+        self._migration_count(record, "migrations_attempted", lane=source)
+        deadline = record.deadline
+        budget = self.config.migrate_timeout_s
+        if deadline is not None:
+            budget = min(budget, max(0.1, deadline.remaining_s()))
+        export = None
+        try:
+            reason = "source lane has no migrate surface"
+            if client is not None and hasattr(client, "migrate"):
+                fut = self._pool().submit(
+                    client.migrate, {"request_id": rid}, budget)
+                resp = fut.result(timeout=budget + 1.0)
+                if resp.get("ok"):
+                    export = {k: v for k, v in resp.items()
+                              if k not in ("ok", "node_id")}
+                else:
+                    reason = str(resp.get("reason", "export refused"))
+        except Exception as exc:
+            reason = f"export failed: {exc}"
+        if export is None:
+            # Includes the benign cases (stream just finished, row still
+            # prefilling): the relay either never sees a migrated
+            # terminal, or replays — both complete the stream.
+            self._migration_count(record, "export_refusals", lane=source,
+                                  reason=reason[:120])
+            record.fail(reason)
+            return
+        try:
+            dest = self._pick_migration_dest(record, source)
+            if dest is None:
+                self._migration_count(record, "destination_unavailable",
+                                      lane=source)
+                record.fail("no destination lane available")
+                return
+            cont = {**record.payload, "request_id": rid,
+                    "migrate_import": export}
+            if deadline is not None:
+                cont["deadline_ms"] = max(0.0, deadline.remaining_ms())
+            result = self._try_node(dest, cont, op="generate_stream")
+            if not _ok(result):
+                self._migration_count(record, "import_dispatch_failed",
+                                      lane=dest)
+                record.fail(f"destination {dest} refused the "
+                            f"continuation")
+                return
+            if not record.offer(result, dest):
+                # The relay timed out and owns the replay fallback now:
+                # dispose of the orphan continuation so the
+                # destination's admission depth and connection release.
+                self._dispose_iter(result)
+        except Exception as exc:
+            self._migration_count(record, "import_dispatch_failed",
+                                  lane=source, error=str(exc)[:120])
+            record.fail(f"migration failed: {exc}")
+
+    def _pick_migration_dest(self, record: _StreamRecord,
+                             source: str) -> Optional[str]:
+        """Destination preference: the lane owning the prompt-prefix
+        AFFINITY fingerprint (its radix tree most likely already holds
+        the prompt's blocks — the import re-adopts them and ships
+        less), then the request_id's ring lane, then ring order — the
+        first candidate that is present, un-ejected, and
+        breaker-admitted; never the source."""
+        payload = record.payload
+        mdl = payload.get("model")
+        with self._lock:
+            if mdl is None and len(self._model_rings) > 1:
+                mdl = self.default_model
+            ring = (self._model_rings.get(str(mdl))
+                    if mdl is not None else self._ring)
+        if ring is None:
+            ring = self._ring
+        candidates: List[str] = []
+        fp = self._affinity_fingerprint(payload)
+        if fp is not None:
+            try:
+                candidates.append(ring.get_node(fp))
+            except RuntimeError:
+                pass
+        try:
+            candidates.append(ring.get_node(record.request_id))
+        except RuntimeError:
+            pass
+        candidates += ring.get_all_nodes()
+        seen = set()
+        for lane in candidates:
+            if lane == source or lane in seen:
+                continue
+            seen.add(lane)
+            with self._lock:
+                present = lane in self._clients
+                ejected = lane in self._ejected
+                breaker = self._breakers.get(lane)
+            if (not present or ejected or breaker is None
+                    or not breaker.allow_request()):
+                continue
+            return lane
+        return None
+
+    def _dispose_iter(self, it) -> None:
+        """Drain an orphaned stream iterator in the background: running
+        it to exhaustion is the one path that releases the serving
+        side's admission depth and pooled connection whether or not the
+        generator ever started (close() on an unstarted generator skips
+        its finally)."""
+        def drain():
+            try:
+                for _ in it:
+                    pass
+            except Exception:
+                pass
+            finally:
+                try:
+                    it.close()
+                except Exception:
+                    pass
+        threading.Thread(target=drain, name="gw-migrate-dispose",
+                         daemon=True).start()
 
     # -- prefix-affinity routing ----------------------------------------------
 
@@ -1521,6 +1923,13 @@ class Gateway:
             fo = self.failover.as_dict()
             fo["ejected_lanes"] = self.ejected_lanes()
             out["failover"] = fo
+        # Additive "migration" block (live stream migration + the
+        # bounded-drain counter), same gating discipline.
+        if self.config.migrate_streams or self.migration.any_nonzero():
+            mig = self.migration.as_dict()
+            with self._lock:
+                mig["active_streams"] = len(self._streams)
+            out["migration"] = mig
         # Additive "affinity" block (prefix-affinity routing), same
         # gating discipline: a defaults-only /stats stays byte-identical.
         if self.config.prefix_affinity or self.affinity.any_nonzero():

@@ -204,6 +204,29 @@ class FailoverCounters(ResilienceCounters):
               "prober_restores")
 
 
+class MigrationCounters(ResilienceCounters):
+    """Every live-stream-migration decision, counted — the additive
+    ``/stats`` ``migration`` block and the ``tpu_engine_migration_*``
+    Prometheus family. Decision fields pair 1:1 with gateway
+    ``migration`` marker spans (``tools/fault_injection.py --migrate``
+    asserts counters == spans); ``tokens_migrated`` is a value counter
+    (tokens carried across a splice), span-free like
+    ``tokens_replayed``. ``drain_failures`` counts graceful-drain calls
+    that timed out or errored during ``remove_worker(drain=True)`` —
+    removal proceeds anyway (a wedged lane must never hang membership
+    changes)."""
+
+    FIELDS = ("migrations_attempted", "streams_migrated",
+              "migration_fallbacks", "export_refusals",
+              "destination_unavailable", "import_dispatch_failed",
+              "tokens_migrated", "drain_failures")
+
+    SPAN_FIELDS = ("migrations_attempted", "streams_migrated",
+                   "migration_fallbacks", "export_refusals",
+                   "destination_unavailable", "import_dispatch_failed",
+                   "drain_failures")
+
+
 class AffinityCounters(ResilienceCounters):
     """Every prefix-affinity routing decision, counted — the additive
     ``/stats`` ``affinity`` block and the ``tpu_engine_affinity_*``

@@ -1015,6 +1015,30 @@ class WorkerNode:
     def draining(self) -> bool:
         return self._admission.draining
 
+    # -- live stream migration (DESIGN.md "Live stream migration") -------------
+
+    def handle_migrate_export(self, request: dict) -> dict:
+        """/admin/migrate: export ONE live stream's row — tokens
+        emitted, sampling state, remaining budget, and its KV block
+        chain — so the gateway can adopt it on another lane with zero
+        re-prefilled tokens. The local stream ends with a retryable
+        ``migrated`` terminal event. Refusals (unknown stream, mid-
+        prefill row, non-paged lane) come back ``{"ok": False,
+        "reason"}`` — never an error: the caller's fallback is the
+        replay resume, which needs nothing from this lane."""
+        rid = request.get("request_id")
+        if not rid:
+            raise ValueError("request_id is required")
+        gen = self.generator
+        if gen is None or not hasattr(gen, "export_row"):
+            return {"ok": False, "node_id": self.node_id,
+                    "reason": "this lane has no continuous decode "
+                              "scheduler to export from"}
+        timeout_s = float(request.get("timeout_s", 10.0))
+        out = gen.export_row(str(rid), timeout_s=timeout_s)
+        out["node_id"] = self.node_id
+        return out
+
     def on_fault_change(self, listener) -> None:
         """Register listener(healthy: bool) — the native HTTP front uses
         this to stop serving a faulted lane's cache hits in C++."""
@@ -1364,7 +1388,8 @@ class WorkerNode:
                 stop_tokens=list(item.stop_tokens), min_p=item.min_p,
                 deadline=deadline,
                 sink=TraceSink(self.tracer, self.node_id,
-                               item.request_id, tctx))
+                               item.request_id, tctx),
+                tag=item.request_id)
             # The scheduler itself cancels expired rows between chunks
             # (the future then raises DeadlineExceeded); the +5 s slack
             # keeps this outer wait a backstop, never the arbiter.
@@ -1407,6 +1432,12 @@ class WorkerNode:
         # bad request must be a 400 like the blocking endpoint's (on both
         # scheduler paths).
         request_id = request["request_id"]
+        if request.get("migrate_import") is not None:
+            # Live stream migration continuation: the snapshot carries
+            # every decode parameter — the surrounding payload's fields
+            # are routing metadata only.
+            return self._stream_import(request, deadline,
+                                       self._request_tier(request))
         prompt = [int(t) for t in request["prompt_tokens"]]
         tier = self._request_tier(request)
         max_new = self._brownout_clamp(
@@ -1496,11 +1527,59 @@ class WorkerNode:
                 temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
                 repetition_penalty=rep_pen, stop_tokens=stop_toks,
                 min_p=min_p_val, stream=q, deadline=deadline,
-                sink=TraceSink(self.tracer, self.node_id, request_id, tctx))
+                sink=TraceSink(self.tracer, self.node_id, request_id, tctx),
+                tag=request_id)
         except BaseException:
             self._admission.release()
             raise
+        return self._continuous_stream_events(
+            q, fut, request_id, tctx, parent, t0, t_start_wall, t_admit)
 
+    def _stream_import(self, request: dict,
+                       deadline: Optional[Deadline], tier: Optional[int]):
+        """Continuation half of live stream migration: adopt an exported
+        row (the ``migrate_import`` snapshot) and stream its REMAINING
+        tokens — no prefill, no re-emitted prefix. Rides the normal
+        /generate/stream surface so the gateway journal splices it like
+        any other segment, and admission applies like any stream (a
+        draining or overloaded destination sheds 503 before the 200
+        commits — the orchestrator's fallback ladder handles it)."""
+        gen = self.generator
+        if gen is None or not hasattr(gen, "submit_import"):
+            raise ValueError(
+                "migrate_import requires a continuous-scheduler lane "
+                "with the paged KV cache")
+        request_id = request["request_id"]
+        snap = request["migrate_import"]
+        parent = TraceContext.from_request(request)
+        tctx = (parent.child() if parent is not None
+                else TraceContext.root(request_id))
+        t_start_wall = time.time()
+        t_admit = time.perf_counter()
+        self._admission.admit(deadline, tier=tier)
+        try:
+            self._maybe_slow()
+            with self._counter_lock:
+                self._total_requests += 1
+            q: "queue.Queue" = queue.Queue()
+            t0 = time.perf_counter()
+            # ValueError (malformed snapshot) raises HERE -> wire 400
+            # before the 200 SSE stream commits.
+            fut = gen.submit_import(
+                snap, stream=q, deadline=deadline,
+                sink=TraceSink(self.tracer, self.node_id, request_id,
+                               tctx),
+                tag=request_id)
+        except BaseException:
+            self._admission.release()
+            raise
+        return self._continuous_stream_events(
+            q, fut, request_id, tctx, parent, t0, t_start_wall, t_admit)
+
+    def _continuous_stream_events(self, q, fut, request_id, tctx, parent,
+                                  t0, t_start_wall, t_admit):
+        """The continuous-scheduler SSE event iterator, shared by fresh
+        submissions and migration imports. Owns the admission release."""
         def events():
             sent = 0  # tokens relayed to the client so far (resume offset)
             completed = False
@@ -1571,6 +1650,17 @@ class WorkerNode:
                "retryable": bool(retryable),
                "request_id": request_id, "trace_id": trace_id,
                "tokens_emitted": int(tokens_emitted)}
+        if getattr(exc, "migrated", False):
+            # The row was EXPORTED (live stream migration): the
+            # gateway's journal splices the destination's continuation
+            # instead of replay-resuming; a journal-less client can
+            # still resume manually like any retryable terminal.
+            out["migrated"] = True
+        if getattr(exc, "import_refused", False):
+            # A migration import THIS lane refused post-splice
+            # (checksum, geometry, pool pressure): the gateway counts
+            # the replay fallback against migration, not the lane.
+            out["import_refused"] = True
         if isinstance(exc, ShedError):
             # Policy refusal from a HEALTHY lane: the gateway's failover
             # journal resumes these WITHOUT a breaker penalty (the same

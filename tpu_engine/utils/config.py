@@ -241,6 +241,25 @@ class GatewayConfig:
     failover_streams: bool = False
     # Resume attempts per stream; each also consumes the retry budget.
     failover_max_resumes: int = 3
+    # Live stream migration (--migrate-streams): graceful removal
+    # (remove_worker(drain=True)) EXPORTS each journaled in-flight
+    # /generate/stream off the draining lane — KV block chain + stream
+    # state over the wire — and resumes it mid-stream on another lane
+    # with ZERO re-prefilled tokens, splicing the continuation
+    # byte-identically. Implies the stream journal (the PR 6 machinery
+    # is the fallback ladder: checksum mismatch, full destination,
+    # transfer timeout, or destination death all land on the replay
+    # resume). Off (default) keeps today's shed+replay drain semantics
+    # and wire bytes.
+    migrate_streams: bool = False
+    # Per-stream transfer budget (export + continuation dispatch),
+    # always clamped to the stream's ORIGINAL deadline.
+    migrate_timeout_s: float = 30.0
+    # Graceful-drain call bound: remove_worker(drain=True) gives the
+    # lane this long to acknowledge /admin/drain, then counts the
+    # failure and proceeds with removal — a wedged lane must never hang
+    # membership changes.
+    drain_timeout_s: float = 10.0
     # Proactive lane health prober (--health-probe-interval): a gateway
     # background thread GETs every lane's /health at this interval and
     # EJECTS lanes from routing after `health_probe_failures` consecutive

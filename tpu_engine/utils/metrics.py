@@ -352,6 +352,38 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            [({**node(h), "lane": s.get("lane", "continuous")},
              s.get("tokens_per_row_dispatch")) for h, s in sp])
 
+    # Live stream migration, lane side (the scheduler's additive
+    # "migration" stats block — present once a row was exported or
+    # imported on the lane).
+    mg = [(h, g.get("migration")) for h, g in gen
+          if isinstance(g, dict) and g.get("migration")]
+    metric("tpu_engine_migration_exported_rows_total", "counter",
+           "Live rows exported off this lane (migrate-mode drain)",
+           [(node(h), m.get("exported_rows")) for h, m in mg])
+    metric("tpu_engine_migration_exported_tokens_total", "counter",
+           "Tokens already emitted by rows at export",
+           [(node(h), m.get("exported_tokens")) for h, m in mg])
+    metric("tpu_engine_migration_export_refused_total", "counter",
+           "Export requests this lane refused (finished or mid-prefill "
+           "rows) — each fell back to a replay resume",
+           [(node(h), m.get("export_refused")) for h, m in mg])
+    metric("tpu_engine_migration_imported_rows_total", "counter",
+           "Migrated rows adopted by this lane (zero re-prefill)",
+           [(node(h), m.get("imported_rows")) for h, m in mg])
+    metric("tpu_engine_migration_imported_tokens_total", "counter",
+           "Tokens already emitted by rows at import (the stream "
+           "position adopted — reconciles with exported_tokens "
+           "fleet-wide)",
+           [(node(h), m.get("imported_tokens")) for h, m in mg])
+    metric("tpu_engine_migration_imported_chain_tokens_total", "counter",
+           "KV tokens written verbatim from imported chains "
+           "(radix-matched prefix blocks excluded)",
+           [(node(h), m.get("imported_chain_tokens")) for h, m in mg])
+    metric("tpu_engine_migration_import_rejected_total", "counter",
+           "Imports this lane refused (checksum, geometry, pool "
+           "pressure) — each fell back to a replay resume",
+           [(node(h), m.get("import_rejected")) for h, m in mg])
+
     # Resilience layer, lane side (the "admission" /health block appears
     # only once admission control has made a decision).
     adm = [(h, h.get("admission")) for h in healths if h.get("admission")]
@@ -465,6 +497,37 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
             metric("tpu_engine_failover_ejected_lanes", "gauge",
                    "Lanes currently ejected from routing",
                    [({}, len(fo.get("ejected_lanes", ())))])
+        mig = stats.get("migration")
+        if mig:
+            # Live stream migration (the /stats "migration" block;
+            # present once configured or first exercised).
+            for key, help_text in (
+                    ("migrations_attempted",
+                     "Per-stream migrations started by a migrate-mode "
+                     "drain"),
+                    ("streams_migrated",
+                     "Streams spliced onto their migration destination "
+                     "(zero re-prefilled tokens)"),
+                    ("migration_fallbacks",
+                     "Migrations that fell back to the replay resume"),
+                    ("export_refusals",
+                     "Source-side export refusals (finished row, "
+                     "mid-prefill row, wedged lane)"),
+                    ("destination_unavailable",
+                     "Migrations with no admitting destination lane"),
+                    ("import_dispatch_failed",
+                     "Continuation dispatches the destination refused "
+                     "or failed"),
+                    ("tokens_migrated",
+                     "Tokens carried across migration splices"),
+                    ("drain_failures",
+                     "Graceful-drain calls that timed out or errored "
+                     "(removal proceeded)")):
+                metric(f"tpu_engine_migration_{key}_total", "counter",
+                       help_text, [({}, mig.get(key))])
+            metric("tpu_engine_migration_active_streams", "gauge",
+                   "Journaled streams the migrate registry tracks",
+                   [({}, mig.get("active_streams"))])
         aff = stats.get("affinity")
         if aff:
             # Prefix-affinity routing (the /stats "affinity" block;
