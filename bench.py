@@ -59,24 +59,47 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-# Every completed sub-measurement lands here AND in BENCH_partial.json
-# immediately — so a tunnel wedge mid-run (the r2/r4 failure mode: the
-# driver kills the hung process and records only rc=1) still leaves every
-# number measured before the wedge, both on disk and attached to the
-# error JSON line main() prints. Mirrors tools/onchip_campaign.py's
-# save-after-every-stage discipline.
+# Every completed sub-measurement lands here AND in a RUN-STAMPED
+# partial artifact immediately — so a tunnel wedge mid-run (the r2/r4
+# failure mode: the driver kills the hung process and records only
+# rc=1) still leaves every number measured before the wedge, both on
+# disk and attached to the error JSON line main() prints. Mirrors
+# tools/onchip_campaign.py's save-after-every-stage discipline.
+# Run-stamped (scenario + timestamp + pid) so concurrent runs never
+# clobber each other, and REMOVED on a completed run — only aborted
+# runs leave a partial behind (a stale fixed-name BENCH_partial.json
+# used to sit at the repo root forever).
 _PARTIAL: dict = {}
-_PARTIAL_PATH = os.path.join(REPO, "BENCH_partial.json")
+_PARTIAL_PATH = None  # set on first write (run-stamped)
+
+
+def _partial_path() -> str:
+    global _PARTIAL_PATH
+    if _PARTIAL_PATH is None:
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        _PARTIAL_PATH = os.path.join(
+            REPO, f"BENCH_partial.{_SCENARIO}.{stamp}.{os.getpid()}.json")
+    return _PARTIAL_PATH
 
 
 def record_partial(name: str, data) -> None:
     _PARTIAL[name] = data
     _PARTIAL["ts"] = time.strftime("%Y-%m-%d %H:%M:%S")
     try:
-        with open(_PARTIAL_PATH, "w") as f:
+        with open(_partial_path(), "w") as f:
             json.dump(_PARTIAL, f, indent=2)
     except OSError as exc:  # a read-only checkout must not kill the bench
         log(f"partial artifact write failed: {exc}")
+
+
+def cleanup_partial() -> None:
+    """Remove this run's partial artifact — called once the run emitted
+    its final line (an ABORTED run keeps its partials for forensics)."""
+    if _PARTIAL_PATH is not None and os.path.exists(_PARTIAL_PATH):
+        try:
+            os.remove(_PARTIAL_PATH)
+        except OSError:
+            pass
 
 
 def free_port() -> int:
@@ -2053,6 +2076,318 @@ def run_drain_ab(n_streams: int = 10, max_new: int = 48,
                 proc.kill()
 
 
+def run_disagg_ab(model: str = "gpt2-small-test", n_streams: int = 24,
+                  max_new: int = 24, prompt_len: int = 230,
+                  burst: int = 3, mean_burst_gap_ms: float = 350.0,
+                  block_size: int = 16, slots_per_lane: int = 6,
+                  max_seq: int = 512, prefill_chunk: int = 128,
+                  quick: bool = False) -> dict:
+    """Disaggregated prefill/decode serving A/B (the PR 14 tentpole):
+    a bursty long-prompt Poisson workload over 4 in-process lanes —
+    2 dedicated prefill + 2 dedicated decode behind a ``--disagg``
+    gateway vs 4 colocated mixed-step lanes behind a default gateway.
+
+    The mechanism under test: colocated mixed stepping co-schedules
+    every in-flight row's decode token with admitting rows' prefill
+    chunks in ONE ragged dispatch — a burst of long prompts inflates
+    every decode row's inter-token latency by the chunk compute, and
+    prefill TTFT queues behind the decode ticks. Disaggregation gives
+    each phase its own lanes: prefill lanes run prompt chunks only
+    (TTFT no longer waits out decode ticks), park the finished row, and
+    ship chain + sampling snapshot to a decode lane (PR 11 wire
+    format, zero re-prefilled tokens); decode lanes never co-schedule a
+    prefill chunk again (ITL stops absorbing 100+-token chunk
+    dispatches). The handoff gap itself lands in the disagg arm's ITL
+    sample — the win must survive paying it honestly.
+
+    Reported per arm: client-side TTFT p50/p99 and ITL p50/p99 over
+    every stream, stream identity across arms (greedy AND seeded — the
+    splice is byte-exact), handoff accounting (spliced == streams,
+    fallbacks 0), zero KV blocks leaked on every pool. Bars:
+    disagg TTFT p99 AND ITL p99 both beat colocated; defaults-off
+    /stats //health byte-identical (no handoff/role keys anywhere);
+    a quantized (int8) split fleet hands off verbatim with no
+    requantization. CPU mesh (tiny registry model — phase-interference
+    and handoff-cost shapes, not model-size properties); on-chip rerun
+    pending like r06-r13."""
+    import queue as _q
+    import random
+    import threading
+
+    import jax
+
+    from tpu_engine.models.registry import (
+        _ensure_builtin_models_imported, create_model)
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+    from tpu_engine.utils.tracing import percentile
+
+    _ensure_builtin_models_imported()
+    if quick:
+        n_streams, prompt_len, max_seq = 12, 110, 256
+        prefill_chunk = 64
+    spec = create_model(model, max_seq=max_seq)
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(29)
+    requests = []
+    for i in range(n_streams):
+        params_i = ({} if i % 2 == 0
+                    else {"temperature": 0.8, "seed": 900 + i})
+        requests.append({
+            "request_id": f"dg-{i}",
+            "prompt_tokens": [rnd.randrange(1, 200)
+                              for _ in range(prompt_len + (i % 7))],
+            "max_new_tokens": max_new, **params_i})
+    # Bursty Poisson: arrivals land in bursts of `burst` streams, burst
+    # gaps exponential — several long prompts hit the fleet at once,
+    # the interference shape disaggregation exists for.
+    gaps = []
+    for i in range(n_streams):
+        gaps.append(0.0 if i % burst else
+                    rnd.expovariate(1000.0 / mean_burst_gap_ms) / 1000.0)
+
+    # Equal FLEET resources, role-shaped: the colocated arm spreads
+    # rows over 4 lanes; the disagg arm concentrates decode rows on 2,
+    # so an operator provisions decode lanes with more slots + pool and
+    # prefill lanes (rows exported moments after prefill) with less —
+    # both arms get the same total slots and total KV blocks.
+    bucket = 16
+    while bucket < prompt_len + 8:
+        bucket *= 2
+    blocks_per_row = bucket // block_size + 3
+    colo_blocks = slots_per_lane * blocks_per_row + 36
+    prefill_slots = max(2, slots_per_lane - 2)
+    prefill_blocks = prefill_slots * blocks_per_row + 20
+    decode_slots = 2 * slots_per_lane - prefill_slots
+    decode_blocks = (4 * colo_blocks - 2 * prefill_blocks) // 2
+    shapes = {"both": (slots_per_lane, colo_blocks),
+              "prefill": (prefill_slots, prefill_blocks),
+              "decode": (decode_slots, decode_blocks)}
+
+    def make_fleet(roles):
+        workers = []
+        for i, role in enumerate(roles):
+            slots, blocks = shapes[role]
+            cfg = WorkerConfig(
+                node_id=f"lane_{i+1}", model=model, role=role,
+                gen_max_batch_size=slots, gen_step_chunk=4,
+                gen_prefix_cache_mb=0, gen_kv_block_size=block_size,
+                gen_kv_blocks=blocks, gen_mixed_step=True,
+                gen_prefill_chunk=prefill_chunk)
+            engine = InferenceEngine(spec, params=params, dtype="float32")
+            workers.append(WorkerNode(cfg, engine=engine))
+        return workers
+
+    def leak_free(workers):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            ok = True
+            for w in workers:
+                st = w.generator.stats()
+                kp = st["kv_pool"]
+                if (st["active"] != 0
+                        or kp["blocks_free"] + kp["radix_nodes"]
+                        < kp["blocks_total"]):
+                    ok = False
+            if ok:
+                return True
+            time.sleep(0.2)
+        return False
+
+    def drive(gw, req, out):
+        t0 = time.perf_counter()
+        toks, ttft, last, gaps_s = [], None, None, []
+        try:
+            for frame in gw.route_generate_stream(dict(req)):
+                evt = _parse_sse(frame)
+                if evt is None or evt.get("done"):
+                    continue
+                if evt.get("tokens"):
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    else:
+                        gaps_s.append(now - last)
+                    last = now
+                    toks.extend(evt["tokens"])
+        except Exception as exc:
+            out.put((req["request_id"], None, [], [f"error: {exc}"]))
+            return
+        out.put((req["request_id"], ttft, gaps_s, toks))
+
+    def run_arm(disagg: bool) -> tuple:
+        roles = (("prefill", "prefill", "decode", "decode") if disagg
+                 else ("both",) * 4)
+        workers = make_fleet(roles)
+        gw = Gateway(workers, GatewayConfig(
+            disagg=disagg, handoff_timeout_s=60.0))
+        try:
+            # Warm every lane's compile set (prefill chunks, decode
+            # ticks, export/import paths) outside the measurement.
+            warm = []
+            for i in range(4):
+                warm.append({"request_id": f"warm-{i}",
+                             "prompt_tokens": [3 + i] * (prompt_len // 2),
+                             "max_new_tokens": 4})
+            wq: _q.Queue = _q.Queue()
+            wt = [threading.Thread(target=drive, args=(gw, r, wq))
+                  for r in warm]
+            for t in wt:
+                t.start()
+            for t in wt:
+                t.join(timeout=300)
+            while not wq.empty():
+                wq.get()
+            # Handoff accounting over the MEASURED window only (the
+            # warm streams hand off too).
+            ho0 = dict(gw.get_stats().get("handoff", {})) if disagg \
+                else {}
+            out: _q.Queue = _q.Queue()
+            threads = []
+            for req, gap in zip(requests, gaps):
+                time.sleep(gap)
+                t = threading.Thread(target=drive, args=(gw, req, out))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=600)
+            got = {}
+            ttfts, itl = [], []
+            while not out.empty():
+                rid, ttft, gaps_s, toks = out.get()
+                got[rid] = toks
+                if ttft is not None:
+                    ttfts.append(ttft)
+                itl.extend(gaps_s)
+            ttfts.sort()  # percentile() takes a pre-sorted list
+            itl.sort()
+            stats = gw.get_stats()
+            arm = {
+                "disagg": disagg, "streams": len(requests),
+                "completed": sum(1 for t in got.values() if t),
+                "ttft_ms": {
+                    "p50": round(1e3 * (percentile(ttfts, 50) or 0), 1),
+                    "p99": round(1e3 * (percentile(ttfts, 99) or 0), 1)},
+                "itl_ms": {
+                    "p50": round(1e3 * (percentile(itl, 50) or 0), 1),
+                    "p99": round(1e3 * (percentile(itl, 99) or 0), 1)},
+                "pools_leak_free": leak_free(workers),
+            }
+            if disagg:
+                ho = stats.get("handoff", {})
+                arm["handoff"] = {k: ho.get(k, 0) - ho0.get(k, 0)
+                                  for k in (
+                    "prefill_routed", "handoffs_attempted",
+                    "handoffs_spliced", "handoff_fallbacks",
+                    "export_refusals", "destination_unavailable",
+                    "dispatch_failed")}
+                arm["decode_imported_rows"] = sum(
+                    (w.generator.stats().get("migration") or {})
+                    .get("imported_rows", 0) for w in workers)
+                arm["prefill_holds"] = sum(
+                    (w.generator.stats().get("handoff") or {})
+                    .get("holds", 0) for w in workers)
+            else:
+                arm["stats_has_handoff_key"] = "handoff" in stats
+                arm["health_has_role_key"] = any(
+                    "role" in w.get_health() for w in workers)
+            return arm, got
+        finally:
+            gw.stop()
+            for w in workers:
+                w.stop()
+
+    off, off_tokens = run_arm(False)
+    record_partial("disagg_colocated", off)
+    on, on_tokens = run_arm(True)
+    record_partial("disagg_on", on)
+
+    identical = sum(1 for rid in off_tokens
+                    if on_tokens.get(rid) == off_tokens[rid]
+                    and off_tokens[rid])
+
+    # Quantized split fleet: the int8+scale chain must ride the hop
+    # verbatim — the handed-off stream equals the same quantized
+    # fleet's colocated stream (determinism contract: quantized-vs-
+    # quantized byte-identity, not bf16 equality).
+    def quant_phase() -> dict:
+        qreq = {"request_id": "qz-1",
+                "prompt_tokens": [rnd.randrange(1, 200)
+                                  for _ in range(prompt_len)],
+                "max_new_tokens": 12, "temperature": 0.7, "seed": 17}
+
+        def one(roles, disagg):
+            workers = []
+            for i, role in enumerate(roles):
+                cfg = WorkerConfig(
+                    node_id=f"q_{i+1}", model=model, role=role,
+                    gen_max_batch_size=2, gen_step_chunk=4,
+                    gen_prefix_cache_mb=0, gen_kv_block_size=block_size,
+                    gen_kv_blocks=colo_blocks, gen_kv_quantize="int8")
+                engine = InferenceEngine(spec, params=params,
+                                         dtype="float32")
+                workers.append(WorkerNode(cfg, engine=engine))
+            gw = Gateway(workers, GatewayConfig(
+                disagg=disagg, handoff_timeout_s=60.0))
+            try:
+                out: _q.Queue = _q.Queue()
+                drive(gw, qreq, out)
+                _rid, _ttft, _gaps, toks = out.get()
+                imported = sum(
+                    (w.generator.stats().get("migration") or {})
+                    .get("imported_rows", 0) for w in workers)
+                spliced = (gw.get_stats().get("handoff", {})
+                           .get("handoffs_spliced", 0))
+                clean = leak_free(workers)
+                return toks, imported, spliced, clean
+            finally:
+                gw.stop()
+                for w in workers:
+                    w.stop()
+
+        ctoks, _imp, _spl, cclean = one(("both", "both"), False)
+        htoks, imported, spliced, hclean = one(("prefill", "decode"),
+                                               True)
+        return {
+            "stream_identical": bool(htoks and htoks == ctoks),
+            "imported_rows": imported, "handoffs_spliced": spliced,
+            "pools_leak_free": bool(cclean and hclean),
+        }
+
+    quant = quant_phase()
+    record_partial("disagg_quant", quant)
+
+    results = {
+        "model": model, "n_streams": n_streams,
+        "prompt_len": prompt_len, "max_new": max_new,
+        "lanes": "2 prefill + 2 decode vs 4 colocated mixed-step",
+        "colocated": off, "disagg": on,
+        "streams_identical_across_arms": identical,
+        "ttft_p99_speedup": round(
+            off["ttft_ms"]["p99"] / max(on["ttft_ms"]["p99"], 1e-3), 3),
+        "itl_p99_speedup": round(
+            off["itl_ms"]["p99"] / max(on["itl_ms"]["p99"], 1e-3), 3),
+        "quantized_handoff": quant,
+    }
+    results["checks_passed"] = bool(
+        identical == n_streams
+        and on["completed"] == n_streams
+        and off["completed"] == n_streams
+        and on["ttft_ms"]["p99"] < off["ttft_ms"]["p99"]
+        and on["itl_ms"]["p99"] < off["itl_ms"]["p99"]
+        and on["handoff"]["handoffs_spliced"] == n_streams
+        and on["handoff"]["handoff_fallbacks"] == 0
+        and on["pools_leak_free"] and off["pools_leak_free"]
+        and not off["stats_has_handoff_key"]
+        and not off["health_has_role_key"]
+        and quant["stream_identical"]
+        and quant["imported_rows"] >= 1
+        and quant["pools_leak_free"])
+    return results
+
+
 def run_affinity_ab(model: str = "gpt2-small-test", n_requests: int = 48,
                     n_tenants: int = 8, prefix_len: int = 96,
                     suffix_len: int = 8, max_new: int = 8,
@@ -2629,7 +2964,11 @@ def device_fallback(exc: BaseException) -> str:
 
 def main() -> int:
     try:
-        return _main()
+        rc = _main()
+        # The run emitted its final line: the run-stamped partial is
+        # redundant now (aborted runs keep theirs for forensics).
+        cleanup_partial()
+        return rc
     except Exception as exc:  # ALWAYS leave the driver one JSON line
         log(f"bench failed: {exc!r}")
         line = {
@@ -2640,8 +2979,9 @@ def main() -> int:
         if _DEVICE_NOTE is not None:
             line["device"] = _DEVICE_NOTE
         # A wedge after N completed measurements must not zero them out:
-        # attach whatever landed before the failure (also on disk at
-        # BENCH_partial.json). Metadata-only partials (scenario/ts) are
+        # attach whatever landed before the failure (also on disk at the
+        # run-stamped partial path). Metadata-only partials (scenario/ts)
+        # are
         # NOT attached — "partial" present must mean real numbers
         # survived, or the driver would read an empty run as evidence.
         if any(k not in ("scenario", "ts") for k in _PARTIAL):
@@ -2676,7 +3016,7 @@ def _main() -> int:
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
                              "crash-ab", "drain-ab", "affinity-ab",
-                             "overload-ab", "quant-ab"],
+                             "overload-ab", "quant-ab", "disagg-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -2711,7 +3051,7 @@ def _main() -> int:
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
     if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab",
-                          "overload-ab", "quant-ab")
+                          "overload-ab", "quant-ab", "disagg-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -2929,6 +3269,17 @@ def _main() -> int:
         emit({
             "metric": "kv_quant_capacity_gain",
             "value": result["capacity_gain"], "unit": "x",
+            "vs_baseline": None, "model": args.model, **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "disagg-ab":
+        result = run_disagg_ab(model=args.model, quick=args.quick)
+        record_partial("disagg_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "disagg_itl_p99_speedup",
+            "value": result["itl_p99_speedup"], "unit": "x",
             "vs_baseline": None, "model": args.model, **result,
         })
         return 0 if result["checks_passed"] else 1

@@ -25,8 +25,8 @@ OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
 #             --spec-parity step 9, --quant-parity step 10, --failover
-#             step 11, --migrate step 12, --overload step 13,
-#             --lint step 14
+#             step 11, --migrate step 12, --disagg step 13,
+#             --overload step 14, --lint step 15
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -105,14 +105,24 @@ def main() -> int:
                          "spliced-vs-control diff plus the migration "
                          "counters — the KV-handoff smoke without the "
                          "full fault_injection --migrate chaos run")
+    ap.add_argument("--disagg", action="store_true",
+                    help="step 13: one scripted prefill→decode handoff "
+                         "against a local 1-prefill + 1-decode worker "
+                         "pair (spawned here) behind a --disagg "
+                         "gateway: stream routes to the prefill lane, "
+                         "ships its KV chain, splices on the decode "
+                         "lane — prints the spliced-vs-control diff "
+                         "plus the handoff counters, the disagg smoke "
+                         "without the full fault_injection --disagg "
+                         "chaos run")
     ap.add_argument("--overload", action="store_true",
-                    help="step 13: overload-control state of the live "
+                    help="step 14: overload-control state of the live "
                          "system — the gateway's /stats overload block "
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 14: engine-lint static-analysis suite "
+                    help="step 15: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -121,7 +131,7 @@ def main() -> int:
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
               + int(args.spec_parity) + int(args.quant_parity)
               + int(args.failover) + int(args.migrate)
-              + int(args.overload) + int(args.lint))
+              + int(args.disagg) + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -448,7 +458,76 @@ def main() -> int:
                 if p.poll() is None:
                     p.terminate()
 
-    # 11 (--overload): overload-control state, live — the gateway's
+    # (--disagg): one scripted prefill→decode handoff against a local
+    # worker pair — the steady-state disaggregated path, live, in one
+    # line: stream through a --disagg gateway (1 prefill + 1 decode
+    # lane), let the KV chain hand off, and diff the spliced stream
+    # against an unkilled blocking control (zero re-prefilled tokens).
+    if args.disagg:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.failover) + int(args.migrate) + 1)
+        procs = []
+        try:
+            import threading
+
+            from tools.fault_injection import _call, launch_worker_procs
+            from tpu_engine.serving.gateway import Gateway, _parse_sse
+            from tpu_engine.utils.config import GatewayConfig
+
+            ports, procs = launch_worker_procs(
+                2, per_worker_args=(("--role", "prefill"),
+                                    ("--role", "decode")))
+            dgw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                          GatewayConfig(disagg=True,
+                                        handoff_timeout_s=60.0,
+                                        failover_streams=True))
+            req = {"request_id": "dg", "prompt_tokens": [5, 9, 3, 17],
+                   "max_new_tokens": 24, "temperature": 0.9, "seed": 7}
+            _, ctl = _call(ports[1], "POST", "/generate",
+                           dict(req, request_id="ctl"), timeout=600)
+            control = ctl["tokens"]
+            toks, final = [], {}
+
+            def consume_dg():
+                for frame in dgw.route_generate_stream(dict(req)):
+                    evt = _parse_sse(frame)
+                    if evt and evt.get("done"):
+                        final.update(evt)
+                        break
+                    if evt and "tokens" in evt:
+                        toks.extend(evt["tokens"])
+
+            t = threading.Thread(target=consume_dg, daemon=True)
+            t.start()
+            t.join(timeout=300)
+            ho = dgw.get_stats().get("handoff", {})
+            dgw.stop()
+            spliced = final.get("tokens")
+            if spliced == control and toks == control:
+                detail = (f"(identical: {len(control)} tokens, "
+                          f"routed={ho.get('prefill_routed')}, "
+                          f"spliced={ho.get('handoffs_spliced')}, "
+                          f"fallbacks={ho.get('handoff_fallbacks')})")
+                ok = ho.get("handoffs_spliced", 0) >= 1
+            else:
+                div = next((i for i, (a, b) in enumerate(
+                    zip(spliced or [], control))
+                    if a != b), min(len(spliced or []), len(control)))
+                detail = (f"(DIVERGED at token {div}: "
+                          f"spliced={spliced} control={control})")
+                ok = False
+            step(n, "disagg prefill→decode handoff vs control", ok,
+                 detail)
+        except Exception as exc:
+            step(n, "disagg prefill→decode handoff vs control", False,
+                 f"({exc})")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+    # (--overload): overload-control state, live — the gateway's
     # /stats overload block and each lane's brownout ladder stage. Works
     # whether or not the flags are on: a defaults-off deployment reports
     # "overload control off" (the additive blocks are absent), which is
@@ -456,7 +535,8 @@ def main() -> int:
     if args.overload:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.failover) + int(args.migrate) + 1)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + 1)
         try:
             status, stats = _get(gw, "/stats")
             ov = stats.get("overload")

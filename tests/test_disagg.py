@@ -1,0 +1,399 @@
+"""Disaggregated prefill/decode serving: role-aware routing + the
+steady-state KV chain handoff.
+
+DESIGN.md "Disaggregated serving": with ``--disagg`` on and a split
+fleet (dedicated ``--role prefill`` lanes beside decode-capable ones),
+/generate(/stream) lands on a prefill lane, which prefills into its
+block pool, PARKS the row (first token emitted, decode ticks skipped),
+and ships the finished chain + sampling snapshot to a decode lane
+picked by load via the live-migration wire format — the gateway splices
+the continuation into one seamless stream with ZERO re-prefilled
+tokens. Every failure rung lands on local decode (unexported row) or
+the PR 6 replay resume (exported row), both byte-identical. Defaults
+off — an all-"both" fleet routes and serves byte-identically to today.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tpu_engine.serving.gateway import Gateway, _parse_sse
+from tpu_engine.serving.resilience import HandoffCounters
+from tpu_engine.serving.worker import WorkerNode
+from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+GEN_KW = dict(model="gpt2-small-test", dtype="float32",
+              gen_scheduler="continuous", gen_step_chunk=2,
+              gen_kv_block_size=16, gen_kv_blocks=40,
+              gen_prefill_chunk=16, gen_max_batch_size=4)
+
+PROMPT = [5, 9, 3, 17, 4, 22, 8]
+LONG_PROMPT = list(range(2, 36))
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """2 prefill + 2 decode lanes sharing one parameter set (the
+    lane-uniformity deployments the handoff assumes)."""
+    roles = ("prefill", "prefill", "decode", "decode")
+    workers = [WorkerNode(WorkerConfig(node_id=f"w{i}", role=r, **GEN_KW))
+               for i, r in enumerate(roles)]
+    p0 = workers[0].engine.params
+    for w in workers[1:]:
+        w.apply_weights(p0)
+    yield workers
+    for w in workers:
+        w.stop()
+
+
+@pytest.fixture(autouse=True)
+def _heal_fleet(request):
+    yield
+    if "fleet" in request.fixturenames:
+        for w in request.getfixturevalue("fleet"):
+            w.heal()
+            w.undrain()
+
+
+@pytest.fixture()
+def gw(fleet):
+    g = Gateway(fleet, GatewayConfig(disagg=True, handoff_timeout_s=20.0))
+    yield g
+    g.stop()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def pool_leak_free(worker) -> bool:
+    st = worker.generator.stats()
+    kp = st["kv_pool"]
+    return (st["active"] == 0
+            and kp["blocks_free"] + kp["radix_nodes"] >= kp["blocks_total"])
+
+
+def consume(gway, req):
+    toks, final = [], None
+    for frame in gway.route_generate_stream(dict(req)):
+        evt = _parse_sse(frame)
+        if evt is None:
+            continue
+        if evt.get("done"):
+            final = evt
+            break
+        if "tokens" in evt:
+            toks.extend(evt["tokens"])
+    return toks, final
+
+
+def _handoff_spans(gway):
+    return [s for s in gway.tracer.snapshot() if s["op"] == "kv_handoff"]
+
+
+def assert_counters_match_spans(gway):
+    ho = gway.get_stats()["handoff"]
+    expect = sum(ho[f] for f in HandoffCounters.SPAN_FIELDS)
+    spans = _handoff_spans(gway)
+    assert len(spans) == expect, (ho, [s["attrs"] for s in spans])
+
+
+# -- counters + scheduler-level holds -----------------------------------------
+
+def test_handoff_counters_schema():
+    c = HandoffCounters()
+    assert not c.any_nonzero()
+    for f in HandoffCounters.FIELDS:
+        assert c.get(f) == 0
+    c.bump("tokens_handed_off", 5)
+    assert c.as_dict()["tokens_handed_off"] == 5 and c.any_nonzero()
+    assert "tokens_handed_off" not in HandoffCounters.SPAN_FIELDS
+
+
+def test_scheduler_hold_exports_first_token_only(fleet):
+    """A handoff row parks at prefill completion: the export ships
+    EXACTLY the first token (no decode-tick work spent on the source),
+    and the import continues the stream byte-identically with zero
+    re-prefilled destination tokens."""
+    src, dst = fleet[0].generator, fleet[2].generator
+    control = fleet[1].generator.generate(
+        [PROMPT], max_new_tokens=16, temperature=0.8, seed=13)[0]
+    q: queue.Queue = queue.Queue()
+    src.submit(PROMPT, max_new_tokens=16, temperature=0.8, seed=13,
+               stream=q, tag="hx", handoff=True, handoff_park_s=20.0)
+    pre_prefilled = dst.stats()["kv_pool"]["prefilled_tokens"]
+    snap = src.export_row("hx", timeout_s=30.0, wait_prefill=True)
+    assert snap["ok"], snap
+    assert len(snap["emitted"]) == 1  # first token only: no decode ticks
+    got = []
+    while True:
+        item = q.get(timeout=10)
+        if item is None:
+            break
+        got.extend(item)
+    assert got == snap["emitted"]
+    q2: queue.Queue = queue.Queue()
+    fut = dst.submit_import(snap, stream=q2, tag="hx2")
+    cont = []
+    while True:
+        item = q2.get(timeout=60)
+        if item is None:
+            break
+        cont.extend(item)
+    assert got + cont == control
+    fut.result(timeout=10)
+    assert dst.stats()["kv_pool"]["prefilled_tokens"] == pre_prefilled
+    ho = src.stats()["handoff"]
+    assert ho["holds"] >= 1 and ho["held_rows"] == 0
+    assert _wait(lambda: pool_leak_free(fleet[0]))
+    assert _wait(lambda: pool_leak_free(fleet[2]))
+
+
+def test_scheduler_park_expiry_decodes_locally(fleet):
+    """No export command ever arrives: the parked row unparks at its
+    bound and decodes locally — the stream is byte-identical to a
+    colocated run (the fallback a dead orchestrator relies on)."""
+    gen = fleet[0].generator
+    control = fleet[1].generator.generate([PROMPT], max_new_tokens=8,
+                                          seed=3)[0]
+    before = gen.stats().get("handoff", {}).get("park_expired", 0)
+    fut = gen.submit(PROMPT, max_new_tokens=8, seed=3, tag="pk",
+                     handoff=True, handoff_park_s=0.4)
+    assert fut.result(timeout=120) == control
+    assert gen.stats()["handoff"]["park_expired"] == before + 1
+
+
+def test_scheduler_cancel_releases_hold(fleet):
+    """An orchestrator cancel unparks the row immediately (no
+    destination existed) — same stream as an unparked run."""
+    gen = fleet[0].generator
+    control = fleet[1].generator.generate([PROMPT], max_new_tokens=8)[0]
+    fut = gen.submit(PROMPT, max_new_tokens=8, tag="cx",
+                     handoff=True, handoff_park_s=30.0)
+    assert _wait(lambda: gen.stats().get("handoff", {})
+                 .get("held_rows", 0) > 0, timeout=30)
+    resp = gen.export_row("cx", timeout_s=5.0, cancel=True)
+    assert resp["cancelled"], resp
+    assert fut.result(timeout=120) == control
+    assert gen.stats()["handoff"]["hold_cancelled"] >= 1
+
+
+# -- gateway: role-aware routing + steady-state handoff ------------------------
+
+def test_disagg_stream_spliced_byte_identical(fleet, gw):
+    """The full steady-state path: prefill lane → export-after-prefill
+    → decode lane adoption → relay splice. Stream byte-identical to a
+    colocated run; the handoff is attributed (counters == kv_handoff
+    spans); zero KV blocks leaked on every pool."""
+    control_gw = Gateway(fleet, GatewayConfig())
+    try:
+        req = {"request_id": "d1", "prompt_tokens": LONG_PROMPT,
+               "max_new_tokens": 12, "temperature": 0.9, "seed": 21}
+        ctoks, cfin = consume(control_gw, req)
+        dtoks, dfin = consume(gw, req)
+        assert dtoks == ctoks and dfin["tokens"] == cfin["tokens"]
+        ho = gw.get_stats()["handoff"]
+        assert ho["prefill_routed"] == 1
+        assert ho["handoffs_attempted"] == 1
+        assert ho["handoffs_spliced"] == 1
+        assert ho["handoff_fallbacks"] == 0
+        # The terminal summary came from a DECODE lane.
+        roles = gw.worker_roles()
+        assert roles[dfin["node_id"]] == "decode"
+        assert_counters_match_spans(gw)
+        assert _wait(lambda: all(pool_leak_free(w) for w in fleet))
+    finally:
+        control_gw.stop()
+
+
+def test_disagg_greedy_and_blocking_identity(fleet, gw):
+    """Greedy streams and the blocking /generate both ride the handoff
+    and match the colocated result."""
+    control = fleet[1].generator.generate([LONG_PROMPT],
+                                          max_new_tokens=10)[0]
+    toks, fin = consume(gw, {"request_id": "d2",
+                             "prompt_tokens": LONG_PROMPT,
+                             "max_new_tokens": 10})
+    assert toks == control
+    resp = gw.route_generate({"request_id": "d3",
+                              "prompt_tokens": LONG_PROMPT,
+                              "max_new_tokens": 10})
+    assert resp["tokens"] == control
+    assert gw.worker_roles()[resp["node_id"]] == "decode"
+    st = gw.get_stats()["handoff"]
+    assert st["handoffs_spliced"] == 2
+    assert_counters_match_spans(gw)
+    assert _wait(lambda: all(pool_leak_free(w) for w in fleet))
+
+
+def test_disagg_dead_decode_lanes_fall_back_to_replay(fleet, gw):
+    """Both decode lanes die before the continuation dispatch: the
+    exported stream lands on the replay-resume rung — completed
+    byte-identically on a surviving prefill-capable lane, counted as a
+    handoff failure, zero leaks."""
+    control = fleet[1].generator.generate([LONG_PROMPT],
+                                          max_new_tokens=10, seed=2)[0]
+    fleet[2].inject_fault("dead decode lane")
+    fleet[3].inject_fault("dead decode lane")
+    try:
+        toks, fin = consume(gw, {"request_id": "d4",
+                                 "prompt_tokens": LONG_PROMPT,
+                                 "max_new_tokens": 10, "seed": 2})
+        assert toks == control, (toks, control)
+        ho = gw.get_stats()["handoff"]
+        assert ho["handoffs_spliced"] == 0
+        # The hop failed somewhere past routing: dispatch failure (both
+        # decode lanes dead) or — if the export landed first — the
+        # replay fallback; either way it is attributed.
+        assert (ho["dispatch_failed"] + ho["handoff_fallbacks"]
+                + ho["destination_unavailable"] + ho["export_refusals"]
+                >= 1), ho
+        assert_counters_match_spans(gw)
+    finally:
+        fleet[2].heal()
+        fleet[3].heal()
+    assert _wait(lambda: all(pool_leak_free(w)
+                             for w in (fleet[0], fleet[1])))
+
+
+def test_disagg_defaults_off_schema_and_routing(fleet):
+    """disagg off — or an all-'both' fleet — keeps /stats, /health, and
+    routing byte-identical: no handoff key anywhere, no handoff field
+    in payloads, streams come straight off the routed lane."""
+    plain = Gateway(fleet, GatewayConfig())
+    try:
+        st = plain.get_stats()
+        assert "handoff" not in st
+        toks, fin = consume(plain, {"request_id": "p1",
+                                    "prompt_tokens": PROMPT,
+                                    "max_new_tokens": 6})
+        assert len(toks) == 6
+        assert "handoff" not in plain.get_stats()
+    finally:
+        plain.stop()
+    # A 'both' lane's /health carries no role key (absent = both).
+    both = WorkerNode(WorkerConfig(node_id="nb", **GEN_KW))
+    try:
+        h = both.get_health()
+        assert "role" not in h
+        assert "handoff" not in h.get("generator", {})
+    finally:
+        both.stop()
+    # Dedicated-role lanes advertise it (the gateway's discovery key).
+    assert fleet[0].get_health()["role"] == "prefill"
+    assert fleet[2].get_health()["role"] == "decode"
+
+
+def test_admin_role_flip_rebalances_routing(fleet):
+    """set_worker_role rides drain(+migrate)/undrain and updates the
+    role maps: flipping the last decode lane to prefill deactivates
+    disagg; flipping back restores it. Counted + spanned."""
+    g = Gateway(fleet, GatewayConfig(disagg=True))
+    try:
+        assert g._disagg_split() is not None
+        r = g.set_worker_role("w2", "prefill")
+        assert r["ok"] and fleet[2].config.role == "prefill"
+        assert not fleet[2].draining
+        r = g.set_worker_role("w3", "prefill")
+        assert r["ok"]
+        assert g._disagg_split() is None  # no decode-capable lane left
+        # Streams still complete (colocated on prefill lanes).
+        toks, fin = consume(g, {"request_id": "f1",
+                                "prompt_tokens": PROMPT,
+                                "max_new_tokens": 4})
+        assert len(toks) == 4
+        g.set_worker_role("w2", "decode")
+        g.set_worker_role("w3", "decode")
+        assert g._disagg_split() is not None
+        ho = g.get_stats()["handoff"]
+        assert ho["role_flips"] == 4
+        assert ho["roles"] == {"w0": "prefill", "w1": "prefill",
+                               "w2": "decode", "w3": "decode"}
+        with pytest.raises(ValueError):
+            g.set_worker_role("w0", "bogus")
+        with pytest.raises(ValueError):
+            g.set_worker_role("missing", "both")
+        assert_counters_match_spans(g)
+    finally:
+        # The fleet is module-scoped: restore the canonical roles even
+        # if an assertion above tripped mid-flip.
+        for i, role in enumerate(("prefill", "prefill",
+                                  "decode", "decode")):
+            fleet[i].config.role = role
+        g.stop()
+
+
+@pytest.mark.slow
+def test_disagg_handoff_under_concurrency(fleet, gw):
+    """A burst of concurrent disagg streams all splice byte-identically
+    (shared-prefix prompts converge on one prefill lane; decode picks
+    spread by load), with zero leaks after the burst."""
+    control = {}
+    for i in range(6):
+        req = {"request_id": f"c{i}",
+               "prompt_tokens": LONG_PROMPT + [40 + i],
+               "max_new_tokens": 8, "temperature": 0.7, "seed": i}
+        control[i] = fleet[1].generator.generate(
+            [req["prompt_tokens"]], max_new_tokens=8, temperature=0.7,
+            seed=i)[0]
+    results = {}
+    def run(i):
+        results[i] = consume(gw, {"request_id": f"c{i}",
+                                  "prompt_tokens": LONG_PROMPT + [40 + i],
+                                  "max_new_tokens": 8,
+                                  "temperature": 0.7, "seed": i})[0]
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for i in range(6):
+        assert results.get(i) == control[i], (i, results.get(i),
+                                              control[i])
+    ho = gw.get_stats()["handoff"]
+    assert ho["handoffs_spliced"] + ho["handoff_fallbacks"] \
+        + ho["export_refusals"] + ho["dispatch_failed"] \
+        + ho["destination_unavailable"] >= 6
+    assert_counters_match_spans(gw)
+    assert _wait(lambda: all(pool_leak_free(w) for w in fleet),
+                 timeout=30)
+
+
+@pytest.mark.slow
+def test_disagg_quantized_fleet_hands_off_verbatim():
+    """An all-int8 split fleet hands off int8+scale chains verbatim —
+    the stream equals the quantized colocated control (no
+    requantization anywhere on the hop), zero scale-slot leaks."""
+    kw = dict(GEN_KW, gen_kv_quantize="int8")
+    lanes = [WorkerNode(WorkerConfig(node_id=f"q{i}", role=r, **kw))
+             for i, r in enumerate(("prefill", "decode"))]
+    g = Gateway(lanes, GatewayConfig(disagg=True, handoff_timeout_s=20.0))
+    try:
+        p0 = lanes[0].engine.params
+        lanes[1].apply_weights(p0)
+        control = lanes[0].generator.generate([LONG_PROMPT],
+                                              max_new_tokens=10,
+                                              seed=5)[0]
+        toks, fin = consume(g, {"request_id": "q1",
+                                "prompt_tokens": LONG_PROMPT,
+                                "max_new_tokens": 10, "seed": 5})
+        assert toks == control
+        ho = g.get_stats()["handoff"]
+        assert ho["handoffs_spliced"] == 1, ho
+        mig = lanes[1].generator.stats()["migration"]
+        assert mig["imported_rows"] == 1
+        assert _wait(lambda: all(pool_leak_free(w) for w in lanes))
+        for w in lanes:
+            host = w.generator.stats()["kv_pool"].get("host_tier", {})
+            assert host.get("scale_slots_leaked", 0) == 0
+    finally:
+        g.stop()
+        for w in lanes:
+            w.stop()

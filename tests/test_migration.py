@@ -16,6 +16,7 @@ import base64
 import queue
 import threading
 import time
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -129,6 +130,114 @@ def test_chain_checksum_and_geometry_gates():
     assert "block_size" in other.chain_compatible(chain)
     qpool = BlockPool(cfg, 8, 4, jnp.bfloat16, quantize="int8")
     assert qpool.chain_compatible(chain) is not None  # dtype named first
+
+
+def _rechecksum(chain):
+    """Recompute a (possibly doctored) chain's checksum so it is
+    SELF-CONSISTENT — the fuzz tests that must be caught by structural
+    validation, not the crc."""
+    crc = 0
+    for entry in chain["blocks"]:
+        for name in ("k", "v", "ks", "vs"):
+            if name in entry:
+                crc = zlib.crc32(base64.b64decode(entry[name]), crc)
+    return {**chain, "checksum": crc}
+
+
+def test_chain_fuzz_truncated_payloads_refused_before_alloc():
+    """Truncated payload bytes — with a checksum recomputed to match,
+    so only STRUCTURAL validation can catch them — are refused by
+    chain_compatible with the byte counts named, before any allocation
+    (the import gate runs it first; a raw reshape would crash the
+    decode thread and kill every live row)."""
+    cfg = _cfg()
+    pool = BlockPool(cfg, 8, 4, jnp.bfloat16)
+    ids = _fill_blocks(pool, 2)
+    with pool.lock:
+        chain = pool.export_chain(ids)
+    free0 = pool.free_blocks
+    for cut in (0, 1, 17):
+        raw = base64.b64decode(chain["blocks"][1]["k"])[:cut]
+        bad = _rechecksum({**chain, "blocks": [
+            chain["blocks"][0],
+            dict(chain["blocks"][1],
+                 k=base64.b64encode(raw).decode())]})
+        assert BlockPool.verify_chain(bad)  # crc is self-consistent...
+        reason = pool.chain_compatible(bad)  # ...structure still refuses
+        assert reason is not None and str(cut) in reason, (cut, reason)
+    # Missing tensor entirely / non-base64 garbage: named, not crashed.
+    bad = _rechecksum({**chain, "blocks": [
+        {k: v for k, v in chain["blocks"][0].items() if k != "v"}]})
+    assert "missing 'v'" in pool.chain_compatible(bad)
+    bad = {**chain, "blocks": [dict(chain["blocks"][0], k="!!not-b64!!")]}
+    assert "not base64" in pool.chain_compatible(bad)
+    assert pool.free_blocks == free0  # pure validation: nothing allocated
+
+
+def test_chain_fuzz_corrupted_crc_and_garbage():
+    """Corrupted checksums and structurally garbage chains refuse via
+    verify_chain returning False — never an exception (the gate runs on
+    the prefill thread against attacker-shaped bytes)."""
+    cfg = _cfg()
+    pool = BlockPool(cfg, 8, 4, jnp.bfloat16)
+    ids = _fill_blocks(pool, 1)
+    with pool.lock:
+        chain = pool.export_chain(ids)
+    assert not BlockPool.verify_chain({**chain,
+                                       "checksum": chain["checksum"] ^ 1})
+    assert not BlockPool.verify_chain({**chain, "checksum": "wat"})
+    for garbage in ({}, {"blocks": 3}, {"blocks": [None]},
+                    {"blocks": [{"k": 5}], "checksum": 0},
+                    {"blocks": "nope", "checksum": 0}):
+        assert BlockPool.verify_chain(garbage) is False
+
+
+def test_chain_fuzz_mismatched_geometry_headers():
+    """Every geometry/dtype header mismatch is refused with the KEY
+    named — cross-dtype or cross-shape imports would reinterpret bytes
+    (or requantize), never silently land."""
+    cfg = _cfg()
+    pool = BlockPool(cfg, 8, 4, jnp.bfloat16)
+    ids = _fill_blocks(pool, 1)
+    with pool.lock:
+        chain = pool.export_chain(ids)
+    for key, bogus in (("dtype", "float64"), ("quantized", True),
+                       ("block_size", 32), ("n_layers", 7),
+                       ("kv_heads", 5), ("d_head", 48)):
+        reason = pool.chain_compatible({**chain, key: bogus})
+        assert reason is not None and key in reason, (key, reason)
+    # Absent header (old/foreign producer) refuses the same way.
+    chopped = {k: v for k, v in chain.items() if k != "d_head"}
+    assert "d_head" in pool.chain_compatible(chopped)
+
+
+def test_zero_block_chain_refused_before_alloc(fleet):
+    """A snapshot whose chain holds ZERO blocks for a row spanning
+    several must resolve ImportRefused on the validation path — before
+    any allocation (blocks_free untouched)."""
+    src, dst = fleet[0].generator, fleet[1].generator
+    q: queue.Queue = queue.Queue()
+    src.submit(PROMPT, max_new_tokens=16, stream=q, tag="zb")
+    got = []
+    while len(got) < 3:
+        item = q.get(timeout=60)
+        assert item is not None
+        got.extend(item)
+    snap = src.export_row("zb")
+    assert snap["ok"], snap
+    empty = _rechecksum({**snap["chain"], "blocks": []})
+    free0 = dst.stats()["kv_pool"]["blocks_free"]
+    fut = dst.submit_import({**snap, "chain": empty}, tag="zb2")
+    with pytest.raises(ImportRefused) as ei:
+        fut.result(timeout=30)
+    assert "holds 0 blocks" in str(ei.value)
+    assert dst.stats()["kv_pool"]["blocks_free"] == free0
+    assert dst.stats()["migration"]["import_rejected"] >= 1
+    # A chain that is not even an object refuses the same way.
+    fut = dst.submit_import({**snap, "chain": "garbage"}, tag="zb3")
+    with pytest.raises(ImportRefused):
+        fut.result(timeout=30)
+    assert _wait(lambda: pool_leak_free(fleet[1]))
 
 
 def test_migration_counters_schema():

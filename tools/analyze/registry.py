@@ -110,6 +110,7 @@ _RECEIVER_ALIASES = {
     "self.affinity": "AffinityCounters",
     "self.overload": "OverloadCounters",
     "self.migration": "MigrationCounters",
+    "self.handoff": "HandoffCounters",
     "self._tenant_bucket": "TenantRateLimiter",
     "self._shed_stats": "SheddingStats",
     "self._aimd": "AIMDLimit",
@@ -150,13 +151,14 @@ ENGINE_REGISTRY = Registry(
             classes=("BlockPool",),
             receivers=("pool", "self._pool")),
         # Gateway membership / routing state (+ the overload-control
-        # in-flight gauge the tier fractions admit against).
+        # in-flight gauge the tier fractions admit against, + the
+        # disaggregated-serving role map).
         GuardedEntry(
             attrs=("_clients", "_breakers", "_ejected", "_model_rings",
                    "_untyped", "_latency", "_lane_recent",
                    "_affinity_assigned", "_hedge_pool", "default_model",
                    "_total_requests", "_failovers", "_inflight",
-                   "_streams"),
+                   "_streams", "_roles"),
             lock="Gateway._lock",
             classes=("Gateway",)),
         # Live-stream-migration handoff slot: the orchestrator/relay
@@ -211,7 +213,7 @@ ENGINE_REGISTRY = Registry(
         # GIL-safe reads carry explicit lockfree-ok waivers).
         ThreadOwnedEntry(
             attrs=("_tables", "_row_blocks", "_row_req", "_row_emitted",
-                   "_pending"),
+                   "_pending", "_export_waiting", "_hold_cancel_tags"),
             owner_class="ContinuousGenerator",
             module="tpu_engine.runtime.scheduler",
             entries=("ContinuousGenerator._loop",),
@@ -224,7 +226,7 @@ ENGINE_REGISTRY = Registry(
                              "SheddingStats._gc"}),
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
-                                 "overload", "migration"}),
+                                 "overload", "migration", "handoff"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

@@ -70,6 +70,17 @@ asserts the PR 6 resume splices byte-identically on another quantized
 lane with zero device-block, host-block, or scale-slot leaks on the
 survivors.
 
+``--disagg`` runs the STANDALONE disaggregated-serving chaos scenario
+(DESIGN.md "Disaggregated serving"): four worker processes — two
+``--role prefill``, two ``--role decode`` — behind a ``--disagg``
+gateway. Steady state first: every /generate/stream routes to a prefill
+lane, ships its finished KV chain to a decode lane (spliced, zero
+fallbacks, zero replay tokens, counters == kv_handoff spans, zero block
+leaks on all four pools, byte-identical to control). Then kill -9 a
+prefill lane MID-HANDOFF and the adopted stream's decode lane MID-ADOPT
+— both land on the replay fallback byte-identically with zero leaks on
+the survivors.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
@@ -77,6 +88,7 @@ Usage:
   python3 tools/fault_injection.py --spec
   python3 tools/fault_injection.py --crash
   python3 tools/fault_injection.py --quant
+  python3 tools/fault_injection.py --disagg
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -576,12 +588,14 @@ def run_spec_standalone() -> int:
             proc.kill()
 
 
-def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=()):
+def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=(),
+                        per_worker_args=None):
     """Spawn ``n`` standalone worker processes (``cli worker``, paged KV,
     tiny chunks so streams span many frames) — the killable unit of the
     crash/offload scenarios. ``extra_args`` append to each worker's argv
-    (the offload scenario adds a tiny pool + ``--kv-host-blocks``).
-    Returns (ports, procs)."""
+    (the offload scenario adds a tiny pool + ``--kv-host-blocks``);
+    ``per_worker_args[i]`` appends per worker (the disagg scenario's
+    ``--role`` split). Returns (ports, procs)."""
     from tpu_engine.utils.net import launch_with_retry
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -591,10 +605,12 @@ def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=()):
 
     def make_spawn(i):
         def spawn(port: int):
+            per = (tuple(per_worker_args[i])
+                   if per_worker_args is not None else ())
             cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "worker",
                    str(port), f"w{i}", "gpt2-small-test",
                    "--kv-block-size", "16", "--step-chunk", "2",
-                   "--prefill-chunk", "16", *extra_args]
+                   "--prefill-chunk", "16", *extra_args, *per]
             proc = subprocess.Popen(cmd, cwd=repo, env=env,
                                     stdout=sys.stderr, stderr=sys.stderr)
             deadline = time.monotonic() + 600
@@ -1368,6 +1384,268 @@ def run_migrate_standalone() -> int:
                 proc.kill()
 
 
+def _handoff_counters_match_spans(gw) -> bool:
+    from tpu_engine.serving.resilience import HandoffCounters
+
+    ho = gw.get_stats().get("handoff", {})
+    expect = sum(ho.get(f, 0) for f in HandoffCounters.SPAN_FIELDS)
+    spans = [s for s in gw.tracer.snapshot() if s["op"] == "kv_handoff"]
+    return len(spans) == expect
+
+
+def disagg_phase(ports, procs, checks: list) -> dict:
+    """Disaggregated-serving chaos (--disagg) over 2 prefill + 2 decode
+    worker processes behind a role-aware gateway. Phase A: steady-state
+    Poisson load — every stream routes to a prefill lane, hands its KV
+    chain to a decode lane (spliced, zero fallbacks, zero replay
+    tokens), and completes byte-identical to an unkilled control; every
+    handoff decision has a matching counter AND kv_handoff span; zero
+    block leaks on all four pools. Phase B: kill -9 a PREFILL lane
+    mid-handoff (row admitted, chain not yet shipped) — the stream
+    lands on the replay fallback and still completes byte-identically.
+    Phase C: kill -9 the DECODE lane mid-adopt (continuation spliced
+    and streaming) — same replay guarantee, zero leaks on survivors."""
+    import random
+    import signal
+    import threading
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(disagg=True, handoff_timeout_s=60.0,
+                               failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    roles = gw.worker_roles()
+    checks.append(("disagg: gateway discovered the role split",
+                   sorted(roles.values())
+                   == ["decode", "decode", "prefill", "prefill"]))
+
+    # ---- Phase A: steady-state handoff under Poisson load ---------------
+    requests = []
+    for k in range(8):
+        params = {}
+        if k % 3 == 1:
+            params = {"temperature": 0.9, "seed": 300 + k}
+        elif k % 3 == 2:
+            params = {"temperature": 0.8, "seed": 400 + k,
+                      "repetition_penalty": 1.3, "stop_tokens": [7],
+                      "top_p": 0.9}
+        requests.append({
+            "request_id": f"dg{k}",
+            "prompt_tokens": [(k * 7 + j) % 90 + 1
+                              for j in range(18 + k % 5)],
+            "max_new_tokens": 20, **params})
+    try:
+        control = control_oracle(ports[0], requests)
+    except RuntimeError as exc:
+        checks.append(("disagg: control generate", False))
+        return {"error": str(exc)}
+
+    rng = random.Random(11)
+    results: dict = {}
+    lock = threading.Lock()
+
+    def consume(req, progress=None):
+        toks, final = [], None
+        try:
+            for frame in gw.route_generate_stream(dict(req)):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    final = evt
+                    break
+                if "tokens" in evt:
+                    toks.extend(evt["tokens"])
+                    if progress is not None:
+                        progress(req["request_id"], len(toks))
+        except Exception as exc:
+            final = {"harness_exception": str(exc)}
+        with lock:
+            results[req["request_id"]] = (toks, final)
+
+    threads = []
+    for req in requests:
+        t = threading.Thread(target=consume, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(rng.expovariate(20.0))
+    for t in threads:
+        t.join(timeout=600)
+    complete, identical, _ = tally_streams(results, control)
+    checks.append(("disagg: all steady-state streams completed "
+                   f"({complete}/{len(requests)})",
+                   complete == len(requests)))
+    checks.append(("disagg: all streams byte-identical to control "
+                   f"({identical}/{len(requests)})",
+                   identical == len(requests)))
+    ho = gw.get_stats().get("handoff", {})
+    fo = gw.get_stats().get("failover", {})
+    checks.append(("disagg: every stream routed to a prefill lane "
+                   f"({ho.get('prefill_routed', 0)})",
+                   ho.get("prefill_routed", 0) == len(requests)))
+    checks.append(("disagg: every handoff spliced onto a decode lane "
+                   f"({ho.get('handoffs_spliced', 0)})",
+                   ho.get("handoffs_spliced", 0) == len(requests)))
+    checks.append(("disagg: zero handoff fallbacks in steady state",
+                   ho.get("handoff_fallbacks", 0) == 0
+                   and ho.get("export_refusals", 0) == 0
+                   and ho.get("dispatch_failed", 0) == 0))
+    checks.append(("disagg: zero tokens replayed (zero re-prefill)",
+                   fo.get("tokens_replayed", 0) == 0))
+    checks.append(("disagg: counters == kv_handoff spans",
+                   _handoff_counters_match_spans(gw)))
+    imported = exported = holds = 0
+    for p in ports:
+        pool = _worker_pool_clean(p)
+        checks.append((f"disagg: zero blocks leaked on :{p}",
+                       pool is not None))
+        _, health = _call(p, "GET", "/health", timeout=10)
+        g = health.get("generator") or {}
+        imported += (g.get("migration") or {}).get("imported_rows", 0)
+        exported += (g.get("migration") or {}).get("exported_rows", 0)
+        holds += (g.get("handoff") or {}).get("holds", 0)
+    checks.append(("disagg: prefill lanes exported every row "
+                   f"({exported})", exported >= len(requests)))
+    checks.append(("disagg: decode lanes adopted every row "
+                   f"({imported})", imported >= len(requests)))
+    checks.append((f"disagg: rows parked for handoff ({holds})",
+                   holds >= len(requests)))
+    phase_a = {"streams": len(requests), "complete": complete,
+               "identical": identical, "handoff": ho,
+               "exported_rows": exported, "imported_rows": imported}
+
+    # ---- Phase B: kill -9 the PREFILL lane mid-handoff ------------------
+    victim_lane = next(l for l in lanes if roles[l] == "prefill")
+    victim_port = next(p for p in ports
+                       if victim_lane.endswith(f":{p}"))
+    victim_idx = ports.index(victim_port)
+    rid_b = rid_for_lane(gw._prefill_ring, victim_lane, "dgb")
+    req_b = {"request_id": rid_b,
+             "prompt_tokens": [9, 4, 1, 8, 3, 6, 2, 11, 5, 7],
+             "max_new_tokens": 24, "temperature": 0.7, "seed": 77}
+    control_b = control_oracle(ports[1], [req_b])[rid_b]
+    tb = threading.Thread(target=consume, args=(req_b,), daemon=True)
+
+    def victim_admitted() -> bool:
+        try:
+            _, health = _call(victim_port, "GET", "/health", timeout=2)
+        except OSError:
+            return False
+        return (health.get("generator") or {}).get("active", 0) >= 1
+
+    tb.start()
+    deadline = time.monotonic() + 60
+    fired = False
+    while time.monotonic() < deadline:
+        if victim_admitted():
+            # The row is on the prefill lane (prefilling or parked,
+            # chain not yet adopted elsewhere): kill mid-handoff.
+            procs[victim_idx].send_signal(signal.SIGKILL)
+            procs[victim_idx].wait(timeout=10)
+            fired = True
+            break
+        time.sleep(0.01)
+    tb.join(timeout=600)
+    toks_b, final_b = results.get(rid_b, ([], None))
+    checks.append(("disagg: prefill lane killed mid-handoff", fired))
+    checks.append(("disagg: prefill-death stream completed "
+                   "byte-identically via the replay fallback",
+                   stream_completed(final_b) and toks_b == control_b
+                   and final_b.get("tokens") == control_b))
+    checks.append(("disagg: phase-B counters == kv_handoff spans",
+                   _handoff_counters_match_spans(gw)))
+    survivors_b = [p for p in ports if p != victim_port]
+    for p in survivors_b:
+        pool = _worker_pool_clean(p)
+        checks.append((f"disagg: zero blocks leaked on survivor :{p}",
+                       pool is not None))
+    phase_b = {"victim": victim_lane, "completed_identical":
+               stream_completed(final_b) and toks_b == control_b}
+
+    # ---- Phase C: kill -9 the DECODE lane mid-adopt ---------------------
+    live_prefill = next(l for l in lanes
+                        if roles[l] == "prefill" and l != victim_lane)
+    rid_c = rid_for_lane(gw._prefill_ring, live_prefill, "dgc")
+    req_c = {"request_id": rid_c,
+             "prompt_tokens": [3, 14, 8, 2, 9, 5, 1, 12],
+             "max_new_tokens": 60}
+    alive_port = next(p for p in ports
+                      if procs[ports.index(p)].poll() is None)
+    control_c = control_oracle(alive_port, [req_c])[rid_c]
+    progress = {"n": 0}
+
+    def track(_rid, n):
+        progress["n"] = n
+
+    tc = threading.Thread(target=consume, args=(req_c, track),
+                          daemon=True)
+    tc.start()
+    deadline = time.monotonic() + 120
+    fired_c = False
+    while time.monotonic() < deadline:
+        serving = gw.active_streams().get(rid_c)
+        if (progress["n"] >= 3 and serving is not None
+                and roles.get(serving) == "decode"):
+            # The decode lane ADOPTED the chain and is streaming: kill
+            # it mid-adopt(ed decode).
+            dport = next(p for p in ports if serving.endswith(f":{p}"))
+            didx = ports.index(dport)
+            procs[didx].send_signal(signal.SIGKILL)
+            procs[didx].wait(timeout=10)
+            fired_c = True
+            break
+        time.sleep(0.01)
+    tc.join(timeout=600)
+    toks_c, final_c = results.get(rid_c, ([], None))
+    checks.append(("disagg: decode lane killed mid-adopt", fired_c))
+    checks.append(("disagg: decode-death stream completed "
+                   "byte-identically via the replay fallback",
+                   stream_completed(final_c) and toks_c == control_c
+                   and final_c.get("tokens") == control_c))
+    checks.append(("disagg: phase-C counters == kv_handoff spans",
+                   _handoff_counters_match_spans(gw)))
+    survivors_c = [p for p in ports
+                   if procs[ports.index(p)].poll() is None]
+    for p in survivors_c:
+        pool = _worker_pool_clean(p)
+        checks.append((f"disagg: zero blocks leaked on survivor :{p} "
+                       "after the decode kill", pool is not None))
+    gw.stop()
+    return {"phase_a": phase_a, "phase_b": phase_b,
+            "phase_c": {"completed_identical":
+                        stream_completed(final_c)
+                        and toks_c == control_c}}
+
+
+def run_disagg_standalone() -> int:
+    ports, procs = launch_worker_procs(
+        4, extra_args=("--kv-blocks", "60"),
+        per_worker_args=(("--role", "prefill"), ("--role", "prefill"),
+                         ("--role", "decode"), ("--role", "decode")))
+    checks: list = []
+    try:
+        report = {"mode": "disagg-standalone", "worker_ports": ports,
+                  "phases": {"disagg": disagg_phase(ports, procs,
+                                                    checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_offload_standalone() -> int:
     ports, procs = launch_worker_procs(
         3, extra_args=("--kv-blocks", "20", "--kv-host-blocks", "16"))
@@ -1944,6 +2222,16 @@ def main() -> int:
                          "(int8+scale chains verbatim, zero scale-slot "
                          "leaks); counters == migration spans "
                          "throughout; ignores the other flags")
+    ap.add_argument("--disagg", action="store_true",
+                    help="standalone disaggregated-serving scenario: "
+                         "spawns 2 prefill + 2 decode worker processes "
+                         "behind a role-aware gateway, proves the "
+                         "steady-state KV chain handoff live (spliced, "
+                         "zero fallbacks, byte-identical, zero leaks, "
+                         "counters == kv_handoff spans), then kill -9s "
+                         "a prefill lane mid-handoff and a decode lane "
+                         "mid-adopt — both land on the replay fallback "
+                         "byte-identically; ignores the other flags")
     ap.add_argument("--overload", action="store_true",
                     help="standalone overload-control scenario: spawns a "
                          "3-lane combined server with every overload "
@@ -1955,6 +2243,8 @@ def main() -> int:
                          "marker spans, and zero KV blocks leak; "
                          "ignores the other flags")
     args = ap.parse_args()
+    if args.disagg:
+        return run_disagg_standalone()
     if args.migrate:
         return run_migrate_standalone()
     if args.quant:

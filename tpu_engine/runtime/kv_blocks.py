@@ -761,10 +761,18 @@ class BlockPool:
     @staticmethod
     def verify_chain(chain: dict) -> bool:
         """Recompute the chain checksum over the decoded payload bytes —
-        the destination's first gate, BEFORE any block is allocated."""
+        the destination's first gate, BEFORE any block is allocated.
+        Structurally garbage chains (blocks not a list of objects) are
+        False, never a pass-through: an empty or non-iterable block
+        list must not verify against a zero checksum."""
         crc = 0
         try:
-            for entry in chain["blocks"]:
+            blocks = chain["blocks"]
+            if not isinstance(blocks, (list, tuple)):
+                return False
+            for entry in blocks:
+                if not isinstance(entry, dict):
+                    return False
                 for name in ("k", "v", "ks", "vs"):
                     if name in entry:
                         crc = zlib.crc32(

@@ -123,6 +123,17 @@ class _Request:
     # prefilling (DESIGN.md "Live stream migration").
     tag: Optional[str] = None
     migrate: Optional[dict] = None
+    # Disaggregated serving (DESIGN.md "Disaggregated serving"): a
+    # handoff request PARKS after prefill — the row holds its first
+    # token and KV chain, skipping decode ticks, until the gateway's
+    # export command ships it to a decode lane (or `park_s` seconds
+    # pass and the row decodes locally — the colocated fallback, so a
+    # handoff whose orchestrator died can never strand a client).
+    # `park_until` is stamped at HOLD time (prefill completion): a slow
+    # prefill must not eat the export window.
+    handoff: bool = False
+    park_s: float = 5.0
+    park_until: float = 0.0
 
 
 class _StaleAdmission(RuntimeError):
@@ -405,6 +416,13 @@ class ContinuousGenerator:
         self._done = np.ones((self.n_slots,), bool)          # sampling mask
         self._row_req: List[Optional[_Request]] = [None] * self.n_slots
         self._row_emitted: List[List[int]] = [[] for _ in range(self.n_slots)]
+        # Disaggregated handoff holds: a True slot is a live row parked
+        # after prefill (first token emitted, KV chain complete) waiting
+        # for the gateway's export-after-prefill command — excluded from
+        # decode dispatch so a prefill-role lane never spends decode-tick
+        # work on rows it is about to ship. Decode-thread-owned like the
+        # row tables.
+        self._held: List[bool] = [False] * self.n_slots
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         # Live stream migration: (tag, Future) export commands enqueued
@@ -413,6 +431,15 @@ class ContinuousGenerator:
         # state and pool blocks are mutually consistent). queue.Queue:
         # its own lock, no registry entry needed.
         self._migrate_q: "queue.Queue[tuple]" = queue.Queue()
+        # Export commands waiting on a row's prefill (wait_prefill):
+        # re-checked at every tick boundary, decode-thread-owned.
+        self._export_waiting: List[tuple] = []
+        # Handoff cancels that arrived BEFORE the row parked (still
+        # queued or prefilling): remembered so the row skips its park
+        # instead of waiting out the full window for an orchestrator
+        # that already gave up. Decode-thread-owned; bounded.
+        self._hold_cancel_tags: "collections.deque" = collections.deque(
+            maxlen=64)
         # Prefilled requests ready for row insertion: (req, row_caches,
         # first_tok, pb, L). The prefill thread fills this so admission work
         # (prompt forward + first-token sample, with its host sync) never
@@ -1159,7 +1186,9 @@ class ContinuousGenerator:
                repetition_penalty: float = 1.0, stop_tokens=None,
                min_p: float = 0.0, stream=None,
                deadline: Optional[Deadline] = None,
-               sink=None, tag: Optional[str] = None) -> Future:
+               sink=None, tag: Optional[str] = None,
+               handoff: bool = False,
+               handoff_park_s: float = 5.0) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
@@ -1169,7 +1198,13 @@ class ContinuousGenerator:
         DeadlineExceeded if it expires before prefill or mid-decode (the
         row is freed; already-streamed tokens stand). `sink`: optional
         utils.tracing.TraceSink — the scheduler records queue_wait /
-        prefill / decode stage spans for this request against it."""
+        prefill / decode stage spans for this request against it.
+        `handoff` (paged mode): park the row after prefill — first
+        token emitted, decode ticks skipped — for up to
+        `handoff_park_s` seconds awaiting an export-after-prefill
+        command (export_row(wait_prefill=True)); past the park window
+        the row decodes locally like any other (the colocated
+        fallback). Ignored on dense layouts (nothing to export)."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
         pens, stops = expand_stopping_params(1, repetition_penalty,
@@ -1193,13 +1228,21 @@ class ContinuousGenerator:
                        stop_tokens=stops[0], min_p=float(min_p),
                        stream=stream, deadline=deadline, sink=sink,
                        t_submit=time.perf_counter(),
-                       tag=str(tag) if tag is not None else None)
+                       tag=str(tag) if tag is not None else None,
+                       handoff=bool(handoff) and self._paged,
+                       # Clamped: a parked row pins a slot + KV chain,
+                       # so the window must stay bounded no matter what
+                       # the caller passed.
+                       park_s=min(300.0, max(0.1,
+                                             float(handoff_park_s))))
         self._queue.put(req)
         return req.future
 
     # -- live stream migration (DESIGN.md "Live stream migration") -------------
 
-    def export_row(self, tag: str, timeout_s: float = 10.0) -> dict:
+    def export_row(self, tag: str, timeout_s: float = 10.0,
+                   wait_prefill: bool = False,
+                   cancel: bool = False) -> dict:
         """Quiesce and export ONE live row by its submit() tag: snapshot
         the stream state (emitted tokens, sampling key position, penalty
         counts' inputs, stop ids, remaining budget) plus its KV block
@@ -1212,16 +1255,32 @@ class ContinuousGenerator:
         Thread-safe; returns ``{"ok": True, ...snapshot...}`` or
         ``{"ok": False, "reason": ...}`` (mid-prefill rows, finished
         rows, unknown tags — the caller falls back to the replay
-        resume, which these cases cost nothing extra)."""
+        resume, which these cases cost nothing extra).
+
+        ``wait_prefill`` (disaggregated serving): instead of refusing a
+        row that has not finished prefill (or not yet admitted), the
+        command PARKS on the decode loop and exports at the first tick
+        boundary after the row's prefill completes — the
+        export-after-prefill half of the steady-state prefill→decode
+        handoff. Bounded by ``timeout_s``; a row that never appears
+        refuses at the bound. ``cancel``: release a handoff HOLD
+        instead of exporting (the orchestrator found no destination) —
+        the row resumes normal decoding immediately."""
         if not self._paged:
             return {"ok": False,
                     "reason": "migration requires the paged KV cache"}
         if not self._running:
             return {"ok": False, "reason": "scheduler stopped"}
         fut: Future = Future()
-        self._migrate_q.put((str(tag), fut))
+        opts: dict = {}
+        if cancel:
+            opts["cancel"] = True
+        elif wait_prefill:
+            opts["wait_until"] = time.monotonic() + max(0.1,
+                                                        float(timeout_s))
+        self._migrate_q.put((str(tag), fut, opts))
         try:
-            return fut.result(timeout=timeout_s)
+            return fut.result(timeout=timeout_s + 1.0)
         except Exception as exc:
             return {"ok": False, "reason": f"export failed: {exc}"}
 
@@ -1275,6 +1334,53 @@ class ContinuousGenerator:
         self._queue.put(req)
         return req.future
 
+    # -- disaggregated handoff holds (DESIGN.md "Disaggregated serving") -------
+
+    def _handoff_stats(self) -> dict:
+        """The additive ``handoff`` stats block, created on first touch
+        (defaults-off /stats and /health bytes stay identical). Bumps
+        hold ``_stats_lock`` like the migration block."""
+        h = self._stats.get("handoff")
+        if h is None:
+            h = self._stats["handoff"] = {
+                "holds": 0, "park_expired": 0, "hold_cancelled": 0,
+            }
+        return h
+
+    def _bump_handoff(self, field: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._handoff_stats()[field] += n
+
+    def _maybe_hold(self, row: int, req: _Request) -> None:
+        """Park a handoff row that just finished prefill (decode
+        thread): the slot keeps its first token and KV chain but skips
+        decode ticks until the export command arrives or the park
+        window passes. A row that already completed (EOS/budget at the
+        first token) has nothing to hand off."""
+        if not req.handoff or self._row_req[row] is not req:
+            return
+        if req.tag is not None and req.tag in self._hold_cancel_tags:
+            # The orchestrator cancelled while the row was still
+            # queued/prefilling: skip the park entirely.
+            self._hold_cancel_tags.remove(req.tag)
+            self._bump_handoff("hold_cancelled")
+            return
+        self._held[row] = True
+        req.park_until = time.monotonic() + req.park_s
+        self._bump_handoff("holds")
+
+    def _unpark_expired(self) -> None:
+        """Decode loop, once per iteration: a held row whose park window
+        passed resumes normal decoding — the colocated fallback when the
+        gateway's export never came (orchestrator death, cancelled
+        handoff race). The relayed stream simply continues from the
+        source lane, byte-identical to an undisaggregated run."""
+        now = time.monotonic()
+        for r, req in enumerate(self._row_req):
+            if req is not None and self._held[r] and now >= req.park_until:
+                self._held[r] = False
+                self._bump_handoff("park_expired")
+
     def _migration_stats(self) -> dict:
         """The additive ``migration`` stats block, created on first
         touch (defaults-off /stats and /health bytes stay identical).
@@ -1296,30 +1402,80 @@ class ContinuousGenerator:
 
     def _serve_exports(self) -> None:
         """Drain pending export commands — called by the decode loop at
-        the top of every iteration (the tick boundary)."""
+        the top of every iteration (the tick boundary). Commands whose
+        row has not finished prefill yet (wait_prefill, the
+        disaggregated handoff shape) re-park until the next boundary,
+        bounded by their own deadline."""
+        pending = self._export_waiting
+        self._export_waiting = []
         while True:
             try:
-                tag, fut = self._migrate_q.get_nowait()
+                pending.append(self._migrate_q.get_nowait())
             except queue.Empty:
-                return
+                break
+        for tag, fut, opts in pending:
+            if fut.done():
+                continue
             try:
-                result = self._do_export(tag)
+                if opts.get("cancel"):
+                    result = self._cancel_hold(tag)
+                else:
+                    result = self._do_export(tag, opts)
             except Exception as exc:  # never kill the loop over an export
                 result = {"ok": False, "reason": f"export failed: {exc}"}
+            if result is None:  # row not exportable YET: re-check next tick
+                self._export_waiting.append((tag, fut, opts))
+                continue
             if not fut.done():
                 fut.set_result(result)
 
-    def _do_export(self, tag: str) -> dict:
+    def _cancel_hold(self, tag: str) -> dict:
+        """Release a handoff hold (the orchestrator is not coming): the
+        row resumes normal decoding at the next tick. A row that has
+        not PARKED yet (still queued or prefilling) has its future park
+        cancelled instead — it must never wait out a window nobody will
+        collect. ok:False — there is no snapshot; ``cancelled`` reports
+        whether a hold existed or was pre-empted."""
+        row = next((r for r, req in enumerate(self._row_req)
+                    if req is not None and req.tag == tag), None)
+        if row is not None:
+            req = self._row_req[row]
+            was_held = self._held[row]
+            self._held[row] = False
+            cancelled = was_held or req.handoff
+            req.handoff = False  # mixed mid-prefill: skip the park too
+            if cancelled:
+                self._bump_handoff("hold_cancelled")
+            return {"ok": False, "cancelled": cancelled,
+                    "reason": "handoff hold cancelled" if cancelled
+                    else "no held row with this tag"}
+        # Not admitted yet: remember the cancel so _maybe_hold skips
+        # the park when the row finally lands.
+        if tag not in self._hold_cancel_tags:
+            self._hold_cancel_tags.append(tag)
+        return {"ok": False, "cancelled": False,
+                "reason": "no live row with this tag; park pre-cancelled"}
+
+    def _do_export(self, tag: str, opts: Optional[dict] = None) -> dict:
         """Decode-thread half of export_row (the row is quiescent by
         construction here). On success the row is GONE from this lane:
         stream flushed + ended with StreamMigratedAway, blocks released
-        (radix-shared prefix blocks survive in the tree), slot freed."""
+        (radix-shared prefix blocks survive in the tree), slot freed.
+        Returns None when a ``wait_until``-carrying command must re-park
+        (row still queued/prefilling and the bound has not passed)."""
+        waiting = (opts is not None
+                   and opts.get("wait_until") is not None
+                   and time.monotonic() < opts["wait_until"])
         row = next((r for r, req in enumerate(self._row_req)
                     if req is not None and req.tag == tag), None)
         if row is None:
+            if waiting:
+                return None  # not admitted yet (queued or prefilling)
             return {"ok": False, "reason": "no live row with this tag"}
         req = self._row_req[row]
         if self._mixed and self._prefilling[row]:
+            if waiting:
+                return None  # prefill chunks still running
             # Nothing emitted yet — a replay resume re-prefills exactly
             # what an import would have to ship; refusing is free.
             self._bump_migration("export_refused")
@@ -1463,6 +1619,14 @@ class ContinuousGenerator:
             # Snapshot, not the live nested dict (same rule as "mixed").
             with self._stats_lock:
                 out["migration"] = dict(self._stats["migration"])
+        if "handoff" in self._stats:
+            # Disaggregated prefill→decode handoff holds (additive,
+            # created on first hold — defaults-off bytes identical).
+            with self._stats_lock:
+                ho = dict(self._stats["handoff"])
+            ho["held_rows"] = int(sum(  # lint: lockfree-ok GIL-safe scrape
+                1 for h in self._held if h))
+            out["handoff"] = ho
         # Additive, present only while a brownout degradation is engaged
         # (defaults-off stats bytes unchanged).
         if (self._bo_budget_frac < 1.0 or self._bo_spec_off
@@ -1999,6 +2163,7 @@ class ContinuousGenerator:
             # The drafter's lookup corpus: prompt + emitted-so-far.
             self._row_prompt_toks[row] = prompt
         self._init_row(req, row, first_tok, pos=first_col, start=0)
+        self._maybe_hold(row, req)
 
     def _admit_mixed(self, item, row: int) -> None:
         """Mixed-mode admission (decode thread): allocate the bucket's
@@ -2238,7 +2403,10 @@ class ContinuousGenerator:
         """Drop a row's mixed-mode prefill / speculative state
         (completion, deadline cancel, recovery, shutdown): the row must
         never reappear in a later tick's ragged batch, and the drafter
-        must never see a freed row's history."""
+        must never see a freed row's history. Handoff holds clear on
+        every one of those paths too — a freed slot must never stay
+        parked."""
+        self._held[row] = False
         if self._mixed:
             self._prefilling[row] = False
             self._row_prompt[row] = None
@@ -2409,12 +2577,16 @@ class ContinuousGenerator:
                 if item is not None:
                     self._discard_item(item)
                     self._fail_request(item[0], exc)
-            # Pending export commands: answer, never strand the caller.
+            # Pending export commands (queued AND parked wait_prefill
+            # ones): answer, never strand the caller.
+            stranded = list(self._export_waiting)
+            self._export_waiting = []
             while True:
                 try:
-                    _tag, fut = self._migrate_q.get_nowait()
+                    stranded.append(self._migrate_q.get_nowait())
                 except queue.Empty:
                     break
+            for _tag, fut, _opts in stranded:
                 if not fut.done():
                     fut.set_result({"ok": False,
                                     "reason": "scheduler stopped"})
@@ -2432,6 +2604,8 @@ class ContinuousGenerator:
         for r, req in enumerate(self._row_req):
             if req is None or self._done[r]:
                 continue  # done rows rewrite their own (allocated) column
+            if self._held[r]:
+                continue  # parked handoff rows decode nothing this tick
             if self._mixed and self._prefilling[r]:
                 continue  # bucket + first-decode blocks reserved at admit
             last_col = min(int(self._pos[r]) + self._row_horizon(r, req),
@@ -2508,6 +2682,7 @@ class ContinuousGenerator:
         self._first_token_metrics(req, r)
         self._push_stream(r, req)
         self._maybe_complete(r)
+        self._maybe_hold(r, req)
 
     def _tick_mixed(self) -> None:
         """One mixed tick: form the ragged batch (decode rows x 1 token +
@@ -2531,6 +2706,8 @@ class ContinuousGenerator:
                 eos_vec[r] = req.eos_id
             if req.rep_penalty != 1.0 or req.stop_tokens:
                 controls = True
+            if self._held[r]:
+                continue  # parked handoff rows: no budget, no decode slot
             if self._prefilling[r]:
                 prefill_rows.append(r)
             else:
@@ -2578,7 +2755,10 @@ class ContinuousGenerator:
                 qlen[r] = 1
                 tokens[r, 0] = self._tok[r]
                 fold_pos[r] = int(self._pos[r]) + 1
-                active[r] = not self._done[r]
+                # Parked handoff rows ride inactive (like done rows):
+                # writes confined to the not-yet-valid column `pos`,
+                # sampled token discarded, host state untouched below.
+                active[r] = not self._done[r] and not self._held[r]
 
         # ONE dispatch, under the pool lock (it donates the pool buffers).
         with pool.lock:
@@ -2630,6 +2810,8 @@ class ContinuousGenerator:
             req = self._row_req[r]
             if req is None:
                 continue
+            if self._held[r]:
+                continue  # parked: nothing was dispatched for this row
             if self._prefilling[r]:
                 self._row_w0[r] += int(chunk[r])
                 if not completing[r]:
@@ -2687,6 +2869,8 @@ class ContinuousGenerator:
                 eos_vec[r] = req.eos_id
             if req.rep_penalty != 1.0 or req.stop_tokens:
                 controls = True
+            if self._held[r]:
+                continue  # parked handoff rows: no budget, no proposals
             if self._mixed and self._prefilling[r]:
                 prefill_rows.append(r)
             else:
@@ -2709,7 +2893,8 @@ class ContinuousGenerator:
         drafts: List[List[int]] = [[] for _ in range(B)]
         proposed = 0
         for r, req in enumerate(self._row_req):
-            if (req is None or self._done[r] or self._bo_spec_off
+            if (req is None or self._done[r] or self._held[r]
+                    or self._bo_spec_off
                     or (self._mixed and self._prefilling[r])):
                 # Brownout spec suspension: no proposals — every row
                 # rides q_len 1 through the same compiled dispatch
@@ -2783,7 +2968,8 @@ class ContinuousGenerator:
                 # the flag below selects the compiled variant, so the
                 # all-greedy common case never traces it.
                 stoch[r] = req.temperature > 0 and nd > 0
-                active[r] = not self._done[r]
+                # Parked handoff rows ride inactive like done rows.
+                active[r] = not self._done[r] and not self._held[r]
         stochastic = bool(stoch.any())
 
         # ONE dispatch, under the pool lock (it donates the pool buffers).
@@ -2846,6 +3032,8 @@ class ContinuousGenerator:
             req = self._row_req[r]
             if req is None:
                 continue
+            if self._held[r]:
+                continue  # parked: nothing was dispatched for this row
             if self._mixed and self._prefilling[r]:
                 self._row_w0[r] += int(chunk[r])
                 if not completing[r]:
@@ -3011,7 +3199,19 @@ class ContinuousGenerator:
                     self._recover(exc)
                     break
             self._cancel_expired_rows()
-            if all(r is None for r in self._row_req):
+            if self._paged:
+                # Handoff holds past their park window resume decoding
+                # (the colocated fallback — the export never came).
+                self._unpark_expired()
+            live = [r for r in range(self.n_slots)
+                    if self._row_req[r] is not None]
+            if not live:
+                continue
+            if self._paged and all(self._held[r] for r in live):
+                # Only parked handoff rows: no dispatchable work this
+                # tick — idle briefly instead of spinning while the
+                # export command (or the park bound) arrives.
+                time.sleep(0.002)
                 continue
 
             if self._mixed or self._spec:
@@ -3043,6 +3243,19 @@ class ContinuousGenerator:
                     if req is not None and (req.rep_penalty != 1.0
                                             or req.stop_tokens):
                         controls = True
+                # Handoff holds ride the chunk as DONE rows (pos frozen,
+                # sampled tokens discarded, writes confined to the
+                # not-yet-valid column `pos`) and restore their host
+                # state after — a parked row spends no budget and emits
+                # nothing while it waits for export.
+                held_rows = ([r for r in live if self._held[r]]
+                             if self._paged else [])
+                done_in = self._done
+                if held_rows:
+                    done_in = self._done.copy()
+                    done_in[held_rows] = True
+                    saved = [(r, int(self._tok[r]), int(self._pos[r]))
+                             for r in held_rows]
                 if self._paged:
                     # Pool-donating dispatch under the pool lock so the
                     # prefill thread's prefix gathers order before it.
@@ -3054,7 +3267,7 @@ class ContinuousGenerator:
                                   jnp.asarray(self._tables),
                                   jnp.asarray(self._tok),
                                   jnp.asarray(self._pos),
-                                  jnp.asarray(self._done),
+                                  jnp.asarray(done_in),
                                   jnp.asarray(self._seeds),
                                   jnp.asarray(self._temps),
                                   jnp.asarray(self._topps),
@@ -3104,13 +3317,19 @@ class ContinuousGenerator:
                 self._pos = np.array(pos)
                 self._done = np.array(done)
                 toks_host = np.asarray(toks)
+                for r, tok_r, pos_r in (saved if held_rows else ()):
+                    # Parked rows rode the dispatch masked done: restore
+                    # their true pending state (they are NOT done).
+                    self._tok[r] = tok_r
+                    self._pos[r] = pos_r
+                    self._done[r] = False
             except Exception as exc:
                 self._recover(exc)
                 continue
             self._stats["chunks"] += 1
 
             for r, req in enumerate(self._row_req):
-                if req is None:
+                if req is None or self._held[r]:
                     continue
                 need = req.max_new - len(self._row_emitted[r])
                 if need > 0:

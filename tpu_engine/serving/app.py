@@ -89,6 +89,11 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     # continuation rides /generate/stream with a `migrate_import` body.
     server.route("POST", "/admin/migrate",
                  lambda body: (200, worker.handle_migrate_export(body or {})))
+    # Disaggregated serving: flip the lane's role at runtime (the
+    # gateway's set_worker_role rides drain + migrate around this).
+    server.route("POST", "/admin/role",
+                 lambda body: (200, worker.set_role((body or {}).get(
+                     "role", ""))))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -116,6 +121,11 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     }))
     server.route("GET", "/trace/export", lambda _body: (
         200, export_chrome({"gateway": gateway.tracer})))
+    # Disaggregated serving: flip a lane's role fleet-side — the
+    # gateway drains + migrates streams off the lane around the flip.
+    server.route("POST", "/admin/role", lambda body: (
+        200, gateway.set_worker_role((body or {}).get("node", ""),
+                                     (body or {}).get("role", ""))))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
     print("Circuit breakers enabled")
@@ -180,6 +190,7 @@ def serve_combined(
     warmup: bool = False,
     native_front: Optional[bool] = None,
     mesh=None,
+    lane_roles: Optional[List[str]] = None,
 ):
     """One process: HTTP front door + in-process lanes over local devices.
 
@@ -192,6 +203,12 @@ def serve_combined(
     engine spans all mesh devices — the dynamic batcher aggregates requests
     and each batch is scattered over the ``data`` axis / computed against
     ``model``-sharded weights in a single XLA dispatch.
+
+    ``lane_roles`` (disaggregated serving): per-lane serving roles
+    assigned round-robin, e.g. ["prefill", "prefill", "decode",
+    "decode"] — pair with a ``--disagg`` gateway config so fresh
+    generate work lands on prefill lanes and finished KV chains ship to
+    decode lanes. None (default) uses ``worker_config.role`` uniformly.
     """
     import jax
 
@@ -231,11 +248,19 @@ def serve_combined(
                 f"lanes={lanes} cannot serve {len(models)} models — "
                 f"later-listed models would silently get no lane")
         n_lanes = lanes or max(len(devices), len(models))
+        if lane_roles and lanes and lanes < len(lane_roles):
+            raise ValueError(
+                f"lanes={lanes} cannot honor {len(lane_roles)} lane "
+                f"roles — later-listed roles would silently get no lane")
+        if lane_roles:
+            n_lanes = max(n_lanes, len(lane_roles))
         for i in range(n_lanes):
             cfg = worker_config or WorkerConfig()
-            lane_cfg = WorkerConfig(**{**cfg.__dict__,
-                                       "node_id": f"worker_{i+1}",
-                                       "model": models[i % len(models)]})
+            over = {"node_id": f"worker_{i+1}",
+                    "model": models[i % len(models)]}
+            if lane_roles:
+                over["role"] = lane_roles[i % len(lane_roles)]
+            lane_cfg = WorkerConfig(**{**cfg.__dict__, **over})
             from tpu_engine.runtime.engine import InferenceEngine
 
             engine = InferenceEngine(
@@ -397,6 +422,18 @@ def serve_combined(
                      and action == "drain"}
 
     routes[("POST", "/admin/drain")] = _admin_drain
+
+    # Role flips (disaggregated serving): {"node": "worker_1", "role":
+    # "prefill"|"decode"|"both"} — the gateway rides /admin/drain +
+    # stream migration around the flip so live streams move, not break.
+    def _admin_role(body):
+        node = (body or {}).get("node")
+        role = (body or {}).get("role", "")
+        if not any(w.node_id == node for w in workers):
+            return 404, {"error": f"unknown node '{node}'"}
+        return 200, gateway.set_worker_role(node, role)
+
+    routes[("POST", "/admin/role")] = _admin_role
 
     # Tracing (SURVEY.md §5: the reference has only per-request wall
     # clocks). "summary"/"recent" keep the original schema; "gateway" and

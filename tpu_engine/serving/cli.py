@@ -131,6 +131,16 @@ def main(argv=None) -> int:
                                  "gracefully (budget shrink, spec off, "
                                  "swap-in deferral, low-tier clamp) "
                                  "before shedding")
+        parser.add_argument("--role", default=None,
+                            choices=("prefill", "decode", "both"),
+                            help="disaggregated serving role (needs "
+                                 "--kv-block-size for dedicated roles): "
+                                 "a role-aware gateway (--disagg) lands "
+                                 "fresh generate work on prefill lanes "
+                                 "and ships finished KV chains to "
+                                 "decode lanes; flippable at runtime "
+                                 "via /admin/role (default: both = "
+                                 "today's colocated behavior)")
         args = parser.parse_args(rest)
         port = args.port
         node_id = args.node_id or f"worker_{port}"
@@ -178,6 +188,8 @@ def main(argv=None) -> int:
             gen_kw["adaptive_depth"] = True
         if args.brownout:
             gen_kw["brownout"] = True
+        if args.role is not None:
+            gen_kw["role"] = args.role
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
                            model_path=model_path, **gen_kw)
@@ -268,6 +280,20 @@ def main(argv=None) -> int:
         parser.add_argument("--tenant-rate", type=float, default=None,
                             help="per-tenant token-bucket rate limit "
                                  "(requests/s; 0 = off)")
+        parser.add_argument("--disagg", action="store_true",
+                            help="disaggregated prefill/decode serving: "
+                                 "while the fleet has dedicated "
+                                 "--role prefill lanes, /generate(+/"
+                                 "stream) lands on a prefill lane and "
+                                 "the finished KV chain ships to a "
+                                 "decode lane picked by load (zero "
+                                 "re-prefilled tokens; every failure "
+                                 "falls back to local decode or the "
+                                 "replay resume)")
+        parser.add_argument("--handoff-timeout", type=float, default=None,
+                            help="per-stream prefill→decode handoff "
+                                 "budget in seconds, clamped to the "
+                                 "stream's deadline (default 30)")
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.overload_control:
@@ -292,6 +318,10 @@ def main(argv=None) -> int:
             gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
         if args.affinity_max_imbalance is not None:
             gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
+        if args.disagg:
+            gw_kw["disagg"] = True
+        if args.handoff_timeout is not None:
+            gw_kw["handoff_timeout_s"] = args.handoff_timeout
         gw, server = serve_gateway(
             args.workers,
             GatewayConfig(port=args.port,
@@ -599,6 +629,31 @@ def main(argv=None) -> int:
                             help="weight-only quantization: dense/conv "
                                  "kernels stored int8 with per-channel "
                                  "scales (halves weight HBM traffic)")
+        parser.add_argument("--role", default="both",
+                            choices=("prefill", "decode", "both"),
+                            help="serving role for EVERY lane (see "
+                                 "--lane-roles for a split in-process "
+                                 "fleet; dedicated roles need "
+                                 "--kv-block-size)")
+        parser.add_argument("--lane-roles", default=None,
+                            help="disaggregated in-process fleet: "
+                                 "comma-separated per-lane roles, e.g. "
+                                 "prefill,prefill,decode,decode "
+                                 "(assigned round-robin; overrides "
+                                 "--role; pair with --disagg)")
+        parser.add_argument("--disagg", action="store_true",
+                            help="role-aware gateway: land fresh "
+                                 "/generate(+/stream) work on prefill "
+                                 "lanes and ship each finished KV chain "
+                                 "to a decode lane picked by load — "
+                                 "zero re-prefilled tokens, every "
+                                 "failure falls back to local decode "
+                                 "or the replay resume (bench.py "
+                                 "--scenario disagg-ab)")
+        parser.add_argument("--handoff-timeout", type=float, default=None,
+                            help="per-stream prefill→decode handoff "
+                                 "budget in seconds, clamped to the "
+                                 "stream's deadline (default 30)")
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.breaker_timeout is not None:
@@ -646,6 +701,10 @@ def main(argv=None) -> int:
                 gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
             if args.affinity_max_imbalance is not None:
                 gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
+        if args.disagg:
+            gw_kw["disagg"] = True
+        if args.handoff_timeout is not None:
+            gw_kw["handoff_timeout_s"] = args.handoff_timeout
         gateway_config = None
         if gw_kw:
             from tpu_engine.utils.config import GatewayConfig
@@ -703,14 +762,19 @@ def main(argv=None) -> int:
                                      gen_spec_draft=args.spec_draft,
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,
+                                     role=args.role,
                                      model_path=args.model_path)
         native_front = {"auto": None, "on": True, "off": False}[
             args.native_front]
+        lane_roles = None
+        if args.lane_roles:
+            lane_roles = [r.strip() for r in args.lane_roles.split(",")
+                          if r.strip()]
         gw, workers, server = serve_combined(
             model=args.model, lanes=args.lanes, port=args.port,
             warmup=args.warmup, worker_config=worker_config,
             gateway_config=gateway_config, mesh=args.mesh,
-            native_front=native_front)
+            native_front=native_front, lane_roles=lane_roles)
         _run_forever([server, *workers, gw])
         return 0
 

@@ -96,6 +96,21 @@ class LocalWorkerClient:
         return {"ok": True, "node_id": self.worker.node_id,
                 "draining": True}
 
+    def undrain(self) -> dict:
+        self.worker.undrain()
+        return {"ok": True, "node_id": self.worker.node_id,
+                "draining": False}
+
+    def set_role(self, role: str) -> dict:
+        """Flip the lane's serving role (disaggregated serving; the
+        gateway's set_worker_role drives this around a drain+migrate)."""
+        try:
+            return self.worker.set_role(role)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def migrate(self, payload: dict, timeout_s: Optional[float] = None) -> dict:
         """Export one live stream's row for migration (in-process: the
         worker's quiesce-and-snapshot runs directly; ``timeout_s`` rides
@@ -381,6 +396,13 @@ class HttpWorkerClient:
 
     def drain(self) -> dict:
         return self._request("POST", "/admin/drain", {"action": "drain"})
+
+    def undrain(self) -> dict:
+        return self._request("POST", "/admin/drain",
+                             {"action": "undrain"})
+
+    def set_role(self, role: str) -> dict:
+        return self._request("POST", "/admin/role", {"role": role})
 
     def migrate(self, payload: dict,
                 timeout_s: Optional[float] = None) -> dict:

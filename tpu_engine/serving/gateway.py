@@ -67,6 +67,7 @@ from tpu_engine.serving.overload import (
 from tpu_engine.serving.resilience import (
     AffinityCounters,
     FailoverCounters,
+    HandoffCounters,
     LatencyTracker,
     MigrationCounters,
     ProbeStateMachine,
@@ -135,7 +136,7 @@ class _StreamRecord:
 
     __slots__ = ("request_id", "payload", "deadline", "ctx", "lane",
                  "_hlock", "_ready", "_it", "_dest", "_error",
-                 "_abandoned")
+                 "_abandoned", "handoff", "spliced_handoff")
 
     def __init__(self, request_id: str, payload: dict, deadline, ctx,
                  lane: Optional[str]):
@@ -144,6 +145,17 @@ class _StreamRecord:
         self.deadline = deadline
         self.ctx = ctx
         self.lane = lane
+        # Disaggregated serving: True while the steady-state
+        # prefill→decode handoff orchestrator owns this stream's next
+        # migrated terminal (counts into the `handoff` family, not
+        # `migration`); cleared after the first splice so a LATER
+        # drain-time migration counts normally. Written by the relay
+        # thread and the stream's orchestrator only. `spliced_handoff`
+        # remembers whether the LATEST splice was a handoff, so a
+        # post-splice in-band import refusal attributes its fallback to
+        # the right counter family.
+        self.handoff = False
+        self.spliced_handoff = False
         self._hlock = threading.Lock()
         self._ready = threading.Event()
         self._it = None
@@ -281,6 +293,11 @@ class Gateway:
         # exists to cover.
         self._latency: Dict[str, LatencyTracker] = {}
         self._hedge_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        # Disagg handoff orchestrators get their own bounded executor
+        # (created on first use): they block for whole prefill
+        # durations and must not starve the hedge/drain pool.
+        self._handoff_exec: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
         # Requests without a "model" field in multi-model mode route to
         # the first-registered model (deterministic default) instead of
         # whichever lane the global ring happens to own.
@@ -301,6 +318,13 @@ class Gateway:
         # registry the drain orchestrator walks lives under self._lock.
         self.migration = MigrationCounters()
         self._streams: Dict[str, _StreamRecord] = {}
+        # Disaggregated prefill/decode serving (DESIGN.md "Disaggregated
+        # serving"): per-lane roles (absent = "both") drive role-aware
+        # routing while config.disagg is on and the fleet is actually
+        # split; every handoff decision is counted here with a matching
+        # `kv_handoff` marker span. The role map lives under self._lock.
+        self.handoff = HandoffCounters()
+        self._roles: Dict[str, str] = {}
         # Prefix-affinity routing (DESIGN.md "Prefix-affinity routing"):
         # decisions counted here; per-lane assignment totals and the
         # recent-dispatch window (imbalance signal) under self._lock.
@@ -324,6 +348,12 @@ class Gateway:
         # when no in-flight gauge is configured.
         self._shed_stats = SheddingStats()
         self._ejected: set = set()
+        # Consistent-hash ring over the PREFILL-CAPABLE lanes (role
+        # prefill|both) — the disagg primary hashes the affinity
+        # fingerprint (or request_id) here so shared prefixes still
+        # converge on one prefill lane. Maintained beside the main ring
+        # (membership changes + role flips); ConsistentHash self-locks.
+        self._prefill_ring = ConsistentHash(self.config.virtual_nodes)
         self._probe_state = ProbeStateMachine(
             self.config.health_probe_failures)
         self._prober_stop = threading.Event()
@@ -348,6 +378,7 @@ class Gateway:
 
     def add_worker(self, worker) -> str:
         model_name = None
+        role = "both"
         if isinstance(worker, str):
             client = HttpWorkerClient(
                 worker,
@@ -356,17 +387,34 @@ class Gateway:
                 gen_timeout_s=self.config.gen_timeout_s,
             )
             name = client.url
+            if self.config.disagg:
+                # Role discovery for HTTP lanes (URLs carry no
+                # metadata): one best-effort /health read — absent key
+                # or an unreachable lane reads "both", today's
+                # behavior. Only paid when disagg is on.
+                try:
+                    role = str(client.health().get("role", "both"))
+                except Exception:
+                    role = "both"
         else:
             client = LocalWorkerClient(worker)
             name = worker.node_id
             spec = getattr(getattr(worker, "engine", None), "spec", None)
             model_name = getattr(spec, "name", None)
+            role = str(getattr(getattr(worker, "config", None), "role",
+                               "both") or "both")
+        if role not in ("prefill", "decode", "both"):
+            role = "both"
         with self._lock:
             self._clients[name] = client
             self._breakers[name] = self._make_breaker()
+            if role != "both":
+                self._roles[name] = role
             if model_name is None:
                 self._untyped.add(name)
         self._ring.add_node(name)
+        if role != "decode":
+            self._prefill_ring.add_node(name)
         if model_name is not None:
             with self._lock:
                 ring = self._model_rings.get(model_name)
@@ -497,6 +545,7 @@ class Gateway:
             if self.config.migrate_streams:
                 self._migrate_lane_streams(name, client)
         self._ring.remove_node(name)
+        self._prefill_ring.remove_node(name)
         with self._lock:
             rings = dict(self._model_rings)
             self._clients.pop(name, None)
@@ -505,6 +554,7 @@ class Gateway:
             self._lane_recent.pop(name, None)
             self._untyped.discard(name)
             self._ejected.discard(name)
+            self._roles.pop(name, None)
         # A later lane reusing the name must start with clean probe state.
         self._probe_state.forget(name)
         for ring in rings.values():
@@ -539,7 +589,12 @@ class Gateway:
 
     def route_generate(self, payload: dict) -> dict:
         """Route a /generate request the same way as /infer: ring primary,
-        breaker-gated, ring-order failover."""
+        breaker-gated, ring-order failover. Under active
+        disaggregation the blocking call rides the same prefill→decode
+        handoff path as the stream (collapsed into the blocking
+        response)."""
+        if self._disagg_split() is not None:
+            return self._generate_via_handoff(payload)
         return self._route(payload, op="generate")
 
     def route_generate_stream(self, payload: dict):
@@ -560,13 +615,16 @@ class Gateway:
         seamless, byte-identical stream — the request is bound to the
         fleet, not to the lane that happened to start it."""
         if not (self.config.failover_streams
-                or self.config.migrate_streams):
+                or self.config.migrate_streams
+                or self.config.disagg):
             info: dict = {}
             it = self._route(payload, op="generate_stream",
                              out_info=info)
             return self._breaker_watched(it, info.get("lane"))
         # migrate_streams implies the journal: the replay resume IS the
-        # migration fallback ladder's last rung (MIGRATION.md).
+        # migration fallback ladder's last rung (MIGRATION.md). Disagg
+        # needs the journal for the same reason — the handoff's last
+        # rung is the replay resume.
         return self._stream_with_failover(payload)
 
     def _breaker_watched(self, it, lane: Optional[str]):
@@ -673,22 +731,55 @@ class Gateway:
         parent = TraceContext.from_request(payload)
         ctx = (parent.child() if parent is not None
                else TraceContext.root(request_id))
+        cfg = self.config
+        # Disaggregated serving: while the fleet is split, the FIRST
+        # segment is stamped `handoff` — routed to a prefill-capable
+        # lane which parks the row after prefill for the
+        # export-after-prefill command. The record keeps the UNSTAMPED
+        # payload: resumes and continuations must never re-park.
+        disagg = self._disagg_split() is not None
+        dispatch_payload = payload
+        if disagg:
+            dispatch_payload = {
+                **payload, "handoff": True,
+                "handoff_park_ms": cfg.handoff_timeout_s * 1000.0}
         info: dict = {}
         # Admission of the FIRST segment keeps every existing semantic:
         # shed/400/no-workers raise here, before the 200 SSE commits.
-        first = self._route(payload, op="generate_stream", out_info=info)
-        cfg = self.config
-        # Migrate mode: register the stream so a migrate-mode drain can
-        # find it (which lane serves it, its payload and deadline) and
-        # hand the relay a continuation. Registered only AFTER the first
-        # segment admitted — a stream that never started has nothing to
+        first = self._route(dispatch_payload, op="generate_stream",
+                            out_info=info)
+        # Migrate mode (and disagg — the handoff rides the same relay):
+        # register the stream so the orchestrator can find it (which
+        # lane serves it, its payload and deadline) and hand the relay
+        # a continuation. Registered only AFTER the first segment
+        # admitted — a stream that never started has nothing to
         # migrate.
         record: Optional[_StreamRecord] = None
-        if cfg.migrate_streams:
+        if cfg.migrate_streams or disagg:
             record = _StreamRecord(request_id, payload, deadline, ctx,
                                    info.get("lane"))
             with self._lock:
                 self._streams[request_id] = record
+        if disagg and record is not None:
+            lane = info.get("lane")
+            with self._lock:
+                lane_role = self._roles.get(lane, "both")
+            if lane_role == "prefill":
+                # The steady-state handoff orchestrator owns this
+                # stream's prefill→decode hop from here (one handoff-
+                # pool thread per stream, bounded by handoff_timeout_s).
+                self._handoff_pool().submit(self._handoff_stream,
+                                            record, lane)
+            else:
+                # The stamped stream landed COLOCATED — ring fallback
+                # past the prefill lanes, or a model ring with no split
+                # (disagg activation is fleet-wide; this request's ring
+                # may not be). A both/decode lane decodes fine itself:
+                # no pointless KV transfer — just release the park so
+                # the row never waits out a window nobody will collect
+                # (the cancel pre-empts a row that has not parked yet).
+                self._handoff_pool().submit(self._cancel_colocated_hold,
+                                            record, lane)
 
         def terminal_error(reason: str, retryable: bool,
                            emitted: List[int]) -> bytes:
@@ -749,12 +840,20 @@ class Gateway:
                                     # was refused post-dispatch
                                     # (checksum / geometry / pool
                                     # pressure): attribute the replay
-                                    # fallback to the MIGRATION — the
-                                    # destination lane is healthy.
-                                    self._migration_count(
-                                        record, "migration_fallbacks",
-                                        lane=lane,
-                                        cause="import_refused")
+                                    # fallback to the MIGRATION — or to
+                                    # the HANDOFF when the latest
+                                    # splice was the steady-state hop —
+                                    # the destination lane is healthy.
+                                    if record.spliced_handoff:
+                                        self._handoff_count(
+                                            "handoff_fallbacks",
+                                            record=record, lane=lane,
+                                            cause="import_refused")
+                                    else:
+                                        self._migration_count(
+                                            record, "migration_fallbacks",
+                                            lane=lane,
+                                            cause="import_refused")
                                 failure = (str(evt.get("error")), retr,
                                            retr
                                            and not evt.get("shed", False)
@@ -821,7 +920,9 @@ class Gateway:
                     # dead, checksum mismatch, timeout — falls through
                     # to the replay resume below: the fallback ladder's
                     # last rung needs nothing from either side.
-                    wait_s = cfg.migrate_timeout_s + 5.0
+                    is_handoff = record.handoff
+                    wait_s = (cfg.handoff_timeout_s if is_handoff
+                              else cfg.migrate_timeout_s) + 5.0
                     if deadline is not None:
                         wait_s = min(wait_s,
                                      max(0.0, deadline.remaining_s()))
@@ -830,14 +931,36 @@ class Gateway:
                         it, new_lane = handoff
                         lane = new_lane
                         record.lane = new_lane
-                        self._migration_count(record, "streams_migrated",
-                                              lane=new_lane)
-                        self.migration.bump("tokens_migrated",
-                                            len(emitted))
+                        record.spliced_handoff = is_handoff
+                        if is_handoff:
+                            # The steady-state prefill→decode hop
+                            # landed: the decode lane adopted the chain
+                            # with zero re-prefilled tokens.
+                            record.handoff = False
+                            self._handoff_count("handoffs_spliced",
+                                                record=record,
+                                                lane=new_lane)
+                            self.handoff.bump("tokens_handed_off",
+                                              len(emitted))
+                        else:
+                            self._migration_count(record,
+                                                  "streams_migrated",
+                                                  lane=new_lane)
+                            self.migration.bump("tokens_migrated",
+                                                len(emitted))
                         continue
-                    self._migration_count(record, "migration_fallbacks",
-                                          lane=lane)
-                    reason = f"migration fell back to replay ({reason})"
+                    if is_handoff:
+                        record.handoff = False
+                        self._handoff_count("handoff_fallbacks",
+                                            record=record, lane=lane)
+                        reason = (f"handoff fell back to replay "
+                                  f"({reason})")
+                    else:
+                        self._migration_count(record,
+                                              "migration_fallbacks",
+                                              lane=lane)
+                        reason = (f"migration fell back to replay "
+                                  f"({reason})")
                     retryable = True
                 self.failover.bump("stream_failures")
                 if lane_fault:
@@ -1002,6 +1125,7 @@ class Gateway:
         if deadline is not None:
             budget = min(budget, max(0.1, deadline.remaining_s()))
         export = None
+        refused_cleanly = True
         try:
             reason = "source lane has no migrate surface"
             if client is not None and hasattr(client, "migrate"):
@@ -1014,14 +1138,20 @@ class Gateway:
                 else:
                     reason = str(resp.get("reason", "export refused"))
         except Exception as exc:
+            refused_cleanly = False  # a late export may still land
             reason = f"export failed: {exc}"
         if export is None:
             # Includes the benign cases (stream just finished, row still
             # prefilling): the relay either never sees a migrated
-            # terminal, or replays — both complete the stream.
+            # terminal, or replays — both complete the stream. The
+            # fallback is armed only when a terminal might still arrive
+            # (timeout/transport): a clean worker refusal produces none,
+            # and latching a stale failure would poison a later
+            # migration window of the still-running stream.
             self._migration_count(record, "export_refusals", lane=source,
                                   reason=reason[:120])
-            record.fail(reason)
+            if not refused_cleanly:
+                record.fail(reason)
             return
         try:
             dest = self._pick_migration_dest(record, source)
@@ -1085,14 +1215,8 @@ class Gateway:
             if lane == source or lane in seen:
                 continue
             seen.add(lane)
-            with self._lock:
-                present = lane in self._clients
-                ejected = lane in self._ejected
-                breaker = self._breakers.get(lane)
-            if (not present or ejected or breaker is None
-                    or not breaker.allow_request()):
-                continue
-            return lane
+            if self._lane_admits(lane):
+                return lane
         return None
 
     def _dispose_iter(self, it) -> None:
@@ -1114,6 +1238,365 @@ class Gateway:
                     pass
         threading.Thread(target=drain, name="gw-migrate-dispose",
                          daemon=True).start()
+
+    # -- disaggregated prefill/decode serving (DESIGN.md) ----------------------
+
+    def worker_roles(self) -> Dict[str, str]:
+        """{lane: role} for every member lane (absent map entry =
+        "both") — tests, diagnostics, and the /stats handoff block."""
+        with self._lock:
+            return {name: self._roles.get(name, "both")
+                    for name in self._clients}
+
+    def _disagg_split(self, ring=None):
+        """(prefill_capable, decode_capable) lane lists over ``ring``
+        (default: the whole fleet), or None unless disagg routing
+        should engage: the flag on, at least one DEDICATED prefill
+        lane, and at least one decode-capable lane beside it. An
+        all-"both" fleet — or disagg off — returns None and routes
+        byte-identically to today."""
+        if not self.config.disagg:
+            return None
+        nodes = ring.get_all_nodes() if ring is not None else None
+        with self._lock:
+            if nodes is None:
+                nodes = list(self._clients)
+            roles = {n: self._roles.get(n, "both") for n in nodes}
+        if not any(r == "prefill" for r in roles.values()):
+            return None
+        prefill = [n for n in nodes if roles[n] != "decode"]
+        decode = [n for n in nodes if roles[n] != "prefill"]
+        if not prefill or not decode:
+            return None
+        return prefill, decode
+
+    def _lane_admits(self, lane: str) -> bool:
+        """Present, un-ejected, breaker-admitted — the dispatchability
+        gate every handoff candidate walk applies."""
+        with self._lock:
+            present = lane in self._clients
+            ejected = lane in self._ejected
+            breaker = self._breakers.get(lane)
+        return (present and not ejected and breaker is not None
+                and breaker.allow_request())
+
+    def _handoff_count(self, decision: str,
+                       record: Optional[_StreamRecord] = None,
+                       trace: Optional[_RouteTrace] = None,
+                       **attrs) -> None:
+        """Bump a handoff counter AND drop a zero-duration
+        ``kv_handoff`` marker span — parented under the stream's
+        request trace (record) or the route span (trace) when either
+        exists. Same counters==spans discipline as the migration
+        markers; fault_injection --disagg asserts the two agree."""
+        self.handoff.bump(decision)
+        if decision not in HandoffCounters.SPAN_FIELDS:
+            return
+        if record is not None:
+            child = record.ctx.child()
+            rid, parent = record.request_id, record.ctx.span_id
+        elif trace is not None:
+            child = trace.ctx.child()
+            rid, parent = trace.request_id, trace.ctx.span_id
+        else:
+            child = TraceContext.root(f"handoff:{decision}").child()
+            rid, parent = "handoff", None
+        self.tracer.record(
+            rid, "kv_handoff", "gateway", 0,
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=parent, start_ts=time.time(),
+            attrs={"decision": decision, **attrs})
+
+    def _handoff_primary(self, ring, ring_primary: str, payload: dict,
+                         skip: tuple,
+                         trace: Optional[_RouteTrace]) -> str:
+        """Disagg primary selection: hash the prompt's affinity
+        fingerprint (radix sharing keeps paying fleet-wide) — or the
+        request_id when affinity is off / nothing to fingerprint — on
+        the PREFILL ring, walking its ring order for the first
+        admittable prefill-capable lane. No admittable prefill lane →
+        ring order over everyone (``prefill_unavailable``): the request
+        serves colocated on whatever lane, today's behavior."""
+        split = self._disagg_split(ring)
+        if split is None:
+            return ring_primary
+        prefill_set = set(split[0])
+        fp = (self._affinity_fingerprint(payload)
+              if self.config.prefix_affinity else None)
+        key = fp if fp is not None else str(
+            payload.get("request_id") or "")
+        candidates: List[str] = []
+        try:
+            candidates.append(self._prefill_ring.get_node(key))
+        except RuntimeError:
+            pass
+        candidates += self._prefill_ring.get_all_nodes()
+        seen = set()
+        for lane in candidates:
+            if lane in seen or lane in skip or lane not in prefill_set:
+                continue
+            seen.add(lane)
+            if self._lane_admits(lane):
+                self._handoff_count("prefill_routed", trace=trace,
+                                    lane=lane)
+                return lane
+        self._handoff_count("prefill_unavailable", trace=trace)
+        return ring_primary
+
+    def set_worker_role(self, name: str, role: str) -> dict:
+        """/admin/role: flip one lane's serving role at runtime — fleet
+        rebalancing under diurnal load. Rides the existing graceful
+        machinery: bounded drain first (new admissions shed while the
+        flip lands), live streams migrated off when --migrate-streams
+        is on, then the worker-side flip, undrain, and the role maps /
+        prefill ring update. A failed worker flip restores admissions
+        and reports — the lane keeps its old role everywhere."""
+        role = str(role)
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be prefill|decode|both, got {role!r}")
+        with self._lock:
+            client = self._clients.get(name)
+        if client is None:
+            raise ValueError(f"unknown worker '{name}'")
+        drained = False
+        if hasattr(client, "drain"):
+            fut = self._pool().submit(client.drain)
+            try:
+                fut.result(timeout=self.config.drain_timeout_s)
+                drained = True
+            except Exception as exc:
+                # Same bounded-drain contract as remove_worker: count
+                # it and carry on — the flip itself is still safe.
+                self._migration_count(None, "drain_failures", lane=name,
+                                      error=str(exc)[:120])
+        if self.config.migrate_streams:
+            self._migrate_lane_streams(name, client)
+
+        def _undrain():
+            # UNCONDITIONAL (idempotent): a drain call that timed out
+            # here may still have landed worker-side moments later —
+            # unlike remove_worker, this lane STAYS in the fleet, and
+            # a silently-draining member would shed every admission
+            # until an operator noticed.
+            if hasattr(client, "undrain"):
+                try:
+                    client.undrain()
+                except Exception:
+                    pass
+
+        try:
+            if hasattr(client, "set_role"):
+                client.set_role(role)
+            else:
+                raise WorkerError("lane has no role surface")
+        except Exception as exc:
+            _undrain()
+            return {"ok": False, "node_id": name,
+                    "error": str(exc)[:300]}
+        _undrain()
+        with self._lock:
+            if role == "both":
+                self._roles.pop(name, None)
+            else:
+                self._roles[name] = role
+        # Prefill-ring membership follows the role (idempotent ops).
+        if role == "decode":
+            self._prefill_ring.remove_node(name)
+        elif name not in self._prefill_ring.get_all_nodes():
+            self._prefill_ring.add_node(name)
+        self._handoff_count("role_flips", lane=name, role=role)
+        return {"ok": True, "node_id": name, "role": role,
+                "drained": drained}
+
+    def _handoff_stream(self, record: _StreamRecord,
+                        source: Optional[str]) -> None:
+        """Steady-state prefill→decode handoff orchestrator (one per
+        disagg stream, off the gateway pool): ask the source for an
+        export-AFTER-PREFILL (the command parks on its decode loop and
+        snapshots the row — first token, sampling state, KV chain — the
+        moment prefill completes), pick a decode lane by load, dispatch
+        the ``migrate_import`` continuation, and offer it to the relay.
+        EVERY failure leaves the stream completable without us: an
+        unexported row unparks and decodes locally (colocated
+        fallback); an exported-but-unspliced stream lands on the PR 6
+        replay resume. Both are byte-identical."""
+        rid = record.request_id
+        record.handoff = True
+        self._handoff_count("handoffs_attempted", record=record,
+                            lane=source or "?")
+        deadline = record.deadline
+        budget = self.config.handoff_timeout_s
+        if deadline is not None:
+            budget = min(budget, max(0.1, deadline.remaining_s()))
+        with self._lock:
+            client = self._clients.get(source) if source else None
+        export = None
+        refused_cleanly = True  # a missing surface produces no terminal
+        reason = "source lane has no migrate surface"
+        if client is not None and hasattr(client, "migrate"):
+            try:
+                # Direct call on THIS pool thread: client.migrate is
+                # already bounded by its own payload/socket timeouts —
+                # a nested pool submit would hold two of the shared
+                # 256 workers per in-flight handoff for the whole
+                # prefill duration.
+                resp = client.migrate(
+                    {"request_id": rid, "wait_prefill": True}, budget)
+                if resp.get("ok"):
+                    export = {k: v for k, v in resp.items()
+                              if k not in ("ok", "node_id")}
+                else:
+                    reason = str(resp.get("reason", "export refused"))
+            except Exception as exc:
+                # Ambiguous: a timed-out export may still have landed
+                # worker-side, so a `migrated` terminal MAY arrive.
+                refused_cleanly = False
+                reason = f"export failed: {exc}"
+        if export is None:
+            # Nothing left this lane: cancel any lingering hold so the
+            # row resumes local decoding NOW instead of at the park
+            # bound, and let the relay keep relaying the source stream.
+            self._handoff_count("export_refusals", record=record,
+                                lane=source or "?", reason=reason[:120])
+            record.handoff = False
+            if not refused_cleanly:
+                # Arm the relay's fallback ONLY when a migrated
+                # terminal might still arrive; a clean refusal produces
+                # none, and a latched stale failure would poison a
+                # LATER drain migration's handoff window (instant
+                # replay instead of awaiting the offer).
+                record.fail(reason)
+            self._cancel_source_hold(record, client, rid)
+            return
+        try:
+            dests = self._handoff_candidates(record, source)
+            if not dests:
+                # The row is GONE from the source (exported): the
+                # relay's replay resume finishes the stream.
+                self._handoff_count("destination_unavailable",
+                                    record=record, lane=source or "?")
+                record.fail("no decode-capable destination lane")
+                return
+            cont = {**record.payload, "request_id": rid,
+                    "migrate_import": export}
+            cont.pop("handoff", None)
+            cont.pop("handoff_park_ms", None)
+            if deadline is not None:
+                cont["deadline_ms"] = max(0.0, deadline.remaining_ms())
+            result, dest = None, None
+            for cand in dests:
+                # A draining/overloaded candidate sheds (_SHED): try
+                # the next decode lane instead of abandoning the hop.
+                result = self._try_node(cand, cont, op="generate_stream")
+                if _ok(result):
+                    dest = cand
+                    break
+            if dest is None:
+                self._handoff_count("dispatch_failed", record=record,
+                                    lane=dests[0])
+                record.fail("every decode lane refused the continuation")
+                return
+            if not record.offer(result, dest):
+                # The relay moved on (timeout → replay fallback owns
+                # the stream): dispose of the orphan continuation.
+                self._dispose_iter(result)
+        except Exception as exc:
+            self._handoff_count("dispatch_failed", record=record,
+                                lane=source or "?", error=str(exc)[:120])
+            record.fail(f"handoff failed: {exc}")
+
+    def _handoff_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        """Dedicated bounded executor for handoff orchestration: each
+        orchestrator blocks up to handoff_timeout_s on the export-
+        after-prefill call, and riding the shared hedge pool would let
+        a disagg burst starve hedged dispatches and drain calls."""
+        with self._lock:
+            if self._handoff_exec is None:
+                self._handoff_exec = \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=64, thread_name_prefix="gw-handoff")
+            return self._handoff_exec
+
+    def _cancel_colocated_hold(self, record: _StreamRecord,
+                               lane: Optional[str]) -> None:
+        """A handoff-stamped stream that landed on a non-prefill lane:
+        no hop is coming — release the park (or pre-empt it) so local
+        decode starts immediately."""
+        with self._lock:
+            client = self._clients.get(lane) if lane else None
+        self._cancel_source_hold(record, client, record.request_id)
+
+    def _cancel_source_hold(self, record: _StreamRecord, client,
+                            rid: str) -> None:
+        """Best-effort release of a parked source row after a failed
+        export (the row would otherwise wait out its park bound before
+        resuming local decode)."""
+        if client is None or not hasattr(client, "migrate"):
+            return
+        try:
+            resp = client.migrate({"request_id": rid, "cancel": True},
+                                  5.0)
+            if resp.get("cancelled"):
+                self._handoff_count("holds_cancelled", record=record)
+        except Exception:
+            pass
+
+    def _handoff_candidates(self, record: _StreamRecord,
+                            source: Optional[str]) -> List[str]:
+        """Decode-capable destination lanes for one handoff, best
+        first: fewest journaled active streams (the load signal the
+        stream registry already tracks), ring order as the tiebreak;
+        never the source, the prober-ejected, or a breaker-open lane
+        (draining lanes shed at dispatch — the caller walks to the
+        next candidate)."""
+        payload = record.payload
+        mdl = payload.get("model")
+        with self._lock:
+            if mdl is None and len(self._model_rings) > 1:
+                mdl = self.default_model
+            ring = (self._model_rings.get(str(mdl))
+                    if mdl is not None else self._ring)
+        if ring is None:
+            ring = self._ring
+        split = self._disagg_split(ring)
+        decode = split[1] if split else ring.get_all_nodes()
+        with self._lock:
+            load: Dict[str, int] = {}
+            for rec in self._streams.values():
+                if rec.lane:
+                    load[rec.lane] = load.get(rec.lane, 0) + 1
+        order = {n: i for i, n in enumerate(ring.get_all_nodes())}
+        cands = [n for n in decode
+                 if n != source and self._lane_admits(n)]
+        cands.sort(key=lambda n: (load.get(n, 0),
+                                  order.get(n, len(order))))
+        return cands
+
+    def _generate_via_handoff(self, payload: dict) -> dict:
+        """Blocking /generate under active disaggregation: the prefill
+        lane → KV handoff → decode lane path runs as the internal
+        stream and collapses into the blocking response shape.
+        Admission refusals raise before any consumption (same wire
+        classes as the direct dispatch); a terminal error event
+        surfaces as the gateway-level failure it is."""
+        it = self._stream_with_failover(payload)
+        final = None
+        try:
+            for frame in it:
+                evt = _parse_sse(frame)
+                if evt is not None and evt.get("done"):
+                    final = evt
+        finally:
+            try:
+                it.close()
+            except Exception:
+                pass
+        if final is None:
+            raise GatewayError("stream ended without a terminal event")
+        if "error" in final:
+            raise GatewayError(str(final["error"]))
+        return {k: v for k, v in final.items() if k != "done"}
 
     # -- prefix-affinity routing ----------------------------------------------
 
@@ -1359,7 +1842,13 @@ class Gateway:
             primary = ring.get_node(request_id)
         except RuntimeError:  # every lane of this model was removed
             raise GatewayError(f"no workers available for model '{mdl}'")
-        if (self.config.prefix_affinity
+        if payload.get("handoff") and op == "generate_stream":
+            # Disaggregated first segment: the prefill ring owns
+            # primary selection (affinity fingerprint folded in), with
+            # ring order over everyone as the colocated fallback.
+            primary = self._handoff_primary(ring, primary, payload,
+                                            skip, trace)
+        elif (self.config.prefix_affinity
                 and op in ("generate", "generate_stream")):
             primary = self._affinity_primary(ring, primary, payload,
                                              skip, trace)
@@ -1930,6 +2419,15 @@ class Gateway:
             with self._lock:
                 mig["active_streams"] = len(self._streams)
             out["migration"] = mig
+        # Additive "handoff" block (disaggregated prefill/decode
+        # serving), same gating discipline: present only once
+        # configured or exercised.
+        if self.config.disagg or self.handoff.any_nonzero():
+            ho = self.handoff.as_dict()
+            with self._lock:
+                ho["roles"] = {n: self._roles.get(n, "both")
+                               for n in sorted(self._clients)}
+            out["handoff"] = ho
         # Additive "affinity" block (prefix-affinity routing), same
         # gating discipline: a defaults-only /stats stays byte-identical.
         if self.config.prefix_affinity or self.affinity.any_nonzero():

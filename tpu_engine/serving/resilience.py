@@ -227,6 +227,40 @@ class MigrationCounters(ResilienceCounters):
                    "drain_failures")
 
 
+class HandoffCounters(ResilienceCounters):
+    """Every disaggregated prefill→decode handoff decision, counted —
+    the additive ``/stats`` ``handoff`` block and the
+    ``tpu_engine_handoff_*`` Prometheus family. Decision fields pair
+    1:1 with gateway ``kv_handoff`` marker spans
+    (``tools/fault_injection.py --disagg`` asserts counters == spans);
+    ``tokens_handed_off`` counts tokens carried across a handoff splice
+    (value counter, span-free like ``tokens_replayed``).
+
+    ``prefill_routed`` — fresh generate-class dispatches sent to a
+    prefill-capable lane; ``prefill_unavailable`` — no admittable
+    prefill lane, ring order took over (colocated on whatever lane).
+    ``handoffs_attempted`` → then exactly one of ``handoffs_spliced``
+    (decode lane adopted, zero re-prefilled tokens),
+    ``export_refusals`` / ``destination_unavailable`` /
+    ``dispatch_failed`` (handoff abandoned — the source row unparks and
+    decodes locally, or the relay replays), or ``handoff_fallbacks``
+    (the export landed but the splice did not — replay resume finished
+    the stream). ``holds_cancelled`` — source holds released because no
+    destination existed. ``role_flips`` — /admin/role rebalances."""
+
+    FIELDS = ("prefill_routed", "prefill_unavailable",
+              "handoffs_attempted", "handoffs_spliced",
+              "export_refusals", "destination_unavailable",
+              "dispatch_failed", "handoff_fallbacks", "holds_cancelled",
+              "tokens_handed_off", "role_flips")
+
+    SPAN_FIELDS = ("prefill_routed", "prefill_unavailable",
+                   "handoffs_attempted", "handoffs_spliced",
+                   "export_refusals", "destination_unavailable",
+                   "dispatch_failed", "handoff_fallbacks",
+                   "holds_cancelled", "role_flips")
+
+
 class AffinityCounters(ResilienceCounters):
     """Every prefix-affinity routing decision, counted — the additive
     ``/stats`` ``affinity`` block and the ``tpu_engine_affinity_*``

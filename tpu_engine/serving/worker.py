@@ -326,6 +326,21 @@ class WorkerNode:
             raise RuntimeError(
                 f"--kv-quantize must be 'int8', got "
                 f"{self.config.gen_kv_quantize!r}")
+        if self.config.role not in ("prefill", "decode", "both"):
+            raise RuntimeError(
+                f"--role must be prefill|decode|both, got "
+                f"{self.config.role!r}")
+        if self.config.role != "both" and (
+                not self._continuous
+                or self.config.gen_kv_block_size <= 0):
+            # A dedicated role without the paged continuous scheduler
+            # could never export or adopt a KV chain — the lane would
+            # silently serve colocated. Same loud contract as every
+            # other misconfiguration.
+            raise RuntimeError(
+                "--role prefill|decode requires the continuous "
+                "scheduler with the paged KV cache "
+                "(--kv-block-size > 0)")
         if getattr(self.engine.spec, "config", None) is not None:
             try:
                 if self._speculative:
@@ -1035,9 +1050,35 @@ class WorkerNode:
                     "reason": "this lane has no continuous decode "
                               "scheduler to export from"}
         timeout_s = float(request.get("timeout_s", 10.0))
-        out = gen.export_row(str(rid), timeout_s=timeout_s)
+        out = gen.export_row(str(rid), timeout_s=timeout_s,
+                             wait_prefill=bool(
+                                 request.get("wait_prefill", False)),
+                             cancel=bool(request.get("cancel", False)))
         out["node_id"] = self.node_id
         return out
+
+    def set_role(self, role: str) -> dict:
+        """/admin/role: flip this lane's serving role at runtime
+        (fleet rebalancing under diurnal load — the gateway rides
+        /admin/drain + stream migration around the flip). Role is
+        advisory routing metadata: the lane keeps serving whatever it
+        receives, so the flip itself is safe mid-traffic."""
+        role = str(role)
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role must be prefill|decode|both, "
+                             f"got {role!r}")
+        if role != "both" and (
+                not self._continuous
+                or self.config.gen_kv_block_size <= 0):
+            raise ValueError(
+                "a dedicated role requires the continuous scheduler "
+                "with the paged KV cache (--kv-block-size > 0)")
+        self.config.role = role
+        return {"ok": True, "node_id": self.node_id, "role": role}
+
+    @property
+    def role(self) -> str:
+        return self.config.role
 
     def on_fault_change(self, listener) -> None:
         """Register listener(healthy: bool) — the native HTTP front uses
@@ -1522,13 +1563,29 @@ class WorkerNode:
                 self._total_requests += 1
             q: "queue.Queue" = queue.Queue()
             t0 = time.perf_counter()
+            # Disaggregated handoff (gateway-stamped): park the row
+            # after prefill for the export-after-prefill command; the
+            # park window bounds how long a row can wait before local
+            # decode resumes (the colocated fallback).
+            handoff_kw = {}
+            if request.get("handoff") and hasattr(self.generator,
+                                                  "export_row"):
+                # Clamped: a client-supplied park window must never pin
+                # a slot + KV chain indefinitely (the scheduler clamps
+                # again as a backstop).
+                handoff_kw = {
+                    "handoff": True,
+                    "handoff_park_s": min(120.0, max(
+                        0.1,
+                        float(request.get("handoff_park_ms",
+                                          5000.0)) / 1000.0))}
             fut = self.generator.submit(
                 prompt, max_new_tokens=max_new, eos_id=eos_id,
                 temperature=temperature, seed=seed, top_p=top_p, top_k=top_k,
                 repetition_penalty=rep_pen, stop_tokens=stop_toks,
                 min_p=min_p_val, stream=q, deadline=deadline,
                 sink=TraceSink(self.tracer, self.node_id, request_id, tctx),
-                tag=request_id)
+                tag=request_id, **handoff_kw)
         except BaseException:
             self._admission.release()
             raise
@@ -1750,6 +1807,11 @@ class WorkerNode:
             "cache_hit_rate": self.cache.hit_rate(),
             "batch_processor": m.as_dict(),
         }
+        if self.config.role != "both":
+            # Additive, and only for dedicated-role lanes: a default
+            # fleet's /health stays byte-identical (absent key = "both"
+            # — the gateway's role discovery reads it that way).
+            out["role"] = self.config.role
         # Additive (reference schema untouched — its parsers ignore extra
         # keys): decode-lane scheduler counters for transformer workers.
         if self.generator is not None and hasattr(self.generator, "stats"):

@@ -164,6 +164,16 @@ class WorkerConfig:
     # Stage-4 ("clamp") max_new_tokens ceiling for below-top-tier
     # generate requests.
     brownout_clamp_tokens: int = 32
+    # Disaggregated serving role (--role; DESIGN.md "Disaggregated
+    # serving"): "prefill" | "decode" | "both". Advisory for the
+    # gateway's role-aware routing — a "both" fleet (default) behaves
+    # byte-identically to today, and a lane of EITHER dedicated role
+    # still serves any request it receives (the fallback ladder depends
+    # on that: a replay resume must be admittable anywhere). "prefill"
+    # lanes are where the gateway lands fresh /generate(/stream) work;
+    # finished prefills ship their KV chain to a "decode" lane via the
+    # export-after-prefill handoff. Flippable at runtime (/admin/role).
+    role: str = "both"
     # Tracing ring-buffer capacity (spans kept per lane, utils.tracing).
     # On by default — recording is lock-guarded ring writes, ~1 µs/span.
     # 0 disables span recording AND the /metrics stage histograms.
@@ -260,6 +270,27 @@ class GatewayConfig:
     # failure and proceeds with removal — a wedged lane must never hang
     # membership changes.
     drain_timeout_s: float = 10.0
+    # Disaggregated prefill/decode serving (--disagg; DESIGN.md
+    # "Disaggregated serving"): while the fleet has at least one
+    # prefill-role lane AND a distinct decode-capable lane,
+    # /generate(/stream) routes to a prefill lane (prefix-affinity
+    # fingerprint restricted to prefill-capable lanes when
+    # --prefix-affinity is on, else the request_id hash over them),
+    # which prefills into its block pool, parks the row, and ships the
+    # finished KV chain + sampling snapshot to a decode lane picked by
+    # load — the gateway splices the continuation into one seamless
+    # stream with ZERO re-prefilled tokens. Every failure on the hop
+    # (export refused, no destination, transfer timeout, checksum
+    # refusal, dead lane) lands on the existing fallback ladder —
+    # local decode on the source, then the replay resume — always
+    # byte-identical. Off (default), or with an all-"both" fleet,
+    # routing and wire bytes are identical to today.
+    disagg: bool = False
+    # Per-stream handoff budget: export-after-prefill + continuation
+    # dispatch, clamped to the stream's original deadline. Also the
+    # source row's park window (a handoff whose orchestrator died
+    # resumes local decoding after this long).
+    handoff_timeout_s: float = 30.0
     # Proactive lane health prober (--health-probe-interval): a gateway
     # background thread GETs every lane's /health at this interval and
     # EJECTS lanes from routing after `health_probe_failures` consecutive

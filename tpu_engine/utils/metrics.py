@@ -384,6 +384,26 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "pressure) — each fell back to a replay resume",
            [(node(h), m.get("import_rejected")) for h, m in mg])
 
+    # Disaggregated handoff, lane side (the scheduler's additive
+    # "handoff" stats block — present once a row parked for export).
+    hol = [(h, g.get("handoff")) for h, g in gen
+           if isinstance(g, dict) and g.get("handoff")]
+    metric("tpu_engine_handoff_holds_total", "counter",
+           "Rows parked after prefill awaiting the export-after-prefill "
+           "command (disaggregated serving)",
+           [(node(h), m.get("holds")) for h, m in hol])
+    metric("tpu_engine_handoff_park_expired_total", "counter",
+           "Parked rows whose export never came — resumed local decode "
+           "(the colocated fallback)",
+           [(node(h), m.get("park_expired")) for h, m in hol])
+    metric("tpu_engine_handoff_hold_cancelled_total", "counter",
+           "Parked rows released by an orchestrator cancel (no "
+           "destination lane)",
+           [(node(h), m.get("hold_cancelled")) for h, m in hol])
+    metric("tpu_engine_handoff_held_rows", "gauge",
+           "Rows currently parked awaiting export",
+           [(node(h), m.get("held_rows")) for h, m in hol])
+
     # Resilience layer, lane side (the "admission" /health block appears
     # only once admission control has made a decision).
     adm = [(h, h.get("admission")) for h in healths if h.get("admission")]
@@ -528,6 +548,45 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
             metric("tpu_engine_migration_active_streams", "gauge",
                    "Journaled streams the migrate registry tracks",
                    [({}, mig.get("active_streams"))])
+        ho = stats.get("handoff")
+        if ho:
+            # Disaggregated prefill/decode serving (the /stats
+            # "handoff" block; present once configured or exercised).
+            for key, help_text in (
+                    ("prefill_routed",
+                     "Fresh generate dispatches landed on a "
+                     "prefill-capable lane"),
+                    ("prefill_unavailable",
+                     "No admittable prefill lane: ring order took over "
+                     "(colocated)"),
+                    ("handoffs_attempted",
+                     "Steady-state prefill→decode handoffs started"),
+                    ("handoffs_spliced",
+                     "Handoffs spliced onto their decode lane (zero "
+                     "re-prefilled tokens)"),
+                    ("export_refusals",
+                     "Export-after-prefill refusals (row finished "
+                     "first, wedged lane) — local decode continued"),
+                    ("destination_unavailable",
+                     "Handoffs with no decode-capable destination "
+                     "lane"),
+                    ("dispatch_failed",
+                     "Continuation dispatches every decode lane "
+                     "refused or failed"),
+                    ("handoff_fallbacks",
+                     "Handoffs that fell back to the replay resume"),
+                    ("holds_cancelled",
+                     "Source holds released after a failed handoff"),
+                    ("tokens_handed_off",
+                     "Tokens carried across handoff splices"),
+                    ("role_flips",
+                     "Runtime /admin/role rebalances")):
+                metric(f"tpu_engine_handoff_{key}_total", "counter",
+                       help_text, [({}, ho.get(key))])
+            metric("tpu_engine_handoff_prefill_lanes", "gauge",
+                   "Lanes currently prefill-capable (role prefill|both)",
+                   [({}, sum(1 for r in (ho.get("roles") or {}).values()
+                             if r != "decode"))])
         aff = stats.get("affinity")
         if aff:
             # Prefix-affinity routing (the /stats "affinity" block;
