@@ -1295,6 +1295,197 @@ def run_quant_ab(model: str = "gpt2-small-test", n_requests: int = 24,
     return results
 
 
+def run_recurrent_ab(att_model: str = "gpt2-small-test",
+                     ssd_model: str = "ssd-small-test",
+                     n_requests: int = 12, max_new: int = 16,
+                     seq_sweep=(46, 110, 238), att_rows_budget: int = 3,
+                     block_size: int = 16, max_seq: int = 256,
+                     n_slots: int = 16, mixed_budget: int = 32,
+                     quick: bool = False) -> dict:
+    """Attention (kv_paged) vs SSD (state_slab) at EQUAL HBM budget —
+    the O(1)-state tentpole A/B. One byte budget, sized to
+    ``att_rows_budget`` full-length attention rows, provisions BOTH
+    arms' pools: the attention arm gets that many KV blocks, the SSD
+    arm however many fixed-size state rows fit in the same bytes. A
+    saturating greedy burst of ``n_requests`` streams runs at each
+    SEQUENCE LENGTH in ``seq_sweep`` (prompt lengths; +max_new decode
+    tokens each) and the headline is PEAK CONCURRENT ROWS vs length:
+
+    - attention rows allocate their prompt bucket's blocks AT
+      admission, so the pool binds exactly there: peak rows FALL as
+      sequences lengthen (excess admissions defer, the PR 3 parking);
+    - SSD rows need exactly ONE state row forever, so peak rows are
+      CONSTANT in sequence length — "KV capacity" became "state
+      capacity", and it does not depreciate with context.
+
+    Both arms run MIXED stepping so a row occupies its slot from
+    admission through prefill and decode (concurrency measures pool
+    capacity, not the host mesh's serial admission rate), and the
+    sweep lengths are chosen so prompt+decode never outgrows the
+    admission-time bucket — the pool binds at ADMISSION, never by
+    mid-stream starvation (starved early completions would poison the
+    determinism check). Every burst runs twice (streams must be
+    byte-identical run to run, both arms) and every pool must account
+    for every block/row after each burst (zero slab leaks — rows_free
+    == rows_total on the SSD arm, blocks free+radix-held == total on
+    the attention arm). CPU mesh; the artifact carries the device
+    stamp like every in-process A/B."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.kv_blocks import dense_block_bytes
+    from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+    _ensure_builtin_models_imported()
+    if quick:
+        seq_sweep = (seq_sweep[0], seq_sweep[-1])
+        n_requests = min(n_requests, 8)
+    att_spec = create_model(att_model, max_seq=max_seq)
+    ssd_spec = create_model(ssd_model, max_seq=max_seq)
+    att_params = att_spec.init(jax.random.PRNGKey(0))
+    ssd_params = ssd_spec.init(jax.random.PRNGKey(0))
+    # Equal BYTE budget from the pools' OWN layout formulas (never a
+    # re-derivation): att_rows_budget full-length attention rows.
+    width = -(-max_seq // block_size)
+    dense_bpb = dense_block_bytes(att_spec.config, block_size,
+                                  jnp.bfloat16)
+    budget_bytes = att_rows_budget * width * dense_bpb
+    att_blocks = budget_bytes // dense_bpb + 1  # +1: the null block
+    # The SSD row cost comes from the pool's own layout formula.
+    from tpu_engine.models.ssd import ssd_state_dim
+    ssd_row_bytes = ssd_spec.config.n_layers \
+        * ssd_state_dim(ssd_spec.config) * 4
+    ssd_rows = budget_bytes // ssd_row_bytes + 1  # +1: the null row
+    rnd = random.Random(11)
+
+    def run_burst(gen, prompts):
+        peak = [0]
+        stop_flag = threading.Event()
+
+        def sampler():
+            while not stop_flag.is_set():
+                peak[0] = max(peak[0], gen.stats()["active"])
+                time.sleep(0.002)
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        futs = [gen.submit(p, max_new_tokens=max_new) for p in prompts]
+        outs = [f.result(600) for f in futs]
+        wall = time.perf_counter() - t0
+        stop_flag.set()
+        th.join(timeout=1)
+        toks = sum(len(o) for o in outs)
+        return outs, {"wall_s": round(wall, 3), "tokens": toks,
+                      "tokens_per_s": round(toks / wall, 2) if wall
+                      else 0.0,
+                      "peak_concurrent_rows": peak[0]}
+
+    def sweep_arm(arm: str):
+        per_len = {}
+        deterministic = True
+        leaks_clean = True
+        complete = True
+        for plen in seq_sweep:
+            prompts = [[rnd.randrange(1, 200) for _ in range(plen)]
+                       for _ in range(n_requests)]
+            if arm == "ssd":
+                gen = ContinuousGenerator(
+                    ssd_spec, params=ssd_params, dtype="float32",
+                    n_slots=n_slots, max_seq=max_seq,
+                    prefill_chunk=block_size, mixed_step=True,
+                    mixed_token_budget=mixed_budget,
+                    state_rows=int(ssd_rows))
+            else:
+                gen = ContinuousGenerator(
+                    att_spec, params=att_params, dtype="bfloat16",
+                    n_slots=n_slots, max_seq=max_seq,
+                    prefill_chunk=block_size, mixed_step=True,
+                    mixed_token_budget=mixed_budget,
+                    kv_block_size=block_size, kv_blocks=int(att_blocks),
+                    prefix_sharing=False)
+            try:
+                gen.generate([prompts[0][:8]], max_new_tokens=2)  # warm
+                s1, r1 = run_burst(gen, prompts)
+                s2, r2 = run_burst(gen, prompts)
+                deterministic &= s1 == s2
+                # Full-length streams only: a starved early completion
+                # would mean the pool bound mid-stream, not at
+                # admission — the A/B's sizing contract.
+                complete &= all(len(o) == max_new for o in s1 + s2)
+                r1["peak_concurrent_rows"] = max(
+                    r1["peak_concurrent_rows"],
+                    r2["peak_concurrent_rows"])
+                st = gen.stats()
+                if arm == "ssd":
+                    pool = st["state_pool"]
+                    r1["pool"] = {k: pool[k] for k in
+                                  ("rows_total", "rows_free",
+                                   "bytes_per_row")}
+                    leaks_clean &= (pool["rows_free"]
+                                    == pool["rows_total"])
+                else:
+                    pool = st["kv_pool"]
+                    r1["pool"] = {k: pool[k] for k in
+                                  ("blocks_total", "blocks_free",
+                                   "radix_nodes")}
+                    leaks_clean &= (pool["blocks_free"]
+                                    + pool["radix_nodes"]
+                                    >= pool["blocks_total"])
+            finally:
+                gen.stop()
+            per_len[plen] = r1
+        return {"per_seq_len": per_len,
+                "streams_deterministic": deterministic,
+                "streams_complete": complete,
+                "pools_leak_free": leaks_clean}
+
+    ssd_res = sweep_arm("ssd")
+    att_res = sweep_arm("att")
+    ssd_peaks = [ssd_res["per_seq_len"][s]["peak_concurrent_rows"]
+                 for s in seq_sweep]
+    att_peaks = [att_res["per_seq_len"][s]["peak_concurrent_rows"]
+                 for s in seq_sweep]
+    longest = seq_sweep[-1]
+    results = {
+        "att_model": att_model, "ssd_model": ssd_model,
+        "max_seq": max_seq, "block_size": block_size,
+        "n_slots": n_slots, "n_requests": n_requests,
+        "hbm_byte_budget": int(budget_bytes),
+        "att": {"kv_blocks": int(att_blocks),
+                "bytes_per_block": int(dense_bpb), **att_res},
+        "ssd": {"state_rows": int(ssd_rows),
+                "bytes_per_row": int(ssd_row_bytes), **ssd_res},
+        "seq_sweep": list(seq_sweep),
+        "ssd_peak_rows": ssd_peaks,
+        "att_peak_rows": att_peaks,
+        # The capacity story at the longest length: constant-state rows
+        # vs linearly-depreciating KV rows on the same HBM.
+        "capacity_gain_at_longest": round(
+            ssd_peaks[-1] / max(1, att_peaks[-1]), 2),
+    }
+    results["checks_passed"] = bool(
+        # SSD peak concurrent rows constant in sequence length...
+        len(set(ssd_peaks)) == 1
+        # ...while the attention arm's fall as streams lengthen...
+        and att_peaks[-1] < att_peaks[0]
+        # ...and the SSD arm holds more rows at the longest length.
+        and ssd_peaks[-1] > att_peaks[-1]
+        and ssd_res["streams_deterministic"]
+        and att_res["streams_deterministic"]
+        and ssd_res["streams_complete"]
+        and att_res["streams_complete"]
+        and ssd_res["pools_leak_free"]
+        and att_res["pools_leak_free"]
+        # The sweep actually saturated the SSD arm (peak == the burst).
+        and ssd_peaks[-1] == min(n_requests, n_slots))
+    return results
+
+
 def run_mixed_ab(model: str = "gpt2-small-test", n_short: int = 12,
                  n_long: int = 4, max_new: int = 40, long_max_new: int = 4,
                  short_prompt_len: int = 8, long_prompt_len: int = 440,
@@ -3016,7 +3207,8 @@ def _main() -> int:
                              "prefill-mfu", "longctx",
                              "miss-sweep", "paged-ab", "mixed-ab",
                              "crash-ab", "drain-ab", "affinity-ab",
-                             "overload-ab", "quant-ab", "disagg-ab"],
+                             "overload-ab", "quant-ab", "disagg-ab",
+                             "recurrent-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -3051,7 +3243,8 @@ def _main() -> int:
     if args.scenario == "mixed" and args.model == "resnet50":
         args.model = "yolov8n"
     if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab",
-                          "overload-ab", "quant-ab", "disagg-ab")
+                          "overload-ab", "quant-ab", "disagg-ab",
+                          "recurrent-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -3269,6 +3462,17 @@ def _main() -> int:
         emit({
             "metric": "kv_quant_capacity_gain",
             "value": result["capacity_gain"], "unit": "x",
+            "vs_baseline": None, "model": args.model, **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "recurrent-ab":
+        result = run_recurrent_ab(att_model=args.model, quick=args.quick)
+        record_partial("recurrent_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "recurrent_state_capacity_gain",
+            "value": result["capacity_gain_at_longest"], "unit": "x",
             "vs_baseline": None, "model": args.model, **result,
         })
         return 0 if result["checks_passed"] else 1

@@ -24,9 +24,10 @@ import sys
 OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
-#             --spec-parity step 9, --quant-parity step 10, --failover
-#             step 11, --migrate step 12, --disagg step 13,
-#             --overload step 14, --lint step 15
+#             --spec-parity step 9, --quant-parity step 10,
+#             --ssd-parity step 11, --failover step 12, --migrate
+#             step 13, --disagg step 14, --overload step 15,
+#             --lint step 16
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -90,15 +91,23 @@ def main() -> int:
                          "gather references — the fused-dequant decode "
                          "and ragged read paths behind --kv-quantize "
                          "(the on-chip gate before serving int8 KV)")
+    ap.add_argument("--ssd-parity", action="store_true",
+                    help="step 11: State Space Duality parity — the "
+                         "SSD/Mamba chunked matmul-form prefill scan vs "
+                         "the O(1) decode recurrence (ops.ssd, the "
+                         "state_slab model family behind e.g. mamba2): "
+                         "max|Δ| over outputs AND final state must stay "
+                         "bounded, the gate before serving the "
+                         "matmul-form prefill on a device")
     ap.add_argument("--failover", action="store_true",
-                    help="step 11: one scripted kill/resume against a "
+                    help="step 12: one scripted kill/resume against a "
                          "local worker pair (spawned here): kill -9 the "
                          "stream's lane mid-generation and print the "
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
     ap.add_argument("--migrate", action="store_true",
-                    help="step 12: one scripted migrate-mode drain "
+                    help="step 13: one scripted migrate-mode drain "
                          "against a local worker pair (spawned here): "
                          "drain the stream's lane mid-generation with "
                          "--migrate-streams semantics and print the "
@@ -106,7 +115,7 @@ def main() -> int:
                          "counters — the KV-handoff smoke without the "
                          "full fault_injection --migrate chaos run")
     ap.add_argument("--disagg", action="store_true",
-                    help="step 13: one scripted prefill→decode handoff "
+                    help="step 14: one scripted prefill→decode handoff "
                          "against a local 1-prefill + 1-decode worker "
                          "pair (spawned here) behind a --disagg "
                          "gateway: stream routes to the prefill lane, "
@@ -116,13 +125,13 @@ def main() -> int:
                          "without the full fault_injection --disagg "
                          "chaos run")
     ap.add_argument("--overload", action="store_true",
-                    help="step 14: overload-control state of the live "
+                    help="step 15: overload-control state of the live "
                          "system — the gateway's /stats overload block "
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 15: engine-lint static-analysis suite "
+                    help="step 16: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -130,6 +139,7 @@ def main() -> int:
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
               + int(args.spec_parity) + int(args.quant_parity)
+              + int(args.ssd_parity)
               + int(args.failover) + int(args.migrate)
               + int(args.disagg) + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
@@ -301,14 +311,40 @@ def main() -> int:
         except Exception as exc:
             step(n, "quantized (int8) kernel parity", False, f"({exc})")
 
-    # 10 (--failover): one scripted kill/resume against a local worker
+    # 11 (--ssd-parity): State Space Duality — the SSD/Mamba family's
+    # chunked matmul-form prefill scan against the O(1) decode
+    # recurrence (the two dual forms of the same selective-SSM layer;
+    # ops.ssd). The serving path keeps the recurrence for byte-identity,
+    # so this parity is the gate before the matmul form serves prefill
+    # on a device.
+    if args.ssd_parity:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity) + 1)
+        try:
+            from tpu_engine.ops.ssd import ssd_parity_check
+
+            small = ssd_parity_check()
+            wide = ssd_parity_check(batch=1, seq=65, heads=8, head_dim=16,
+                                    d_state=16, chunk=16, seed=3)
+            worst_y = max(small["max_abs_diff_y"], wide["max_abs_diff_y"])
+            worst_s = max(small["max_abs_diff_state"],
+                          wide["max_abs_diff_state"])
+            step(n, "SSD duality parity (matmul form vs recurrence)",
+                 small["ok"] and wide["ok"],
+                 f"(max|Δ| y {worst_y:.2e}, state {worst_s:.2e})")
+        except Exception as exc:
+            step(n, "SSD duality parity (matmul form vs recurrence)",
+                 False, f"({exc})")
+
+    # 12 (--failover): one scripted kill/resume against a local worker
     # pair — the journal splice, live, in one line: spawn two standalone
     # workers, stream through a failover-enabled gateway, kill -9 the
     # serving lane mid-stream, and diff the spliced stream against an
     # unkilled blocking control.
     if args.failover:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
-             + int(args.spec_parity) + int(args.quant_parity) + 1)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + 1)
         procs = []
         try:
             import signal
@@ -386,7 +422,7 @@ def main() -> int:
     if args.migrate:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.failover) + 1)
+             + int(args.ssd_parity) + int(args.failover) + 1)
         procs = []
         try:
             import threading
@@ -466,7 +502,8 @@ def main() -> int:
     if args.disagg:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.failover) + int(args.migrate) + 1)
+             + int(args.ssd_parity) + int(args.failover)
+             + int(args.migrate) + 1)
         procs = []
         try:
             import threading
@@ -535,8 +572,8 @@ def main() -> int:
     if args.overload:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.failover) + int(args.migrate)
-             + int(args.disagg) + 1)
+             + int(args.ssd_parity) + int(args.failover)
+             + int(args.migrate) + int(args.disagg) + 1)
         try:
             status, stats = _get(gw, "/stats")
             ov = stats.get("overload")

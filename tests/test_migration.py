@@ -896,3 +896,142 @@ def test_quantized_migration_round_trip():
         A.stop()
         B.stop()
         C.stop()
+
+
+# -- state_slab (SSD/Mamba) chains: the one-pseudo-block wire format ----------
+
+
+def _ssd_fleet_kw():
+    return dict(model="ssd-small-test", dtype="float32",
+                gen_scheduler="continuous", gen_step_chunk=2,
+                gen_prefill_chunk=16, gen_max_batch_size=4,
+                gen_state_rows=8)
+
+
+@pytest.fixture(scope="module")
+def ssd_fleet():
+    """Two in-process state_slab lanes sharing one parameter set."""
+    workers = [WorkerNode(WorkerConfig(node_id=f"s{i}", **_ssd_fleet_kw()))
+               for i in range(2)]
+    p0 = workers[0].engine.params
+    workers[1].apply_weights(p0)
+    yield workers
+    for w in workers:
+        w.stop()
+
+
+def slab_leak_free(worker) -> bool:
+    st = worker.generator.stats()
+    sp = st["state_pool"]
+    return (st["active"] == 0
+            and sp["rows_free"] == sp["rows_total"]
+            and sp["rows_admitted"] == sp["rows_released"])
+
+
+def test_ssd_state_chain_round_trip_bit_exact():
+    """A state row's one-pseudo-block chain exports and imports
+    BIT-exactly between same-geometry slab pools, and the paged pool's
+    checksum verifier accepts the shape unchanged (shared wire
+    format)."""
+    from tpu_engine.runtime.kv_blocks import StateSlabPool
+
+    src = StateSlabPool(3, 11, 4)
+    rid = src.alloc_row()
+    flat = (np.arange(33, dtype=np.float32).reshape(3, 11) * 0.173
+            - 2.5)
+    src.slab = src.slab.at[:, rid].set(jnp.asarray(flat))
+    chain = src.export_row_chain(rid)
+    assert BlockPool.verify_chain(chain)  # the PR 11 verifier, verbatim
+    dst = StateSlabPool(3, 11, 4)
+    assert dst.chain_compatible(chain) is None
+    rid2 = dst.alloc_row()
+    dst.import_row_chain(chain, rid2)
+    assert np.array_equal(np.asarray(dst.slab[:, rid2]), flat)
+
+
+def test_ssd_state_chain_refusals_named_before_allocation():
+    from tpu_engine.runtime.kv_blocks import StateSlabPool
+
+    src = StateSlabPool(2, 7, 3)
+    chain = src.export_row_chain(src.alloc_row())
+    # Every geometry/family header mismatch is NAMED; a kv_paged pool
+    # never accepts a state chain (family key) and vice versa.
+    assert "family" in BlockPool(_cfg(), 4, 16,
+                                 jnp.float32).chain_compatible(chain)
+    assert "state_dim" in StateSlabPool(2, 8, 3).chain_compatible(chain)
+    assert "n_layers" in StateSlabPool(3, 7, 3).chain_compatible(chain)
+    assert "dtype" in StateSlabPool(
+        2, 7, 3, dtype=jnp.bfloat16).chain_compatible(chain)
+    # Truncated payload with a SELF-CONSISTENT checksum: refused
+    # structurally with byte counts named (never reaches allocation).
+    raw = base64.b64decode(chain["blocks"][0]["k"])[:-8]
+    trunc = dict(chain, blocks=[{"k": base64.b64encode(raw).decode()}],
+                 checksum=zlib.crc32(raw))
+    assert StateSlabPool.verify_chain(trunc)  # checksum IS consistent
+    reason = src.chain_compatible(trunc)
+    assert "48" in reason and "56" in reason  # holds vs expected bytes
+
+
+@pytest.mark.parametrize("params", [
+    {},                                      # greedy
+    {"temperature": 0.9, "seed": 1234},      # seeded sampling
+])
+def test_ssd_stream_migrates_between_lanes_byte_identical(ssd_fleet,
+                                                          params):
+    """Migration of an SSD stream between lanes splices byte-identically
+    through the WORKER surface (/admin/migrate export → migrate_import
+    continuation): the state slab ships verbatim, decoding resumes at
+    the exported position with zero re-prefill, zero slab leaks on both
+    lanes."""
+    src, dst = ssd_fleet
+    control = src.handle_generate(
+        {"request_id": "sc", "prompt_tokens": PROMPT,
+         "max_new_tokens": 20, **params})["tokens"]
+
+    toks, final = [], [None]
+    armed = threading.Event()
+    exported = {}
+
+    def consume():
+        stream = src.handle_generate_stream(
+            {"request_id": "sm1", "prompt_tokens": PROMPT,
+             "max_new_tokens": 20, **params})
+        for frame in stream:
+            evt = _parse_sse(frame)
+            if evt is None:
+                continue
+            if evt.get("done"):
+                final[0] = evt
+                break
+            if "tokens" in evt:
+                toks.extend(evt["tokens"])
+                if len(toks) >= 4:
+                    armed.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert armed.wait(120), "stream never reached the export point"
+    exported = src.handle_migrate_export({"request_id": "sm1"})
+    t.join(timeout=120)
+    assert exported["ok"], exported
+    assert exported["chain"]["family"] == "state_slab"
+    assert final[0] is not None and final[0].get("migrated") is True
+
+    # Adopt on the destination lane via the migrate_import surface.
+    cont = []
+    for frame in dst.handle_generate_stream(
+            {"request_id": "sm1b", "migrate_import": exported}):
+        evt = _parse_sse(frame)
+        if evt is None:
+            continue
+        if evt.get("done"):
+            assert "error" not in evt, evt
+            spliced = toks + cont
+            assert spliced == control
+            assert evt["tokens"] == control
+            break
+        if "tokens" in evt:
+            cont.extend(evt["tokens"])
+    assert _wait(lambda: slab_leak_free(src) and slab_leak_free(dst))
+    assert src.generator.stats()["migration"]["exported_rows"] >= 1
+    assert dst.generator.stats()["migration"]["imported_rows"] >= 1

@@ -71,6 +71,24 @@ def test_percentile_nearest_rank_boundaries():
     assert percentile(vals, 99) == 99   # old code returned 100 here
     assert percentile(vals, 1) == 1
     assert percentile(vals, 100) == 100
+    # p <= 0 clamps to the minimum (rank floor), never a negative index.
+    assert percentile(vals, 0) == 1
+
+
+def test_percentile_sorts_unsorted_input():
+    """The helper sorts internally — UNSORTED input used to silently
+    return garbage (the known bench footgun: a latency list in arrival
+    order produced plausible-looking nonsense percentiles). The input
+    list must not be mutated (callers reuse their samples)."""
+    unsorted = [9.0, 1.0, 7.0, 3.0, 5.0]
+    snapshot = list(unsorted)
+    assert percentile(unsorted, 50) == 5.0
+    assert percentile(unsorted, 100) == 9.0
+    assert percentile(unsorted, 1) == 1.0
+    assert unsorted == snapshot  # sorted a COPY, caller's list intact
+    # Reverse-sorted worst case agrees with the sorted result.
+    rev = list(range(100, 0, -1))
+    assert percentile(rev, 99) == percentile(sorted(rev), 99) == 99
 
 
 def test_summary_uses_nearest_rank():

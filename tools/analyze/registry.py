@@ -95,6 +95,9 @@ _RECEIVER_ALIASES = {
     # BlockPool, reached from the scheduler and from RadixTree.
     "pool": "BlockPool",
     "self._pool": "BlockPool",
+    # StateSlabPool (state_slab family), reached from the scheduler.
+    "spool": "StateSlabPool",
+    "self._spool": "StateSlabPool",
     # The pool's radix tree, driven under the pool lock.
     "pool.radix": "RadixTree",
     "self._pool.radix": "RadixTree",
@@ -121,15 +124,25 @@ _RECEIVER_ALIASES = {
 ENGINE_REGISTRY = Registry(
     package="tpu_engine",
     lock_aliases=(
-        (None, "self.lock", "BlockPool.lock"),
+        # `self.lock` is scoped per owning class — an unscoped alias
+        # would canonicalize EVERY pool's internal `with self.lock:` to
+        # BlockPool.lock (StateSlabPool's would be wrong).
+        ("BlockPool", "self.lock", "BlockPool.lock"),
+        ("RadixTree", "self.lock", "BlockPool.lock"),
         (None, "pool.lock", "BlockPool.lock"),
         (None, "self._pool.lock", "BlockPool.lock"),
+        # The state-slab pool's own lock (state_slab family).
+        ("StateSlabPool", "self.lock", "StateSlabPool.lock"),
+        (None, "spool.lock", "StateSlabPool.lock"),
+        (None, "self._spool.lock", "StateSlabPool.lock"),
         # Conditions share their underlying lock: nesting them with it
         # would self-deadlock, so they must canonicalize together.
         ("BatchProcessor", "self._cv", "BatchProcessor._lock"),
         ("AdmissionController", "self._idle", "AdmissionController._lock"),
     ),
-    reentrant=frozenset({"BlockPool.lock"}),  # RLock: eviction inside alloc
+    # RLocks: BlockPool eviction runs inside alloc; StateSlabPool
+    # mirrors the discipline (stats helpers may nest).
+    reentrant=frozenset({"BlockPool.lock", "StateSlabPool.lock"}),
     guarded=(
         # Block pool bookkeeping + the pool-ordering dispatch surface
         # (the quantized pool's host scale slots pair 1:1 with the host
@@ -150,6 +163,16 @@ ENGINE_REGISTRY = Registry(
             lock="BlockPool.lock",
             classes=("BlockPool",),
             receivers=("pool", "self._pool")),
+        # State slab pool bookkeeping + its donated dispatch surface
+        # (state_slab family: the slab tensor is replaced under the
+        # pool lock exactly like BlockPool.caches, so admission writes
+        # / chain exports order against decode-tick donations).
+        GuardedEntry(
+            attrs=("_free", "_ref", "slab", "rows_admitted",
+                   "rows_released", "exports", "imports"),
+            lock="StateSlabPool.lock",
+            classes=("StateSlabPool",),
+            receivers=("spool", "self._spool")),
         # Gateway membership / routing state (+ the overload-control
         # in-flight gauge the tier fractions admit against, + the
         # disaggregated-serving role map).
@@ -213,7 +236,8 @@ ENGINE_REGISTRY = Registry(
         # GIL-safe reads carry explicit lockfree-ok waivers).
         ThreadOwnedEntry(
             attrs=("_tables", "_row_blocks", "_row_req", "_row_emitted",
-                   "_pending", "_export_waiting", "_hold_cancel_tags"),
+                   "_pending", "_export_waiting", "_hold_cancel_tags",
+                   "_slab_rows"),
             owner_class="ContinuousGenerator",
             module="tpu_engine.runtime.scheduler",
             entries=("ContinuousGenerator._loop",),
@@ -222,6 +246,7 @@ ENGINE_REGISTRY = Registry(
     # BlockPool/RadixTree methods document "caller holds the pool lock":
     # the analyzer checks their CALL sites instead of their bodies.
     caller_locked=frozenset({"BlockPool.*", "RadixTree.*",
+                             "StateSlabPool.*",
                              "TenantRateLimiter._evict_idle",
                              "SheddingStats._gc"}),
     receiver_aliases=_RECEIVER_ALIASES,
@@ -236,6 +261,9 @@ ENGINE_REGISTRY = Registry(
         "tpu_engine.runtime.scheduler:ContinuousGenerator._prefill_loop",
         "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_mixed",
         "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_spec",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_slab",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator."
+        "_tick_slab_mixed",
     ),
     cli_module="tpu_engine.serving.cli",
     config_module="tpu_engine.utils.config",

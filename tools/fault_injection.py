@@ -589,13 +589,19 @@ def run_spec_standalone() -> int:
 
 
 def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=(),
-                        per_worker_args=None):
+                        per_worker_args=None,
+                        model: str = "gpt2-small-test",
+                        base_args=("--kv-block-size", "16",
+                                   "--step-chunk", "2",
+                                   "--prefill-chunk", "16")):
     """Spawn ``n`` standalone worker processes (``cli worker``, paged KV,
     tiny chunks so streams span many frames) — the killable unit of the
     crash/offload scenarios. ``extra_args`` append to each worker's argv
     (the offload scenario adds a tiny pool + ``--kv-host-blocks``);
     ``per_worker_args[i]`` appends per worker (the disagg scenario's
-    ``--role`` split). Returns (ports, procs)."""
+    ``--role`` split). ``model``/``base_args`` swap the served family
+    (the recurrent scenario runs state_slab lanes, which take no
+    --kv-block-size). Returns (ports, procs)."""
     from tpu_engine.utils.net import launch_with_retry
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -608,9 +614,8 @@ def launch_worker_procs(n: int = 3, attempts: int = 3, extra_args=(),
             per = (tuple(per_worker_args[i])
                    if per_worker_args is not None else ())
             cmd = [sys.executable, "-m", "tpu_engine.serving.cli", "worker",
-                   str(port), f"w{i}", "gpt2-small-test",
-                   "--kv-block-size", "16", "--step-chunk", "2",
-                   "--prefill-chunk", "16", *extra_args, *per]
+                   str(port), f"w{i}", model,
+                   *base_args, *extra_args, *per]
             proc = subprocess.Popen(cmd, cwd=repo, env=env,
                                     stdout=sys.stderr, stderr=sys.stderr)
             deadline = time.monotonic() + 600
@@ -1871,6 +1876,191 @@ def run_crash_standalone() -> int:
                 proc.kill()
 
 
+def _worker_state_pool_clean(port: int, timeout_s: float = 30.0):
+    """Poll a state_slab worker's /health until its scheduler is idle
+    and every state row is accounted for (rows_free == rows_total and
+    the admitted/released counters agree) — the zero-slab-leak check.
+    Returns the final state_pool dict (or None if it never settled)."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _, health = _call(port, "GET", "/health", timeout=5.0)
+        except OSError:
+            time.sleep(0.3)
+            continue
+        gen = health.get("generator", {})
+        last = gen.get("state_pool")
+        if (gen.get("active") == 0 and last
+                and last["rows_free"] == last["rows_total"]
+                and last["rows_admitted"] == last["rows_released"]):
+            return last
+        time.sleep(0.3)
+    return None
+
+
+def recurrent_phase(ports, procs, checks: list) -> dict:
+    """The state_slab family under the crash harness: kill -9 one
+    SSD-model worker while its streams are mid-generation under Poisson
+    load; with failover on, every stream must complete byte-identical
+    to the unkilled control (the replay resume re-prefills prompt ⧺
+    emitted through the SAME recurrence the decode steps run, so the
+    resumed state is exact) and every surviving pool must account for
+    every state row — zero slab leaks."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    victim_lane = victim_lane_for_port(lanes, ports[1])
+    victim_proc = procs[1]
+
+    # The served family is live and declared: state_pool present,
+    # kv_pool absent, on every lane's /health.
+    family_ok = True
+    for p in ports:
+        _, health = _call(p, "GET", "/health", timeout=10.0)
+        g = health.get("generator", {})
+        family_ok &= ("state_pool" in g and "kv_pool" not in g
+                      and "block-addressable"
+                      in g["state_pool"]["prefix_sharing"])
+    checks.append(("recurrent: lanes serve the state_slab family "
+                   "(state_pool in /health, no kv_pool)", family_ok))
+
+    # Request mix: greedy and seeded-sampled streams, victim-primary
+    # rows with long budgets so they are provably mid-flight at kill.
+    requests = []
+    for k in range(12):
+        lane = victim_lane if k % 3 == 0 else lanes[k % len(lanes)]
+        params = {}
+        if k % 3 == 1:
+            params = {"temperature": 0.9, "seed": 300 + k}
+        requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"rc{k}"),
+            "prompt_tokens": [(k * 5 + j) % 90 + 1
+                              for j in range(5 + k % 4)],
+            "max_new_tokens": 56 if lane == victim_lane else 20,
+            **params})
+    victim_rids = {r["request_id"] for r in requests
+                   if gw._ring.get_node(r["request_id"]) == victim_lane}
+
+    try:
+        control = control_oracle(ports[0], requests)
+    except RuntimeError as exc:
+        checks.append(("recurrent: control generate", False))
+        return {"error": str(exc)}
+    for p in ports[1:]:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+
+    def kill_victim():
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=10)
+
+    # Tight arrivals: an O(1)-state lane streams a 56-token request in
+    # ~100 ms on the CPU mesh — the default 8/s Poisson stagger would
+    # let every victim stream FINISH before the kill loop even starts.
+    results, killed = drive_streams_with_kill(
+        gw, requests, victim_rids, kill_victim, random.Random(2),
+        arrival_rate=60.0)
+    checks.append(("recurrent: victim killed mid-stream", killed))
+
+    complete, identical, resumed = tally_streams(results, control)
+    checks.append(("recurrent: all streams completed "
+                   f"({complete}/{len(requests)})",
+                   complete == len(requests)))
+    checks.append(("recurrent: all streams byte-identical to control "
+                   f"({identical}/{len(requests)})",
+                   identical == len(requests)))
+    checks.append(("recurrent: at least one stream resumed",
+                   resumed >= 1))
+
+    # Failover decisions: counters == spans (the family rides the SAME
+    # journal/resume machinery — no recurrent-specific counters to
+    # drift), and the prober ejects the corpse.
+    ejected = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if victim_lane in gw.ejected_lanes():
+            ejected = True
+            break
+        time.sleep(0.1)
+    checks.append(("recurrent: prober ejected the dead lane", ejected))
+    fo, resume_spans = {}, []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        fo = gw.get_stats().get("failover", {})
+        resume_spans = [s for s in gw.tracer.snapshot()
+                        if s["op"] == "resume"]
+        if len(resume_spans) == fo.get("resumes_attempted", -1):
+            break
+        time.sleep(0.1)
+    checks.append(("recurrent: failover counters == resume spans",
+                   len(resume_spans) == fo.get("resumes_attempted", -1)
+                   and fo.get("resumes_attempted", 0) >= 1))
+
+    # Post-kill availability: a FRESH stream admits and completes.
+    fresh = {"request_id": "post_kill_rc", "prompt_tokens": [9, 8, 7],
+             "max_new_tokens": 8}
+    ctl = _call(ports[0], "POST", "/generate",
+                dict(fresh, request_id="ctl_post_rc"), timeout=600)[1]
+    for frame in gw.route_generate_stream(dict(fresh)):
+        evt = _parse_sse(frame)
+        if evt and evt.get("done"):
+            checks.append(("recurrent: post-kill stream completes "
+                           "identically",
+                           "error" not in evt
+                           and evt["tokens"] == ctl["tokens"]))
+            break
+
+    # Zero state-slab rows leaked on the survivors.
+    pools = {}
+    for p in (ports[0], ports[2]):
+        pool = _worker_state_pool_clean(p)
+        pools[p] = pool
+        checks.append((f"recurrent: zero slab rows leaked on "
+                       f"survivor :{p}", pool is not None))
+    gw.stop()
+    return {"streams": len(requests), "complete": complete,
+            "identical": identical, "resumed_streams": resumed,
+            "victim_primary_streams": len(victim_rids),
+            "failover": fo, "survivor_state_pools": pools}
+
+
+def run_recurrent_standalone() -> int:
+    # step-chunk 1: one token per dispatch, so streams span many SSE
+    # frames and the kill provably lands mid-generation.
+    ports, procs = launch_worker_procs(
+        3, model="ssd-small-test",
+        base_args=("--step-chunk", "1", "--prefill-chunk", "16",
+                   "--state-rows", "12"))
+    checks: list = []
+    try:
+        report = {"mode": "recurrent-standalone", "worker_ports": ports,
+                  "phases": {"recurrent": recurrent_phase(ports, procs,
+                                                          checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_mixed_standalone() -> int:
     port, proc = launch_mixed_server()
     checks: list = []
@@ -2232,6 +2422,18 @@ def main() -> int:
                          "a prefill lane mid-handoff and a decode lane "
                          "mid-adopt — both land on the replay fallback "
                          "byte-identically; ignores the other flags")
+    ap.add_argument("--recurrent", action="store_true",
+                    help="standalone recurrent-family (state_slab) "
+                         "scenario: spawns three SSD-model worker "
+                         "processes (fixed-size state rows, no KV "
+                         "blocks), kill -9s one mid-stream under "
+                         "Poisson load, and asserts every stream "
+                         "completes byte-identical to an unkilled "
+                         "control via the replay resume (the "
+                         "recurrence makes prompt ⧺ emitted re-prefill "
+                         "exact) with zero state-slab rows leaked on "
+                         "the survivors and failover counters == "
+                         "resume spans; ignores the other flags")
     ap.add_argument("--overload", action="store_true",
                     help="standalone overload-control scenario: spawns a "
                          "3-lane combined server with every overload "
@@ -2257,6 +2459,8 @@ def main() -> int:
         return run_spec_standalone()
     if args.crash:
         return run_crash_standalone()
+    if args.recurrent:
+        return run_recurrent_standalone()
     if args.offload:
         return run_offload_standalone()
     proc = None

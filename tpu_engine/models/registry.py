@@ -14,6 +14,22 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, Optional, Tuple
 
+# Serving-capability flags per state family (VirtualFlow framing: the
+# registry, not the serving machinery, declares what a model family can
+# do — the scheduler and worker fence mismatches LOUDLY instead of
+# silently degrading). "kv_paged": autoregressive state is a growing KV
+# chain in the block pool; "state_slab": a fixed-size recurrent state
+# slab (O(1) per stream — SSD/Mamba family); "stateless": no generation
+# lane (one-shot /infer only).
+FAMILY_CAPABILITIES: Dict[str, Tuple[str, ...]] = {
+    "kv_paged": ("generate", "two_path", "mixed_step", "spec_decode",
+                 "paged_kv", "prefix_sharing", "kv_quantize",
+                 "kv_host_tier", "migration", "handoff"),
+    "state_slab": ("generate", "two_path", "mixed_step", "migration",
+                   "handoff"),
+    "stateless": (),
+}
+
 
 @dataclasses.dataclass
 class ModelSpec:
@@ -23,6 +39,33 @@ class ModelSpec:
     input_shape: Tuple[int, ...]   # per-sample shape the model consumes
     output_shape: Tuple[int, ...]  # per-sample output shape
     config: Optional[object] = None  # architecture config (e.g. TransformerConfig)
+    # Serving-state family ("" = derive from the config below): which
+    # autoregressive-state machinery the continuous scheduler must build
+    # for this model. Every registered model carries a declaration.
+    state_family: str = ""
+    # Serving-capability flags ("" sentinel tuple = derive from the
+    # family table above). Consumers fence on these, never on isinstance.
+    capabilities: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if not self.state_family:
+            # A config may declare its family (SSDConfig does); causal
+            # transformer configs default to the paged-KV family; models
+            # without a generation-capable config are stateless.
+            fam = getattr(self.config, "serving_state_family", None)
+            if fam is None and getattr(self.config, "causal", False):
+                fam = "kv_paged"
+            self.state_family = fam or "stateless"
+        if self.state_family not in FAMILY_CAPABILITIES:
+            raise ValueError(
+                f"model '{self.name}' declares unknown state family "
+                f"{self.state_family!r}; known: "
+                f"{sorted(FAMILY_CAPABILITIES)}")
+        if not self.capabilities:
+            self.capabilities = FAMILY_CAPABILITIES[self.state_family]
+
+    def supports(self, flag: str) -> bool:
+        return flag in self.capabilities
 
     @property
     def input_size(self) -> int:
@@ -69,6 +112,6 @@ def _ensure_builtin_models_imported():
 
     from tpu_engine.models import mlp, resnet  # noqa: F401
 
-    for optional in ("bert", "gpt2", "llama", "yolo"):
+    for optional in ("bert", "gpt2", "llama", "yolo", "ssd"):
         if importlib.util.find_spec(f"tpu_engine.models.{optional}") is not None:
             importlib.import_module(f"tpu_engine.models.{optional}")

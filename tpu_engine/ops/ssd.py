@@ -1,0 +1,174 @@
+"""State Space Duality (SSD) primitives — the Mamba-2-style selective
+state-space scan in its two dual forms.
+
+The Compiler-First State Space Duality paper (PAPERS.md) is the source:
+a selective SSM layer admits ONE mathematical recurrence
+
+    s_t = exp(dt_t * A) * s_{t-1} + dt_t * x_t ⊗ B_t        (state update)
+    y_t = C_t · s_t                                          (readout)
+
+with two dual computational forms:
+
+- **O(1) recurrence** (`ssd_step` / `ssd_recurrent`): one step per token,
+  a fixed-size state ``(heads, head_dim, d_state)`` per row. This is the
+  DECODE form — autoregressive serving costs constant state per stream
+  no matter how long it runs (the "portable O(1) autoregressive caching"
+  the paper names), and it is partition-invariant: processing a sequence
+  in windows of any size through repeated steps produces bit-identical
+  states, which is what makes the serving scheduler's budgeted prefill
+  chunks, crash-replay resumes, and two-path-vs-mixed stepping
+  byte-identical (runtime.scheduler, DESIGN.md "Recurrent state
+  serving").
+- **Chunked matmul form** (`ssd_chunked`): the sequence splits into
+  chunks; within a chunk the scan becomes an attention-like masked
+  matmul (decay-weighted score matrix @ inputs) and only one recurrence
+  per CHUNK carries state across — MXU-shaped work instead of T
+  sequential steps. This is the PREFILL throughput form. Floating-point
+  association differs from the recurrence (low-bit diffs), so the
+  serving path keeps the recurrence form for byte-identity and this
+  form is the on-chip prefill fast path staged behind
+  `ssd_parity_check` (diagnostics.py --ssd-parity), the same
+  correctness-anchor-first pattern as ops.paged_attention.
+
+Conventions (Mamba-2 defaults): ``A`` is one negative scalar per head;
+``B``/``C`` are shared across heads (one state group); ``dt`` is a
+per-head per-step rate. Shapes:
+  x (b, t, h, p) · dt (b, t, h) · A (h,) · B (b, t, n) · C (b, t, n)
+  → y (b, t, h, p), final state (b, h, p, n).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ssd_step(state, x, dt, A, B, C):
+    """One recurrence step for a batch of rows — the O(1) decode form.
+
+    state (b, h, p, n) · x (b, h, p) · dt (b, h) · A (h,) · B (b, n) ·
+    C (b, n) → (y (b, h, p), new_state). The caller owns masking (a row
+    that must not advance keeps its old state) and the D·x skip term."""
+    dA = jnp.exp(dt * A)                                   # (b, h) decay
+    dBx = (dt[..., None] * x)[..., None] * B[:, None, None, :]
+    new_state = state * dA[..., None, None] + dBx          # (b, h, p, n)
+    y = jnp.einsum("bhpn,bn->bhp", new_state, C)
+    return y, new_state
+
+
+def ssd_recurrent(x, dt, A, B, C, initial_state=None):
+    """Sequential reference: scan `ssd_step` over t. This IS the serving
+    decode computation unrolled — the parity anchor `ssd_chunked` must
+    match."""
+    b, t, h, p = x.shape
+    n = B.shape[-1]
+    if initial_state is None:
+        initial_state = jnp.zeros((b, h, p, n), x.dtype)
+
+    def body(state, inp):
+        x_t, dt_t, B_t, C_t = inp
+        y_t, state = ssd_step(state, x_t, dt_t, A, B_t, C_t)
+        return state, y_t
+
+    xs = (jnp.moveaxis(x, 1, 0), jnp.moveaxis(dt, 1, 0),
+          jnp.moveaxis(B, 1, 0), jnp.moveaxis(C, 1, 0))
+    final, ys = jax.lax.scan(body, initial_state, xs)
+    return jnp.moveaxis(ys, 0, 1), final
+
+
+def _segsum(a):
+    """Lower-triangular pairwise decay sums: out[..., i, j] =
+    sum_{j < m <= i} a[..., m] for i >= j, -inf above the diagonal
+    (exp → 0, so masked positions contribute nothing)."""
+    T = a.shape[-1]
+    cs = jnp.cumsum(a, axis=-1)
+    s = cs[..., :, None] - cs[..., None, :]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    return jnp.where(mask, s, -jnp.inf)
+
+
+def ssd_chunked(x, dt, A, B, C, chunk: int = 16, initial_state=None):
+    """Chunked matmul form — the prefill-throughput dual of
+    `ssd_recurrent`. Sequences whose length is not a chunk multiple are
+    zero-padded (dt 0 = identity step: exp(0·A) = 1, no input injected),
+    so any T works. Returns (y (b, t, h, p), final state (b, h, p, n));
+    equal to the recurrence up to float association
+    (`ssd_parity_check`)."""
+    b, t, h, p = x.shape
+    n = B.shape[-1]
+    c = max(1, int(chunk))
+    pad = (-t) % c
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        dt = jnp.pad(dt, ((0, 0), (0, pad), (0, 0)))
+        B = jnp.pad(B, ((0, 0), (0, pad), (0, 0)))
+        C = jnp.pad(C, ((0, 0), (0, pad), (0, 0)))
+    T = t + pad
+    k = T // c
+    xd = x * dt[..., None]                                  # dt-weighted input
+    a = dt * A[None, None, :]                               # (b, T, h) log decay
+    xd_c = xd.reshape(b, k, c, h, p)
+    a_c = jnp.moveaxis(a.reshape(b, k, c, h), -1, 1)        # (b, h, k, c)
+    B_c = B.reshape(b, k, c, n)
+    C_c = C.reshape(b, k, c, n)
+
+    # Intra-chunk: attention-like masked matmul. L[i, j] carries the
+    # decay from step j's injection to step i's readout.
+    L = jnp.exp(_segsum(a_c))                               # (b, h, k, c, c)
+    scores = jnp.einsum("bkin,bkjn->bkij", C_c, B_c)        # (b, k, c, c)
+    y_diag = jnp.einsum("bhkij,bkij,bkjhp->bkihp", L, scores, xd_c)
+
+    # Each chunk's contribution to the state at its own end.
+    a_cum = jnp.cumsum(a_c, axis=-1)                        # (b, h, k, c)
+    decay_to_end = jnp.exp(a_cum[..., -1:] - a_cum)         # (b, h, k, c)
+    chunk_states = jnp.einsum("bkjn,bhkj,bkjhp->bkhpn", B_c, decay_to_end,
+                              xd_c)
+
+    # One recurrence per chunk carries state across chunk boundaries.
+    chunk_decay = jnp.exp(a_cum[..., -1])                   # (b, h, k)
+    if initial_state is None:
+        initial_state = jnp.zeros((b, h, p, n), x.dtype)
+
+    def body(carry, inp):
+        contrib, decay = inp                                # (b,h,p,n), (b,h)
+        new = carry * decay[..., None, None] + contrib
+        return new, carry                                   # emit ENTERING state
+
+    final, entering = jax.lax.scan(
+        body, initial_state,
+        (jnp.moveaxis(chunk_states, 1, 0), jnp.moveaxis(chunk_decay, -1, 0)))
+    entering = jnp.moveaxis(entering, 0, 1)                 # (b, k, h, p, n)
+
+    # Off-diagonal: the entering state decayed THROUGH each step i
+    # (inclusive — the state update runs before the readout).
+    state_decay = jnp.exp(a_cum)                            # (b, h, k, c)
+    y_off = jnp.einsum("bkin,bkhpn,bhki->bkihp", C_c, entering, state_decay)
+
+    y = (y_diag + y_off).reshape(b, T, h, p)[:, :t]
+    return y, final
+
+
+def ssd_parity_check(batch: int = 2, seq: int = 37, heads: int = 3,
+                     head_dim: int = 8, d_state: int = 5, chunk: int = 8,
+                     seed: int = 0, tol: float = 1e-4) -> dict:
+    """Duality proof: the chunked matmul form and the O(1) recurrence
+    produce the same outputs and final state (max|Δ| bounded — float
+    association is the only difference). Deliberately uses a seq length
+    that is NOT a chunk multiple so the padding path is covered.
+    `diagnostics.py --ssd-parity` runs this; tests pin the bound."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((batch, seq, heads, head_dim)),
+                    jnp.float32)
+    dt = jnp.asarray(rng.uniform(0.01, 0.4, (batch, seq, heads)),
+                     jnp.float32)
+    A = -jnp.exp(jnp.asarray(rng.uniform(-1.0, 1.0, (heads,)), jnp.float32))
+    B = jnp.asarray(rng.standard_normal((batch, seq, d_state)), jnp.float32)
+    C = jnp.asarray(rng.standard_normal((batch, seq, d_state)), jnp.float32)
+    y_rec, s_rec = ssd_recurrent(x, dt, A, B, C)
+    y_chk, s_chk = ssd_chunked(x, dt, A, B, C, chunk=chunk)
+    dy = float(jnp.max(jnp.abs(y_rec - y_chk)))
+    ds = float(jnp.max(jnp.abs(s_rec - s_chk)))
+    return {"max_abs_diff_y": dy, "max_abs_diff_state": ds,
+            "tol": float(tol), "chunk": int(chunk), "seq": int(seq),
+            "ok": bool(dy < tol and ds < tol)}

@@ -723,6 +723,14 @@ class BlockPool:
         must be refused HERE, on the import's validation path — never
         crash the decode thread mid-admission (a decode-thread failure
         recovers the whole pool and kills every live row on the lane)."""
+        fam = chain.get("family")
+        if fam not in (None, "kv_paged"):
+            # Cross-family chains refuse by NAME, not by accidental
+            # geometry mismatch: a state_slab chain holds a recurrent
+            # state row, never KV blocks (and PR 11 kv chains predate
+            # the key, so absent = kv_paged).
+            return (f"chain family={fam!r} does not match destination "
+                    f"pool family 'kv_paged'")
         want = {"dtype": str(jnp.dtype(self._dtype)),
                 "quantized": self.quantized,
                 "block_size": self.block_size,
@@ -927,6 +935,217 @@ class BlockPool:
                     out["host"]["scale_slots_leaked"] = (
                         used - self._demoted_nodes())
             return out
+
+
+class StateSlabPool:
+    """Fixed-size recurrent-state rows for the ``state_slab`` model
+    family (SSD/Mamba — models.ssd): one ``(n_layers, state_dim)`` f32
+    row per live stream, CONSTANT in sequence length. The paged pool's
+    "KV capacity" becomes "state capacity" here: a row costs the same
+    HBM at token 1 and token 100k, so peak concurrent rows are
+    independent of stream length (bench.py --scenario recurrent-ab).
+
+    Same host-side discipline as ``BlockPool`` — one lock over the free
+    list/refcounts that ALSO orders pool-touching device dispatches
+    (the decode tick donates ``slab``; admission writes and chain
+    exports order against it under the lock), a reserved null row 0 for
+    free slots' gather/scatter targets, and a generation stamp that
+    voids row ids across ``reset()`` rebuilds.
+
+    Deliberately NO radix tree and no prefix sharing: a recurrent
+    prefix is a dense nonlinear state, not a block-addressable chain —
+    two prompts sharing a prefix produce states that cannot be split,
+    shared, or partially matched. ``stats()`` says so loudly
+    (``prefix_sharing: "unsupported: recurrent state is not
+    block-addressable"``) so operators never hunt for a radix knob
+    that cannot exist for this family.
+
+    Chain wire format: a state row serializes as a ONE-pseudo-block
+    chain over the PR 11 ``export_chain`` shape — a ``blocks`` list
+    with a single ``{"k": <payload b64>}`` entry, a crc32 checksum, and
+    the pool generation — so ``BlockPool.verify_chain`` verifies it
+    unchanged and drain/migration/handoff machinery (gateway,
+    /admin/migrate, ``migrate_import``) composes for free."""
+
+    def __init__(self, n_layers: int, state_dim: int, num_rows: int,
+                 dtype=jnp.float32, device=None):
+        if num_rows < 2:
+            raise ValueError("need >= 2 state rows (row 0 is the null row)")
+        self.n_layers = int(n_layers)
+        self.state_dim = int(state_dim)
+        self.num_rows = int(num_rows)
+        self._dtype = dtype
+        self._device = device
+        # One lock for bookkeeping AND slab-touching dispatch ordering
+        # (BlockPool's rule). RLock for symmetry with BlockPool — stats
+        # helpers may nest.
+        self.lock = threading.RLock()
+        self.generation = 0
+        self.slab = self._init_device()
+        self._ref = np.zeros((self.num_rows,), np.int32)
+        self._ref[0] = 1  # null row: permanently pinned, never allocated
+        self._free: List[int] = list(range(self.num_rows - 1, 0, -1))
+        self._import_exe = None
+        # Counters for the gated /stats `state_pool` block and the
+        # `tpu_engine_state_*` metrics family.
+        self.rows_admitted = 0
+        self.rows_released = 0
+        self.exports = 0
+        self.imports = 0
+
+    def _init_device(self):
+        slab = jnp.zeros((self.n_layers, self.num_rows, self.state_dim),
+                         self._dtype)
+        if self._device is not None:
+            slab = jax.device_put(slab, self._device)
+        return slab
+
+    # -- bookkeeping (hold self.lock) -----------------------------------------
+
+    @property
+    def rows_free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, row_id: int) -> int:
+        return int(self._ref[row_id])
+
+    def alloc_row(self) -> int:
+        """One fresh state row (refcount 1). Raises PoolExhausted (state
+        unchanged) when none is free — the scheduler defers the
+        admission exactly like a paged pool under block pressure."""
+        if not self._free:
+            raise PoolExhausted(
+                f"no free state rows ({self.num_rows - 1} total)")
+        rid = self._free.pop()
+        self._ref[rid] = 1
+        self.rows_admitted += 1
+        return rid
+
+    def release_row(self, row_id: int) -> None:
+        if row_id == 0:
+            return  # null row: permanent
+        self._ref[row_id] -= 1
+        assert self._ref[row_id] >= 0, "double free of a state row"
+        if self._ref[row_id] == 0:
+            self._free.append(row_id)
+            self.rows_released += 1
+
+    # -- chain export/import (one-pseudo-block wire format) -------------------
+
+    def export_row_chain(self, row_id: int) -> dict:
+        """Serialize one state row as a one-pseudo-block chain. The
+        device read orders after every donation that produced the row's
+        bytes (same-lock rule); the payload is verbatim f32 bytes, so
+        an import on any same-geometry pool is bit-exact (tested)."""
+        raw = np.asarray(
+            jax.device_get(self.slab[:, row_id])).tobytes()
+        self.exports += 1
+        return {
+            "version": 1,
+            "family": "state_slab",
+            "dtype": str(jnp.dtype(self._dtype)),
+            "n_layers": self.n_layers,
+            "state_dim": self.state_dim,
+            "blocks": [{"k": base64.b64encode(raw).decode("ascii")}],
+            "checksum": zlib.crc32(raw),
+            "generation": self.generation,
+        }
+
+    def chain_compatible(self, chain: dict) -> Optional[str]:
+        """None when ``chain`` can be imported into THIS pool verbatim;
+        else a human-readable refusal. Family, geometry, and dtype must
+        match exactly, and the single pseudo-block's decoded payload
+        must hold exactly one row's bytes — refused HERE, before any
+        row is allocated (BlockPool.chain_compatible's contract)."""
+        want = {"family": "state_slab",
+                "dtype": str(jnp.dtype(self._dtype)),
+                "n_layers": self.n_layers,
+                "state_dim": self.state_dim}
+        for key, val in want.items():
+            if chain.get(key) != val:
+                return (f"chain {key}={chain.get(key)!r} does not match "
+                        f"destination state pool {key}={val!r}")
+        blocks = chain.get("blocks")
+        if not isinstance(blocks, (list, tuple)) or len(blocks) != 1:
+            return "state chain must carry exactly one pseudo-block"
+        entry = blocks[0]
+        if not isinstance(entry, dict) or not isinstance(entry.get("k"),
+                                                         str):
+            return "state chain block 0 is missing its payload"
+        try:
+            n = len(base64.b64decode(entry["k"], validate=True))
+        except Exception:
+            return "state chain block 0 payload is not base64"
+        want_len = (self.n_layers * self.state_dim
+                    * jnp.zeros((), self._dtype).dtype.itemsize)
+        if n != want_len:
+            return (f"state chain block 0 holds {n} bytes, expected "
+                    f"{want_len}")
+        return None
+
+    # The checksum gate is byte-shape-agnostic: the paged pool's
+    # verifier works on the one-pseudo-block chain unchanged.
+    verify_chain = staticmethod(BlockPool.verify_chain)
+
+    def import_row_chain(self, chain: dict, row_id: int) -> None:
+        """Write a verified chain's payload into an already-allocated
+        row VERBATIM (one jitted donating write, like every other
+        slab-writing dispatch). Caller holds the lock and has run
+        chain_compatible + verify_chain."""
+        if self._import_exe is None:
+            def write_row(slab, flat, rid):
+                return slab.at[:, rid].set(flat)
+
+            self._import_exe = jax.jit(write_row, donate_argnums=(0,))
+        dt = jnp.zeros((), self._dtype).dtype
+        flat = np.frombuffer(
+            base64.b64decode(chain["blocks"][0]["k"]),
+            dtype=dt).reshape(self.n_layers, self.state_dim)
+        host = jnp.asarray(flat)
+        if self._device is not None:
+            host = jax.device_put(host, self._device)
+        self.slab = self._import_exe(self.slab, host, jnp.int32(row_id))
+        self.imports += 1
+
+    def reset(self) -> None:
+        """Post-device-failure recovery (BlockPool.reset's contract):
+        the donated slab may be invalid — rebuild it, void every row id
+        issued against the old generation."""
+        self.generation += 1
+        self.slab = self._init_device()
+        self._ref[:] = 0
+        self._ref[0] = 1
+        self._free = list(range(self.num_rows - 1, 0, -1))
+
+    def bytes_per_row(self) -> int:
+        """HBM bytes ONE stream's whole autoregressive state costs —
+        constant in sequence length (the family's capacity story; the
+        recurrent-ab bench sizes equal-HBM arms with this and
+        dense_block_bytes, never a re-derivation)."""
+        return int(self.n_layers * self.state_dim
+                   * jnp.zeros((), self._dtype).dtype.itemsize)
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "rows_total": self.num_rows - 1,  # null row excluded
+                "rows_free": len(self._free),
+                "state_dim": self.state_dim,
+                "n_layers": self.n_layers,
+                "bytes_per_row": self.bytes_per_row(),
+                "rows_admitted": self.rows_admitted,
+                "rows_released": self.rows_released,
+                "exports": self.exports,
+                "imports": self.imports,
+                # Loud, structural, and deliberate — not a missing
+                # feature: a recurrent prefix is a dense nonlinear
+                # state, never a block-addressable chain, so there is
+                # no radix tree, no COW, no prefix skip for this
+                # family (DESIGN.md "Recurrent state serving").
+                "prefix_sharing":
+                    "unsupported: recurrent state is not "
+                    "block-addressable",
+            }
 
 
 # -- device-side block movement (jitted by the scheduler per bucket) ----------

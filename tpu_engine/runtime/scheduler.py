@@ -54,6 +54,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_engine.models.registry import ModelSpec, create_model, _ensure_builtin_models_imported
+from tpu_engine.models.ssd import (
+    SSDConfig,
+    SSDState,
+    flatten_states,
+    ssd_init_states,
+    ssd_state_dim,
+    ssd_step_rows_masked,
+    ssd_window_scan,
+    unflatten_states,
+)
 from tpu_engine.models.transformer import (
     TransformerConfig,
     init_caches,
@@ -74,6 +84,7 @@ from tpu_engine.runtime.generator import (
 from tpu_engine.runtime.kv_blocks import (
     BlockPool,
     PoolExhausted,
+    StateSlabPool,
     gather_blocks,
     gather_blocks_quant,
     scatter_blocks,
@@ -249,6 +260,7 @@ class ContinuousGenerator:
         spec_draft: str = "ngram",
         spec_draft_model=None,
         spec_draft_params=None,
+        state_rows: int = 0,
     ):
         """`kv_block_size` > 0 switches the KV cache from one dense
         (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
@@ -325,10 +337,39 @@ class ContinuousGenerator:
         if isinstance(model, str):
             _ensure_builtin_models_imported()
             model = create_model(model)
-        if not isinstance(model.config, TransformerConfig) or not model.config.causal:
-            raise ValueError(f"model '{model.name}' is not a decoder transformer")
+        # Family dispatch (registry framing — VirtualFlow in PAPERS.md):
+        # the model's DECLARED state family selects which autoregressive
+        # state machinery this scheduler builds — never an isinstance
+        # probe (the registry's contract: consumers fence on the
+        # declaration). "kv_paged" = the transformer families' growing
+        # KV chain (dense or block pool); "state_slab" = the SSD/Mamba
+        # families' fixed-size recurrent state rows (StateSlabPool).
+        # Everything above the state layer — admission, deadlines,
+        # streams, brownout, crash recovery, migration — is
+        # family-independent and shared. Bare stand-in specs without a
+        # declaration (test fakes) derive it from their config, the
+        # same rule ModelSpec.__post_init__ applies.
+        fam = getattr(model, "state_family", None)
+        if not fam:
+            fam = ("state_slab" if isinstance(model.config, SSDConfig)
+                   else "kv_paged")
+        self._slab = fam == "state_slab"
+        if self._slab:
+            if not isinstance(model.config, SSDConfig):
+                # The slab machinery's step functions are the SSD
+                # mixer's; a new recurrent architecture joins by
+                # carrying (or subclassing) an SSDConfig, not by
+                # declaration alone.
+                raise ValueError(
+                    f"model '{model.name}' declares state family "
+                    f"'state_slab' but its config is not an SSDConfig "
+                    f"(the slab step functions are models.ssd's)")
+        elif (not isinstance(model.config, TransformerConfig)
+              or not model.config.causal):
+            raise ValueError(f"model '{model.name}' is not a decoder "
+                             f"transformer")
         self.spec = model
-        self.cfg: TransformerConfig = model.config
+        self.cfg = model.config
         self._dtype = _DTYPES[dtype]
         self.max_seq = min(max_seq or self.cfg.max_seq, self.cfg.max_seq)
         self.n_slots = int(n_slots)
@@ -352,6 +393,35 @@ class ContinuousGenerator:
         # per-row block tables (runtime.kv_blocks); everything else —
         # row vectors, sampling, admission — is layout-independent.
         self._paged = int(kv_block_size) > 0
+        if self._slab:
+            # Family fences, loud and specific (the registry declares
+            # capabilities; a silently ignored knob would be worse than
+            # a refusal — MIGRATION.md's misconfiguration contract).
+            if self._paged or int(kv_blocks) > 0:
+                raise ValueError(
+                    "the state_slab family has no paged KV cache: "
+                    "kv_block_size/kv_blocks apply to kv_paged models "
+                    "(state capacity is state_rows)")
+            if int(kv_host_blocks) > 0:
+                raise ValueError(
+                    "kv_host_blocks applies to the kv_paged family's "
+                    "block pool; the state_slab family has no "
+                    "demotable KV blocks")
+            if kv_quantize:
+                raise ValueError(
+                    "kv_quantize applies to the kv_paged family's "
+                    "block pool; the state_slab family's slab stays "
+                    "full precision")
+            if int(spec_k) > 0:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) requires the "
+                    "kv_paged family: the state_slab recurrence has no "
+                    "KV verify window")
+        elif int(state_rows) > 0:
+            raise ValueError(
+                "state_rows applies to the state_slab family; model "
+                f"'{model.name}' serves the "
+                f"{getattr(model, 'state_family', 'kv_paged')} family")
         if int(kv_host_blocks) > 0 and not self._paged:
             raise ValueError("kv_host_blocks requires the paged KV cache "
                              "(set kv_block_size > 0)")
@@ -361,6 +431,25 @@ class ContinuousGenerator:
                              "(set kv_block_size > 0)")
         self._caches = None
         self._pool: Optional[BlockPool] = None
+        self._spool: Optional[StateSlabPool] = None
+        if self._slab:
+            # Fixed-size recurrent state rows: the whole per-stream
+            # autoregressive state is one (n_layers, state_dim) f32 row
+            # — constant in sequence length, so "KV capacity" becomes
+            # "state capacity" (rows) for this family. No radix tree:
+            # recurrent prefixes are not block-addressable (the pool's
+            # stats say so loudly).
+            rows = int(state_rows) or self.n_slots + 1
+            self._spool = StateSlabPool(self.cfg.n_layers,
+                                        ssd_state_dim(self.cfg), rows,
+                                        device=device)
+            # Slab row id each scheduler slot owns (-1 = none).
+            # Decode-thread-owned like the paged row tables.
+            self._slab_rows: List[int] = [-1] * self.n_slots
+            self._prefix_sharing = False
+            # Admissions deferred on row exhaustion, retried as rows
+            # free — the same parking the paged pool uses for blocks.
+            self._pending: "collections.deque" = collections.deque()
         if self._paged:
             bs = int(kv_block_size)
             if self.cfg.sliding_window is not None:
@@ -392,7 +481,7 @@ class ContinuousGenerator:
             self._pending: "collections.deque" = collections.deque()
             self._gather_exe = {}   # {n_blocks: compiled prefix gather}
             self._scatter_exe = {}  # {n_blocks: compiled block scatter}
-        else:
+        elif not self._slab:
             self._caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                                        self._dtype)
             if device is not None:
@@ -468,7 +557,7 @@ class ContinuousGenerator:
         self._window_exe = None
         # Mixed stepping (paged only): ONE ragged dispatch per tick.
         self._mixed = bool(mixed_step)
-        if self._mixed and not self._paged:
+        if self._mixed and not (self._paged or self._slab):
             raise ValueError("mixed_step requires the paged KV cache "
                              "(set kv_block_size > 0)")
         # Continuous speculative decoding (paged layouts only): drafts
@@ -1165,6 +1254,200 @@ class ContinuousGenerator:
                                                 donate_argnums=donate)
             return self._decode_exe[key]
 
+    # -- state-slab compiled stages (the state_slab family's step fns) ---------
+    #
+    # The SSD family's autoregressive step is models.ssd.ssd_step_rows —
+    # an O(1) recurrence per row instead of a KV-cache read. Every stage
+    # below threads (and donates) the slab pool exactly like the paged
+    # stages thread the block pool, and the decode/mixed bodies reuse
+    # the SAME sampling/penalty/stop logic (fold_in(seed, position)), so
+    # streams are family-portable in every property the scheduler
+    # promises: seeded determinism, deadline cancel, crash replay,
+    # migration splice, brownout.
+
+    def _slab_prefill_window(self, width: int):
+        """One prompt window on the PREFILL thread (batch 1): consume up
+        to `width` tokens from the request's carried state via the
+        masked recurrence scan. Partition-invariant: any window split
+        yields the same per-token steps, which is what makes two-path,
+        mixed, and replay-resume prompt states agree."""
+        key = ("slab_window", width)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                cfg = self.cfg
+
+                def window(params, tokens, conv, ssm, n_valid):
+                    logits, states = ssd_window_scan(
+                        params, tokens, SSDState(conv, ssm),
+                        n_valid, n_valid - 1, cfg)
+                    return logits[0], states.conv, states.ssm
+
+                self._decode_exe[key] = jax.jit(window,
+                                                donate_argnums=(2, 3))
+            return self._decode_exe[key]
+
+    def _slab_write(self):
+        """Admission write: one row's prompt state (computed on the
+        prefill thread) lands in its allocated slab row. Donates the
+        slab — decode-thread only, under the pool lock."""
+        key = ("slab_write",)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                def write(slab, conv, ssm, rid):
+                    flat = flatten_states(SSDState(conv, ssm))[:, 0]
+                    return slab.at[:, rid].set(flat)
+
+                self._decode_exe[key] = jax.jit(write, donate_argnums=(0,))
+            return self._decode_exe[key]
+
+    def _slab_zero(self):
+        """Zero a freshly-allocated slab row (mixed-mode admission: the
+        prompt's state accumulates IN the slab across ticks, so the row
+        must not inherit a previous occupant's bytes)."""
+        key = ("slab_zero",)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                def zero(slab, rid):
+                    return slab.at[:, rid].set(0.0)
+
+                self._decode_exe[key] = jax.jit(zero, donate_argnums=(0,))
+            return self._decode_exe[key]
+
+    def _slab_decode(self, controls: bool):
+        """Compiled decode chunk over the slab pool — `_decode_paged`
+        with (pool, block tables) swapped for (slab, row ids) and the
+        attention read swapped for the O(1) recurrence. Rows are
+        0-aligned like paged rows (pos IS the logical position), so the
+        sampling folds match the other families token for token. Done
+        (and parked-handoff) rows ride the batch with their state
+        FROZEN — the slab family's equivalent of the paged path's
+        frozen-column writes."""
+        key = ("slab", controls)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                cfg, chunk = self.cfg, self._step_chunk
+                max_col = self.max_seq - 1
+
+                def decode_chunk(params, slab, row_ids, tok, pos, done,
+                                 seeds, temps, topps, topks, minps,
+                                 eos_vec, counts=None, pens=None,
+                                 stops=None):
+                    rows = jnp.arange(tok.shape[0])
+                    states = unflatten_states(slab[:, row_ids], cfg)
+
+                    def body(carry, _):
+                        if controls:
+                            states, tok, pos, done, counts = carry
+                        else:
+                            states, tok, pos, done = carry
+                            counts = None
+                        # The ONE shared masked-step primitive: done
+                        # rows ride the batch with state frozen.
+                        logits, states = ssd_step_rows_masked(
+                            params, tok, states, ~done, cfg)
+                        if controls:
+                            logits = apply_repetition_penalty(
+                                logits, counts, pens)
+                        nxt = _sample(logits, seeds, pos + 1, temps,
+                                      topps, topks, minps)
+                        nxt = jnp.where(done, eos_vec, nxt)
+                        if controls:
+                            counts = counts.at[rows, nxt].add(
+                                (~done).astype(jnp.int32))
+                        done = done | (nxt == eos_vec)
+                        if controls:
+                            done = done | jnp.any(nxt[:, None] == stops,
+                                                  axis=1)
+                        pos = jnp.where(done, pos,
+                                        jnp.minimum(pos + 1, max_col))
+                        if controls:
+                            return (states, nxt, pos, done, counts), nxt
+                        return (states, nxt, pos, done), nxt
+
+                    state = (states, tok, pos, done)
+                    if controls:
+                        state += (counts,)
+                    state, toks = jax.lax.scan(body, state, None,
+                                               length=chunk)
+                    states = state[0]
+                    slab = slab.at[:, row_ids].set(flatten_states(states))
+                    return (slab,) + state[1:] + (toks.T,)
+
+                self._decode_exe[key] = jax.jit(
+                    decode_chunk,
+                    donate_argnums=(1, 12) if controls else (1,))
+            return self._decode_exe[key]
+
+    def _slab_mixed_exe(self, width: int, controls: bool):
+        """Compiled mixed step for the state_slab family: ONE dispatch
+        per tick serving decode rows (1 recurrence step) and admitting
+        rows' budgeted prefill chunks (up to `width` masked steps from
+        the state carried in their slab row) — the family's
+        `_mixed_step_exe`. `step_ok` marks rows whose STATE may advance
+        this tick (prefilling rows and live decode rows; done and
+        parked-handoff rows are frozen); `active`/`sample_slot`/
+        `fold_pos` follow the paged mixed contract exactly, so the
+        budget rule, brownout scaling, and stream identity carry over
+        unchanged. Exactly two widths compile per controls variant
+        (1 and the chunk cap)."""
+        key = ("slab_mixed", width, controls)
+        exe = self._decode_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            if key not in self._decode_exe:
+                cfg = self.cfg
+
+                def mixed_step(params, slab, row_ids, tokens, qlen,
+                               sample_slot, fold_pos, step_ok, active,
+                               done, seeds, temps, topps, topks, minps,
+                               eos_vec, counts=None, pens=None,
+                               stops=None):
+                    rows = jnp.arange(tokens.shape[0])
+                    states = unflatten_states(slab[:, row_ids], cfg)
+                    # The ONE shared window primitive (the same scan the
+                    # two-path prefill windows run): a frozen row is
+                    # simply a row with zero valid steps.
+                    kept, states = ssd_window_scan(
+                        params, tokens, states,
+                        jnp.where(step_ok, qlen, 0), sample_slot, cfg)
+                    if controls:
+                        kept = apply_repetition_penalty(kept, counts,
+                                                        pens)
+                    nxt = _sample(kept, seeds, fold_pos, temps, topps,
+                                  topks, minps)
+                    live = active & ~done
+                    nxt = jnp.where(live, nxt, eos_vec)
+                    if controls:
+                        counts = counts.at[rows, nxt].add(
+                            live.astype(jnp.int32))
+                    done = done | (live & (nxt == eos_vec))
+                    if controls:
+                        done = done | (live & jnp.any(
+                            nxt[:, None] == stops, axis=1))
+                    slab = slab.at[:, row_ids].set(flatten_states(states))
+                    out = (slab, nxt, done)
+                    if controls:
+                        out += (counts,)
+                    return out
+
+                self._decode_exe[key] = jax.jit(
+                    mixed_step,
+                    donate_argnums=(1, 16) if controls else (1,))
+            return self._decode_exe[key]
+
     @staticmethod
     def _spec_eligible(req: _Request) -> bool:
         """Rows the drafter may propose for. Deterministic (greedy) rows
@@ -1229,7 +1512,8 @@ class ContinuousGenerator:
                        stream=stream, deadline=deadline, sink=sink,
                        t_submit=time.perf_counter(),
                        tag=str(tag) if tag is not None else None,
-                       handoff=bool(handoff) and self._paged,
+                       handoff=bool(handoff) and (self._paged
+                                                  or self._slab),
                        # Clamped: a parked row pins a slot + KV chain,
                        # so the window must stay bounded no matter what
                        # the caller passed.
@@ -1266,7 +1550,7 @@ class ContinuousGenerator:
         refuses at the bound. ``cancel``: release a handoff HOLD
         instead of exporting (the orchestrator found no destination) —
         the row resumes normal decoding immediately."""
-        if not self._paged:
+        if not (self._paged or self._slab):
             return {"ok": False,
                     "reason": "migration requires the paged KV cache"}
         if not self._running:
@@ -1300,7 +1584,7 @@ class ContinuousGenerator:
         ``ImportRefused`` (retryable → the gateway's replay fallback)."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
-        if not self._paged:
+        if not (self._paged or self._slab):
             raise ValueError("migration import requires the paged KV "
                              "cache (kv_block_size > 0)")
         if not isinstance(snapshot, dict):
@@ -1483,17 +1767,33 @@ class ContinuousGenerator:
         if self._done[row]:
             self._bump_migration("export_refused")
             return {"ok": False, "reason": "row already finishing"}
-        pool = self._pool
-        bs = pool.block_size
         pos = int(self._pos[row])
-        n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
-        with pool.lock:
-            chain = pool.export_chain(self._row_blocks[row][:n_chain])
-        # The bucket-truncated prompt is what the row's 0-aligned
-        # columns actually hold (same formula as admission).
-        pb = next((b for b in self._prompt_buckets
-                   if b >= len(req.prompt)), self._prompt_buckets[-1])
-        prompt = req.prompt[-pb:]
+        if self._slab:
+            # The whole autoregressive state is ONE slab row — it ships
+            # as a one-pseudo-block chain over the same wire format, so
+            # the gateway's drain/migration/handoff orchestration needs
+            # no family awareness at all.
+            t0 = time.perf_counter()
+            with self._spool.lock:
+                chain = self._spool.export_row_chain(
+                    self._slab_rows[row])
+            if req.sink is not None:
+                dur_us = (time.perf_counter() - t0) * 1e6
+                req.sink.stage("state_export", dur_us,
+                               start_ts=time.time() - dur_us / 1e6,
+                               state_bytes=self._spool.bytes_per_row())
+            prompt = list(req.prompt)
+        else:
+            pool = self._pool
+            bs = pool.block_size
+            n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
+            with pool.lock:
+                chain = pool.export_chain(self._row_blocks[row][:n_chain])
+            # The bucket-truncated prompt is what the row's 0-aligned
+            # columns actually hold (same formula as admission).
+            pb = next((b for b in self._prompt_buckets
+                       if b >= len(req.prompt)), self._prompt_buckets[-1])
+            prompt = req.prompt[-pb:]
         emitted = list(self._row_emitted[row])
         # Flush everything visible BEFORE the terminal, so the relayed
         # stream and the snapshot agree on the resume offset.
@@ -1614,6 +1914,13 @@ class ContinuousGenerator:
         if self._paged:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = \
+                len(self._pending)  # lint: lockfree-ok GIL-safe deque len
+        if self._slab:
+            # Gated additive block (the state_slab family's kv_pool
+            # analog): a kv_paged lane's /stats and /health bytes never
+            # carry this key.
+            out["state_pool"] = self._spool.stats()
+            out["state_pool"]["pending_admissions"] = \
                 len(self._pending)  # lint: lockfree-ok GIL-safe deque len
         if "migration" in self._stats:
             # Snapshot, not the live nested dict (same rule as "mixed").
@@ -2014,7 +2321,98 @@ class ContinuousGenerator:
         return (req, None, None, n_chain * bs, len(prompt), row_counts,
                 matched, prompt, gen)
 
+    def _run_prefill_slab(self, req: _Request):
+        """state_slab admission prefill (prefill thread): consume the
+        prompt through the O(1) recurrence in fixed-width masked
+        windows, carrying the state between window dispatches — the
+        budgeted prefill chunks of the two-path discipline, with decode
+        chunks interleaving between windows exactly like the
+        transformer families. Touches NO shared state (a fresh stream's
+        state starts from zeros — nothing to read from the slab pool),
+        so there is no radix lookup, no gather, no pool lock on this
+        thread: recurrent prefixes are not block-addressable."""
+        spool = self._spool
+        prompt = list(req.prompt)
+        L = len(prompt)
+        Leff = max(L, 1)  # empty prompts consume one pad-token step
+        with spool.lock:
+            gen = spool.generation
+        W = self._prefill_chunk if self._prefill_chunk > 0 else 64
+        W = max(1, min(W, self.max_seq))
+        win_exe = self._slab_prefill_window(W)
+        states = ssd_init_states(self.cfg, 1)
+        conv, ssm = states.conv, states.ssm
+        tokens = np.zeros((1, W), np.int32)
+        logits = None
+        for w0 in range(0, Leff, W):
+            n_valid = min(W, Leff - w0)
+            tokens[:] = 0
+            if L:
+                tokens[0, :n_valid] = prompt[w0:w0 + n_valid]
+            logits, conv, ssm = win_exe(
+                self.params, jnp.asarray(tokens), conv, ssm,
+                jnp.asarray([n_valid], jnp.int32))
+            self._count_admission_dispatch()
+        first_tok, row_counts = self._first_token(req, logits, prompt, L)
+        return (req, SSDState(conv, ssm), first_tok, L, L, row_counts,
+                [], prompt, gen)
+
+    def _run_prefill_mixed_slab(self, req: _Request):
+        """Mixed-mode batch formation for the state_slab family: NO
+        device work and no lookups at all (no radix to walk) — the
+        prompt's recurrence runs inside the decode thread's ticks,
+        accumulating state directly in the row's slab. Returns the
+        shared 9-tuple item shape."""
+        spool = self._spool
+        prompt = list(req.prompt)
+        L = len(prompt)
+        with spool.lock:
+            gen = spool.generation
+        row_counts = None
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            row_counts = token_counts([prompt], 1, self.cfg.vocab)
+        return (req, None, None, L, L, row_counts, [], prompt, gen)
+
+    def _run_prefill_import_slab(self, req: _Request):
+        """Import-side validation for a migrated state_slab stream
+        (prefill thread): the checksum and geometry gates run here —
+        off the decode thread, before any row is allocated — on the
+        one-pseudo-block state chain. No prefill dispatch ever runs:
+        the whole autoregressive state arrives in the chain."""
+        spool = self._spool
+        snap = req.migrate
+        chain = snap.get("chain")
+        reason = None
+        if not isinstance(chain, dict) or "blocks" not in chain:
+            reason = "snapshot carries no state chain"
+        if reason is None:
+            reason = spool.chain_compatible(chain)
+        if reason is None and not spool.verify_chain(chain):
+            reason = "chain checksum mismatch"
+        pos = int(snap["pos"])
+        if reason is None and pos > self.max_seq - 1:
+            reason = (f"row position {pos} exceeds this lane's max_seq "
+                      f"{self.max_seq}")
+        if reason is not None:
+            self._bump_migration("import_rejected")
+            raise ImportRefused(f"migration import rejected: {reason}")
+        prompt = [int(t) for t in snap["prompt"]]
+        row_counts = None
+        if req.rep_penalty != 1.0 or req.stop_tokens:
+            ctx = prompt + [int(t) for t in snap["emitted"]]
+            row_counts = token_counts([ctx], 1, self.cfg.vocab)
+        with spool.lock:
+            gen = spool.generation
+        return (req, None, None, len(prompt), len(prompt), row_counts,
+                [], prompt, gen)
+
     def _run_prefill(self, req: _Request):
+        if self._slab:
+            if req.migrate is not None:
+                return self._run_prefill_import_slab(req)
+            if self._mixed:
+                return self._run_prefill_mixed_slab(req)
+            return self._run_prefill_slab(req)
         if self._paged:
             if req.migrate is not None:
                 return self._run_prefill_import(req)
@@ -2321,9 +2719,145 @@ class ContinuousGenerator:
         self._push_stream(row, req)
         self._maybe_complete(row)
 
+    def _admit_slab(self, item, row: int) -> None:
+        """Decode-thread half of state_slab admission: allocate ONE slab
+        row (the stream's whole autoregressive state budget, now and
+        forever) and write the prefill thread's computed state into it.
+        Raises PoolExhausted (nothing consumed) when no row is free —
+        the caller defers the admission exactly like paged block
+        pressure."""
+        (req, states, first_tok, _pb, L, row_counts, _m, prompt,
+         gen) = item
+        spool = self._spool
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        first_col = min(L, self.max_seq - 1)
+        with spool.lock:
+            if gen != spool.generation:
+                raise _StaleAdmission(
+                    "state slab pool was rebuilt during this request's "
+                    "admission")
+            rid = spool.alloc_row()  # PoolExhausted -> defer
+            spool.slab = self._slab_write()(
+                spool.slab, states.conv, states.ssm, jnp.int32(rid))
+        self._slab_rows[row] = rid
+        self._count_admission_dispatch()
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("state_alloc", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           state_row=rid)
+        if row_counts is not None:
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._init_row(req, row, first_tok, pos=first_col, start=0)
+        self._maybe_hold(row, req)
+
+    def _admit_slab_mixed(self, item, row: int) -> None:
+        """Mixed-mode state_slab admission (decode thread): allocate the
+        slab row, ZERO it (the prompt's recurrence accumulates in the
+        slab across ticks, so a previous occupant's bytes must never
+        leak into a fresh state), and mark the row PREFILLING — the
+        prompt consumes inside subsequent ragged ticks under the shared
+        token-budget rule."""
+        (req, _st, _ft, _pb, L, row_counts, _m, prompt, gen) = item
+        spool = self._spool
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        with spool.lock:
+            if gen != spool.generation:
+                raise _StaleAdmission(
+                    "state slab pool was rebuilt during this request's "
+                    "admission")
+            rid = spool.alloc_row()  # PoolExhausted -> defer
+            spool.slab = self._slab_zero()(spool.slab, jnp.int32(rid))
+        self._slab_rows[row] = rid
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("state_alloc", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           state_row=rid)
+        if row_counts is not None:
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._set_row_params(req, row, pos=min(L, self.max_seq - 1),
+                             start=0)
+        self._prefilling[row] = True
+        self._row_prompt[row] = right_pad_prompt(prompt, max(L, 1))[0]
+        self._row_prompt_toks[row] = prompt
+        self._row_L[row] = L
+        self._row_w0[row] = 0  # no radix resume: the prompt runs whole
+        self._row_emitted[row] = []
+        self._done[row] = False
+        self._stats["admitted"] += 1
+
+    def _admit_import_slab(self, item, row: int) -> None:
+        """Decode-thread half of a state_slab migration import: one
+        fresh row, the chain's state bytes written VERBATIM (bit-exact
+        — the recurrence resumes exactly where the source lane stopped,
+        zero re-prefilled tokens), host stream state restored. Raises
+        PoolExhausted (nothing consumed) when no row is free — imports
+        are never parked; the caller fails RETRYABLE into the replay
+        fallback."""
+        (req, _st, _ft, _pb, L, row_counts, _m, prompt, gen) = item
+        spool = self._spool
+        snap = req.migrate
+        emitted = [int(t) for t in snap["emitted"]]
+        pos = min(int(snap["pos"]), self.max_seq - 1)
+        t0 = time.perf_counter()
+        req.t_admit = t0
+        with spool.lock:
+            if gen != spool.generation:
+                raise _StaleAdmission(
+                    "state slab pool was rebuilt during this import")
+            rid = spool.alloc_row()  # PoolExhausted -> ImportRefused
+            spool.import_row_chain(snap["chain"], rid)
+        self._slab_rows[row] = rid
+        self._count_admission_dispatch()
+        if req.sink is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            req.sink.stage("state_import", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           state_row=rid,
+                           state_bytes=spool.bytes_per_row())
+        if row_counts is not None:
+            self._counts = self._ensure_counts().at[row].set(
+                jnp.asarray(row_counts[0]))
+        self._set_row_params(req, row, pos=pos, start=0)
+        self._tok[row] = int(snap["tok"])
+        self._done[row] = False
+        self._row_emitted[row] = emitted
+        if self._mixed:
+            self._prefilling[row] = False
+            self._row_prompt[row] = None
+            self._row_L[row] = L
+            self._row_w0[row] = 0
+        if self._mixed or self._spec:
+            self._row_prompt_toks[row] = prompt
+        # No TTFT sample (the first token happened on the source lane);
+        # ITL resumes from now — the migration gap shows up client-side.
+        self._row_last_emit[row] = time.perf_counter()
+        self._stats["admitted"] += 1
+        with self._stats_lock:
+            mig = self._migration_stats()
+            mig["imported_rows"] += 1
+            mig["imported_tokens"] += len(emitted)
+        self._push_stream(row, req)
+        self._maybe_complete(row)
+
     def _release_row_blocks(self, row: int) -> None:
         """Return a freed row's block references to the pool (blocks the
-        radix tree also references survive at refcount >= 1)."""
+        radix tree also references survive at refcount >= 1). The
+        state_slab family frees its one slab row the same way — every
+        row-free path (completion, cancel, export, shutdown) funnels
+        here, so the zero-leak invariant is family-wide."""
+        if self._slab:
+            rid = self._slab_rows[row]
+            if rid >= 0:
+                with self._spool.lock:
+                    self._spool.release_row(rid)
+                self._slab_rows[row] = -1
+            return
         if not self._paged or not self._row_blocks[row]:
             return
         with self._pool.lock:
@@ -2377,7 +2911,18 @@ class ContinuousGenerator:
 
     def _admit(self, item, row: int) -> None:
         """Decode-thread half of admission: splice the prefilled KV block
-        into the shared cache and initialise the row's host-side state."""
+        into the shared cache and initialise the row's host-side state.
+        Family-dispatched: state_slab rows write their computed state
+        into one slab row instead of scattering KV into pool blocks."""
+        if self._slab:
+            if item[0].migrate is not None:
+                self._admit_import_slab(item, row)
+                return
+            if self._mixed:
+                self._admit_slab_mixed(item, row)
+            else:
+                self._admit_slab(item, row)
+            return
         if self._paged:
             if item[0].migrate is not None:
                 self._admit_import(item, row)
@@ -2537,6 +3082,27 @@ class ContinuousGenerator:
                     + len(violations))
                 print(f"[scheduler] POST-RECOVER INVARIANT VIOLATED: "
                       f"{'; '.join(violations)}", flush=True)
+        elif self._slab:
+            # The donated slab may be invalid: rebuild the pool; row
+            # ids issued against the old generation are void.
+            with self._spool.lock:
+                self._spool.reset()
+                spool = self._spool
+                violations = []
+                if len(spool._free) != spool.num_rows - 1:
+                    violations.append(
+                        f"free list {len(spool._free)} != "
+                        f"{spool.num_rows - 1}")
+                if int(np.sum(spool._ref[1:])) != 0:
+                    violations.append("nonzero refcounts after reset")
+            for r in range(self.n_slots):
+                self._slab_rows[r] = -1
+            if violations:
+                self._stats["recover_invariant_violations"] = (
+                    self._stats.get("recover_invariant_violations", 0)
+                    + len(violations))
+                print(f"[scheduler] POST-RECOVER INVARIANT VIOLATED: "
+                      f"{'; '.join(violations)}", flush=True)
         else:
             caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                                  self._dtype)
@@ -2564,7 +3130,7 @@ class ContinuousGenerator:
                     self._row_emitted[r] = []
                 self._release_row_blocks(r)
                 self._clear_mixed_row(r)
-            if self._paged:
+            if self._paged or self._slab:
                 while self._pending:
                     item = self._pending.popleft()
                     self._discard_item(item)
@@ -3090,17 +3656,244 @@ class ContinuousGenerator:
                            "decode_rows": int(n_decode),
                            "width": int(width)})
 
+    def _tick_slab(self) -> None:
+        """One two-path decode chunk for the state_slab family — the
+        paged chunk with (pool, block tables) swapped for (slab, row
+        ids) and the attention read swapped for the O(1) recurrence.
+        Held (parked handoff) rows ride the fixed batch masked done
+        with their STATE frozen in-dispatch (the family's analog of the
+        paged path's frozen-column writes) and host state restored
+        after. Exceptions propagate to the loop's _recover."""
+        spool = self._spool
+        eos_vec = np.full((self.n_slots,), -1, np.int32)
+        controls = False
+        live = []
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            live.append(r)
+            if req.eos_id >= 0:
+                eos_vec[r] = req.eos_id
+            if req.rep_penalty != 1.0 or req.stop_tokens:
+                controls = True
+        held_rows = [r for r in live if self._held[r]]
+        done_in = self._done
+        saved = []
+        if held_rows:
+            done_in = self._done.copy()
+            done_in[held_rows] = True
+            saved = [(r, int(self._tok[r]), int(self._pos[r]))
+                     for r in held_rows]
+        row_ids = np.asarray([rid if rid >= 0 else 0
+                              for rid in self._slab_rows], np.int32)
+        # Slab-donating dispatch under the pool lock (exports and
+        # admission writes order against it).
+        with spool.lock:
+            common = (self.params, spool.slab, jnp.asarray(row_ids),
+                      jnp.asarray(self._tok), jnp.asarray(self._pos),
+                      jnp.asarray(done_in), jnp.asarray(self._seeds),
+                      jnp.asarray(self._temps), jnp.asarray(self._topps),
+                      jnp.asarray(self._topks), jnp.asarray(self._minps),
+                      jnp.asarray(eos_vec))
+            if controls:
+                out = self._slab_decode(True)(
+                    *common, self._ensure_counts(),
+                    jnp.asarray(self._pens), jnp.asarray(self._stops))
+            else:
+                out = self._slab_decode(False)(*common)
+            spool.slab = out[0]
+            out = out[1:]
+            if controls:
+                tok, pos, done, self._counts, toks = out
+            else:
+                tok, pos, done, toks = out
+        start_host_copies(tok, pos, done, toks)
+        self._tok = np.array(tok)
+        self._pos = np.array(pos)
+        self._done = np.array(done)
+        toks_host = np.asarray(toks)
+        for r, tok_r, pos_r in saved:
+            # Parked rows rode the dispatch masked done: restore their
+            # true pending state (they are NOT done; their slab row was
+            # never written — the state freeze is in-dispatch).
+            self._tok[r] = tok_r
+            self._pos[r] = pos_r
+            self._done[r] = False
+        self._stats["chunks"] += 1
+
+        for r, req in enumerate(self._row_req):
+            if req is None or self._held[r]:
+                continue
+            need = req.max_new - len(self._row_emitted[r])
+            if need > 0:
+                self._row_emitted[r].extend(
+                    int(t) for t in toks_host[r, :need])
+                now = time.perf_counter()
+                if self._row_last_emit[r] > 0:
+                    self.itl_hist.observe(
+                        max(0.0, now - self._row_last_emit[r]))
+                self._row_last_emit[r] = now
+            self._push_stream(r, req)
+            self._maybe_complete(r)
+
+    def _tick_slab_mixed(self) -> None:
+        """One mixed tick for the state_slab family: the SAME batch
+        formation, token-budget rule, and post-processing as
+        `_tick_mixed`, dispatched through the family's step function
+        (`_slab_mixed_exe`) — admitting rows consume budgeted prompt
+        chunks through the recurrence, decode rows advance one step,
+        all in ONE dispatch. Brownout budget scaling, handoff holds,
+        and stream identity carry over unchanged (tested)."""
+        spool = self._spool
+        B = self.n_slots
+        t0 = time.perf_counter()
+        eos_vec = np.full((B,), -1, np.int32)
+        controls = False
+        n_decode = 0
+        prefill_rows: List[int] = []
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            if req.eos_id >= 0:
+                eos_vec[r] = req.eos_id
+            if req.rep_penalty != 1.0 or req.stop_tokens:
+                controls = True
+            if self._held[r]:
+                continue  # parked handoff rows: no budget, no decode slot
+            if self._prefilling[r]:
+                prefill_rows.append(r)
+            else:
+                n_decode += 1
+        budget_left = max(1, self._effective_mixed_budget() - n_decode)
+        chunk = np.zeros((B,), np.int32)
+        for r in prefill_rows:
+            remaining = max(self._row_L[r], 1) - self._row_w0[r]
+            c = min(remaining, self._chunk_cap, budget_left)
+            chunk[r] = max(0, c)
+            budget_left -= chunk[r]
+        width = self._chunk_cap if prefill_rows and chunk.max() > 0 else 1
+
+        tokens = np.zeros((B, width), np.int32)
+        qlen = np.zeros((B,), np.int32)
+        sample_slot = np.zeros((B,), np.int32)
+        fold_pos = np.zeros((B,), np.int32)
+        step_ok = np.zeros((B,), bool)
+        active = np.zeros((B,), bool)
+        completing = [False] * B
+        prefill_tokens = 0
+        for r, req in enumerate(self._row_req):
+            if req is None:
+                continue  # free rows: qlen 0, frozen, null-row writes
+            if self._prefilling[r]:
+                w0 = self._row_w0[r]
+                c = int(chunk[r])
+                Leff = max(self._row_L[r], 1)
+                qlen[r] = c
+                prefill_tokens += c
+                step_ok[r] = c > 0
+                if c > 0:
+                    tokens[r, :c] = self._row_prompt[r][w0:w0 + c]
+                    if w0 <= Leff - 1 < w0 + c:
+                        completing[r] = True
+                        active[r] = True
+                        sample_slot[r] = Leff - 1 - w0
+                        fold_pos[r] = self._row_L[r]
+            else:
+                qlen[r] = 1
+                tokens[r, 0] = self._tok[r]
+                fold_pos[r] = int(self._pos[r]) + 1
+                # Parked handoff rows ride frozen (like done rows):
+                # state untouched, sampled token discarded.
+                active[r] = not self._done[r] and not self._held[r]
+                step_ok[r] = active[r]
+        row_ids = np.asarray([rid if rid >= 0 else 0
+                              for rid in self._slab_rows], np.int32)
+
+        # ONE dispatch, under the pool lock (it donates the slab).
+        with spool.lock:
+            common = (self.params, spool.slab, jnp.asarray(row_ids),
+                      jnp.asarray(tokens), jnp.asarray(qlen),
+                      jnp.asarray(sample_slot), jnp.asarray(fold_pos),
+                      jnp.asarray(step_ok), jnp.asarray(active),
+                      jnp.asarray(self._done), jnp.asarray(self._seeds),
+                      jnp.asarray(self._temps), jnp.asarray(self._topps),
+                      jnp.asarray(self._topks), jnp.asarray(self._minps),
+                      jnp.asarray(eos_vec))
+            if controls:
+                out = self._slab_mixed_exe(width, True)(
+                    *common, self._ensure_counts(),
+                    jnp.asarray(self._pens), jnp.asarray(self._stops))
+            else:
+                out = self._slab_mixed_exe(width, False)(*common)
+            spool.slab = out[0]
+            out = out[1:]
+            if controls:
+                nxt, done, self._counts = out
+            else:
+                nxt, done = out
+        start_host_copies(nxt, done)
+        nxt = np.array(nxt)
+        done_new = np.array(done)
+        # Dispatch counted only past the host sync (the `_tick_mixed`
+        # rule: a recovered failure must leave dispatches == ticks).
+        self._stats["mixed"]["dispatches"] += 1
+
+        m = self._stats["mixed"]
+        m["ticks"] += 1
+        m["prefill_tokens"] += prefill_tokens
+        m["decode_tokens"] += n_decode
+        if prefill_tokens and n_decode:
+            m["coscheduled_ticks"] += 1
+
+        for r in list(range(B)):
+            req = self._row_req[r]
+            if req is None:
+                continue
+            if self._held[r]:
+                continue  # parked: nothing was dispatched for this row
+            if self._prefilling[r]:
+                self._row_w0[r] += int(chunk[r])
+                if not completing[r]:
+                    continue
+                self._complete_prefill_row(r, req, int(nxt[r]),
+                                           bool(done_new[r]))
+                continue
+            tok_r = int(nxt[r])
+            self._tok[r] = tok_r
+            self._done[r] = bool(done_new[r])
+            if not self._done[r]:
+                self._pos[r] = min(int(self._pos[r]) + 1, self.max_seq - 1)
+            if req.max_new - len(self._row_emitted[r]) > 0:
+                self._row_emitted[r].append(tok_r)
+                now = time.perf_counter()
+                if self._row_last_emit[r] > 0:
+                    self.itl_hist.observe(
+                        max(0.0, now - self._row_last_emit[r]))
+                self._row_last_emit[r] = now
+            self._push_stream(r, req)
+            self._maybe_complete(r)
+
+        if self.tracer is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            self.tracer.record(
+                "tick", "mixed_step", self.trace_node, dur_us,
+                start_ts=time.time() - dur_us / 1e6,
+                attrs={"prefill_tokens": int(prefill_tokens),
+                       "decode_rows": int(n_decode),
+                       "width": int(width)})
+
     def _loop_body(self) -> None:
         while self._running:
             self._last_tick = time.monotonic()  # liveness heartbeat
             # Live rows' block growth outranks new admissions for pool
             # space (an admitted row must never be starved mid-stream by
             # a newcomer).
-            if self._paged:
+            if self._paged or self._slab:
                 # Export commands run FIRST: between ticks the row is
                 # quiescent, and an export ahead of admissions can never
                 # observe a half-admitted batch.
                 self._serve_exports()
+            if self._paged:
                 self._ensure_capacity_paged()
             # Admit as many prefilled requests as there are free rows —
             # deferred (pool-pressure) admissions first, in arrival
@@ -3108,7 +3901,8 @@ class ContinuousGenerator:
             free = self._free_rows()
             admitted_any = False
             while free:
-                from_pending = bool(self._paged and self._pending)
+                from_pending = bool((self._paged or self._slab)
+                                    and self._pending)
                 if from_pending:
                     item = self._pending[0]
                 else:
@@ -3149,6 +3943,16 @@ class ContinuousGenerator:
                         self._fail_request(req, ImportRefused(
                             f"migration import refused: {exc}"))
                         continue
+                    if self._slab:
+                        # A state_slab request needs exactly ONE row,
+                        # and the pool holds >= 1 usable row by
+                        # construction — park until a completion frees
+                        # one (no impossible-fit case, no pins to drop).
+                        if not from_pending:
+                            self._pending.append(item)
+                        if all(r is None for r in self._row_req):
+                            time.sleep(0.005)
+                        break
                     # No blocks even after eviction. A request larger
                     # than the whole pool can never admit — fail it;
                     # otherwise park it until completions free blocks.
@@ -3199,7 +4003,7 @@ class ContinuousGenerator:
                     self._recover(exc)
                     break
             self._cancel_expired_rows()
-            if self._paged:
+            if self._paged or self._slab:
                 # Handoff holds past their park window resume decoding
                 # (the colocated fallback — the export never came).
                 self._unpark_expired()
@@ -3207,7 +4011,8 @@ class ContinuousGenerator:
                     if self._row_req[r] is not None]
             if not live:
                 continue
-            if self._paged and all(self._held[r] for r in live):
+            if (self._paged or self._slab) and all(self._held[r]
+                                                   for r in live):
                 # Only parked handoff rows: no dispatchable work this
                 # tick — idle briefly instead of spinning while the
                 # export command (or the park bound) arrives.
@@ -3223,8 +4028,20 @@ class ContinuousGenerator:
                 try:
                     if self._spec:
                         self._tick_spec()
+                    elif self._slab:
+                        self._tick_slab_mixed()
                     else:
                         self._tick_mixed()
+                except Exception as exc:
+                    self._recover(exc)
+                continue
+
+            if self._slab:
+                # Two-path decode chunk through the family's step
+                # function (the state_slab analog of the paged/dense
+                # chunk below).
+                try:
+                    self._tick_slab()
                 except Exception as exc:
                     self._recover(exc)
                 continue

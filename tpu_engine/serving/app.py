@@ -304,7 +304,7 @@ def serve_combined(
         (additive keys; the reference-exact schema is untouched for
         dense deployments)."""
         out = gateway.get_stats()
-        kv, mixed, spec = {}, {}, {}
+        kv, mixed, spec, state = {}, {}, {}, {}
         for w in workers:
             gen = getattr(w, "generator", None)
             if gen is None or not hasattr(gen, "stats"):
@@ -315,6 +315,11 @@ def serve_combined(
                 continue
             if st.get("kv_pool"):
                 kv[w.node_id] = st["kv_pool"]
+            if st.get("state_pool"):
+                # state_slab-family lanes (models.ssd): the kv_pool
+                # analog — gated the same way, absent on kv_paged
+                # fleets.
+                state[w.node_id] = st["state_pool"]
             if st.get("mixed"):
                 mixed[w.node_id] = dict(st["mixed"],
                                         active=st.get("active"))
@@ -323,6 +328,8 @@ def serve_combined(
                                        active=st.get("active"))
         if kv:
             out["kv_pool"] = kv
+        if state:
+            out["state_pool"] = state
         if mixed:
             out["mixed"] = mixed
         if spec:

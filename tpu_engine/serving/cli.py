@@ -109,6 +109,12 @@ def main(argv=None) -> int:
                                  "per-(slot, kv-head) f32 scales — ~2x "
                                  "blocks on the same HBM; requires "
                                  "--kv-block-size (unset = bf16 pool)")
+        parser.add_argument("--state-rows", type=int, default=None,
+                            help="recurrent state slab pool capacity in "
+                                 "rows (state_slab-family models, e.g. "
+                                 "mamba2: one fixed-size row per live "
+                                 "stream, constant in sequence length; "
+                                 "0/unset = auto)")
         parser.add_argument("--step-chunk", type=int, default=None,
                             help="decode chunk length per dispatch")
         parser.add_argument("--prefill-chunk", type=int, default=None,
@@ -176,6 +182,8 @@ def main(argv=None) -> int:
             gen_kw["gen_kv_host_blocks"] = args.kv_host_blocks
         if args.kv_quantize is not None:
             gen_kw["gen_kv_quantize"] = args.kv_quantize
+        if args.state_rows is not None:
+            gen_kw["gen_state_rows"] = args.state_rows
         if args.step_chunk is not None:
             gen_kw["gen_step_chunk"] = args.step_chunk
         if args.prefill_chunk is not None:
@@ -567,6 +575,16 @@ def main(argv=None) -> int:
                                  "Greedy streams stay deterministic but "
                                  "are not byte-identical to the bf16 "
                                  "pool. Default off = today's pool")
+        parser.add_argument("--state-rows", type=int, default=0,
+                            help="recurrent state slab pool capacity in "
+                                 "rows (state_slab-family models, e.g. "
+                                 "mamba2/ssd-small-test: each live "
+                                 "stream owns ONE fixed-size "
+                                 "(n_layers, state_dim) f32 row for its "
+                                 "whole life — peak concurrent rows are "
+                                 "independent of sequence length, "
+                                 "bench.py --scenario recurrent-ab. "
+                                 "0 = auto: decode slots + 1)")
         parser.add_argument("--prefix-affinity", action="store_true",
                             help="gateway: route /generate(+/stream) on a "
                                  "block-aligned prompt-prefix fingerprint "
@@ -759,6 +777,7 @@ def main(argv=None) -> int:
                                      gen_mixed_token_budget=(
                                          args.mixed_token_budget),
                                      gen_continuous_spec_k=args.spec_k,
+                                     gen_state_rows=args.state_rows,
                                      gen_spec_draft=args.spec_draft,
                                      gen_decode_fused=args.gen_decode_fused,
                                      quantize=args.quantize,

@@ -326,17 +326,52 @@ class WorkerNode:
             raise RuntimeError(
                 f"--kv-quantize must be 'int8', got "
                 f"{self.config.gen_kv_quantize!r}")
+        # Serving-state family fences (models.registry declares the
+        # family; the worker refuses mismatched machinery LOUDLY — an
+        # operator who asked for a kv_paged knob on a recurrent model
+        # must never get a lane that quietly ignores it).
+        model_family = getattr(self.engine.spec, "state_family", None)
+        if model_family == "state_slab":
+            if not self._continuous:
+                raise RuntimeError(
+                    f"model "
+                    f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
+                    f" serves the state_slab family, which requires "
+                    f"gen_scheduler=continuous (got "
+                    f"{self.config.gen_scheduler!r}: the batch and "
+                    f"speculative lanes serve only kv_paged models)")
+            if (self.config.gen_kv_block_size > 0
+                    or self.config.gen_kv_blocks > 0
+                    or self.config.gen_kv_host_blocks > 0
+                    or self.config.gen_kv_quantize):
+                raise RuntimeError(
+                    "state_slab-family models have no paged KV cache: "
+                    "--kv-block-size/--kv-blocks/--kv-host-blocks/"
+                    "--kv-quantize apply to the kv_paged family "
+                    "(state capacity is --state-rows)")
+            if self.config.gen_continuous_spec_k > 0:
+                raise RuntimeError(
+                    "--spec-k requires a kv_paged-family model: the "
+                    "state_slab recurrence has no KV verify window")
+        elif self.config.gen_state_rows > 0:
+            raise RuntimeError(
+                "--state-rows applies to state_slab-family models; "
+                f"model "
+                f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
+                f" serves the {model_family or 'kv_paged'} family")
         if self.config.role not in ("prefill", "decode", "both"):
             raise RuntimeError(
                 f"--role must be prefill|decode|both, got "
                 f"{self.config.role!r}")
         if self.config.role != "both" and (
                 not self._continuous
-                or self.config.gen_kv_block_size <= 0):
-            # A dedicated role without the paged continuous scheduler
-            # could never export or adopt a KV chain — the lane would
-            # silently serve colocated. Same loud contract as every
-            # other misconfiguration.
+                or (self.config.gen_kv_block_size <= 0
+                    and model_family != "state_slab")):
+            # A dedicated role without an exportable state family could
+            # never export or adopt a chain — the lane would silently
+            # serve colocated. Same loud contract as every other
+            # misconfiguration. (state_slab rows export as
+            # one-pseudo-block chains, so slab lanes qualify.)
             raise RuntimeError(
                 "--role prefill|decode requires the continuous "
                 "scheduler with the paged KV cache "
@@ -378,6 +413,7 @@ class WorkerNode:
                         mixed_step=self.config.gen_mixed_step,
                         mixed_token_budget=(
                             self.config.gen_mixed_token_budget),
+                        state_rows=self.config.gen_state_rows,
                         **self._continuous_spec_kwargs(),
                         device=getattr(engine, "_device", None))
                     # Per-tick mixed_step spans land in the lane's ring.
@@ -1069,7 +1105,9 @@ class WorkerNode:
                              f"got {role!r}")
         if role != "both" and (
                 not self._continuous
-                or self.config.gen_kv_block_size <= 0):
+                or (self.config.gen_kv_block_size <= 0
+                    and getattr(self.engine.spec, "state_family", None)
+                    != "state_slab")):
             raise ValueError(
                 "a dedicated role requires the continuous scheduler "
                 "with the paged KV cache (--kv-block-size > 0)")

@@ -132,6 +132,13 @@ class WorkerConfig:
     # Worth enabling where dispatch latency is high; costs one compile per
     # (batch, prompt, output-capacity) bucket triple.
     gen_decode_fused: bool = False
+    # Recurrent state serving (state_slab family ONLY — SSD/Mamba
+    # models): capacity of the fixed-size state slab pool in rows. Each
+    # live stream owns exactly ONE (n_layers, state_dim) f32 row for its
+    # whole life — constant in sequence length — so this is the family's
+    # "KV capacity" knob. 0 = auto (gen_max_batch_size + the null row).
+    # Loud RuntimeError on a kv_paged model (--state-rows).
+    gen_state_rows: int = 0
     # Admission control (resilience layer): maximum concurrently admitted
     # requests on this lane; excess is shed with 503 + Retry-After instead
     # of queueing unboundedly. 0 = unbounded (reference behavior).

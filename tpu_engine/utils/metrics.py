@@ -234,6 +234,44 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
            "Radix lookups that matched at least one full block",
            [(node(h), p.get("radix_hits")) for h, p in kv])
 
+    # Recurrent state slab pool (state_slab-family models: SSD/Mamba —
+    # the continuous scheduler's O(1)-state workload class). Rows are
+    # the family's capacity unit: one fixed-size state row per live
+    # stream, constant in sequence length.
+    spl = [(h, g.get("state_pool")) for h, g in gen
+           if isinstance(g, dict) and g.get("state_pool")]
+    metric("tpu_engine_state_rows_total", "gauge",
+           "Recurrent state slab pool capacity in rows "
+           "(null row excluded)",
+           [(node(h), p.get("rows_total")) for h, p in spl])
+    metric("tpu_engine_state_rows_free", "gauge",
+           "State slab rows currently free",
+           [(node(h), p.get("rows_free")) for h, p in spl])
+    metric("tpu_engine_state_bytes_per_row", "gauge",
+           "HBM bytes one stream's WHOLE autoregressive state costs "
+           "(constant in sequence length)",
+           [(node(h), p.get("bytes_per_row")) for h, p in spl])
+    metric("tpu_engine_state_dim", "gauge",
+           "Flattened per-layer recurrent state width",
+           [(node(h), p.get("state_dim")) for h, p in spl])
+    metric("tpu_engine_state_rows_admitted_total", "counter",
+           "State rows allocated to admitted streams",
+           [(node(h), p.get("rows_admitted")) for h, p in spl])
+    metric("tpu_engine_state_rows_released_total", "counter",
+           "State rows returned to the pool (must track admissions: "
+           "the zero-slab-leak invariant)",
+           [(node(h), p.get("rows_released")) for h, p in spl])
+    metric("tpu_engine_state_exports_total", "counter",
+           "State rows exported as one-pseudo-block chains "
+           "(migration/handoff)",
+           [(node(h), p.get("exports")) for h, p in spl])
+    metric("tpu_engine_state_imports_total", "counter",
+           "State rows imported verbatim from chains (zero re-prefill)",
+           [(node(h), p.get("imports")) for h, p in spl])
+    metric("tpu_engine_state_pending_admissions", "gauge",
+           "Admissions deferred on state-row exhaustion",
+           [(node(h), p.get("pending_admissions")) for h, p in spl])
+
     # Quantized KV blocks (--kv-quantize int8): capacity-economics gauges
     # for the int8 pool — bytes per block vs the full-precision layout
     # and the resulting block-count multiplier at equal HBM.

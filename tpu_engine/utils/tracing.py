@@ -235,16 +235,21 @@ class TraceSink:
             attrs=attrs or None)
 
 
-def percentile(sorted_vals: List, p: float):
-    """Nearest-rank (ceil) percentile of a pre-sorted list: the smallest
-    value with at least p% of samples ≤ it. The previous
-    ``int(p/100*len)`` truncation indexed one past the nearest rank
-    (over-reporting mid percentiles) and could swing either way on small
-    samples; nearest-rank is the standard, monotonic definition."""
-    if not sorted_vals:
+def percentile(vals: List, p: float):
+    """Nearest-rank (ceil) percentile: the smallest value with at least
+    p% of samples ≤ it (monotonic, standard). The helper SORTS a copy
+    itself — it used to require pre-sorted input and silently returned
+    garbage on anything else (a known bench footgun: an unsorted latency
+    list produced plausible-looking nonsense percentiles). Sorting an
+    already-sorted list is O(n) in Timsort, so the hardening costs
+    existing callers nothing. The previous ``int(p/100*len)`` truncation
+    indexed one past the nearest rank (over-reporting mid percentiles)
+    and could swing either way on small samples."""
+    if not vals:
         return None
-    rank = math.ceil(p / 100.0 * len(sorted_vals))  # 1-based
-    return sorted_vals[min(len(sorted_vals) - 1, max(0, rank - 1))]
+    svals = sorted(vals)
+    rank = math.ceil(p / 100.0 * len(svals))  # 1-based
+    return svals[min(len(svals) - 1, max(0, rank - 1))]
 
 
 def export_chrome(recorders: Dict[str, SpanRecorder]) -> dict:
