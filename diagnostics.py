@@ -25,9 +25,9 @@ OK, FAIL = "✓", "✗"
 _results = []
 _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
 #             --spec-parity step 9, --quant-parity step 10,
-#             --ssd-parity step 11, --failover step 12, --migrate
-#             step 13, --disagg step 14, --overload step 15,
-#             --lint step 16
+#             --ssd-parity step 11, --tp-parity step 12, --failover
+#             step 13, --migrate step 14, --disagg step 15,
+#             --overload step 16, --lint step 17
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -99,15 +99,23 @@ def main() -> int:
                          "max|Δ| over outputs AND final state must stay "
                          "bounded, the gate before serving the "
                          "matmul-form prefill on a device")
+    ap.add_argument("--tp-parity", action="store_true",
+                    help="step 12: tensor-parallel serving parity — a "
+                         "tp=2 continuous scheduler (sharded params + "
+                         "H_kv-sharded KV pool on this host's mesh) vs "
+                         "the single-device arm: greedy streams must "
+                         "be byte-identical and every mixed tick one "
+                         "dispatch (in-process, no server; the gate "
+                         "before serving --tp on a device)")
     ap.add_argument("--failover", action="store_true",
-                    help="step 12: one scripted kill/resume against a "
+                    help="step 13: one scripted kill/resume against a "
                          "local worker pair (spawned here): kill -9 the "
                          "stream's lane mid-generation and print the "
                          "spliced-vs-control diff — the crash-tolerant "
                          "streaming smoke without the full "
                          "fault_injection --crash chaos run")
     ap.add_argument("--migrate", action="store_true",
-                    help="step 13: one scripted migrate-mode drain "
+                    help="step 14: one scripted migrate-mode drain "
                          "against a local worker pair (spawned here): "
                          "drain the stream's lane mid-generation with "
                          "--migrate-streams semantics and print the "
@@ -115,7 +123,7 @@ def main() -> int:
                          "counters — the KV-handoff smoke without the "
                          "full fault_injection --migrate chaos run")
     ap.add_argument("--disagg", action="store_true",
-                    help="step 14: one scripted prefill→decode handoff "
+                    help="step 15: one scripted prefill→decode handoff "
                          "against a local 1-prefill + 1-decode worker "
                          "pair (spawned here) behind a --disagg "
                          "gateway: stream routes to the prefill lane, "
@@ -125,13 +133,13 @@ def main() -> int:
                          "without the full fault_injection --disagg "
                          "chaos run")
     ap.add_argument("--overload", action="store_true",
-                    help="step 15: overload-control state of the live "
+                    help="step 16: overload-control state of the live "
                          "system — the gateway's /stats overload block "
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
     ap.add_argument("--lint", action="store_true",
-                    help="step 16: engine-lint static-analysis suite "
+                    help="step 17: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -139,7 +147,7 @@ def main() -> int:
     args = ap.parse_args()
     _TOTAL = (6 + int(args.kernel_parity) + int(args.mixed_parity)
               + int(args.spec_parity) + int(args.quant_parity)
-              + int(args.ssd_parity)
+              + int(args.ssd_parity) + int(args.tp_parity)
               + int(args.failover) + int(args.migrate)
               + int(args.disagg) + int(args.overload) + int(args.lint))
     gw = _strip(args.gateway)
@@ -336,7 +344,73 @@ def main() -> int:
             step(n, "SSD duality parity (matmul form vs recurrence)",
                  False, f"({exc})")
 
-    # 12 (--failover): one scripted kill/resume against a local worker
+    # 12 (--tp-parity): tensor-parallel serving — a tp=2 continuous
+    # scheduler (registry-declared param placement, H_kv-sharded pool)
+    # against the single-device arm, in-process. Greedy streams must be
+    # byte-identical and mixed ticks == dispatches; on a multi-chip
+    # host this validates the SPMD compile the tp-ab campaign stage
+    # needs before serving --tp.
+    if args.tp_parity:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + 1)
+        try:
+            import os as _os
+
+            if "jax" not in sys.modules and not _os.environ.get(
+                    "XLA_FLAGS", ""):
+                # CPU hosts: provision a 2-device virtual mesh while we
+                # still can (before jax initializes). TPU hosts ignore
+                # the flag; a live multi-chip backend uses real chips.
+                _os.environ["XLA_FLAGS"] = (
+                    "--xla_force_host_platform_device_count=2")
+            import jax as _jax
+
+            from tpu_engine.models.registry import (
+                _ensure_builtin_models_imported,
+                create_model,
+            )
+            from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+            _ensure_builtin_models_imported()
+            if len(_jax.devices()) < 2:
+                step(n, "tensor-parallel serving parity (tp=2 vs 1)",
+                     True, "(single visible device: skipped — set "
+                           "XLA_FLAGS=--xla_force_host_platform_device_"
+                           "count=2 on CPU hosts)")
+            else:
+                tp_spec = create_model("gpt2-small-test", max_seq=64)
+                tp_params = tp_spec.init(_jax.random.PRNGKey(0))
+                tp_prompts = [[5, 9, 3, 17], [2, 4, 6, 8, 10, 12],
+                              [1] * 20]
+
+                def _tp_run(tp):
+                    gen = ContinuousGenerator(
+                        tp_spec, params=tp_params, dtype="float32",
+                        n_slots=4, kv_block_size=16, prefill_chunk=16,
+                        mixed_step=True, mixed_token_budget=32, tp=tp)
+                    try:
+                        out = gen.generate(tp_prompts, max_new_tokens=10)
+                        return out, gen.stats()
+                    finally:
+                        gen.stop()
+
+                ref, _ = _tp_run(1)
+                sharded, st = _tp_run(2)
+                m = st["mixed"]
+                ok = (sharded == ref and m["ticks"] == m["dispatches"]
+                      and st.get("tp", {}).get("tp") == 2)
+                step(n, "tensor-parallel serving parity (tp=2 vs 1)",
+                     ok,
+                     f"(streams "
+                     f"{'identical' if sharded == ref else 'DIVERGED'}"
+                     f", ticks={m['ticks']} "
+                     f"dispatches={m['dispatches']})")
+        except Exception as exc:
+            step(n, "tensor-parallel serving parity (tp=2 vs 1)", False,
+                 f"({exc})")
+
+    # 13 (--failover): one scripted kill/resume against a local worker
     # pair — the journal splice, live, in one line: spawn two standalone
     # workers, stream through a failover-enabled gateway, kill -9 the
     # serving lane mid-stream, and diff the spliced stream against an
@@ -344,7 +418,7 @@ def main() -> int:
     if args.failover:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.ssd_parity) + 1)
+             + int(args.ssd_parity) + int(args.tp_parity) + 1)
         procs = []
         try:
             import signal
@@ -422,7 +496,8 @@ def main() -> int:
     if args.migrate:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.ssd_parity) + int(args.failover) + 1)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + 1)
         procs = []
         try:
             import threading
@@ -502,8 +577,8 @@ def main() -> int:
     if args.disagg:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.ssd_parity) + int(args.failover)
-             + int(args.migrate) + 1)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate) + 1)
         procs = []
         try:
             import threading
@@ -572,8 +647,9 @@ def main() -> int:
     if args.overload:
         n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
              + int(args.spec_parity) + int(args.quant_parity)
-             + int(args.ssd_parity) + int(args.failover)
-             + int(args.migrate) + int(args.disagg) + 1)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + 1)
         try:
             status, stats = _get(gw, "/stats")
             ov = stats.get("overload")

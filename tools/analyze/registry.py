@@ -181,9 +181,17 @@ ENGINE_REGISTRY = Registry(
                    "_untyped", "_latency", "_lane_recent",
                    "_affinity_assigned", "_hedge_pool", "default_model",
                    "_total_requests", "_failovers", "_inflight",
-                   "_streams", "_roles"),
+                   "_streams", "_roles", "_topology",
+                   "_topology_updates"),
             lock="Gateway._lock",
             classes=("Gateway",)),
+        # Consistent-hash ring internals (vnode map + per-node topology
+        # weights): the ring self-locks; every public method takes
+        # _lock, and _drop_labels documents "caller holds it".
+        GuardedEntry(
+            attrs=("_ring", "_sorted_hashes", "_weights"),
+            lock="ConsistentHash._lock",
+            classes=("ConsistentHash",)),
         # Live-stream-migration handoff slot: the orchestrator/relay
         # exchange resolves exactly once under the record's own lock.
         GuardedEntry(
@@ -248,7 +256,8 @@ ENGINE_REGISTRY = Registry(
     caller_locked=frozenset({"BlockPool.*", "RadixTree.*",
                              "StateSlabPool.*",
                              "TenantRateLimiter._evict_idle",
-                             "SheddingStats._gc"}),
+                             "SheddingStats._gc",
+                             "ConsistentHash._drop_labels"}),
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
                                  "overload", "migration", "handoff"}),

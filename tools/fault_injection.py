@@ -2061,6 +2061,160 @@ def run_recurrent_standalone() -> int:
                 proc.kill()
 
 
+def tp_phase(ports, procs, checks: list) -> dict:
+    """Kill -9 the TENSOR-PARALLEL lane (tp=2) mid-stream under Poisson
+    load: the PR 6 replay resume must complete every stream
+    byte-identical to an unkilled control on the DIFFERENTLY-SHARDED
+    tp=1 survivor — the cross-geometry identity the TP tentpole
+    promises (same fold_in(seed, position) sampling, logits equal to
+    the argmax on this backend). Also pins: the /health topology label,
+    the gateway ring picking the label up via prober sweeps (vnode
+    weight 2), failover counters == resume spans, and zero KV blocks
+    leaked on the survivor. ports[0] = the tp=2 victim, ports[1] = the
+    tp=1 survivor."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    victim_lane = victim_lane_for_port(lanes, ports[0])
+    victim_proc = procs[0]
+
+    # The TP lane advertises its mesh shape on /health...
+    _, health = _call(ports[0], "GET", "/health", timeout=30.0)
+    topo = health.get("topology") or {}
+    checks.append(("tp: victim /health carries the topology label "
+                   f"(tp={topo.get('tp')})", topo.get("tp") == 2))
+    _, h1 = _call(ports[1], "GET", "/health", timeout=30.0)
+    checks.append(("tp: tp=1 survivor /health has no topology key",
+                   "topology" not in h1))
+    # ...and the prober folds it into the ring: vnode weight 2 beside
+    # the survivor's 1 (the topology-aware ring, discovered not
+    # configured).
+    weighted = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if gw._ring.node_weight(victim_lane) == 2:
+            weighted = True
+            break
+        time.sleep(0.1)
+    topo_stats = gw.get_stats().get("topology", {})
+    checks.append(("tp: prober re-weighted the TP lane's vnodes",
+                   weighted
+                   and topo_stats.get("ring_weights", {}).get(
+                       victim_lane) == 2))
+
+    # Request mix (greedy + seeded), a known share primary on the TP
+    # victim with long budgets so the kill lands mid-generation.
+    requests = []
+    for k in range(10):
+        lane = victim_lane if k % 2 == 0 else lanes[k % len(lanes)]
+        params = ({} if k % 3 == 0
+                  else {"temperature": 0.9, "seed": 300 + k})
+        requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"tp{k}"),
+            "prompt_tokens": [(k * 5 + j) % 90 + 1
+                              for j in range(5 + k % 4)],
+            "max_new_tokens": 48 if lane == victim_lane else 16,
+            **params})
+    victim_rids = {r["request_id"] for r in requests
+                   if gw._ring.get_node(r["request_id"]) == victim_lane}
+
+    # Control oracle: the tp=1 SURVIVOR — spliced streams off the dead
+    # tp=2 lane must match single-device serving byte-for-byte.
+    try:
+        control = control_oracle(ports[1], requests)
+    except RuntimeError as exc:
+        checks.append(("tp: control generate", False))
+        gw.stop()
+        return {"error": str(exc)}
+
+    def kill_victim():
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=10)
+
+    results, killed = drive_streams_with_kill(
+        gw, requests, victim_rids, kill_victim, random.Random(3),
+        arrival_rate=12.0)
+    checks.append(("tp: tp=2 victim killed mid-stream", killed))
+
+    complete, identical, resumed = tally_streams(results, control)
+    mismatches = [
+        {"rid": rid, "control": control[rid], "streamed": toks,
+         "final_tokens": (final or {}).get("tokens"),
+         "victim_primary": rid in victim_rids}
+        for rid, (toks, final) in results.items()
+        if toks != control[rid]
+        or not final or final.get("tokens") != control[rid]]
+    checks.append((f"tp: all streams completed "
+                   f"({complete}/{len(requests)})",
+                   complete == len(requests)))
+    checks.append((f"tp: all streams byte-identical to the tp=1 "
+                   f"control ({identical}/{len(requests)})",
+                   identical == len(requests)))
+    checks.append(("tp: at least one stream resumed on the "
+                   "differently-sharded survivor", resumed >= 1))
+
+    # Counters == spans (the established failover discipline).
+    fo, resume_spans = {}, []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        fo = gw.get_stats().get("failover", {})
+        resume_spans = [s for s in gw.tracer.snapshot()
+                        if s["op"] == "resume"]
+        if len(resume_spans) == fo.get("resumes_attempted", -1):
+            break
+        time.sleep(0.1)
+    checks.append(("tp: failover counters == resume spans",
+                   len(resume_spans) == fo.get("resumes_attempted", -1)
+                   and fo.get("resumes_attempted", 0) >= 1))
+
+    # Zero KV blocks leaked on the tp=1 survivor.
+    pool = _worker_pool_clean(ports[1])
+    checks.append((f"tp: no KV blocks leaked on survivor :{ports[1]}",
+                   pool is not None))
+    gw.stop()
+    return {"streams": len(requests), "complete": complete,
+            "identical": identical, "resumed_streams": resumed,
+            "mismatches": mismatches,
+            "victim_primary_streams": len(victim_rids),
+            "victim_topology": topo, "topology_stats": topo_stats,
+            "failover": fo, "survivor_pool": pool}
+
+
+def run_tp_standalone() -> int:
+    # The worker processes need >= 2 visible devices for the tp=2 lane:
+    # provision the virtual CPU mesh in the inherited env (a TPU host's
+    # real chips override; the flag is a CPU-backend no-op elsewhere).
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    ports, procs = launch_worker_procs(
+        2, per_worker_args=(("--tp", "2"), ()))
+    checks: list = []
+    try:
+        report = {"mode": "tp-standalone", "worker_ports": ports,
+                  "phases": {"tp": tp_phase(ports, procs, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_mixed_standalone() -> int:
     port, proc = launch_mixed_server()
     checks: list = []
@@ -2434,6 +2588,18 @@ def main() -> int:
                          "exact) with zero state-slab rows leaked on "
                          "the survivors and failover counters == "
                          "resume spans; ignores the other flags")
+    ap.add_argument("--tp", action="store_true",
+                    help="standalone tensor-parallel scenario: spawns a "
+                         "tp=2 worker (sharded model + H_kv-sharded KV "
+                         "pool over a 2-device mesh) beside a tp=1 "
+                         "worker, kill -9s the TP lane mid-stream under "
+                         "Poisson load, and asserts every stream "
+                         "completes byte-identical to an unkilled tp=1 "
+                         "control via the replay resume (cross-shard-"
+                         "geometry identity), the /health topology "
+                         "label re-weights the gateway ring, failover "
+                         "counters == resume spans, and zero KV blocks "
+                         "leak on the survivor; ignores the other flags")
     ap.add_argument("--overload", action="store_true",
                     help="standalone overload-control scenario: spawns a "
                          "3-lane combined server with every overload "
@@ -2445,6 +2611,8 @@ def main() -> int:
                          "marker spans, and zero KV blocks leak; "
                          "ignores the other flags")
     args = ap.parse_args()
+    if args.tp:
+        return run_tp_standalone()
     if args.disagg:
         return run_disagg_standalone()
     if args.migrate:

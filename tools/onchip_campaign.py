@@ -418,11 +418,29 @@ def main() -> int:
         return bench.run_drain_ab(n_streams=6 if q else 10,
                                   max_new=24 if q else 48)
 
+    @stage(artifact, out, "tp_serving")
+    def _tp_serving():
+        # Tensor-parallel continuous serving on-chip: the equal-per-
+        # device-HBM A/B (BENCH_r16 ran it on the CPU mesh, stamped
+        # on-chip pending like r06-r15). Stream identity is backend-
+        # empirical, but the real-device questions — ICI collective cost
+        # inside the per-tick SPMD dispatch, the sharded pool's actual
+        # HBM footprint per chip, multi-chip compile time — are device
+        # properties only this stage can answer. Requires >= 2 local
+        # devices (a 1-chip host records the refusal and moves on).
+        import jax as _jax
+
+        if len(_jax.devices()) < 2:
+            return {"skipped": "needs >= 2 local devices for tp=2"}
+        tp = 2 if (q or len(_jax.devices()) < 4) else 4
+        return bench.run_tp_ab(model=model, tp=tp, quick=bool(q))
+
     # Order: cheapest/highest-value evidence first — a mid-campaign wedge
     # keeps everything already saved.
     for fn in (_host_micro, _flash_exact, _compute, _decode, _decode_fused,
                _decode_int8, _flash, _flash_tiling, _paged, _mixed,
                _spec_cont, _spec, _kv_quant, _affinity, _migration,
+               _tp_serving,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
                _miss_sweep):
         fn()

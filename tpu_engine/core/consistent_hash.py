@@ -46,32 +46,60 @@ class ConsistentHash:
         self._virtual_nodes = int(virtual_nodes)
         self._ring: Dict[int, str] = {}
         self._sorted_hashes: List[int] = []
+        # Per-node vnode WEIGHT (absent = 1, the reference behavior):
+        # a weight-w node registers w * virtual_nodes vnodes — the
+        # topology-aware gateway maps virtual nodes onto CHIPS, so a
+        # TP=4 lane (one model spanning 4 chips, 4x the KV pool) owns
+        # 4x the hash share of a single-chip lane.
+        self._weights: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     @property
     def virtual_nodes(self) -> int:
         return self._virtual_nodes
 
-    def add_node(self, node: str) -> None:
-        """Insert ``virtual_nodes`` vnodes labelled ``node#i`` (reference ``:16-23``)."""
+    def add_node(self, node: str, weight: int = 1) -> None:
+        """Insert ``weight * virtual_nodes`` vnodes labelled ``node#i``
+        (reference ``:16-23``; weight 1 = the reference-exact ring).
+        Re-adding with a different weight RESIZES the node's vnode set
+        in place (the topology prober re-weights lanes as /health
+        labels arrive)."""
+        weight = max(1, int(weight))
         with self._lock:
-            for i in range(self._virtual_nodes):
+            prev = self._weights.get(node, 0)
+            if weight < prev:
+                self._drop_labels(node, range(weight * self._virtual_nodes,
+                                              prev * self._virtual_nodes))
+            for i in range(prev * self._virtual_nodes,
+                           weight * self._virtual_nodes):
                 h = fnv1a_32(f"{node}#{i}")
                 if h not in self._ring:
                     bisect.insort(self._sorted_hashes, h)
                 self._ring[h] = node
+            self._weights[node] = weight
+
+    def _drop_labels(self, node: str, label_range) -> None:
+        """Erase this node's vnodes for label indices in ``label_range``
+        (caller holds the lock)."""
+        for i in label_range:
+            h = fnv1a_32(f"{node}#{i}")
+            if self._ring.get(h) == node:
+                del self._ring[h]
+                idx = bisect.bisect_left(self._sorted_hashes, h)
+                if idx < len(self._sorted_hashes) \
+                        and self._sorted_hashes[idx] == h:
+                    self._sorted_hashes.pop(idx)
+
+    def node_weight(self, node: str) -> int:
+        with self._lock:
+            return self._weights.get(node, 0)
 
     def remove_node(self, node: str) -> None:
         """Erase the node's vnodes (reference ``:25-32``) — enables elastic scale-down,
         which the reference declared but never wired up (SURVEY.md §5)."""
         with self._lock:
-            for i in range(self._virtual_nodes):
-                h = fnv1a_32(f"{node}#{i}")
-                if self._ring.get(h) == node:
-                    del self._ring[h]
-                    idx = bisect.bisect_left(self._sorted_hashes, h)
-                    if idx < len(self._sorted_hashes) and self._sorted_hashes[idx] == h:
-                        self._sorted_hashes.pop(idx)
+            weight = self._weights.pop(node, 1)
+            self._drop_labels(node, range(weight * self._virtual_nodes))
 
     def get_node(self, key: str) -> str:
         """First vnode clockwise of ``hash(key)``, wrapping to ring start
@@ -103,7 +131,8 @@ class ConsistentHash:
 
     def size(self) -> int:
         """Number of distinct physical nodes."""
-        return len(set(self._ring.values()))
+        with self._lock:
+            return len(set(self._ring.values()))
 
     def get_distribution(self, keys: Sequence[str]) -> Dict[str, int]:
         """Per-node assignment counts over ``keys`` — the test/debug probe the

@@ -57,6 +57,10 @@ def _make_bert(name: str, cfg: TransformerConfig, seq_len: int,
         input_shape=(seq_len,),
         output_shape=(seq_len, n_outputs),
         config=cfg,
+        # Same stacked-block param layout as the decoder families, so
+        # the named heads-axis rules apply verbatim (one-shot /infer
+        # only — the encoder has no decode lane to shard state for).
+        tp_rule="transformer",
     )
 
 

@@ -49,6 +49,12 @@ def _spec_from_config(name: str, cfg: TransformerConfig, seq_len: int) -> ModelS
         input_shape=(seq_len,),
         output_shape=(cfg.vocab,),
         config=cfg,  # generation service needs the architecture
+        # Megatron-style heads-axis placement (registry.TP_RULES): QKV /
+        # MLP-up column-parallel, wo / proj row-parallel, head on vocab,
+        # norms + embeddings replicated. Covers every family built on
+        # this helper (gpt2, distilgpt2, llama, mistral; MoE expert
+        # banks ride replicated under the catch-all).
+        tp_rule="transformer",
     )
 
 

@@ -40,4 +40,5 @@ def make_mlp(input_dim: int = 16, hidden_dim: int = 128, output_dim: int = 16,
         init=init,
         input_shape=(input_dim,),
         output_shape=(output_dim,),
+        tp_rule="dense_output",  # no named layout: the rank heuristic
     )

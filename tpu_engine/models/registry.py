@@ -12,7 +12,8 @@ wire format (flat float vectors, pad/truncate) intact.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional, Tuple
+import re
+from typing import Callable, Dict, List, Optional, Tuple
 
 # Serving-capability flags per state family (VirtualFlow framing: the
 # registry, not the serving machinery, declares what a model family can
@@ -24,11 +25,167 @@ from typing import Callable, Dict, Optional, Tuple
 FAMILY_CAPABILITIES: Dict[str, Tuple[str, ...]] = {
     "kv_paged": ("generate", "two_path", "mixed_step", "spec_decode",
                  "paged_kv", "prefix_sharing", "kv_quantize",
-                 "kv_host_tier", "migration", "handoff"),
+                 "kv_host_tier", "migration", "handoff",
+                 "tensor_parallel"),
     "state_slab": ("generate", "two_path", "mixed_step", "migration",
                    "handoff"),
     "stateless": (),
 }
+
+# -- tensor-parallel partition rules ------------------------------------------
+#
+# The registry — not the serving machinery — declares how a model's
+# params shard over the `model` mesh axis (the FAMILY_CAPABILITIES
+# pattern, promoted from training.shard_params_tp's rank heuristic):
+# every ModelSpec carries a ``tp_rule`` naming an entry here, and
+# consumers (the continuous scheduler's --tp path, the worker startup
+# fence) resolve it through ``tp_shardings`` / ``tp_unshardable_reason``
+# instead of re-deriving placement per call site. An unshardable family
+# (e.g. mamba2's depthwise conv tail + fused state slab) declares
+# ``unshardable:<reason>`` and gets a LOUD pinned RuntimeError at
+# resolution — never a silent mis-shard.
+#
+# A rule is a list of (regex over the '/'-joined param path, spec tail)
+# pairs, first match wins (SNIPPETS.md [2]'s match_partition_rules
+# idiom). The tail is RIGHT-ALIGNED onto the leaf's shape — stacked
+# per-layer trees carry a leading (L, ...) axis the tail never names —
+# and "model" marks the sharded dim (replaced by the mesh axis name).
+
+# The transformer families' Megatron-style placement: QKV and the MLP
+# up-projections shard their heads/features OUTPUT dim (column
+# parallel), the attention output and MLP down-projections their heads/
+# features INPUT dim (row parallel — XLA inserts the psum on ICI), the
+# LM head its vocab dim; norms and embeddings replicate. The catch-all
+# REPLICATES unmatched leaves (always correct, never silently
+# mis-sharded — MoE expert banks currently ride replicated).
+_TRANSFORMER_TP_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
+    (r"attn/w[qkv]/kernel$", (None, "model")),
+    (r"attn/w[qkv]/bias$", ("model",)),
+    (r"attn/wo/kernel$", ("model", None)),
+    (r"mlp/(fc|gate|up)/kernel$", (None, "model")),
+    (r"mlp/(fc|gate|up)/bias$", ("model",)),
+    (r"mlp/proj/kernel$", ("model", None)),
+    (r"head/kernel$", (None, "model")),
+    (r"head/bias$", ("model",)),
+    (r".*", ()),
+]
+
+
+def _leaf_path_name(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def _refuse_quantized(params) -> None:
+    """Weight-quantized trees refuse TP loudly (shard_params_tp's
+    documented contract): int8 kernel_q + per-channel scale leaves would
+    shard along mismatched axes or silently replicate."""
+    from tpu_engine.ops.quant import tree_is_quantized
+
+    if tree_is_quantized(params):
+        raise RuntimeError(
+            "tensor-parallel sharding cannot place a weight-quantized "
+            "param tree (ops.quant kernel_q/wi_q leaves): the TP "
+            "partition rules target full-precision kernels. Use int8 "
+            "weight quantization OR tensor parallelism per deployment, "
+            "not both.")
+
+
+def _match_rules_shardings(rules, params, mesh, axis: str):
+    """(regex, tail) rules + a param tree -> NamedSharding tree. A tail
+    dim that does not divide over the mesh axis replicates that leaf
+    (never a shape error at placement time — small biases on a wide
+    mesh just stay whole)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    msize = mesh.shape[axis]
+
+    def spec_for(path, leaf):
+        name = _leaf_path_name(path)
+        shape = getattr(leaf, "shape", ())
+        nd = len(shape)
+        for pat, tail in rules:
+            if re.search(pat, name):
+                tail = tuple(axis if t == "model" else t for t in tail)
+                if nd < len(tail):
+                    return NamedSharding(mesh, P())
+                spec = (None,) * (nd - len(tail)) + tail
+                for dim, t in enumerate(spec):
+                    if t is not None and shape[dim] % msize:
+                        return NamedSharding(mesh, P())
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _transformer_tp_rule(params, mesh, axis: str = "model"):
+    _refuse_quantized(params)
+    return _match_rules_shardings(_TRANSFORMER_TP_RULES, params, mesh,
+                                  axis)
+
+
+def _dense_output_tp_rule(params, mesh, axis: str = "model"):
+    """The promoted rank heuristic (training.shard_params_tp): 2-D+
+    kernels shard their output-feature (last) dim, divisible 1-D leaves
+    shard too, everything else replicates. The generic rule for models
+    without a named layout (mlp, resnet, onnx graphs)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _refuse_quantized(params)
+    msize = mesh.shape[axis]
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 2 and shape[-1] % msize == 0:
+            return P(*([None] * (len(shape) - 1)), axis)
+        if len(shape) == 1 and shape[0] % msize == 0 and shape[0] > 1:
+            return P(axis)
+        return P()
+
+    return jax.tree.map(lambda l: NamedSharding(mesh, spec_for(l)),
+                        params)
+
+
+# name -> callable(params, mesh, axis) -> tree of NamedShardings.
+TP_RULES: Dict[str, Callable] = {
+    "transformer": _transformer_tp_rule,
+    "dense_output": _dense_output_tp_rule,
+}
+
+
+def tp_unshardable_reason(spec) -> Optional[str]:
+    """The declared reason this model cannot tensor-parallel shard, or
+    None when its rule resolves. Consumers fence HERE (worker startup,
+    scheduler construction) so a --tp misconfiguration is one loud
+    RuntimeError naming the layer, never a silent mis-shard."""
+    # Bare stand-in specs without a declaration (test fakes) default to
+    # the transformer layout — the same derivation rule the scheduler
+    # applies to their state family.
+    rule = getattr(spec, "tp_rule", "") or "transformer"
+    if rule.startswith("unshardable"):
+        _, _, reason = rule.partition(":")
+        return reason.strip() or "model declares itself unshardable"
+    if rule not in TP_RULES:
+        return f"unknown TP partition rule {rule!r}"
+    return None
+
+
+def tp_shardings(spec, params, mesh, axis: str = "model"):
+    """Resolve ``spec.tp_rule`` and place ``params`` — the ONE entry
+    point every TP consumer uses. Raises RuntimeError (pinned message)
+    for unshardable or unknown rules."""
+    reason = tp_unshardable_reason(spec)
+    if reason is not None:
+        raise RuntimeError(
+            f"model '{getattr(spec, 'name', '?')}' cannot be "
+            f"tensor-parallel sharded: {reason}")
+    rule = getattr(spec, "tp_rule", "") or "transformer"
+    return TP_RULES[rule](params, mesh, axis)
 
 
 @dataclasses.dataclass
@@ -46,6 +203,12 @@ class ModelSpec:
     # Serving-capability flags ("" sentinel tuple = derive from the
     # family table above). Consumers fence on these, never on isinstance.
     capabilities: Tuple[str, ...] = ()
+    # Tensor-parallel partition rule ("" = derive): names a TP_RULES
+    # entry, or "unshardable:<reason>" for families with no heads axis
+    # to split (the mamba2 depthwise conv tail / fused state slab).
+    # Resolved through tp_shardings / tp_unshardable_reason — consumers
+    # fence on the declaration, never on isinstance.
+    tp_rule: str = ""
 
     def __post_init__(self):
         if not self.state_family:
@@ -61,8 +224,28 @@ class ModelSpec:
                 f"model '{self.name}' declares unknown state family "
                 f"{self.state_family!r}; known: "
                 f"{sorted(FAMILY_CAPABILITIES)}")
+        if not self.tp_rule:
+            # A config may declare its rule (SSDConfig pins
+            # "unshardable:..."); causal transformer configs get the
+            # Megatron-style named layout; everything else the promoted
+            # rank heuristic.
+            rule = getattr(self.config, "tp_partition_rule", None)
+            if rule is None:
+                if self.state_family == "kv_paged":
+                    rule = "transformer"
+                elif self.state_family == "state_slab":
+                    # Defensive default for undeclared recurrent models:
+                    # refusal beats a heuristic mis-shard.
+                    rule = ("unshardable: recurrent state_slab models "
+                            "declare no shardable heads axis")
+                else:
+                    rule = "dense_output"
+            self.tp_rule = rule
         if not self.capabilities:
-            self.capabilities = FAMILY_CAPABILITIES[self.state_family]
+            caps = FAMILY_CAPABILITIES[self.state_family]
+            if self.tp_rule.startswith("unshardable"):
+                caps = tuple(c for c in caps if c != "tensor_parallel")
+            self.capabilities = caps
 
     def supports(self, flag: str) -> bool:
         return flag in self.capabilities

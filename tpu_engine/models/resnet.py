@@ -92,6 +92,7 @@ def make_resnet50(image_size: int = 224, num_classes: int = 1000) -> ModelSpec:
         init=init,
         input_shape=(image_size, image_size, 3),
         output_shape=(num_classes,),
+        tp_rule="dense_output",  # conv kernels: the rank heuristic
     )
 
 
@@ -177,4 +178,5 @@ def make_resnet50_v1(image_size: int = 224, num_classes: int = 1000) -> ModelSpe
         init=init,
         input_shape=(image_size, image_size, 3),
         output_shape=(num_classes,),
+        tp_rule="dense_output",  # conv kernels: the rank heuristic
     )

@@ -59,6 +59,16 @@ class SSDConfig:
     # The serving scheduler dispatches by family: this config's streams
     # hold a fixed state slab, never a KV block chain.
     serving_state_family: ClassVar[str] = "state_slab"
+    # Tensor parallelism is REFUSED for this family (registry.tp_rule
+    # contract): the depthwise short-conv tail mixes channels per
+    # position with no heads axis to split, and the fused state slab
+    # (conv tail ⧺ SSM state flattened per row) has no per-device
+    # partition that survives the flatten/unflatten round trip — a
+    # heuristic shard would corrupt the recurrence silently. --tp on a
+    # mamba2-family worker is a pinned RuntimeError at startup.
+    tp_partition_rule: ClassVar[str] = (
+        "unshardable: the mamba2 depthwise conv tail and fused state "
+        "slab rows have no heads axis to shard")
     # Autoregressive decoder by construction (registry capability check).
     causal: ClassVar[bool] = True
 

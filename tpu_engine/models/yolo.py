@@ -208,6 +208,7 @@ def _make_spec(name: str, cfg: YoloConfig, size: int) -> ModelSpec:
         input_shape=(size, size, 3),
         output_shape=(n_anchors(size, size), cfg.head_ch),
         config=cfg,
+        tp_rule="dense_output",  # conv kernels: the rank heuristic
     )
 
 

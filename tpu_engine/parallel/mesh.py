@@ -53,3 +53,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def single_device_mesh() -> Mesh:
     """One-device mesh — lets every code path be mesh-driven even on 1 chip."""
     return create_mesh(shape=(1,), devices=jax.devices()[:1])
+
+
+def tp_topology_label(tp: int) -> dict:
+    """The canonical mesh-shape label a tensor-parallel lane advertises
+    (worker /health, scheduler stats, gateway local-lane discovery) and
+    the gateway's topology-aware ring parses — ONE producer so the
+    three surfaces can never drift from the consumer."""
+    return {"tp": int(tp), "mesh_shape": {"model": int(tp)},
+            "devices": int(tp)}
+
+
+def tp_mesh(tp: int, devices=None) -> Mesh:
+    """A 1-axis ``model`` mesh over ``tp`` devices — the serving-side
+    tensor-parallel slice (runtime.scheduler ``tp=N``). Defaults to the
+    first ``tp`` local devices; pass ``devices`` to pin a lane onto a
+    specific pod slice."""
+    devices = list(devices if devices is not None else jax.devices())
+    if tp > len(devices):
+        raise ValueError(f"tp={tp} needs {tp} devices, have "
+                         f"{len(devices)}")
+    return create_mesh(shape=(int(tp),), axis_names=("model",),
+                       devices=devices[:int(tp)])

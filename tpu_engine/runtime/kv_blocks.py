@@ -327,12 +327,41 @@ class BlockPool:
 
     def __init__(self, cfg: TransformerConfig, num_blocks: int,
                  block_size: int, dtype=jnp.bfloat16, device=None,
-                 host_blocks: int = 0, quantize: str = ""):
+                 host_blocks: int = 0, quantize: str = "", mesh=None,
+                 tp_axis: str = "model"):
+        """``mesh`` (tensor-parallel serving, DESIGN.md "Tensor-parallel
+        serving"): a 1-axis ``model`` mesh — the pool tensors shard
+        their ``H_kv`` dim over it (scale arrays alongside for int8
+        pools), matching the heads-axis model placement so each tick's
+        pool-donating dispatch stays one SPMD program with zero
+        resharding. ``kv_heads`` must divide by the axis size. None
+        (default) keeps today's single-device pool; ``device`` and
+        ``mesh`` are mutually exclusive."""
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
         if quantize not in ("", "int8"):
             raise ValueError(f"unsupported KV quantize mode {quantize!r} "
                              "(only 'int8')")
+        self.tp = 1
+        self.kv_sharding = None      # NamedSharding of the payload pools
+        self.scale_sharding = None   # ... and of the int8 scale arrays
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if device is not None:
+                raise ValueError("BlockPool: pass device OR mesh, not "
+                                 "both (a mesh owns its own placement)")
+            tp = int(mesh.shape[tp_axis])
+            if cfg.kv_heads % tp:
+                raise ValueError(
+                    f"kv_heads={cfg.kv_heads} must divide by the "
+                    f"tensor-parallel degree {tp} (the pool shards its "
+                    f"H_kv axis)")
+            self.tp = tp
+            self.kv_sharding = NamedSharding(
+                mesh, P(None, None, None, tp_axis, None))
+            self.scale_sharding = NamedSharding(
+                mesh, P(None, None, None, tp_axis))
         self.cfg = cfg
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
@@ -406,14 +435,20 @@ class BlockPool:
                  self.cfg.kv_heads, self.cfg.d_head)
         caches = KVCache(jnp.zeros(shape, self._dtype),
                          jnp.zeros(shape, self._dtype))
-        if self._device is not None:
+        if self.kv_sharding is not None:
+            # Tensor-parallel pool: committed H_kv-sharded from birth,
+            # so every consumer executable compiles SPMD over the mesh.
+            caches = jax.device_put(caches, self.kv_sharding)
+        elif self._device is not None:
             caches = jax.device_put(caches, self._device)
         if self.quantized:
             # Scale 1.0 everywhere: unwritten (and null-block) slots
             # dequantize to exact zeros, like a fresh bf16 pool.
             scales = KVCache(jnp.ones(shape[:-1], jnp.float32),
                              jnp.ones(shape[:-1], jnp.float32))
-            if self._device is not None:
+            if self.scale_sharding is not None:
+                scales = jax.device_put(scales, self.scale_sharding)
+            elif self._device is not None:
                 scales = jax.device_put(scales, self._device)
             self.scales = scales
         return caches
@@ -700,7 +735,7 @@ class BlockPool:
                 crc = zlib.crc32(raw, crc)
                 entry[name] = base64.b64encode(raw).decode("ascii")
             blocks.append(entry)
-        return {
+        out = {
             "version": 1,
             "dtype": str(jnp.dtype(self._dtype)),
             "quantized": self.quantized,
@@ -712,6 +747,16 @@ class BlockPool:
             "checksum": crc,
             "generation": self.generation,
         }
+        if self.tp > 1:
+            # Shard-geometry stamp (gated: absent = 1, so pre-TP chains
+            # and TP=1 lanes keep today's wire bytes). KV written under
+            # different SPMD partitionings differs in low-order bits, so
+            # a cross-degree import would resume a stream on bytes its
+            # destination could never have produced — refused BY NAME
+            # (chain_compatible), and the caller's replay fallback
+            # recomputes instead.
+            out["tp"] = self.tp
+        return out
 
     def chain_compatible(self, chain: dict) -> Optional[str]:
         """None when ``chain`` can be imported into THIS pool verbatim;
@@ -741,6 +786,18 @@ class BlockPool:
             if chain.get(key) != val:
                 return (f"chain {key}={chain.get(key)!r} does not match "
                         f"destination pool {key}={val!r}")
+        try:
+            chain_tp = int(chain.get("tp", 1))
+        except (TypeError, ValueError):
+            return f"chain tp={chain.get('tp')!r} is not an integer"
+        if chain_tp != self.tp:
+            # Mismatched shard geometry refuses BY NAME (never by an
+            # accidental byte mismatch): KV computed under a different
+            # tensor-parallel partitioning is not this lane's stream
+            # history bit-for-bit — the replay resume recomputes it.
+            return (f"chain tp={chain_tp} does not match destination "
+                    f"pool tp={self.tp} (tensor-parallel shard "
+                    f"geometry)")
         slots = self.cfg.n_layers * self.block_size * self.cfg.kv_heads
         payload_len = slots * self.cfg.d_head \
             * jnp.zeros((), self._dtype).dtype.itemsize
@@ -905,6 +962,14 @@ class BlockPool:
                 "radix_lookups": self.radix_lookups,
                 "radix_hits": self.radix_hits,
             }
+            if self.tp > 1:
+                # Additive, present ONLY in tensor-parallel pools
+                # (defaults-off /stats and /health bytes identical):
+                # the shard geometry plus the per-DEVICE block cost —
+                # the number the equal-per-device-HBM A/B provisions by.
+                out["tp"] = self.tp
+                out["bytes_per_block_per_device"] = (
+                    self.bytes_per_block() // self.tp)
             if self.quantized:
                 # Additive, present ONLY in quantized pools (defaults-off
                 # /stats and /health bytes stay byte-identical).

@@ -73,6 +73,7 @@ from tpu_engine.models.transformer import (
     transformer_prefill,
     transformer_step_rows_ragged,
 )
+from tpu_engine.ops.attention import KVCache
 from tpu_engine.runtime.generator import (
     _DTYPES,
     _sample,
@@ -261,6 +262,8 @@ class ContinuousGenerator:
         spec_draft_model=None,
         spec_draft_params=None,
         state_rows: int = 0,
+        tp: int = 1,
+        tp_devices=None,
     ):
         """`kv_block_size` > 0 switches the KV cache from one dense
         (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
@@ -312,6 +315,22 @@ class ContinuousGenerator:
         chunk width) so per-tick latency stays bounded; 0 = auto
         (prefill_chunk). Seeded streams are byte-identical to the dense
         and two-path paged schedulers (tested).
+
+        `tp` > 1 (paged kv_paged family only) serves the model
+        TENSOR-PARALLEL over a 1-axis ``model`` mesh of that many
+        devices (the first `tp` local devices, or `tp_devices`):
+        params place by the registry-declared partition rule
+        (models.registry.tp_shardings — heads-axis QKV/MLP up,
+        row-parallel wo/proj, replicated norms/embeddings), the block
+        pool shards its H_kv axis (scale arrays alongside on int8
+        pools), and every pool-donating executable pins its pool
+        outputs to the same sharding, so each tick stays ONE SPMD
+        ragged dispatch with donation intact. Greedy streams are
+        byte-identical to the tp=1 arm on this backend (tested; logits
+        agree to ~1e-6 — the same empirical basis as the mixed-vs-dense
+        stream identity). Unshardable families (state_slab — the
+        mamba2 conv tail/slab) refuse loudly; `device` is mutually
+        exclusive with `tp`.
 
         `spec_k` > 0 (paged layouts only — two-path AND mixed) turns on
         CONTINUOUS SPECULATIVE DECODING: each tick a host-side drafter
@@ -383,9 +402,52 @@ class ContinuousGenerator:
         self._prompt_buckets = tuple(sorted(
             {min(int(p), self.max_seq) for p in prompt_buckets}))
         self._device = device
+        # Tensor-parallel serving (DESIGN.md "Tensor-parallel serving"):
+        # fences first — every misconfiguration is a LOUD error naming
+        # the contract, never a silently single-device lane.
+        self._tp = int(tp)
+        self._tp_mesh = None
+        self._kv_pin = None     # pool payloads' NamedSharding pin
+        self._scale_pin = None  # ... and the int8 scale arrays'
+        if self._tp > 1:
+            if device is not None:
+                raise ValueError(
+                    "tp > 1 builds its own device mesh; `device` is "
+                    "mutually exclusive with tensor-parallel serving")
+            from tpu_engine.models.registry import tp_unshardable_reason
+
+            if self._slab:
+                reason = (tp_unshardable_reason(model)
+                          or "the state_slab family declares no "
+                             "shardable heads axis")
+                raise RuntimeError(
+                    f"model '{model.name}' cannot serve "
+                    f"tensor-parallel (tp={self._tp}): {reason}")
+            if int(kv_block_size) <= 0:
+                raise ValueError(
+                    "tp > 1 requires the paged KV cache "
+                    "(set kv_block_size > 0): the dense per-slot cache "
+                    "has no sharded pool layout")
+            reason = tp_unshardable_reason(model)
+            if reason is not None:
+                raise RuntimeError(
+                    f"model '{model.name}' cannot serve "
+                    f"tensor-parallel (tp={self._tp}): {reason}")
+            from tpu_engine.parallel.mesh import tp_mesh
+
+            self._tp_mesh = tp_mesh(self._tp, tp_devices)
         self.params = params if params is not None else model.init(
             jax.random.PRNGKey(rng_seed))
-        if device is not None:
+        if self._tp_mesh is not None:
+            # Registry-declared placement: heads-axis QKV/MLP up,
+            # row-parallel wo/proj, replicated norms/embeddings — the
+            # scheduler never re-derives partition specs per call site.
+            from tpu_engine.models.registry import tp_shardings
+
+            self.params = jax.device_put(
+                self.params, tp_shardings(model, self.params,
+                                          self._tp_mesh))
+        elif device is not None:
             self.params = jax.device_put(self.params, device)
 
         # Device state: one persistent KV cache + per-row vectors. Paged
@@ -472,7 +534,14 @@ class ContinuousGenerator:
                                  "(the host tier holds radix entries)")
             self._pool = BlockPool(self.cfg, nb, bs, self._dtype, device,
                                    host_blocks=int(kv_host_blocks),
-                                   quantize=str(kv_quantize))
+                                   quantize=str(kv_quantize),
+                                   mesh=self._tp_mesh)
+            if self._tp > 1:
+                # Pool-output pins for every donating executable: the
+                # output sharding must EQUAL the input's or donation is
+                # wasted (and XLA free to re-lay the pool per tick).
+                self._kv_pin = self._pool.kv_sharding
+                self._scale_pin = self._pool.scale_sharding
             self._tables = np.zeros((self.n_slots, width), np.int32)
             self._row_blocks: List[List[int]] = [[] for _ in
                                                  range(self.n_slots)]
@@ -831,6 +900,25 @@ class ContinuousGenerator:
 
     # -- paged compiled stages -------------------------------------------------
 
+    def _pin_pool_out(self, caches, scales=None):
+        """TRACED helper for the pool-donating executables: constrain
+        their pool (and scale) outputs to the pool's tensor-parallel
+        sharding, so output sharding provably equals input sharding —
+        donation holds and XLA never re-lays the pool mid-serve.
+        Identity when tp == 1 (the compiled programs are unchanged
+        byte-for-byte). Also pins prefix-gather row caches: their H_kv
+        axis shares the same 5-dim spec."""
+        if self._kv_pin is None:
+            return caches if scales is None else (caches, scales)
+        wsc = jax.lax.with_sharding_constraint
+        caches = KVCache(wsc(caches.k, self._kv_pin),
+                         wsc(caches.v, self._kv_pin))
+        if scales is None:
+            return caches
+        scales = KVCache(wsc(scales.k, self._scale_pin),
+                         wsc(scales.v, self._scale_pin))
+        return caches, scales
+
     def _gather(self, nb: int):
         """Prefix gather for one bucket width: (pool, nb block ids) ->
         the row's (L, 1, nb*bs, H, D) cache view. Read-only on the pool
@@ -846,6 +934,14 @@ class ContinuousGenerator:
                                            dtype=self._dtype)
                 else:
                     fn = gather_blocks
+                if self._kv_pin is not None:
+                    # TP: the gathered row cache keeps the pool's H_kv
+                    # sharding, so the prefill windows that consume it
+                    # compile SPMD over the same mesh.
+                    base = fn
+
+                    def fn(*args, _base=base):
+                        return self._pin_pool_out(_base(*args))
                 exe = self._gather_exe.setdefault(nb, jax.jit(fn))
         return exe
 
@@ -859,12 +955,22 @@ class ContinuousGenerator:
         if exe is None:
             with self._exe_lock:
                 if self._quant:
+                    fn = scatter_blocks_quant
+                    if self._kv_pin is not None:
+                        def fn(caches, scales, row_k, row_v, ids):
+                            out_c, out_s = scatter_blocks_quant(
+                                caches, scales, row_k, row_v, ids)
+                            return self._pin_pool_out(out_c, out_s)
                     exe = self._scatter_exe.setdefault(
-                        nb, jax.jit(scatter_blocks_quant,
-                                    donate_argnums=(0, 1)))
+                        nb, jax.jit(fn, donate_argnums=(0, 1)))
                 else:
+                    fn = scatter_blocks
+                    if self._kv_pin is not None:
+                        def fn(caches, row_k, row_v, ids):
+                            return self._pin_pool_out(scatter_blocks(
+                                caches, row_k, row_v, ids))
                     exe = self._scatter_exe.setdefault(
-                        nb, jax.jit(scatter_blocks, donate_argnums=(0,)))
+                        nb, jax.jit(fn, donate_argnums=(0,)))
         return exe
 
     def _decode_paged(self, controls: bool):
@@ -941,6 +1047,14 @@ class ContinuousGenerator:
                         state += (counts,)
                     state, toks = jax.lax.scan(body, state, None,
                                                length=chunk)
+                    # TP: pin the donated pool (and scales) outputs to
+                    # the pool sharding (no-op when tp == 1).
+                    if quant:
+                        pc, ps = self._pin_pool_out(state[0], state[1])
+                        state = (pc, ps) + state[2:]
+                    else:
+                        state = (self._pin_pool_out(state[0]),) \
+                            + state[1:]
                     return state + (toks.T,)
 
                 # Donation-friendly positional signatures: the quantized
@@ -1046,6 +1160,11 @@ class ContinuousGenerator:
                     if controls:
                         done = done | (live & jnp.any(
                             nxt[:, None] == stops, axis=1))
+                    if quant:
+                        caches, scales = self._pin_pool_out(caches,
+                                                            scales)
+                    else:
+                        caches = self._pin_pool_out(caches)
                     out = (caches,) + ((scales,) if quant else ())
                     out += (nxt, done)
                     if controls:
@@ -1218,6 +1337,11 @@ class ContinuousGenerator:
                         new_done = new_done | stop_j
                         alive = alive & ~stop_j & chain
                     out = jnp.stack(emitted, axis=1)          # (B, S)
+                    if quant:
+                        caches, scales = self._pin_pool_out(caches,
+                                                            scales)
+                    else:
+                        caches = self._pin_pool_out(caches)
                     res = (caches,) + ((scales,) if quant else ())
                     res += (out, n_emit, n_acc, new_done)
                     if controls:
@@ -1911,6 +2035,14 @@ class ContinuousGenerator:
                 round(spec["emitted_tokens"] / spec["row_ticks"], 3)
                 if spec["row_ticks"] else None)
             out["spec"] = spec
+        if self._tp > 1:
+            # Additive, present ONLY on tensor-parallel lanes
+            # (defaults-off /stats and /health bytes stay identical):
+            # the mesh-shape label the topology-aware gateway ring
+            # reads from /health.
+            from tpu_engine.parallel.mesh import tp_topology_label
+
+            out["tp"] = tp_topology_label(self._tp)
         if self._paged:
             out["kv_pool"] = self._pool.stats()
             out["kv_pool"]["pending_admissions"] = \

@@ -247,7 +247,16 @@ def serve_combined(
             raise ValueError(
                 f"lanes={lanes} cannot serve {len(models)} models — "
                 f"later-listed models would silently get no lane")
-        n_lanes = lanes or max(len(devices), len(models))
+        tp = int(getattr(worker_config, "tp", 1) or 1) \
+            if worker_config is not None else 1
+        if tp > 1:
+            # Tensor-parallel lanes each span a tp-device mesh slice:
+            # the default fleet is devices // tp lanes, not one per
+            # chip (the "lanes are chips" rule becomes "virtual nodes
+            # are chips" — the gateway ring weights them that way).
+            n_lanes = lanes or max(1, len(devices) // tp, len(models))
+        else:
+            n_lanes = lanes or max(len(devices), len(models))
         if lane_roles and lanes and lanes < len(lane_roles):
             raise ValueError(
                 f"lanes={lanes} cannot honor {len(lane_roles)} lane "
@@ -260,6 +269,13 @@ def serve_combined(
                     "model": models[i % len(models)]}
             if lane_roles:
                 over["role"] = lane_roles[i % len(lane_roles)]
+            if tp > 1:
+                # Disjoint mesh slices per lane (round-robin when an
+                # explicit --lanes oversubscribes): lane i owns devices
+                # [i*tp, (i+1)*tp) — without this every lane would
+                # stack its mesh on devices [0, tp).
+                n_slices = max(1, len(devices) // tp)
+                over["tp_device_offset"] = (i % n_slices) * tp
             lane_cfg = WorkerConfig(**{**cfg.__dict__, **over})
             from tpu_engine.runtime.engine import InferenceEngine
 

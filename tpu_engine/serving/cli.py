@@ -115,6 +115,15 @@ def main(argv=None) -> int:
                                  "mamba2: one fixed-size row per live "
                                  "stream, constant in sequence length; "
                                  "0/unset = auto)")
+        parser.add_argument("--tp", type=int, default=None,
+                            help="tensor-parallel serving: shard the "
+                                 "model (registry-declared partition "
+                                 "rule) and the paged KV pool's H_kv "
+                                 "axis over this many local devices — "
+                                 "one SPMD ragged dispatch per tick; "
+                                 "needs --kv-block-size; unshardable "
+                                 "families (mamba2) refuse at startup "
+                                 "(unset/1 = single-device)")
         parser.add_argument("--step-chunk", type=int, default=None,
                             help="decode chunk length per dispatch")
         parser.add_argument("--prefill-chunk", type=int, default=None,
@@ -184,6 +193,8 @@ def main(argv=None) -> int:
             gen_kw["gen_kv_quantize"] = args.kv_quantize
         if args.state_rows is not None:
             gen_kw["gen_state_rows"] = args.state_rows
+        if args.tp is not None:
+            gen_kw["tp"] = args.tp
         if args.step_chunk is not None:
             gen_kw["gen_step_chunk"] = args.step_chunk
         if args.prefill_chunk is not None:
@@ -585,6 +596,19 @@ def main(argv=None) -> int:
                                  "independent of sequence length, "
                                  "bench.py --scenario recurrent-ab. "
                                  "0 = auto: decode slots + 1)")
+        parser.add_argument("--tp", type=int, default=None,
+                            help="tensor-parallel serving (needs "
+                                 "--kv-block-size): every lane serves "
+                                 "the model sharded over this many "
+                                 "local devices on a `model`-axis mesh "
+                                 "— registry-declared param placement, "
+                                 "H_kv-sharded KV pool, one SPMD "
+                                 "ragged dispatch per tick (bench.py "
+                                 "--scenario tp-ab); default lane "
+                                 "count becomes devices//tp; "
+                                 "unshardable families (mamba2) "
+                                 "refuse at startup (unset/1 = "
+                                 "single-device lanes)")
         parser.add_argument("--prefix-affinity", action="store_true",
                             help="gateway: route /generate(+/stream) on a "
                                  "block-aligned prompt-prefix fingerprint "
@@ -750,6 +774,8 @@ def main(argv=None) -> int:
             bb_kw["batch_timeout_ms"] = args.batch_timeout_ms
         if args.max_queue_depth is not None:
             bb_kw["max_queue_depth"] = args.max_queue_depth
+        if args.tp is not None:
+            bb_kw["tp"] = args.tp
         if args.scheduler_stall_s is not None:
             bb_kw["scheduler_stall_s"] = args.scheduler_stall_s
         if args.priority_admission:

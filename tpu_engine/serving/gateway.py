@@ -325,6 +325,18 @@ class Gateway:
         # `kv_handoff` marker span. The role map lives under self._lock.
         self.handoff = HandoffCounters()
         self._roles: Dict[str, str] = {}
+        # Topology-aware ring (DESIGN.md "Tensor-parallel serving" —
+        # the AoiZora placement framing): per-lane mesh-shape labels
+        # ({tp, mesh_shape, devices}, absent = one chip) discovered
+        # from worker config (local lanes), the disagg role-discovery
+        # /health read, or prober sweeps (HTTP lanes). A labelled lane
+        # weights its VIRTUAL NODES by device count on every ring —
+        # the ring hashes over chips, not lanes, so a TP=4 lane beside
+        # TP=1 lanes draws 4x the hash share (it holds 4x the KV pool
+        # at equal per-device HBM). Unlabelled fleets keep the
+        # reference-exact ring byte-for-byte. Lives under self._lock.
+        self._topology: Dict[str, dict] = {}
+        self._topology_updates = 0  # re-weights applied (info counter)
         # Prefix-affinity routing (DESIGN.md "Prefix-affinity routing"):
         # decisions counted here; per-lane assignment totals and the
         # recent-dispatch window (imbalance signal) under self._lock.
@@ -376,9 +388,39 @@ class Gateway:
 
     # -- membership (elastic; reference ring was fixed at launch) ------------
 
+    @staticmethod
+    def _normalize_topology(topo) -> Optional[dict]:
+        """A /health (or worker-config) topology label -> the canonical
+        {tp, devices} dict, or None for unlabelled/one-chip lanes (the
+        absent-key default — rings stay reference-exact)."""
+        if not isinstance(topo, dict):
+            return None
+        try:
+            devices = int(topo.get("devices", topo.get("tp", 1)))
+            tp = int(topo.get("tp", devices))
+        except (TypeError, ValueError):
+            # Malformed labels normalize to "one chip", never raise: a
+            # probe-path exception here would read as a FAILED health
+            # probe and eject a perfectly healthy lane.
+            return None
+        if devices <= 1:
+            return None
+        out = {"tp": tp, "devices": devices}
+        if isinstance(topo.get("mesh_shape"), dict):
+            out["mesh_shape"] = dict(topo["mesh_shape"])
+        return out
+
+    def _lane_weight(self, name: str) -> int:
+        """Virtual-node weight for a lane: its labelled device count
+        (topology-aware ring), 1 when unlabelled."""
+        with self._lock:
+            topo = self._topology.get(name)
+        return int(topo["devices"]) if topo else 1
+
     def add_worker(self, worker) -> str:
         model_name = None
         role = "both"
+        topo = None
         if isinstance(worker, str):
             client = HttpWorkerClient(
                 worker,
@@ -388,12 +430,17 @@ class Gateway:
             )
             name = client.url
             if self.config.disagg:
-                # Role discovery for HTTP lanes (URLs carry no
-                # metadata): one best-effort /health read — absent key
-                # or an unreachable lane reads "both", today's
-                # behavior. Only paid when disagg is on.
+                # Role (and topology) discovery for HTTP lanes (URLs
+                # carry no metadata): one best-effort /health read —
+                # absent keys or an unreachable lane read "both" on one
+                # chip, today's behavior. Only paid when disagg is on;
+                # plain HTTP fleets pick their topology labels up from
+                # the health prober's sweeps instead.
                 try:
-                    role = str(client.health().get("role", "both"))
+                    health = client.health()
+                    role = str(health.get("role", "both"))
+                    topo = self._normalize_topology(
+                        health.get("topology"))
                 except Exception:
                     role = "both"
         else:
@@ -401,20 +448,28 @@ class Gateway:
             name = worker.node_id
             spec = getattr(getattr(worker, "engine", None), "spec", None)
             model_name = getattr(spec, "name", None)
-            role = str(getattr(getattr(worker, "config", None), "role",
-                               "both") or "both")
+            cfg = getattr(worker, "config", None)
+            role = str(getattr(cfg, "role", "both") or "both")
+            tp = int(getattr(cfg, "tp", 1) or 1)
+            if tp > 1:
+                from tpu_engine.parallel.mesh import tp_topology_label
+
+                topo = self._normalize_topology(tp_topology_label(tp))
         if role not in ("prefill", "decode", "both"):
             role = "both"
+        weight = int(topo["devices"]) if topo else 1
         with self._lock:
             self._clients[name] = client
             self._breakers[name] = self._make_breaker()
             if role != "both":
                 self._roles[name] = role
+            if topo is not None:
+                self._topology[name] = topo
             if model_name is None:
                 self._untyped.add(name)
-        self._ring.add_node(name)
+        self._ring.add_node(name, weight)
         if role != "decode":
-            self._prefill_ring.add_node(name)
+            self._prefill_ring.add_node(name, weight)
         if model_name is not None:
             with self._lock:
                 ring = self._model_rings.get(model_name)
@@ -422,13 +477,56 @@ class Gateway:
                     # Populate BEFORE publishing: a concurrent _route must
                     # never see an empty ring for a registered model.
                     ring = ConsistentHash(self.config.virtual_nodes)
-                    ring.add_node(name)
+                    ring.add_node(name, weight)
                     self._model_rings[model_name] = ring
                 else:
-                    ring.add_node(name)
+                    ring.add_node(name, weight)
                 if self.default_model is None:
                     self.default_model = model_name
         return name
+
+    def _apply_topology(self, name: str, topo) -> None:
+        """Adopt a lane's freshly-discovered topology label (prober
+        sweeps: an HTTP lane's /health is the only place its mesh shape
+        exists) and re-weight its virtual nodes on every ring it is a
+        member of. No-op while the label is unchanged — steady-state
+        sweeps touch nothing."""
+        topo = self._normalize_topology(topo)
+        with self._lock:
+            if name not in self._clients:
+                return
+            prev = self._topology.get(name)
+            if topo == prev:
+                return
+            if topo is None:
+                self._topology.pop(name, None)
+            else:
+                self._topology[name] = topo
+            rings = [ring for ring in self._model_rings.values()
+                     if name in ring.get_all_nodes()]
+        weight = int(topo["devices"]) if topo else 1
+        # ConsistentHash self-locks; resize outside the gateway lock.
+        if name in self._ring.get_all_nodes():
+            self._ring.add_node(name, weight)
+        if name in self._prefill_ring.get_all_nodes():
+            self._prefill_ring.add_node(name, weight)
+        for ring in rings:
+            ring.add_node(name, weight)
+        with self._lock:
+            present = name in self._clients
+            if present:
+                self._topology_updates += 1
+        if not present:
+            # remove_worker raced this re-weight and our add_node calls
+            # may have resurrected the lane's vnodes on the captured
+            # rings: undo them — a ghost lane with no client entry must
+            # never own a hash share.
+            self._ring.remove_node(name)
+            self._prefill_ring.remove_node(name)
+            for ring in rings:
+                ring.remove_node(name)
+            with self._lock:
+                self._topology.pop(name, None)
 
     def _make_breaker(self):
         """Native breaker when the C++ core is loaded — the native HTTP
@@ -474,7 +572,13 @@ class Gateway:
                     # one (HTTP lanes): probes must never contend with
                     # data traffic for pool slots.
                     probe = getattr(client, "probe_health", client.health)
-                    ok = bool(probe().get("healthy", False))
+                    body = probe()
+                    ok = bool(body.get("healthy", False))
+                    # Topology labels ride the same read: an HTTP lane's
+                    # mesh shape exists nowhere but its /health, so the
+                    # prober is where TP=4 lanes pick up their per-chip
+                    # vnode weight (no-op while the label is unchanged).
+                    self._apply_topology(name, body.get("topology"))
                 except Exception:
                     ok = False  # unreachable = failed probe
                 action = self._probe_state.record(name, ok)
@@ -555,6 +659,7 @@ class Gateway:
             self._untyped.discard(name)
             self._ejected.discard(name)
             self._roles.pop(name, None)
+            self._topology.pop(name, None)
         # A later lane reusing the name must start with clean probe state.
         self._probe_state.forget(name)
         for ring in rings.values():
@@ -1400,11 +1505,12 @@ class Gateway:
                 self._roles.pop(name, None)
             else:
                 self._roles[name] = role
-        # Prefill-ring membership follows the role (idempotent ops).
+        # Prefill-ring membership follows the role (idempotent ops);
+        # re-entry keeps the lane's topology vnode weight.
         if role == "decode":
             self._prefill_ring.remove_node(name)
         elif name not in self._prefill_ring.get_all_nodes():
-            self._prefill_ring.add_node(name)
+            self._prefill_ring.add_node(name, self._lane_weight(name))
         self._handoff_count("role_flips", lane=name, role=role)
         return {"ok": True, "node_id": name, "role": role,
                 "drained": drained}
@@ -2428,6 +2534,22 @@ class Gateway:
                 ho["roles"] = {n: self._roles.get(n, "both")
                                for n in sorted(self._clients)}
             out["handoff"] = ho
+        # Additive "topology" block (topology-aware ring), present only
+        # once any lane carries a mesh-shape label — an all-single-chip
+        # fleet's /stats stays byte-identical. Reports each labelled
+        # lane's mesh shape plus every lane's vnode weight, so an
+        # operator can see exactly how the ring maps chips.
+        with self._lock:
+            topo = dict(self._topology)
+            topo_updates = self._topology_updates
+            lanes = sorted(self._clients)
+        if topo:
+            out["topology"] = {
+                "lanes": topo,
+                "ring_weights": {n: max(1, self._ring.node_weight(n))
+                                 for n in lanes},
+                "updates": topo_updates,
+            }
         # Additive "affinity" block (prefix-affinity routing), same
         # gating discipline: a defaults-only /stats stays byte-identical.
         if self.config.prefix_affinity or self.affinity.any_nonzero():

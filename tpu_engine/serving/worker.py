@@ -359,6 +359,31 @@ class WorkerNode:
                 f"model "
                 f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
                 f" serves the {model_family or 'kv_paged'} family")
+        # Tensor-parallel serving fences (the registry declares the
+        # partition rule; the worker refuses misconfigurations LOUDLY —
+        # an operator who asked for a sharded lane must never get a
+        # silently single-device one, and an unshardable family must
+        # never be heuristically mis-sharded).
+        if int(self.config.tp) < 1:
+            raise RuntimeError(f"--tp must be >= 1, got {self.config.tp}")
+        if int(self.config.tp) > 1:
+            # Unshardable family first: the pinned per-model refusal
+            # (e.g. mamba2's conv tail/state slab) outranks the generic
+            # knob-combination message.
+            from tpu_engine.models.registry import tp_unshardable_reason
+
+            reason = tp_unshardable_reason(self.engine.spec)
+            if reason is not None:
+                raise RuntimeError(
+                    f"model "
+                    f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
+                    f" cannot serve tensor-parallel (--tp "
+                    f"{self.config.tp}): {reason}")
+            if not self._continuous or self.config.gen_kv_block_size <= 0:
+                raise RuntimeError(
+                    "--tp requires the continuous scheduler with the "
+                    "paged KV cache (--kv-block-size > 0): the sharded "
+                    "pool layout is the paged pool")
         if self.config.role not in ("prefill", "decode", "both"):
             raise RuntimeError(
                 f"--role must be prefill|decode|both, got "
@@ -415,7 +440,15 @@ class WorkerNode:
                             self.config.gen_mixed_token_budget),
                         state_rows=self.config.gen_state_rows,
                         **self._continuous_spec_kwargs(),
-                        device=getattr(engine, "_device", None))
+                        # TP lanes build their own mesh over THIS
+                        # lane's device slice (tp_device_offset keeps
+                        # in-process TP lanes on disjoint chips); the
+                        # engine's single-device pin is mutually
+                        # exclusive.
+                        tp=int(self.config.tp),
+                        tp_devices=self._tp_devices(),
+                        device=(None if int(self.config.tp) > 1
+                                else getattr(engine, "_device", None)))
                     # Per-tick mixed_step spans land in the lane's ring.
                     self.generator.tracer = self.tracer
                     self.generator.trace_node = self.node_id
@@ -561,6 +594,27 @@ class WorkerNode:
                 "beam_width is deterministic: temperature/top_p/top_k/"
                 "min_p/repetition_penalty/stop_tokens do not apply")
 
+
+    def _tp_devices(self):
+        """This lane's tensor-parallel device slice: ``tp`` devices
+        starting at ``tp_device_offset`` (combined mode hands each
+        in-process lane a disjoint slice; standalone workers keep
+        offset 0 = the first tp devices). None when tp == 1. A slice
+        running past the local devices is a loud startup error —
+        silently wrapping would stack two lanes on one chip."""
+        tp = int(self.config.tp)
+        if tp <= 1:
+            return None
+        import jax
+
+        off = int(self.config.tp_device_offset)
+        devices = jax.devices()
+        if off < 0 or off + tp > len(devices):
+            raise RuntimeError(
+                f"--tp {tp} at device offset {off} needs devices "
+                f"[{off}, {off + tp}) but only {len(devices)} local "
+                f"device(s) exist")
+        return devices[off:off + tp]
 
     _AUTO_DRAFT = {"gpt2": "distilgpt2", "gpt2-small-test": "gpt2-small-test"}
 
@@ -1850,6 +1904,15 @@ class WorkerNode:
             # fleet's /health stays byte-identical (absent key = "both"
             # — the gateway's role discovery reads it that way).
             out["role"] = self.config.role
+        if int(self.config.tp) > 1:
+            # Additive topology label (absent key = one chip — the
+            # gateway's topology-aware ring reads it that way): this
+            # lane spans a `model`-axis mesh slice of tp devices, so
+            # its virtual nodes should carry a per-chip weight instead
+            # of one lane == one chip.
+            from tpu_engine.parallel.mesh import tp_topology_label
+
+            out["topology"] = tp_topology_label(self.config.tp)
         # Additive (reference schema untouched — its parsers ignore extra
         # keys): decode-lane scheduler counters for transformer workers.
         if self.generator is not None and hasattr(self.generator, "stats"):

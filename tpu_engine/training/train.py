@@ -76,13 +76,18 @@ def make_train_step(
 # -- sharding rules -----------------------------------------------------------
 
 def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
-    """Tensor-parallel placement heuristic for param pytrees built from
-    `tpu_engine.ops.nn`: 2-D dense kernels shard their output-feature dim
-    over `axis`; matching biases shard too; everything else replicates.
+    """Tensor-parallel placement heuristic for generic param pytrees:
+    2-D+ dense kernels shard their output-feature dim over `axis`;
+    matching biases shard too; everything else replicates.
 
-    XLA then runs each dense as a local matmul producing the local shard of
-    the features — the all-gather (or reduce-scatter in the backward pass)
-    is inserted automatically where a replicated tensor is needed.
+    This is the registry's ``"dense_output"`` TP rule — the rank
+    heuristic now lives in ``models.registry.TP_RULES`` as capability
+    metadata (every registered model declares its rule; the serving
+    path resolves through ``registry.tp_shardings`` so transformer
+    families get the named Megatron-style layout and unshardable
+    families a pinned refusal). This wrapper keeps the training CLI's
+    public surface: arbitrary trees (optimizer states, conv stacks)
+    place by rank.
 
     Weight-quantized trees (ops.quant) are REFUSED loudly: the sharding
     rules were written for full-precision "kernel" leaves, and an int8
@@ -90,26 +95,9 @@ def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
     along mismatched axes (or silently replicate) — the documented
     contract is one or the other per deployment.
     """
-    from tpu_engine.ops.quant import tree_is_quantized
+    from tpu_engine.models.registry import TP_RULES
 
-    if tree_is_quantized(params):
-        raise RuntimeError(
-            "shard_params_tp cannot place a weight-quantized param tree "
-            "(ops.quant kernel_q/wi_q leaves): the TP sharding rules "
-            "target full-precision kernels and would leave quantized "
-            "trees replicated or mis-sharded. Use int8 quantization OR "
-            "tensor-parallel sharding per deployment, not both.")
-    msize = mesh.shape[axis]
-
-    def spec_for(leaf):
-        shape = getattr(leaf, "shape", ())
-        if len(shape) >= 2 and shape[-1] % msize == 0:
-            return P(*([None] * (len(shape) - 1)), axis)
-        if len(shape) == 1 and shape[0] % msize == 0 and shape[0] > 1:
-            return P(axis)
-        return P()
-
-    return jax.tree.map(lambda l: NamedSharding(mesh, spec_for(l)), params)
+    return TP_RULES["dense_output"](params, mesh, axis)
 
 
 def replicated_tree(params, mesh: Mesh):

@@ -139,6 +139,22 @@ class WorkerConfig:
     # "KV capacity" knob. 0 = auto (gen_max_batch_size + the null row).
     # Loud RuntimeError on a kv_paged model (--state-rows).
     gen_state_rows: int = 0
+    # Tensor-parallel serving (--tp; DESIGN.md "Tensor-parallel
+    # serving"): the continuous scheduler serves ONE model sharded over
+    # this many local devices on a 1-axis `model` mesh — params place by
+    # the registry-declared partition rule (heads-axis QKV/MLP,
+    # replicated norms/embeddings), the paged KV pool shards its H_kv
+    # axis, and every tick stays one SPMD ragged dispatch. Requires the
+    # continuous scheduler with the paged KV cache; unshardable families
+    # (mamba2/state_slab) refuse loudly at startup. 1 (default) =
+    # today's single-device path, wire-byte-identical.
+    tp: int = 1
+    # First local-device index of this lane's tp-device mesh slice
+    # (combined mode assigns lane i offset i*tp so in-process TP lanes
+    # own DISJOINT chip slices instead of all stacking on devices
+    # [0, tp)). Must leave tp devices past it; standalone workers
+    # (one lane per process) keep the default 0.
+    tp_device_offset: int = 0
     # Admission control (resilience layer): maximum concurrently admitted
     # requests on this lane; excess is shed with 503 + Retry-After instead
     # of queueing unboundedly. 0 = unbounded (reference behavior).
