@@ -3288,6 +3288,215 @@ def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
     return results
 
 
+def run_elastic_ab(model: str = "gpt2-chaos-test",
+                   max_lanes: int = 4, quick: bool = False) -> dict:
+    """Elastic fleet A/B (DESIGN.md "Elastic fleet"): the SAME diurnal
+    trace — a Poisson burst, then a sparse trough — served by a static
+    ``max_lanes`` fleet vs the ``--autoscale`` closed loop starting from
+    one lane (in-process lanes; InProcessLaneProvider spawns and retires
+    scheduler instances live, retirements drain through the PR 11
+    stream-migration ladder).
+
+    The headline is LANE-SECONDS — the integral of live lane count over
+    the run, the capacity bill a fleet actually pays — at EQUAL
+    completion: both arms must finish every stream, and every stream's
+    tokens must be identical across arms (growth, drain, and migration
+    may never touch stream content). Bar: the elastic arm completes the
+    trace on provably fewer lane-seconds than the static arm; it must
+    also have actually ridden the loop (scaled up to >= 3 lanes inside
+    the burst, back down to 1 in the trough) rather than winning by
+    standing still, with fleet counters == fleet marker spans.
+
+    Uses gpt2-chaos-test (not gpt2-small-test): the loop steers by slot
+    occupancy, and the tiny model drains bursts faster than a 4 Hz
+    control loop can sample them. Runs on the CPU mesh (control-plane
+    property, not a model-size property); on-chip rerun pending like
+    r06-r10."""
+    import random
+    import threading
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.autoscaler import InProcessLaneProvider
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.serving.resilience import FleetCounters
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+
+    _ensure_builtin_models_imported()
+    spec = create_model(model, max_seq=128)
+    params = spec.init(jax.random.PRNGKey(0))
+    n_burst = 12 if quick else 24
+    n_trough = 4 if quick else 6
+    requests = []
+    for k in range(n_burst + n_trough):
+        params_k = {}
+        if k % 3 == 1:
+            params_k = {"temperature": 0.9, "seed": 400 + k}
+        requests.append({
+            "request_id": f"eb_{k}",
+            "prompt_tokens": [(k * 5 + j) % 90 + 1
+                              for j in range(5 + k % 3)],
+            "max_new_tokens": 48 if k < n_burst else 16,
+            **params_k})
+
+    def make_lane(name: str) -> WorkerNode:
+        cfg = WorkerConfig(node_id=name, model=model,
+                           gen_scheduler="continuous",
+                           gen_max_batch_size=8, gen_step_chunk=2,
+                           gen_kv_block_size=16, gen_kv_blocks=48,
+                           gen_prefill_chunk=16, gen_prefix_cache_mb=0)
+        engine = InferenceEngine(spec, params=params, dtype="float32")
+        return WorkerNode(cfg, engine=engine)
+
+    def run_arm(elastic: bool) -> dict:
+        lanes = ([make_lane("el_seed")] if elastic
+                 else [make_lane(f"st_{i}") for i in range(max_lanes)])
+        retired: list = []
+        if elastic:
+            gw = Gateway(lanes, GatewayConfig(
+                autoscale=True, autoscale_interval_s=0.25,
+                autoscale_min_lanes=1, autoscale_max_lanes=max_lanes,
+                autoscale_up_pressure=0.30,
+                autoscale_down_pressure=0.20,
+                autoscale_cooldown_s=0.5,
+                autoscale_spawn_timeout_s=60.0,
+                migrate_streams=True, failover_streams=True))
+            provider = InProcessLaneProvider(
+                lambda idx: make_lane(f"el_{idx}"),
+                on_retire=retired.append)
+            gw.engage_autoscaler(provider=provider)
+        else:
+            gw = Gateway(lanes, GatewayConfig())
+
+        results: dict = {}
+        lock = threading.Lock()
+        samples: list = []
+        stop_sampling = threading.Event()
+
+        def sampler():
+            while not stop_sampling.wait(0.2):
+                samples.append((time.monotonic(),
+                                len(gw.worker_names())))
+
+        def consume(req):
+            toks, final = [], None
+            try:
+                for frame in gw.route_generate_stream(dict(req)):
+                    evt = _parse_sse(frame)
+                    if evt is None:
+                        continue
+                    if evt.get("done"):
+                        final = evt
+                        break
+                    if "tokens" in evt:
+                        toks.extend(evt["tokens"])
+            except Exception as exc:
+                final = {"harness_exception": str(exc)}
+            with lock:
+                results[req["request_id"]] = (toks, final)
+
+        t0 = time.monotonic()
+        samples.append((t0, len(gw.worker_names())))
+        sam = threading.Thread(target=sampler, daemon=True)
+        sam.start()
+        rng = random.Random(23)
+        threads = []
+        for i, req in enumerate(requests):
+            t = threading.Thread(target=consume, args=(req,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            if i == n_burst - 1:
+                time.sleep(6.0)         # the trough opens
+            elif i < n_burst:
+                time.sleep(rng.expovariate(8.0))
+            else:
+                time.sleep(rng.expovariate(0.3))
+        for t in threads:
+            t.join(timeout=600)
+        if elastic:
+            # Let the loop settle back to min-lanes — those lane-seconds
+            # stay on the elastic arm's bill (the sampler keeps running).
+            settle = time.monotonic() + 20.0
+            while (len(gw.worker_names()) > 1
+                   and time.monotonic() < settle):
+                time.sleep(0.2)
+        t1 = time.monotonic()
+        stop_sampling.set()
+        sam.join(timeout=5)
+        samples.append((t1, len(gw.worker_names())))
+        lane_seconds = sum((samples[i + 1][0] - samples[i][0])
+                           * samples[i][1]
+                           for i in range(len(samples) - 1))
+        lane_counts = [n for _, n in samples]
+        fl = dict(gw.get_stats().get("fleet", {}))
+        spans = [s for s in gw.tracer.snapshot() if s["op"] == "fleet"]
+        counters_match = (len(spans) == sum(
+            fl.get(f, 0) for f in FleetCounters.SPAN_FIELDS))
+        completed = sum(1 for toks, final in results.values()
+                        if final and final.get("done")
+                        and "error" not in final)
+        tokens = {rid: final.get("tokens") if final else None
+                  for rid, (toks, final) in results.items()}
+        gw.stop()
+        for w in lanes + retired:
+            try:
+                w.stop()
+            except Exception:
+                pass
+        return {"wall_s": round(t1 - t0, 2),
+                "lane_seconds": round(lane_seconds, 2),
+                "completed": completed,
+                "peak_lanes": max(lane_counts),
+                "final_lanes": lane_counts[-1],
+                "fleet": fl, "counters_match_spans": counters_match,
+                "tokens": tokens}
+
+    log(f"elastic-ab: static arm ({max_lanes} lanes, "
+        f"{len(requests)} streams)")
+    static = run_arm(elastic=False)
+    record_partial("elastic_ab_static", {
+        k: v for k, v in static.items() if k != "tokens"})
+    log(f"elastic-ab: elastic arm (1..{max_lanes} lanes, closed loop)")
+    elastic = run_arm(elastic=True)
+    record_partial("elastic_ab_elastic", {
+        k: v for k, v in elastic.items() if k != "tokens"})
+
+    n = len(requests)
+    identical = sum(
+        1 for rid in static["tokens"]
+        if static["tokens"][rid] is not None
+        and static["tokens"][rid] == elastic["tokens"].get(rid))
+    checks = {
+        "static_completed_all": static["completed"] == n,
+        "elastic_completed_all": elastic["completed"] == n,
+        "tokens_identical_across_arms": identical == n,
+        "elastic_fewer_lane_seconds":
+            elastic["lane_seconds"] < static["lane_seconds"],
+        "elastic_scaled_up": elastic["peak_lanes"] >= 3,
+        "elastic_scaled_back_down": elastic["final_lanes"] == 1,
+        "fleet_counters_match_spans": elastic["counters_match_spans"],
+    }
+    out = {
+        "model": model, "streams": n,
+        "static": {k: v for k, v in static.items() if k != "tokens"},
+        "elastic": {k: v for k, v in elastic.items() if k != "tokens"},
+        "identical_across_arms": identical,
+        "lane_seconds_saved": round(
+            static["lane_seconds"] - elastic["lane_seconds"], 2),
+        "lane_seconds_ratio": round(
+            elastic["lane_seconds"] / max(static["lane_seconds"], 1e-9),
+            4),
+        "checks": checks,
+        "checks_passed": all(checks.values()),
+    }
+    return out
+
+
 def probe_device(timeout_s: float = 240.0, attempts: int = 3,
                  retry_sleep_s: float = 90.0) -> None:
     """Device-liveness preflight in a SUBPROCESS. The axon tunnel, when
@@ -3434,7 +3643,7 @@ def _main() -> int:
                              "miss-sweep", "paged-ab", "mixed-ab",
                              "crash-ab", "drain-ab", "affinity-ab",
                              "overload-ab", "quant-ab", "disagg-ab",
-                             "recurrent-ab", "tp-ab"],
+                             "recurrent-ab", "tp-ab", "elastic-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -3563,6 +3772,25 @@ def _main() -> int:
             "unit": "tokens",
             "vs_baseline": result["replay_off"][
                 "reprefill_tokens_replayed"],
+            **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "elastic-ab":
+        # Elastic fleet A/B: in-process lanes on the host backend (the
+        # capacity bill under a diurnal trace is the variable under
+        # test, not the chip).
+        result = run_elastic_ab(model=(args.model if args.model
+                                       != "resnet50"
+                                       else "gpt2-chaos-test"),
+                                quick=args.quick)
+        record_partial("elastic_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "elastic_lane_seconds_ratio",
+            "value": result["lane_seconds_ratio"], "unit": "x",
+            "vs_baseline": 1.0,
+            "lane_seconds_saved": result["lane_seconds_saved"],
             **result,
         })
         return 0 if result["checks_passed"] else 1

@@ -27,7 +27,7 @@ _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
 #             --spec-parity step 9, --quant-parity step 10,
 #             --ssd-parity step 11, --tp-parity step 12, --failover
 #             step 13, --migrate step 14, --disagg step 15,
-#             --overload step 16, --lint step 17
+#             --overload step 16, --elastic step 17, --lint step 18
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -138,6 +138,13 @@ def main() -> int:
                          "(in-flight gauge, tier/rate-limit sheds, "
                          "pressure) and every lane's current brownout "
                          "ladder stage from /health")
+    ap.add_argument("--elastic", action="store_true",
+                    help="step 17: elastic-fleet state of the live "
+                         "system — the gateway's /admin/fleet status "
+                         "(membership, named degraded states like "
+                         "spawn-wedged/drain-wedged, controller "
+                         "engagement, last observed fleet pressure) "
+                         "and the decision counters")
     ap.add_argument("--lint", action="store_true",
                     help="step 17: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
@@ -149,7 +156,8 @@ def main() -> int:
               + int(args.spec_parity) + int(args.quant_parity)
               + int(args.ssd_parity) + int(args.tp_parity)
               + int(args.failover) + int(args.migrate)
-              + int(args.disagg) + int(args.overload) + int(args.lint))
+              + int(args.disagg) + int(args.overload)
+              + int(args.elastic) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -690,6 +698,41 @@ def main() -> int:
                  "(" + "; ".join(parts) + ")")
         except Exception as exc:
             step(n, "overload control state", False, f"({exc})")
+
+    # 17 (--elastic): elastic-fleet state of the live system — the
+    # /admin/fleet status surface: membership, NAMED degraded states
+    # (spawn-wedged / drain-wedged), whether the closed loop is
+    # engaged, the last observed fleet pressure, and the decision
+    # counters. A static fleet answers too (controller unstarted,
+    # counters zero) — that is the defaults-off wire-compat check.
+    if args.elastic:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + int(args.overload) + 1)
+        try:
+            status, fleet = _post(gw, "/admin/fleet",
+                                  {"action": "status"})
+            parts = [f"state {fleet.get('state')}",
+                     f"{len(fleet.get('lanes') or [])} lanes",
+                     "autoscale "
+                     + ("on" if fleet.get("autoscale") else "off")]
+            if fleet.get("pressure") is not None:
+                parts.append(f"pressure {fleet['pressure']}")
+            ctr = fleet.get("counters") or {}
+            acted = {k: v for k, v in ctr.items() if v}
+            parts.append("decisions " + (", ".join(
+                f"{k}={v}" for k, v in sorted(acted.items()))
+                or "none yet"))
+            for lane, reason in sorted(
+                    (fleet.get("degraded") or {}).items()):
+                parts.append(f"DEGRADED {lane}:{reason}")
+            step(n, "elastic fleet state",
+                 status == 200 and bool(fleet.get("ok")),
+                 "(" + "; ".join(parts) + ")")
+        except Exception as exc:
+            step(n, "elastic fleet state", False, f"({exc})")
 
     # 12 (--lint): the engine-lint suite, in-process — the same gate
     # tier-1 runs (tests/test_engine_lint.py), surfaced here so an

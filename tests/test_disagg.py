@@ -330,6 +330,35 @@ def test_admin_role_flip_rebalances_routing(fleet):
         g.stop()
 
 
+def test_admin_role_flip_migration_failure_restores_lane(fleet):
+    """A role flip whose migration leg dies must RESTORE the lane: the
+    named error comes back, admissions reopen (lane not draining), and
+    BOTH the worker config and the gateway role map keep the pre-flip
+    role — no half-applied flip stranding a draining member."""
+    g = Gateway(fleet, GatewayConfig(disagg=True, migrate_streams=True))
+    try:
+        def _boom(name, client):
+            raise RuntimeError("journal wedged")
+
+        g._migrate_lane_streams = _boom
+        r = g.set_worker_role("w2", "prefill")
+        assert r["ok"] is False
+        assert "migration leg failed" in r["error"]
+        assert not fleet[2].draining          # admissions restored
+        assert fleet[2].config.role == "decode"
+        assert g.get_stats()["handoff"]["roles"]["w2"] == "decode"
+        assert g._disagg_split() is not None  # decode side still live
+        # The lane still serves: a stream routed through the fleet
+        # completes after the failed flip.
+        toks, fin = consume(g, {"request_id": "rf1",
+                                "prompt_tokens": PROMPT,
+                                "max_new_tokens": 4})
+        assert len(toks) == 4 and fin["node_id"]
+    finally:
+        fleet[2].config.role = "decode"
+        g.stop()
+
+
 @pytest.mark.slow
 def test_disagg_handoff_under_concurrency(fleet, gw):
     """A burst of concurrent disagg streams all splice byte-identically

@@ -554,6 +554,68 @@ def test_http_client_maps_503_kinds(http_worker):
         w.undrain()
 
 
+# -- drain/undrain idempotency: named statuses, never errors ------------------
+
+def test_drain_undrain_idempotent_named_statuses():
+    """Double-drain, undrain-of-idle, and undrain-while-draining all
+    answer NAMED no-op statuses — an autoscaler retrying a timed-out
+    drain ack (or an operator double-submit) must never see an error."""
+    from tpu_engine.serving.worker import WorkerNode
+
+    w = WorkerNode(WorkerConfig(node_id="dd1", model="mlp", dtype="float32",
+                                batch_buckets=(1, 2)))
+    try:
+        assert w.drain() == "draining"
+        assert w.drain() == "already-draining"
+        assert w.undrain() == "undrained"
+        assert w.undrain() == "not-draining"
+        # undrain-while-draining round-trips cleanly back to serving
+        assert w.drain() == "draining"
+        assert w.undrain() == "undrained"
+        out = w.handle_infer({"request_id": "dd-x", "input_data": [1.0]})
+        assert out["node_id"] == "dd1"
+    finally:
+        w.stop()
+
+
+def test_http_drain_double_submit_reports_named_status(http_worker):
+    w, s = http_worker
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "drain"})
+    assert st == 200 and body["status"] == "draining"
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "drain"})
+    assert st == 200 and body["status"] == "already-draining"
+    assert body["draining"] is True
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "undrain"})
+    assert st == 200 and body["status"] == "undrained"
+    st, body, _ = _post(f"http://localhost:{s.port}/admin/drain",
+                        {"action": "undrain"})
+    assert st == 200 and body["status"] == "not-draining"
+    assert body["draining"] is False
+
+
+def test_combined_drain_unknown_lane_is_named_not_error():
+    """Draining a lane that is not a member (retired between the
+    operator's read and this call) is a 200 with a named status, not a
+    404 — scale-down retries must be able to treat it as done."""
+    from tpu_engine.serving.app import serve_combined
+
+    gateway, workers, server = serve_combined(model="mlp", lanes=1,
+                                              port=0, background=True)
+    try:
+        st, body, _ = _post(f"http://localhost:{server.port}/admin/drain",
+                            {"node": "worker_99", "action": "drain"})
+        assert st == 200
+        assert body == {"ok": False, "status": "unknown-lane",
+                        "node": "worker_99"}
+    finally:
+        server.stop()
+        for wk in workers:
+            wk.stop()
+
+
 # -- multihost lockstep: abandoned items --------------------------------------
 
 def test_lockstep_abandoned_item_never_burns_a_row():

@@ -114,6 +114,7 @@ _RECEIVER_ALIASES = {
     "self.overload": "OverloadCounters",
     "self.migration": "MigrationCounters",
     "self.handoff": "HandoffCounters",
+    "self.fleet": "FleetCounters",
     "self._tenant_bucket": "TenantRateLimiter",
     "self._shed_stats": "SheddingStats",
     "self._aimd": "AIMDLimit",
@@ -175,14 +176,16 @@ ENGINE_REGISTRY = Registry(
             receivers=("spool", "self._spool")),
         # Gateway membership / routing state (+ the overload-control
         # in-flight gauge the tier fractions admit against, + the
-        # disaggregated-serving role map).
+        # disaggregated-serving role map, + the elastic-fleet controller
+        # maps: named degraded states and the published pressure gauge).
         GuardedEntry(
             attrs=("_clients", "_breakers", "_ejected", "_model_rings",
                    "_untyped", "_latency", "_lane_recent",
                    "_affinity_assigned", "_hedge_pool", "default_model",
                    "_total_requests", "_failovers", "_inflight",
                    "_streams", "_roles", "_topology",
-                   "_topology_updates"),
+                   "_topology_updates", "_fleet_degraded",
+                   "_fleet_pressure"),
             lock="Gateway._lock",
             classes=("Gateway",)),
         # Consistent-hash ring internals (vnode map + per-node topology
@@ -250,6 +253,17 @@ ENGINE_REGISTRY = Registry(
             module="tpu_engine.runtime.scheduler",
             entries=("ContinuousGenerator._loop",),
             thread="continuous-decode"),
+        # Elastic-fleet control loop: the actuation cooldown stamp and
+        # the rebalance hysteresis arm belong to the controller thread
+        # alone — the manual /admin/fleet actuators (scale_up /
+        # scale_down / rebalance) are deliberately stateless so they
+        # never touch these from HTTP handler threads.
+        ThreadOwnedEntry(
+            attrs=("_last_action_ts", "_rebalance_armed"),
+            owner_class="FleetAutoscaler",
+            module="tpu_engine.serving.autoscaler",
+            entries=("FleetAutoscaler._run",),
+            thread="fleet-autoscaler"),
     ),
     # BlockPool/RadixTree methods document "caller holds the pool lock":
     # the analyzer checks their CALL sites instead of their bodies.
@@ -257,10 +271,12 @@ ENGINE_REGISTRY = Registry(
                              "StateSlabPool.*",
                              "TenantRateLimiter._evict_idle",
                              "SheddingStats._gc",
-                             "ConsistentHash._drop_labels"}),
+                             "ConsistentHash._drop_labels",
+                             "ConsistentHash._resize_locked"}),
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
-                                 "overload", "migration", "handoff"}),
+                                 "overload", "migration", "handoff",
+                                 "fleet"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

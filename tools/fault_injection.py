@@ -81,6 +81,24 @@ prefill lane MID-HANDOFF and the adopted stream's decode lane MID-ADOPT
 — both land on the replay fallback byte-identically with zero leaks on
 the survivors.
 
+``--elastic`` runs the STANDALONE elastic-fleet chaos scenario
+(DESIGN.md "Elastic fleet"): two member + two warm-standby worker
+processes behind an ``--autoscale`` gateway, driven through a diurnal
+ramp — the closed loop must DOUBLE the fleet under Poisson stream load
+(standbys join only after a passing /health probe) and HALVE it back at
+low pressure with every retired lane drained through live stream
+migration; every stream (greedy AND seeded) completes byte-identical to
+an unkilled control with zero block leaks on every pool. Then the wedge
+ladder: a scale-up at a dead address latches the NAMED ``spawn-wedged``
+state and a member kill -9ed mid-drain latches ``drain-wedged`` — both
+degraded-but-SERVING (a control stream completes through each), both
+cleared via ``/admin/fleet``. Fleet counters == fleet marker spans
+throughout.
+
+``--all`` runs every standalone scenario above in sequence, each in its
+own interpreter, and prints one JSON summary; exit is nonzero when any
+scenario's check fails.
+
 Usage:
   python3 tools/fault_injection.py [--port 8000] [--victim worker_1]
       [--requests-per-phase 60] [--breaker-timeout 2.0] [--slow-lane]
@@ -89,6 +107,8 @@ Usage:
   python3 tools/fault_injection.py --crash
   python3 tools/fault_injection.py --quant
   python3 tools/fault_injection.py --disagg
+  python3 tools/fault_injection.py --elastic
+  python3 tools/fault_injection.py --all
 Start the server first, with a short breaker timeout so phase 3 is quick:
   python -m tpu_engine.serving.cli serve --model mlp --lanes 3 \
       --port 8000 --breaker-timeout 2
@@ -2493,6 +2513,402 @@ def run_overload_standalone() -> int:
             proc.kill()
 
 
+def _fleet_counters_match_spans(gw) -> bool:
+    from tpu_engine.serving.resilience import FleetCounters
+
+    fl = gw.get_stats().get("fleet", {})
+    expect = sum(fl.get(f, 0) for f in FleetCounters.SPAN_FIELDS)
+    spans = [s for s in gw.tracer.snapshot() if s["op"] == "fleet"]
+    return len(spans) == expect
+
+
+def elastic_phase(ports, procs, checks: list) -> dict:
+    """Elastic-fleet chaos (--elastic). A diurnal ramp against the live
+    closed loop: 2 member lanes + 2 warm standbys behind an --autoscale
+    gateway. The high phase drives Poisson stream load past the up
+    threshold and the fleet must DOUBLE (probe-gated standby
+    registration); the low phase runs a trickle feeder that keeps ONE
+    pinned long stream per live lane so pressure settles below the down
+    threshold while every lane still holds a journaled stream — the
+    fleet must HALVE back to min-lanes with retirements drained through
+    live stream migration. Every stream
+    (greedy AND seeded) must complete byte-identical to an unkilled
+    control, zero blocks leaked anywhere. Then the wedge ladder: a
+    scale-up aimed at a dead address latches ``spawn-wedged``; a member
+    kill -9ed mid-drain latches ``drain-wedged`` — both NAMED
+    degraded-but-serving states the fleet keeps serving through, both
+    clearable via /admin/fleet. Fleet counters == fleet marker spans
+    throughout."""
+    import random
+    import signal
+    import threading
+
+    from tpu_engine.serving.autoscaler import StandbyLaneProvider
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    member_ports, standby_ports = ports[:2], ports[2:4]
+    gw = Gateway([f"127.0.0.1:{p}" for p in member_ports],
+                 GatewayConfig(autoscale=True,
+                               autoscale_interval_s=0.25,
+                               autoscale_min_lanes=2,
+                               autoscale_max_lanes=4,
+                               autoscale_up_pressure=0.30,
+                               autoscale_down_pressure=0.20,
+                               autoscale_cooldown_s=0.5,
+                               autoscale_spawn_timeout_s=5.0,
+                               failover_streams=True,
+                               migrate_streams=True,
+                               migrate_timeout_s=60.0))
+
+    # ---- compile warmup: every lane (members AND standbys) serves one
+    # tiny stream first. A cold worker's first generate blocks /health
+    # behind the compile, which the controller correctly treats as a
+    # BLIND lane and holds — this scenario tests the loop's steering,
+    # not cold-start compile latency. ------------------------------------
+    def _warm(port):
+        try:
+            _call(port, "POST", "/generate",
+                  {"request_id": f"warm_{port}",
+                   "prompt_tokens": [3, 1, 4], "max_new_tokens": 4},
+                  timeout=600)
+        except Exception:
+            pass
+    warmers = [threading.Thread(target=_warm, args=(p,), daemon=True)
+               for p in ports[:4]]
+    for t in warmers:
+        t.start()
+    for t in warmers:
+        t.join(timeout=600)
+
+    # ---- the diurnal waves (built before the loop starts) ---------------
+    # Request ids are mined per member lane (the FNV-1a ring is skewed;
+    # an unmined burst can land almost entirely on one lane and read as
+    # half the fleet pressure it should).
+    member_lanes = sorted(gw.worker_names())
+    high = []
+    for k in range(16):
+        params = {}
+        if k % 3 == 1:
+            params = {"temperature": 0.9, "seed": 700 + k}
+        elif k % 3 == 2:
+            params = {"temperature": 0.8, "seed": 800 + k,
+                      "top_p": 0.9, "repetition_penalty": 1.2}
+        high.append({"request_id": rid_for_lane(
+                         gw._ring, member_lanes[k % 2], f"hi{k}"),
+                     "prompt_tokens": [(k * 7 + j) % 90 + 1
+                                       for j in range(5 + k % 4)],
+                     "max_new_tokens": 32, **params})
+    try:
+        control = control_oracle(ports[0], high)
+    except RuntimeError as exc:
+        checks.append(("elastic: control generate", False))
+        gw.stop()
+        return {"error": str(exc)}
+
+    results: dict = {}
+    lock = threading.Lock()
+    threads: list = []
+
+    def consume(req):
+        toks, final = [], None
+        try:
+            for frame in gw.route_generate_stream(dict(req)):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    final = evt
+                    break
+                if "tokens" in evt:
+                    toks.extend(evt["tokens"])
+        except Exception as exc:
+            final = {"harness_exception": str(exc)}
+        with lock:
+            results[req["request_id"]] = (toks, final)
+
+    def fire(reqs, rate, rng):
+        for req in reqs:
+            t = threading.Thread(target=consume, args=(req,), daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(rng.expovariate(rate))
+
+    def wait_lane_count(target, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(gw.worker_names()) == target:
+                return True
+            time.sleep(0.1)
+        return False
+
+    provider = StandbyLaneProvider(
+        [f"127.0.0.1:{p}" for p in standby_ports])
+    ctl = gw.engage_autoscaler(provider=provider)
+    checks.append(("elastic: controller loop running", ctl.running))
+
+    # ---- high phase: the ramp up ----------------------------------------
+    # The burst must saturate both member lanes long enough for pressure
+    # to outlive the actuation cooldown and force BOTH probe-gated
+    # registrations (hence gpt2-chaos-test: multi-second stream lives).
+    rng = random.Random(17)
+    fire(high, rate=12.0, rng=rng)
+    doubled = wait_lane_count(4, timeout=120.0)
+    checks.append(("elastic: fleet doubled under load (2 -> 4 lanes, "
+                   "probe-gated standby registration)", doubled))
+
+    # ---- low phase: a trickle feeder keeps one pinned long stream per
+    # live lane, so once the burst drains, pressure sits below the down
+    # threshold while every lane still holds a journaled stream — each
+    # retirement must ride live migration, never an idle-lane removal. --
+    low: list = []
+    feed_stop = threading.Event()
+
+    def feeder():
+        for rnd in range(60):
+            if feed_stop.is_set():
+                return
+            round_reqs = []
+            for j, lane in enumerate(sorted(gw.worker_names())):
+                try:
+                    rid = rid_for_lane(gw._ring, lane, f"lo{rnd}_{j}")
+                except RuntimeError:
+                    continue  # lane left the ring mid-build
+                params = {} if (rnd + j) % 2 == 0 else \
+                    {"temperature": 0.9, "seed": 900 + rnd * 8 + j}
+                round_reqs.append(
+                    {"request_id": rid,
+                     "prompt_tokens": [(rnd * 11 + j * 3 + m) % 90 + 1
+                                       for m in range(6)],
+                     "max_new_tokens": 96, **params})
+            with lock:
+                low.extend(round_reqs)
+            round_threads = []
+            for req in round_reqs:
+                t = threading.Thread(target=consume, args=(req,),
+                                     daemon=True)
+                t.start()
+                round_threads.append(t)
+            for t in round_threads:
+                t.join(timeout=600)
+
+    feed_thread = None
+    if doubled:
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+    halved = wait_lane_count(2, timeout=180.0)
+    checks.append(("elastic: fleet halved at low pressure (4 -> 2 lanes "
+                   "through the drain+migrate ladder)", halved))
+    feed_stop.set()
+    if feed_thread is not None:
+        feed_thread.join(timeout=600)
+    ctl.stop()
+
+    for t in threads:
+        t.join(timeout=600)
+    # The feeder's control runs AFTER the wave (the oracle is
+    # deterministic, so when it runs does not matter) — computing it
+    # inline would open pressure gaps mid-descent. The oracle worker
+    # may have been drained by a ramp-down retirement, so undrain it
+    # first (idempotent).
+    try:
+        _call(ports[0], "POST", "/admin/drain", {"action": "undrain"},
+              timeout=30)
+    except Exception:
+        pass
+    try:
+        control.update(control_oracle(ports[0], low))
+    except RuntimeError:
+        checks.append(("elastic: low-phase control generate", False))
+        low = [r for r in low if r["request_id"] in control]
+    wave = high + low
+    complete, identical, _resumed = tally_streams(
+        {r["request_id"]: results[r["request_id"]] for r in wave}, control)
+    checks.append(("elastic: all ramp streams completed "
+                   f"({complete}/{len(wave)})", complete == len(wave)))
+    checks.append(("elastic: all ramp streams byte-identical to control, "
+                   f"greedy and seeded ({identical}/{len(wave)})",
+                   identical == len(wave)))
+    fl = gw.get_stats().get("fleet", {})
+    mig = gw.get_stats().get("migration", {})
+    checks.append(("elastic: >= 2 probe-gated registrations "
+                   f"({fl.get('scale_up_completed', 0)})",
+                   fl.get("scale_up_completed", 0) >= 2))
+    checks.append(("elastic: >= 2 graceful retirements "
+                   f"({fl.get('scale_down_completed', 0)})",
+                   fl.get("scale_down_completed", 0) >= 2))
+    checks.append(("elastic: scale-down rode live stream migration "
+                   f"({mig.get('streams_migrated', 0)} migrated)",
+                   mig.get("streams_migrated", 0) >= 1))
+    checks.append(("elastic: suppressed decisions counted as held "
+                   f"({fl.get('decisions_held', 0)})",
+                   fl.get("decisions_held", 0) >= 1))
+    ramp = {"streams": len(wave), "complete": complete,
+            "identical": identical, "fleet": dict(fl),
+            "migration": dict(mig),
+            "lanes_after_ramp": sorted(gw.worker_names())}
+
+    # ---- wedge ladder: named degraded-but-serving states ----------------
+    # (manual actuations on the STOPPED controller — same ladder.)
+    res = gw.fleet_admin({"action": "add", "worker": "127.0.0.1:1"})
+    checks.append(("elastic: dead-address spawn lands spawn-wedged "
+                   f"({res.get('status')})",
+                   res.get("status") == "spawn-wedged"))
+    st = gw.fleet_status()
+    checks.append(("elastic: fleet state names the wedge "
+                   f"({st['state']})", "spawn-wedged" in st["state"]))
+
+    def still_serving(tag, port_hint):
+        req = {"request_id": tag,
+               "prompt_tokens": [3, 1, 4, 1, 5], "max_new_tokens": 8}
+        try:
+            ctl_toks = control_oracle(port_hint, [req])[tag]
+            toks, final = [], None
+            for frame in gw.route_generate_stream(dict(req)):
+                evt = _parse_sse(frame)
+                if evt is None:
+                    continue
+                if evt.get("done"):
+                    final = evt
+                    break
+                if "tokens" in evt:
+                    toks.extend(evt["tokens"])
+            return stream_completed(final) and toks == ctl_toks
+        except Exception:
+            return False
+
+    live_ports = [p for i, p in enumerate(ports[:4])
+                  if procs[i].poll() is None]
+    serving_port = next(p for p in live_ports
+                        if any(l.endswith(f":{p}")
+                               for l in gw.worker_names()))
+    checks.append(("elastic: fleet serves through spawn-wedged",
+                   still_serving("wz_spawn", serving_port)))
+    res = gw.fleet_admin({"action": "clear", "worker": "127.0.0.1:1"})
+    checks.append(("elastic: spawn wedge clears via /admin/fleet",
+                   res.get("status") == "cleared"))
+
+    # kill -9 a member mid-drain: the drain call dies, membership still
+    # shrinks, drain-wedged latches as a durable operator signal.
+    victim = sorted(gw.worker_names())[0]
+    victim_port = next(p for p in ports if victim.endswith(f":{p}"))
+    procs[ports.index(victim_port)].send_signal(signal.SIGKILL)
+    procs[ports.index(victim_port)].wait(timeout=10)
+    res = gw.fleet_admin({"action": "remove", "worker": victim})
+    checks.append(("elastic: kill -9 mid-drain lands removed-degraded "
+                   f"({res.get('status')})",
+                   res.get("status") == "removed-degraded"))
+    st = gw.fleet_status()
+    checks.append(("elastic: drain wedge latched and named "
+                   f"({st['state']})", "drain-wedged" in st["state"]
+                   and victim not in st["lanes"]))
+    survivor_port = next(p for p in ports
+                         if gw.worker_names()[0].endswith(f":{p}"))
+    checks.append(("elastic: fleet serves through drain-wedged",
+                   still_serving("wz_drain", survivor_port)))
+    res = gw.fleet_admin({"action": "clear", "worker": victim})
+    checks.append(("elastic: drain wedge clears only via /admin/fleet",
+                   res.get("status") == "cleared"
+                   and gw.fleet_status()["state"] == "steady"))
+    # Idempotency of the manual surface: named no-ops, never errors.
+    checks.append(("elastic: re-add of a member answers already-member",
+                   gw.fleet_admin({"action": "add",
+                                   "worker": gw.worker_names()[0]}
+                                  ).get("status") == "already-member"))
+    checks.append(("elastic: re-remove answers unknown-lane",
+                   gw.fleet_admin({"action": "remove", "worker": victim}
+                                  ).get("status") == "unknown-lane"))
+    checks.append(("elastic: double clear answers not-degraded",
+                   gw.fleet_admin({"action": "clear", "worker": victim}
+                                  ).get("status") == "not-degraded"))
+
+    checks.append(("elastic: fleet counters == fleet marker spans",
+                   _fleet_counters_match_spans(gw)))
+    leak_free = {}
+    for p in ports[:4]:
+        if procs[ports.index(p)].poll() is not None:
+            continue  # the kill -9 victim
+        pool = _worker_pool_clean(p)
+        leak_free[p] = pool is not None
+        checks.append((f"elastic: zero KV blocks leaked on :{p}",
+                       pool is not None))
+    fleet_final = dict(gw.get_stats().get("fleet", {}))
+    gw.stop()
+    return {"ramp": ramp, "fleet_final": fleet_final,
+            "leak_free": leak_free, "killed": victim}
+
+
+def run_elastic_standalone() -> int:
+    # gpt2-chaos-test, not gpt2-small-test: the autoscaler steers by lane
+    # pressure, and the tiny model drains a burst faster than the 4 Hz
+    # control loop can observe it (slots never stay occupied).
+    ports, procs = launch_worker_procs(4, model="gpt2-chaos-test",
+                                       extra_args=("--kv-blocks", "80"))
+    checks: list = []
+    try:
+        report = {"mode": "elastic-standalone", "worker_ports": ports,
+                  "phases": {"elastic": elastic_phase(ports, procs,
+                                                      checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def run_all_standalone() -> int:
+    """--all: every standalone chaos scenario in sequence, each in its
+    own interpreter (a wedged scenario cannot poison the next), one JSON
+    summary on stdout, nonzero exit when ANY scenario's check fails."""
+    flags = ("--mixed", "--spec", "--crash", "--offload", "--quant",
+             "--migrate", "--disagg", "--recurrent", "--tp",
+             "--overload", "--elastic")
+    here = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    summary: dict = {"mode": "all-standalone", "scenarios": {}}
+    rc_all = 0
+    for flag in flags:
+        t0 = time.monotonic()
+        verdict: dict = {}
+        try:
+            proc = subprocess.run([sys.executable, here, flag],
+                                  capture_output=True, text=True,
+                                  env=env, timeout=3600)
+            verdict["rc"] = proc.returncode
+            try:
+                # The scenario's stdout is its JSON report; keep the
+                # verdict + the failing check names, not the transcript.
+                rep = json.loads(proc.stdout[proc.stdout.index("{"):])
+                verdict["passed"] = bool(rep.get("passed"))
+                verdict["failed_checks"] = [
+                    k for k, v in (rep.get("checks") or {}).items()
+                    if not v]
+            except (ValueError, KeyError):
+                verdict["passed"] = proc.returncode == 0
+                verdict["stdout_tail"] = proc.stdout[-400:]
+        except subprocess.TimeoutExpired:
+            verdict = {"rc": None, "passed": False, "error": "timeout"}
+        verdict["seconds"] = round(time.monotonic() - t0, 1)
+        if not verdict["passed"]:
+            rc_all = 1
+        summary["scenarios"][flag.lstrip("-")] = verdict
+        print(f"[all] {flag.lstrip('-')}: "
+              f"{'ok' if verdict['passed'] else 'FAIL'} "
+              f"({verdict['seconds']}s)", file=sys.stderr)
+    summary["passed"] = rc_all == 0
+    print(json.dumps(summary, indent=2))
+    return rc_all
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=8000)
@@ -2610,7 +3026,31 @@ def main() -> int:
                          "engages and clears in order, counters == "
                          "marker spans, and zero KV blocks leak; "
                          "ignores the other flags")
+    ap.add_argument("--elastic", action="store_true",
+                    help="standalone elastic-fleet scenario: spawns 2 "
+                         "member + 2 standby worker processes behind an "
+                         "--autoscale gateway and runs a diurnal ramp — "
+                         "the fleet must double under load (probe-gated "
+                         "standby registration) and halve back at low "
+                         "pressure through the drain+migrate ladder with "
+                         "every stream (greedy AND seeded) completing "
+                         "byte-identical to control and zero block "
+                         "leaks; then a dead-address spawn and a kill -9 "
+                         "mid-drain must land in the NAMED spawn-wedged "
+                         "/ drain-wedged degraded states with the fleet "
+                         "still serving; fleet counters == fleet spans "
+                         "throughout; ignores the other flags")
+    ap.add_argument("--all", action="store_true",
+                    help="run EVERY standalone chaos scenario in "
+                         "sequence, each in its own interpreter, and "
+                         "print one JSON summary; exit nonzero when any "
+                         "scenario's check fails; ignores the other "
+                         "flags")
     args = ap.parse_args()
+    if args.all:
+        return run_all_standalone()
+    if args.elastic:
+        return run_elastic_standalone()
     if args.tp:
         return run_tp_standalone()
     if args.disagg:

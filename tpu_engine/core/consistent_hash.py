@@ -66,17 +66,38 @@ class ConsistentHash:
         labels arrive)."""
         weight = max(1, int(weight))
         with self._lock:
-            prev = self._weights.get(node, 0)
-            if weight < prev:
-                self._drop_labels(node, range(weight * self._virtual_nodes,
-                                              prev * self._virtual_nodes))
-            for i in range(prev * self._virtual_nodes,
-                           weight * self._virtual_nodes):
-                h = fnv1a_32(f"{node}#{i}")
-                if h not in self._ring:
-                    bisect.insort(self._sorted_hashes, h)
-                self._ring[h] = node
-            self._weights[node] = weight
+            self._resize_locked(node, self._weights.get(node, 0), weight)
+
+    def reweight_node(self, node: str, weight: int) -> bool:
+        """Resize an EXISTING node's vnode set to ``weight`` — the
+        membership check and the resize happen under ONE lock
+        acquisition, so a concurrent ``remove_node`` can never
+        interleave between them (the add+weight churn race the
+        topology prober previously had to detect and undo by hand:
+        check-then-``add_node`` could resurrect a just-removed lane's
+        vnodes). Returns False — ring untouched — when the node is not
+        a member."""
+        weight = max(1, int(weight))
+        with self._lock:
+            prev = self._weights.get(node)
+            if prev is None:
+                return False
+            self._resize_locked(node, prev, weight)
+            return True
+
+    def _resize_locked(self, node: str, prev: int, weight: int) -> None:
+        """Grow or shrink ``node``'s vnode set from ``prev`` to
+        ``weight`` labels x virtual_nodes (caller holds the lock)."""
+        if weight < prev:
+            self._drop_labels(node, range(weight * self._virtual_nodes,
+                                          prev * self._virtual_nodes))
+        for i in range(prev * self._virtual_nodes,
+                       weight * self._virtual_nodes):
+            h = fnv1a_32(f"{node}#{i}")
+            if h not in self._ring:
+                bisect.insort(self._sorted_hashes, h)
+            self._ring[h] = node
+        self._weights[node] = weight
 
     def _drop_labels(self, node: str, label_range) -> None:
         """Erase this node's vnodes for label indices in ``label_range``

@@ -92,6 +92,23 @@ def make_gpt2_small(seq_len: int = 16, vocab: int = 256, n_layers: int = 2,
     return _spec_from_config("gpt2-small-test", cfg, seq_len)
 
 
+@register("gpt2-chaos-test")
+def make_gpt2_chaos(seq_len: int = 16, vocab: int = 1024, n_layers: int = 4,
+                    d_model: int = 256, n_heads: int = 8, d_ff: int = 1024,
+                    max_seq: int = 128) -> ModelSpec:
+    """Mid-size config for load/elastic chaos harnesses: big enough that
+    CPU decode takes real wall time per token (so slot occupancy is an
+    observable, samplable control signal and streams have multi-second
+    lifetimes), small enough to compile and serve in CI. gpt2-small-test
+    drains a full burst faster than a 4 Hz control loop can sample it —
+    useless for autoscaler/overload scenarios; this one is deliberately
+    ~100x more compute per token."""
+    cfg = TransformerConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, max_seq=max_seq,
+                            causal=True)
+    return _spec_from_config("gpt2-chaos-test", cfg, seq_len)
+
+
 @register("gpt2-moe")
 def make_gpt2_moe(seq_len: int = 128, vocab: int = 50257, n_layers: int = 12,
                   d_model: int = 768, n_heads: int = 12, d_ff: int = 3072,

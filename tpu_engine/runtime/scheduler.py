@@ -723,6 +723,13 @@ class ContinuousGenerator:
         self._bo_budget_frac = 1.0   # mixed-step token budget multiplier
         self._bo_spec_off = False    # suspend speculative drafting
         self._bo_defer_swap = False  # defer host-tier swap-ins
+        # Drain visibility (elastic fleet): set by the worker's
+        # drain/undrain, read by stats() to surface how much live work
+        # a lame-duck lane still holds (the autoscaler's scale-down
+        # watch). Plain GIL-atomic bool, same discipline as the
+        # brownout flags above; False at defaults keeps /health and
+        # /stats bytes identical.
+        self._draining_flag = False
         # Liveness: stamped at the top of every decode-loop iteration.
         # The loop iterates continuously even when idle (bounded admission
         # waits), so a growing age means the loop is WEDGED — inside a
@@ -1998,6 +2005,14 @@ class ContinuousGenerator:
         self._bo_spec_off = bool(suspend_spec)
         self._bo_defer_swap = bool(defer_swap_in)
 
+    def set_draining(self, draining: bool) -> None:
+        """Mark the lane lame-duck (worker drain/undrain): stats() adds
+        a ``drain_pressure`` gauge — live rows over slots — while set,
+        the signal the elastic-fleet controller watches to see a
+        retiring lane empty out. Routing/admission are the worker's
+        job; the scheduler only reports."""
+        self._draining_flag = bool(draining)
+
     def _effective_mixed_budget(self) -> int:
         """The per-tick token budget currently in force: the configured
         budget scaled by the brownout fraction (floored at 1 so the
@@ -2066,6 +2081,13 @@ class ContinuousGenerator:
             ho["held_rows"] = int(sum(  # lint: lockfree-ok GIL-safe scrape
                 1 for h in self._held if h))
             out["handoff"] = ho
+        # Additive, present only while the lane is draining (elastic
+        # fleet scale-down watch; defaults-off stats bytes unchanged):
+        # live-row occupancy of a lame-duck lane — 0.0 means the drain
+        # has fully emptied and removal costs nothing.
+        if self._draining_flag:
+            out["drain_pressure"] = round(
+                out["active"] / max(1, self.n_slots), 4)
         # Additive, present only while a brownout degradation is engaged
         # (defaults-off stats bytes unchanged).
         if (self._bo_budget_frac < 1.0 or self._bo_spec_off

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from tpu_engine.serving.autoscaler import (InProcessLaneProvider,
+                                           StandbyLaneProvider)
 from tpu_engine.serving.gateway import Gateway
 from tpu_engine.serving.http import JsonHttpServer
 from tpu_engine.serving.worker import WorkerNode
@@ -75,13 +77,16 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     def _admin_drain(body):
         action = (body or {}).get("action", "drain")
         if action == "drain":
-            worker.drain()
+            status = worker.drain()
         elif action == "undrain":
-            worker.undrain()
+            status = worker.undrain()
         else:
             return 400, {"error": "action must be drain|undrain"}
+        # "status" names the idempotent outcome (draining /
+        # already-draining / undrained / not-draining) — double-drain
+        # and undrain-of-idle answer it instead of re-running effects.
         return 200, {"ok": True, "node_id": worker.node_id,
-                     "draining": worker.draining}
+                     "draining": worker.draining, "status": status}
 
     server.route("POST", "/admin/drain", _admin_drain)
     # Live stream migration (DESIGN.md): export one live stream's row —
@@ -100,7 +105,13 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
 
 
 def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None,
-                  background: bool = True) -> Tuple[Gateway, JsonHttpServer]:
+                  background: bool = True,
+                  standby_workers: Optional[List[str]] = None,
+                  ) -> Tuple[Gateway, JsonHttpServer]:
+    """``standby_workers``: pre-launched worker ADDRESSES the elastic
+    fleet controller may bring into (and out of) rotation — the warm
+    pool behind ``--autoscale`` in gateway mode. They are NOT registered
+    at startup; the probe gate admits them on scale-up."""
     config = config or GatewayConfig()
     gateway = Gateway(worker_urls, config)
     server = JsonHttpServer(config.port)
@@ -126,6 +137,16 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     server.route("POST", "/admin/role", lambda body: (
         200, gateway.set_worker_role((body or {}).get("node", ""),
                                      (body or {}).get("role", ""))))
+    # Elastic fleet (DESIGN.md "Elastic fleet"): the operator surface —
+    # status / add (probe-then-register) / remove (drain+migrate
+    # retire) / rebalance (role flip) / clear (degraded state). Works
+    # with or without --autoscale; every failure is a named,
+    # non-raising status.
+    server.route("POST", "/admin/fleet", lambda body: (
+        200, gateway.fleet_admin(body or {})))
+    if config.autoscale or standby_workers:
+        gateway.engage_autoscaler(
+            provider=StandbyLaneProvider(list(standby_workers or [])))
     print(f"Gateway listening on port {config.port}")
     print(f"Workers: {len(worker_urls)}")
     print("Circuit breakers enabled")
@@ -308,6 +329,50 @@ def serve_combined(
                 except Exception as exc:  # warmup is best-effort
                     print(f"generate warmup skipped: {exc}")
     gateway = Gateway(workers, gateway_config)
+    if gateway_config.autoscale and mesh is None:
+        # Elastic fleet in combined mode: the provider mints fresh
+        # in-process lanes with the same config/device round-robin the
+        # startup loop used (indices continue past the static fleet so
+        # names never collide), and retired lanes are stopped and
+        # dropped from the per-lane surfaces.
+        from tpu_engine.runtime.engine import InferenceEngine
+
+        base_lanes = n_lanes
+
+        def _spawn_lane(idx):
+            i = base_lanes + idx
+            cfg = worker_config or WorkerConfig()
+            over = {"node_id": f"worker_{i+1}",
+                    "model": models[i % len(models)]}
+            if lane_roles:
+                over["role"] = lane_roles[i % len(lane_roles)]
+            if tp > 1:
+                n_slices = max(1, len(devices) // tp)
+                over["tp_device_offset"] = (i % n_slices) * tp
+            lane_cfg = WorkerConfig(**{**cfg.__dict__, **over})
+            engine = InferenceEngine(
+                lane_cfg.model,
+                params=params,
+                dtype=lane_cfg.dtype,
+                batch_buckets=lane_cfg.batch_buckets,
+                shape_buckets=lane_cfg.shape_buckets,
+                quantize=lane_cfg.quantize,
+                device=devices[i % len(devices)],
+            )
+            w = WorkerNode(lane_cfg, engine=engine)
+            workers.append(w)
+            return w
+
+        def _drop_lane(w):
+            try:
+                workers.remove(w)
+            except ValueError:
+                pass
+
+        gateway.engage_autoscaler(provider=InProcessLaneProvider(
+            _spawn_lane,
+            max_lanes=gateway_config.autoscale_max_lanes,
+            on_retire=_drop_lane))
     routes = {}
     routes[("POST", "/infer")] = lambda body: (200, gateway.route_request_raw(body))
     routes[("POST", "/generate")] = lambda body: (200, gateway.route_generate(body))
@@ -422,7 +487,11 @@ def serve_combined(
         targets = [w for w in workers
                    if w.node_id == node or node in (None, "*")]
         if not targets:
-            return 404, {"error": f"unknown node '{node}'"}
+            # Named, non-raising: draining a lane that is not a member
+            # is an idempotent no-op (it may have been retired between
+            # the operator's read and this call), not a 404 surprise.
+            return 200, {"ok": False, "status": "unknown-lane",
+                         "node": node}
         for w in targets:
             if action == "drain":
                 if body.get("remove") and gateway.config.migrate_streams:
@@ -457,6 +526,12 @@ def serve_combined(
         return 200, gateway.set_worker_role(node, role)
 
     routes[("POST", "/admin/role")] = _admin_role
+
+    # Elastic fleet operator surface (DESIGN.md "Elastic fleet") —
+    # status / add / remove / rebalance / clear; named, non-raising
+    # statuses. Active with or without --autoscale.
+    routes[("POST", "/admin/fleet")] = lambda body: (
+        200, gateway.fleet_admin(body or {}))
 
     # Tracing (SURVEY.md §5: the reference has only per-request wall
     # clocks). "summary"/"recent" keep the original schema; "gateway" and

@@ -58,6 +58,78 @@ def _run_forever(stoppables=()):
             pass
 
 
+def _add_autoscale_flags(parser) -> None:
+    """Elastic-fleet flags shared by ``gateway`` and ``serve`` (DESIGN.md
+    "Elastic fleet"). All default to None so only explicitly-set flags
+    reach GatewayConfig — defaults stay wire-byte-identical."""
+    parser.add_argument("--autoscale", action="store_true",
+                        help="closed-loop elastic fleet: a controller "
+                             "thread reads per-lane overload pressure "
+                             "and spawns/retires lanes against it — "
+                             "scale-down drains via live stream "
+                             "migration (zero tokens lost), scale-up "
+                             "registers only after a passing /health "
+                             "probe (implies --migrate-streams)")
+    parser.add_argument("--autoscale-interval", type=float, default=None,
+                        help="control-loop tick interval seconds "
+                             "(default 1)")
+    parser.add_argument("--autoscale-min-lanes", type=int, default=None,
+                        help="never drain the fleet below this many "
+                             "lanes (default 1)")
+    parser.add_argument("--autoscale-max-lanes", type=int, default=None,
+                        help="never spawn above this many lanes "
+                             "(default 0 = provider capacity rules)")
+    parser.add_argument("--autoscale-up-pressure", type=float,
+                        default=None,
+                        help="mean fleet pressure above which a lane "
+                             "is spawned (default 0.75)")
+    parser.add_argument("--autoscale-down-pressure", type=float,
+                        default=None,
+                        help="mean fleet pressure below which a lane "
+                             "is retired (default 0.25)")
+    parser.add_argument("--autoscale-cooldown", type=float, default=None,
+                        help="minimum seconds between actuated "
+                             "decisions (default 5)")
+    parser.add_argument("--autoscale-spawn-timeout", type=float,
+                        default=None,
+                        help="a spawned lane that has not probed "
+                             "healthy within this window is destroyed "
+                             "and the fleet enters the named "
+                             "spawn-wedged degraded state (default 30)")
+    parser.add_argument("--autoscale-rebalance-band", type=float,
+                        default=None,
+                        help="role-rebalance arm (needs --disagg): flip "
+                             "a lane prefill<->decode when the "
+                             "prefill:decode pressure ratio leaves this "
+                             "band, re-arming inside band/2 "
+                             "(default 0 = off; must be > 1)")
+
+
+def _apply_autoscale_flags(args, gw_kw: dict) -> None:
+    if args.autoscale:
+        gw_kw["autoscale"] = True
+        # Scale-down must ride the live-migration ladder — without it,
+        # retiring a lane sheds its streams onto the replay resume as
+        # the PLAN rather than the last rung.
+        gw_kw["migrate_streams"] = True
+    if args.autoscale_interval is not None:
+        gw_kw["autoscale_interval_s"] = args.autoscale_interval
+    if args.autoscale_min_lanes is not None:
+        gw_kw["autoscale_min_lanes"] = args.autoscale_min_lanes
+    if args.autoscale_max_lanes is not None:
+        gw_kw["autoscale_max_lanes"] = args.autoscale_max_lanes
+    if args.autoscale_up_pressure is not None:
+        gw_kw["autoscale_up_pressure"] = args.autoscale_up_pressure
+    if args.autoscale_down_pressure is not None:
+        gw_kw["autoscale_down_pressure"] = args.autoscale_down_pressure
+    if args.autoscale_cooldown is not None:
+        gw_kw["autoscale_cooldown_s"] = args.autoscale_cooldown
+    if args.autoscale_spawn_timeout is not None:
+        gw_kw["autoscale_spawn_timeout_s"] = args.autoscale_spawn_timeout
+    if args.autoscale_rebalance_band is not None:
+        gw_kw["autoscale_rebalance_band"] = args.autoscale_rebalance_band
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
@@ -313,6 +385,14 @@ def main(argv=None) -> int:
                             help="per-stream prefill→decode handoff "
                                  "budget in seconds, clamped to the "
                                  "stream's deadline (default 30)")
+        _add_autoscale_flags(parser)
+        parser.add_argument("--standby-worker", action="append",
+                            default=None, metavar="HOST:PORT",
+                            help="pre-launched worker ADDRESS for the "
+                                 "elastic fleet's warm standby pool "
+                                 "(repeatable); joins the ring only "
+                                 "when the autoscaler scales up and its "
+                                 "/health probe passes")
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.overload_control:
@@ -325,6 +405,7 @@ def main(argv=None) -> int:
             gw_kw["retry_budget_ratio"] = args.retry_budget
         if args.migrate_streams:
             gw_kw["migrate_streams"] = True
+        _apply_autoscale_flags(args, gw_kw)
         if args.migrate_timeout is not None:
             gw_kw["migrate_timeout_s"] = args.migrate_timeout
         if args.drain_timeout is not None:
@@ -348,7 +429,8 @@ def main(argv=None) -> int:
                           failover_streams=args.failover_streams,
                           health_probe_interval_s=args.health_probe_interval,
                           **gw_kw),
-            background=True)
+            background=True,
+            standby_workers=args.standby_worker)
         _run_forever([server, gw])
         return 0
 
@@ -696,6 +778,7 @@ def main(argv=None) -> int:
                             help="per-stream prefill→decode handoff "
                                  "budget in seconds, clamped to the "
                                  "stream's deadline (default 30)")
+        _add_autoscale_flags(parser)
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.breaker_timeout is not None:
@@ -747,6 +830,7 @@ def main(argv=None) -> int:
             gw_kw["disagg"] = True
         if args.handoff_timeout is not None:
             gw_kw["handoff_timeout_s"] = args.handoff_timeout
+        _apply_autoscale_flags(args, gw_kw)
         gateway_config = None
         if gw_kw:
             from tpu_engine.utils.config import GatewayConfig

@@ -92,14 +92,14 @@ class LocalWorkerClient:
             raise WorkerError(str(exc)) from exc
 
     def drain(self) -> dict:
-        self.worker.drain()
+        status = self.worker.drain()
         return {"ok": True, "node_id": self.worker.node_id,
-                "draining": True}
+                "draining": True, "status": status}
 
     def undrain(self) -> dict:
-        self.worker.undrain()
+        status = self.worker.undrain()
         return {"ok": True, "node_id": self.worker.node_id,
-                "draining": False}
+                "draining": False, "status": status}
 
     def set_role(self, role: str) -> dict:
         """Flip the lane's serving role (disaggregated serving; the

@@ -67,6 +67,7 @@ from tpu_engine.serving.overload import (
 from tpu_engine.serving.resilience import (
     AffinityCounters,
     FailoverCounters,
+    FleetCounters,
     HandoffCounters,
     LatencyTracker,
     MigrationCounters,
@@ -366,6 +367,17 @@ class Gateway:
         # converge on one prefill lane. Maintained beside the main ring
         # (membership changes + role flips); ConsistentHash self-locks.
         self._prefill_ring = ConsistentHash(self.config.virtual_nodes)
+        # Elastic fleet (DESIGN.md "Elastic fleet"): every autoscaler /
+        # /admin/fleet decision counted here with a matching `fleet`
+        # marker span. The named degraded-but-serving states (lane ->
+        # reason, e.g. "spawn-wedged", "drain-wedged") and the last
+        # observed fleet pressure live under self._lock; the controller
+        # itself (serving/autoscaler.py) attaches via engage_autoscaler
+        # and is None at defaults — wire bytes stay identical.
+        self.fleet = FleetCounters()
+        self._fleet_degraded: Dict[str, str] = {}
+        self._fleet_pressure: Optional[float] = None
+        self._autoscaler = None
         self._probe_state = ProbeStateMachine(
             self.config.health_probe_failures)
         self._prober_stop = threading.Event()
@@ -378,8 +390,11 @@ class Gateway:
             self._prober_thread.start()
 
     def stop(self) -> None:
-        """Stop the background health prober (idempotent; routing itself
-        keeps working — the gateway has no other owned threads)."""
+        """Stop the background health prober and the fleet autoscaler
+        (idempotent; routing itself keeps working)."""
+        scaler = self._autoscaler
+        if scaler is not None:
+            scaler.stop()
         self._prober_stop.set()
         t = self._prober_thread
         if t is not None:
@@ -502,31 +517,23 @@ class Gateway:
                 self._topology.pop(name, None)
             else:
                 self._topology[name] = topo
-            rings = [ring for ring in self._model_rings.values()
-                     if name in ring.get_all_nodes()]
+            rings = list(self._model_rings.values())
         weight = int(topo["devices"]) if topo else 1
         # ConsistentHash self-locks; resize outside the gateway lock.
-        if name in self._ring.get_all_nodes():
-            self._ring.add_node(name, weight)
-        if name in self._prefill_ring.get_all_nodes():
-            self._prefill_ring.add_node(name, weight)
+        # reweight_node is atomic (membership check + resize under one
+        # ring-lock acquisition), so a remove_worker racing this sweep
+        # can never be interleaved into a resurrected ghost lane — the
+        # resize simply misses (False) once the removal lands.
+        applied = self._ring.reweight_node(name, weight)
+        self._prefill_ring.reweight_node(name, weight)
         for ring in rings:
-            ring.add_node(name, weight)
-        with self._lock:
-            present = name in self._clients
-            if present:
-                self._topology_updates += 1
-        if not present:
-            # remove_worker raced this re-weight and our add_node calls
-            # may have resurrected the lane's vnodes on the captured
-            # rings: undo them — a ghost lane with no client entry must
-            # never own a hash share.
-            self._ring.remove_node(name)
-            self._prefill_ring.remove_node(name)
-            for ring in rings:
-                ring.remove_node(name)
+            ring.reweight_node(name, weight)
+        if applied:
             with self._lock:
-                self._topology.pop(name, None)
+                if name in self._clients:
+                    self._topology_updates += 1
+                else:
+                    self._topology.pop(name, None)
 
     def _make_breaker(self):
         """Native breaker when the C++ core is loaded — the native HTTP
@@ -677,6 +684,139 @@ class Gateway:
 
     def worker_names(self) -> List[str]:
         return self._ring.get_all_nodes()
+
+    # -- elastic fleet (DESIGN.md "Elastic fleet") ----------------------------
+
+    def lane_clients(self) -> Dict[str, object]:
+        """{lane: client} membership snapshot (one lock acquisition) —
+        the autoscaler's observation loop and tests."""
+        with self._lock:
+            return dict(self._clients)
+
+    def _fleet_count(self, decision: str, **attrs) -> None:
+        """Bump a fleet counter AND drop a zero-duration ``fleet``
+        marker span (same counters==spans discipline as the
+        migration/handoff markers; fault_injection --elastic asserts
+        the two agree)."""
+        self.fleet.bump(decision)
+        ctx = TraceContext.root(f"fleet:{decision}").child()
+        self.tracer.record(
+            "fleet", "fleet", "gateway", 0,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+            start_ts=time.time(), attrs={"decision": decision, **attrs})
+
+    def fleet_observe(self, pressure: float) -> None:
+        """Publish the controller's latest fleet-pressure observation
+        (drives the /stats ``fleet.pressure`` gauge)."""
+        with self._lock:
+            self._fleet_pressure = round(float(pressure), 4)
+
+    def fleet_enter_degraded(self, lane: str, reason: str) -> None:
+        """Latch a NAMED degraded-but-serving state for ``lane``
+        (``spawn-wedged``: a scale-up that never turned healthy;
+        ``drain-wedged``: a scale-down whose drain leg wedged or whose
+        actuator timed out). Serving continues unchanged — the state is
+        an operator signal, visible in /stats ``fleet`` and
+        /admin/fleet until cleared. Idempotent per (lane, reason)."""
+        with self._lock:
+            if self._fleet_degraded.get(lane) == reason:
+                return
+            self._fleet_degraded[lane] = reason
+        self._fleet_count("degraded_entered", lane=lane, reason=reason)
+
+    def fleet_clear_degraded(self, lane: str) -> bool:
+        """Clear a lane's degraded state (controller recovery sweep or
+        operator /admin/fleet clear). True if a state was latched."""
+        with self._lock:
+            reason = self._fleet_degraded.pop(lane, None)
+        if reason is None:
+            return False
+        self._fleet_count("degraded_cleared", lane=lane, reason=reason)
+        return True
+
+    def fleet_status(self) -> dict:
+        """The /admin/fleet status body: membership, named degraded
+        states, controller engagement, and last observed pressure."""
+        with self._lock:
+            degraded = dict(self._fleet_degraded)
+            pressure = self._fleet_pressure
+        lanes = self.worker_names()
+        out = {
+            "state": ("degraded:" + ",".join(sorted(set(degraded.values())))
+                      if degraded else "steady"),
+            "lanes": sorted(lanes),
+            "degraded": degraded,
+            "autoscale": self._autoscaler is not None
+            and self._autoscaler.running,
+        }
+        if pressure is not None:
+            out["pressure"] = pressure
+        return out
+
+    def engage_autoscaler(self, provider=None):
+        """Create (and start) the closed-loop fleet controller —
+        called by the serving app when ``--autoscale`` is set. Returns
+        the controller; idempotent (a live controller is reused)."""
+        if self._autoscaler is None:
+            from tpu_engine.serving.autoscaler import FleetAutoscaler
+
+            self._autoscaler = FleetAutoscaler(self, provider=provider,
+                                               config=self.config)
+        if self.config.autoscale:
+            self._autoscaler.start()
+        return self._autoscaler
+
+    def _fleet_controller(self):
+        """The controller backing /admin/fleet: the engaged autoscaler,
+        or an UNSTARTED one (manual actuations share the exact probe /
+        drain+migrate ladders, counters, and degraded-state handling
+        the closed loop uses — defaults-off deployments get the same
+        semantics without any background thread)."""
+        if self._autoscaler is None:
+            from tpu_engine.serving.autoscaler import FleetAutoscaler
+
+            self._autoscaler = FleetAutoscaler(self, provider=None,
+                                               config=self.config)
+        return self._autoscaler
+
+    def fleet_admin(self, payload: dict) -> dict:
+        """/admin/fleet: the elastic-fleet operator surface. Actions —
+        ``status`` (fleet + controller state), ``add`` (probe-then-
+        register a lane: a worker address, registered on the rings only
+        after a passing /health probe), ``remove`` (retire a member
+        through the drain + PR 11 stream-migration ladder), ``rebalance``
+        (flip a lane's role through the /admin/role path), ``clear``
+        (drop a lane's latched degraded state). Every failure answers a
+        named, non-raising status."""
+        action = str(payload.get("action", "status"))
+        ctl = self._fleet_controller()
+        if action == "status":
+            out = {"ok": True, **self.fleet_status()}
+            out["counters"] = self.fleet.as_dict()
+            return out
+        if action == "add":
+            worker = payload.get("worker")
+            if not worker:
+                return {"ok": False, "status": "missing-worker"}
+            return ctl.scale_up(worker=worker)
+        if action == "remove":
+            name = payload.get("worker")
+            if not name:
+                return {"ok": False, "status": "missing-worker"}
+            return ctl.scale_down(name=str(name), manual=True)
+        if action == "rebalance":
+            name, role = payload.get("worker"), payload.get("role")
+            if not name or not role:
+                return {"ok": False, "status": "missing-worker-or-role"}
+            return ctl.rebalance(str(name), str(role))
+        if action == "clear":
+            name = payload.get("worker")
+            if not name:
+                return {"ok": False, "status": "missing-worker"}
+            cleared = self.fleet_clear_degraded(str(name))
+            return {"ok": True,
+                    "status": "cleared" if cleared else "not-degraded"}
+        return {"ok": False, "status": f"unknown-action:{action}"[:80]}
 
     # -- request path ---------------------------------------------------------
 
@@ -1475,9 +1615,6 @@ class Gateway:
                 # it and carry on — the flip itself is still safe.
                 self._migration_count(None, "drain_failures", lane=name,
                                       error=str(exc)[:120])
-        if self.config.migrate_streams:
-            self._migrate_lane_streams(name, client)
-
         def _undrain():
             # UNCONDITIONAL (idempotent): a drain call that timed out
             # here may still have landed worker-side moments later —
@@ -1489,6 +1626,20 @@ class Gateway:
                     client.undrain()
                 except Exception:
                     pass
+
+        if self.config.migrate_streams:
+            try:
+                self._migrate_lane_streams(name, client)
+            except Exception as exc:
+                # A failed migration leg must RESTORE the lane, not
+                # strand it draining with its old role half-applied:
+                # admissions reopen and both the worker and the gateway
+                # role map keep the pre-flip role (per-stream failures
+                # inside the leg already armed their replay fallbacks;
+                # this catches the leg itself dying).
+                _undrain()
+                return {"ok": False, "node_id": name,
+                        "error": f"migration leg failed: {exc}"[:300]}
 
         try:
             if hasattr(client, "set_role"):
@@ -2477,10 +2628,28 @@ class Gateway:
                 or cfg.retry_backoff_base_ms > 0)
 
     def get_stats(self) -> dict:
-        """Exact /stats schema (``gateway.cpp:63-77``)."""
+        """Exact /stats schema (``gateway.cpp:63-77``).
+
+        Every membership-adjacent snapshot (breakers, role map,
+        topology block, lane list, affinity totals, in-flight gauge,
+        fleet degraded map) is taken under ONE ``_lock`` acquisition —
+        the same idiom as the PR 8 ``_route_inner`` fix. Snapshotting
+        them piecemeal let a concurrent add/remove land between the
+        acquisitions, publishing a torn read: a lane present in the
+        ``handoff.roles`` map but missing from ``topology.ring_weights``
+        (or vice versa) within one response body."""
         with self._lock:
             items = list(self._breakers.items())
             total, failovers = self._total_requests, self._failovers
+            active_streams = len(self._streams)
+            lanes = sorted(self._clients)
+            roles = {n: self._roles.get(n, "both") for n in lanes}
+            topo = dict(self._topology)
+            topo_updates = self._topology_updates
+            aff_assigned = dict(self._affinity_assigned)
+            inflight = self._inflight
+            fleet_degraded = dict(self._fleet_degraded)
+            fleet_pressure = self._fleet_pressure
         out = {
             "total_workers": len(items),
             # Additive fields (reference /stats has only total_workers +
@@ -2522,27 +2691,20 @@ class Gateway:
         # bounded-drain counter), same gating discipline.
         if self.config.migrate_streams or self.migration.any_nonzero():
             mig = self.migration.as_dict()
-            with self._lock:
-                mig["active_streams"] = len(self._streams)
+            mig["active_streams"] = active_streams
             out["migration"] = mig
         # Additive "handoff" block (disaggregated prefill/decode
         # serving), same gating discipline: present only once
         # configured or exercised.
         if self.config.disagg or self.handoff.any_nonzero():
             ho = self.handoff.as_dict()
-            with self._lock:
-                ho["roles"] = {n: self._roles.get(n, "both")
-                               for n in sorted(self._clients)}
+            ho["roles"] = roles
             out["handoff"] = ho
         # Additive "topology" block (topology-aware ring), present only
         # once any lane carries a mesh-shape label — an all-single-chip
         # fleet's /stats stays byte-identical. Reports each labelled
         # lane's mesh shape plus every lane's vnode weight, so an
         # operator can see exactly how the ring maps chips.
-        with self._lock:
-            topo = dict(self._topology)
-            topo_updates = self._topology_updates
-            lanes = sorted(self._clients)
         if topo:
             out["topology"] = {
                 "lanes": topo,
@@ -2554,8 +2716,7 @@ class Gateway:
         # gating discipline: a defaults-only /stats stays byte-identical.
         if self.config.prefix_affinity or self.affinity.any_nonzero():
             aff = self.affinity.as_dict()
-            with self._lock:
-                aff["assigned"] = dict(self._affinity_assigned)
+            aff["assigned"] = aff_assigned
             out["affinity"] = aff
         # Additive "overload" block (adaptive overload control), same
         # gating discipline: present only once configured or exercised.
@@ -2563,11 +2724,20 @@ class Gateway:
                 or self.overload.any_nonzero()):
             ov = self.overload.as_dict()
             ov["pressure"] = round(self._overload_pressure(), 4)
-            with self._lock:
-                ov["inflight"] = self._inflight
+            ov["inflight"] = inflight
             if self.config.overload_max_inflight > 0:
                 ov["max_inflight"] = self.config.overload_max_inflight
             if self._tenant_bucket is not None:
                 ov["tenants"] = self._tenant_bucket.tenants()
             out["overload"] = ov
+        # Additive "fleet" block (elastic fleet: autoscaler +
+        # /admin/fleet), same gating discipline: present only once the
+        # controller is configured or a fleet decision was made.
+        if self.config.autoscale or self.fleet.any_nonzero():
+            fl = self.fleet.as_dict()
+            fl["lanes"] = len(lanes)
+            fl["degraded"] = fleet_degraded
+            if fleet_pressure is not None:
+                fl["pressure"] = fleet_pressure
+            out["fleet"] = fl
         return out

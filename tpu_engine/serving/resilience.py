@@ -261,6 +261,39 @@ class HandoffCounters(ResilienceCounters):
                    "holds_cancelled", "role_flips")
 
 
+class FleetCounters(ResilienceCounters):
+    """Every elastic-fleet (autoscaler + /admin/fleet) decision, counted
+    — the additive ``/stats`` ``fleet`` block and the
+    ``tpu_engine_fleet_*`` Prometheus family. Every field pairs 1:1
+    with a gateway ``fleet`` marker span
+    (``tools/fault_injection.py --elastic`` asserts counters == spans).
+
+    ``scale_up_attempted`` → exactly one of ``scale_up_completed`` (the
+    new lane passed its /health probe and joined every ring) or
+    ``scale_up_failed`` (no standby capacity, or the spawn never turned
+    healthy inside ``autoscale_spawn_timeout_s`` — the fleet enters the
+    named ``spawn-wedged`` degraded state and keeps serving unchanged).
+    ``scale_down_attempted`` → ``scale_down_completed`` (drain +
+    PR 11 stream migration landed cleanly) or ``scale_down_failed``
+    (the drain leg wedged or the actuator timed out — membership still
+    changes, journaled streams fall to the replay-resume rung, and the
+    fleet enters ``drain-wedged``). ``rebalance_*`` mirror the same
+    ladder for the prefill↔decode role-flip arm. ``decisions_held``
+    counts actions the controller WANTED but suppressed (cooldown /
+    min-max clamp / actuator already in flight) — idempotency made
+    visible. ``degraded_entered`` / ``degraded_cleared`` bracket every
+    named degraded-but-serving state."""
+
+    FIELDS = ("scale_up_attempted", "scale_up_completed",
+              "scale_up_failed", "scale_down_attempted",
+              "scale_down_completed", "scale_down_failed",
+              "rebalance_attempted", "rebalance_completed",
+              "rebalance_failed", "decisions_held",
+              "degraded_entered", "degraded_cleared")
+
+    SPAN_FIELDS = FIELDS
+
+
 class AffinityCounters(ResilienceCounters):
     """Every prefix-affinity routing decision, counted — the additive
     ``/stats`` ``affinity`` block and the ``tpu_engine_affinity_*``
@@ -368,13 +401,27 @@ class AdmissionController:
     def draining(self) -> bool:
         return self._draining
 
-    def drain(self) -> None:
+    def drain(self) -> str:
+        """Enter lame-duck mode. Idempotent with a NAMED status: the
+        first call answers ``"draining"``, a repeat answers
+        ``"already-draining"`` — a retried /admin/drain (operator
+        double-submit, controller retry after a timed-out ack) must
+        read as the no-op it is, never as an error."""
         with self._lock:
+            if self._draining:
+                return "already-draining"
             self._draining = True
+            return "draining"
 
-    def undrain(self) -> None:
+    def undrain(self) -> str:
+        """Leave lame-duck mode. Idempotent with a NAMED status:
+        ``"undrained"`` when a drain was actually lifted,
+        ``"not-draining"`` when there was nothing to lift."""
         with self._lock:
+            if not self._draining:
+                return "not-draining"
             self._draining = False
+            return "undrained"
 
     def wait_idle(self, timeout_s: float = 10.0) -> bool:
         """Block until in-flight work reaches zero (True) or the timeout

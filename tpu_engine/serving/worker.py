@@ -1099,22 +1099,39 @@ class WorkerNode:
 
     # -- drain (lame-duck) -----------------------------------------------------
 
-    def drain(self) -> None:
+    def drain(self) -> str:
         """Refuse new admissions (503 + Retry-After) while in-flight work
         completes — the lame-duck half of graceful removal. The gateway's
         ``remove_worker(drain=True)`` and ``/admin/drain`` drive this.
         Fault listeners fire too: the native C++ front must stop answering
         a draining lane's cache hits (its hit path never enters Python, so
-        the admission check alone cannot reach it)."""
-        self._admission.drain()
+        the admission check alone cannot reach it). Idempotent: a second
+        drain answers the named ``already-draining`` status instead of
+        re-running the side effects."""
+        status = self._admission.drain()
+        if status == "already-draining":
+            return status
+        gen = self.generator
+        if gen is not None and hasattr(gen, "set_draining"):
+            gen.set_draining(True)
         for listener in self._fault_listeners:
             listener(False)
+        return status
 
-    def undrain(self) -> None:
-        self._admission.undrain()
+    def undrain(self) -> str:
+        """Inverse of :meth:`drain`; ``not-draining`` names the no-op
+        (undrain of a lane that never drained — idempotent, never
+        raises)."""
+        status = self._admission.undrain()
+        if status == "not-draining":
+            return status
+        gen = self.generator
+        if gen is not None and hasattr(gen, "set_draining"):
+            gen.set_draining(False)
         if self._injected_fault is None:  # don't resurrect a faulted lane
             for listener in self._fault_listeners:
                 listener(True)
+        return status
 
     @property
     def draining(self) -> bool:

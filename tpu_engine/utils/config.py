@@ -374,6 +374,44 @@ class GatewayConfig:
     tenant_rate: float = 0.0
     tenant_burst: float = 0.0           # bucket depth (0 = auto: 2x rate)
 
+    # -- elastic fleet (serving/autoscaler.py; DESIGN.md "Elastic
+    # fleet"). Master switch --autoscale: a gateway-side control loop
+    # reads per-lane overload pressure (AIMD depth / queue fill /
+    # brownout tier), journaled active streams, and ring topology
+    # weights, then spawns lanes from the configured provider and
+    # retires them through the PR 11 drain+migrate ladder (zero tokens
+    # lost; replay resume is the last rung, never the plan). Off
+    # (default): no controller thread, no /stats "fleet" block, wire
+    # bytes identical to the static fleet. /admin/fleet manual actions
+    # work either way. Engaging --autoscale forces migrate_streams on —
+    # scale-down without live migration would shed tokens.
+    autoscale: bool = False
+    # Control-loop tick interval.
+    autoscale_interval_s: float = 1.0
+    # Fleet size clamps: the controller never drains below min_lanes and
+    # never spawns above max_lanes (0 = no upper clamp / provider
+    # capacity rules). Clamped decisions count as decisions_held.
+    autoscale_min_lanes: int = 1
+    autoscale_max_lanes: int = 0
+    # Pressure thresholds: mean fleet pressure (1.0 = lanes saturated)
+    # above up_pressure spawns a lane; below down_pressure retires one.
+    # The gap between them is the hysteresis dead band.
+    autoscale_up_pressure: float = 0.75
+    autoscale_down_pressure: float = 0.25
+    # Minimum seconds between ACTUATED decisions (spawn/retire/flip) —
+    # suppressed ticks count as decisions_held.
+    autoscale_cooldown_s: float = 5.0
+    # Spawn bound: a provider lane that has not answered a passing
+    # /health probe within this window is destroyed and the fleet enters
+    # the named "spawn-wedged" degraded state (still serving).
+    autoscale_spawn_timeout_s: float = 30.0
+    # Role-rebalance arm (requires --disagg): when the observed
+    # prefill:decode pressure ratio exceeds this band (or drops below
+    # its inverse), one lane flips role through the /admin/role
+    # drain+migrate+undrain path; the arm re-arms only once the ratio
+    # returns inside band/2 (hysteresis). <= 1 disables the arm.
+    autoscale_rebalance_band: float = 0.0
+
     # Tracing ring-buffer capacity for the gateway's own spans (route +
     # per-attempt children + resilience decision markers). 0 disables.
     trace_capacity: int = 2048

@@ -676,6 +676,53 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
             metric("tpu_engine_overload_tenants", "gauge",
                    "Tenants with live token buckets",
                    [({}, ovl.get("tenants"))])
+        fl = stats.get("fleet")
+        if fl:
+            # Elastic fleet (the /stats "fleet" block; present once
+            # --autoscale is set or /admin/fleet first actuates).
+            for key, help_text in (
+                    ("scale_up_attempted",
+                     "Scale-up actuations started (spawn + probe gate)"),
+                    ("scale_up_completed",
+                     "Lanes probed healthy and registered on the ring"),
+                    ("scale_up_failed",
+                     "Scale-ups that never probed healthy "
+                     "(spawn-wedged) or found no capacity"),
+                    ("scale_down_attempted",
+                     "Scale-down actuations started (drain + migrate "
+                     "ladder)"),
+                    ("scale_down_completed",
+                     "Lanes retired through the drain + stream-"
+                     "migration ladder"),
+                    ("scale_down_failed",
+                     "Scale-downs that timed out or errored "
+                     "(drain-wedged)"),
+                    ("rebalance_attempted",
+                     "Role-rebalance flips started"),
+                    ("rebalance_completed",
+                     "Role flips completed through /admin/role"),
+                    ("rebalance_failed",
+                     "Role flips refused or failed (state restored)"),
+                    ("decisions_held",
+                     "Control-loop decisions suppressed by cooldown or "
+                     "the min/max lane clamps"),
+                    ("degraded_entered",
+                     "Named degraded-but-serving states latched"),
+                    ("degraded_cleared",
+                     "Degraded states cleared (recovery or operator)")):
+                metric(f"tpu_engine_fleet_{key}_total", "counter",
+                       help_text, [({}, fl.get(key))])
+            metric("tpu_engine_fleet_lanes", "gauge",
+                   "Lanes currently on the routing ring",
+                   [({}, fl.get("lanes"))])
+            metric("tpu_engine_fleet_degraded_lanes", "gauge",
+                   "Lanes in a named degraded state",
+                   [({}, len(fl.get("degraded") or {}))])
+            if fl.get("pressure") is not None:
+                metric("tpu_engine_fleet_pressure", "gauge",
+                       "Mean fleet pressure the control loop last "
+                       "observed (1.0 = lanes saturated)",
+                       [({}, fl.get("pressure"))])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
     if named_hists:
