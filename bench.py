@@ -3087,11 +3087,14 @@ def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
             "priority": tiers[i % 3],
         })
 
-    def make_fleet(overload: bool):
+    def make_fleet(overload: bool, slo_ms: float = 0.0):
         # The OFF arm is the PR 1 default: unbounded admission, the
         # deadline machinery alone decides — exactly the uncontrolled
         # baseline the tentpole replaces. The ON arm bounds depth,
-        # tiers admission, and runs the brownout ladder.
+        # tiers admission, and runs the brownout ladder. slo_ms > 0
+        # additionally declares TTFT/completion objectives derived
+        # from the arm's deadline, so the artifact carries the
+        # error-budget burn the run actually produced.
         workers = []
         for i in range(lanes):
             cfg = WorkerConfig(
@@ -3111,7 +3114,9 @@ def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
         gw = Gateway(workers, GatewayConfig(
             overload_control=overload,
             overload_max_inflight=(2 * lanes * slots_per_lane
-                                   if overload else 0)))
+                                   if overload else 0),
+            slo_ttft_p99_ms=(slo_ms / 2 if slo_ms else 0.0),
+            slo_completion_p99_ms=(slo_ms if slo_ms else 0.0)))
         return workers, gw
 
     def consume(gw, req, deadline_ms, out):
@@ -3137,7 +3142,11 @@ def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
                  len(toks), (time.perf_counter() - t0) * 1e3))
 
     def run_arm(overload: bool, rate_hz: float, deadline_ms: float):
-        workers, gw = make_fleet(overload)
+        # SLO accounting rides the measured arms only (the ON arm's
+        # objectives track its deadline); calibration and the identity
+        # probe stay flag-free.
+        workers, gw = make_fleet(overload,
+                                 slo_ms=deadline_ms if overload else 0.0)
         try:
             for w in workers:  # warm the compile set off the clock
                 w.handle_generate({"request_id": f"warm-{w.node_id}",
@@ -3189,6 +3198,9 @@ def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
             st = gw.get_stats()
             if overload:
                 arm["gateway_overload"] = st.get("overload")
+                # SLO burn-rate block rides the same armed stats
+                # snapshot: budget burn per objective for the arm.
+                arm["slo"] = st.get("slo")
                 arm["brownout"] = {
                     w.node_id: w.get_health().get("brownout")
                     for w in workers}

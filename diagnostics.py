@@ -27,7 +27,8 @@ _TOTAL = 6  # --kernel-parity appends step 7, --mixed-parity step 8,
 #             --spec-parity step 9, --quant-parity step 10,
 #             --ssd-parity step 11, --tp-parity step 12, --failover
 #             step 13, --migrate step 14, --disagg step 15,
-#             --overload step 16, --elastic step 17, --lint step 18
+#             --overload step 16, --elastic step 17, --stitch step 18,
+#             --lint step 19
 
 
 def step(n: int, title: str, ok: bool, detail: str = "") -> None:
@@ -145,8 +146,16 @@ def main() -> int:
                          "spawn-wedged/drain-wedged, controller "
                          "engagement, last observed fleet pressure) "
                          "and the decision counters")
+    ap.add_argument("--stitch", action="store_true",
+                    help="step 18: one scripted cross-lane stitched "
+                         "trace against a local worker pair (spawned "
+                         "here) with --trace-stitch armed: drain-migrate "
+                         "a live stream to the other lane, then render "
+                         "the merged /admin/trace/<request_id> tree — "
+                         "lanes touched, span count, hop markers, and "
+                         "the orphan count (must be zero)")
     ap.add_argument("--lint", action="store_true",
-                    help="step 17: engine-lint static-analysis suite "
+                    help="step 19: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -157,7 +166,7 @@ def main() -> int:
               + int(args.ssd_parity) + int(args.tp_parity)
               + int(args.failover) + int(args.migrate)
               + int(args.disagg) + int(args.overload)
-              + int(args.elastic) + int(args.lint))
+              + int(args.elastic) + int(args.stitch) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -733,6 +742,97 @@ def main() -> int:
                  "(" + "; ".join(parts) + ")")
         except Exception as exc:
             step(n, "elastic fleet state", False, f"({exc})")
+
+    # (--stitch): one scripted cross-lane stitched trace — the
+    # observability-plane smoke, live, in one line: drive a stream
+    # through a --trace-stitch gateway over a spawned worker pair,
+    # drain-migrate it to the other lane mid-generation, then render
+    # the merged /admin/trace/<request_id> tree. The stream must land
+    # byte-identical to an unmoved control AND the stitched tree must
+    # cover both lanes with zero orphaned spans.
+    if args.stitch:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + int(args.overload)
+             + int(args.elastic) + 1)
+        procs = []
+        try:
+            import threading
+
+            from tools.fault_injection import (
+                _call,
+                launch_worker_procs,
+                rid_for_lane,
+            )
+            from tpu_engine.serving.gateway import Gateway, _parse_sse
+            from tpu_engine.utils.config import GatewayConfig
+
+            ports, procs = launch_worker_procs(
+                2, per_worker_args=(("--trace-stitch",),
+                                    ("--trace-stitch",)))
+            sgw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                          GatewayConfig(failover_streams=True,
+                                        migrate_streams=True,
+                                        migrate_timeout_s=60.0,
+                                        trace_stitch=True))
+            victim_lane = next(l for l in sgw.worker_names()
+                               if str(ports[0]) in l)
+            rid = rid_for_lane(sgw._ring, victim_lane, "st")
+            req = {"request_id": rid, "prompt_tokens": [5, 9, 3, 17],
+                   "max_new_tokens": 24, "temperature": 0.9, "seed": 7}
+            _, ctl = _call(ports[1], "POST", "/generate",
+                           dict(req, request_id="ctl"), timeout=600)
+            control = ctl["tokens"]
+            toks, final = [], {}
+
+            def consume_st():
+                for frame in sgw.route_generate_stream(dict(req)):
+                    evt = _parse_sse(frame)
+                    if evt and evt.get("done"):
+                        final.update(evt)
+                        break
+                    if evt and "tokens" in evt:
+                        toks.extend(evt["tokens"])
+
+            t = threading.Thread(target=consume_st, daemon=True)
+            t.start()
+            import time as _time
+
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and len(toks) < 2:
+                _time.sleep(0.02)
+            sgw.remove_worker(victim_lane, drain=True)
+            t.join(timeout=300)
+            stitched = sgw.stitched_trace(rid)
+            sgw.stop()
+            spliced = final.get("tokens")
+            lanes = stitched.get("lanes") or []
+            spans = stitched.get("spans") or []
+            orphans = stitched.get("orphans", -1)
+            hops = stitched.get("hops") or []
+            hop_kinds = ",".join(h.get("kind", "?") for h in hops)
+            if spliced == control and toks == control:
+                detail = (f"({len(control)} tokens identical; "
+                          f"{len(lanes)} lanes {lanes}, "
+                          f"{len(spans)} spans, orphans={orphans}, "
+                          f"hops=[{hop_kinds}])")
+                ok = len(lanes) >= 2 and orphans == 0 and len(hops) >= 2
+            else:
+                div = next((i for i, (a, b) in enumerate(
+                    zip(spliced or [], control))
+                    if a != b), min(len(spliced or []), len(control)))
+                detail = (f"(DIVERGED at token {div}: "
+                          f"spliced={spliced} control={control})")
+                ok = False
+            step(n, "cross-lane stitched trace", ok, detail)
+        except Exception as exc:
+            step(n, "cross-lane stitched trace", False, f"({exc})")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
 
     # 12 (--lint): the engine-lint suite, in-process — the same gate
     # tier-1 runs (tests/test_engine_lint.py), surfaced here so an

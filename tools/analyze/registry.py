@@ -185,7 +185,7 @@ ENGINE_REGISTRY = Registry(
                    "_total_requests", "_failovers", "_inflight",
                    "_streams", "_roles", "_topology",
                    "_topology_updates", "_fleet_degraded",
-                   "_fleet_pressure"),
+                   "_fleet_pressure", "_retired_clients"),
             lock="Gateway._lock",
             classes=("Gateway",)),
         # Consistent-hash ring internals (vnode map + per-node topology
@@ -240,6 +240,36 @@ ENGINE_REGISTRY = Registry(
             lock="ContinuousGenerator._exe_lock",
             classes=("ContinuousGenerator",),
             mode="w"),
+        # Flight recorder (observability plane): the per-tick ring moves
+        # under the recorder's own lock (decode-thread appends vs
+        # /admin/timeline readers).
+        GuardedEntry(
+            attrs=("_flight_ring",),
+            lock="ContinuousGenerator._flight_lock",
+            classes=("ContinuousGenerator",)),
+        # Flight-recorder configuration + dump bookkeeping: mutation is
+        # locked (HTTP forced dumps race the decode thread's anomaly
+        # dumps); GIL-safe /stats reads tolerate staleness.
+        GuardedEntry(
+            attrs=("_flight_capacity", "_flight_dump_dir",
+                   "_flight_dumps", "_flight_last_dump",
+                   "_flight_last_dump_ts"),
+            lock="ContinuousGenerator._flight_lock",
+            classes=("ContinuousGenerator",),
+            mode="w"),
+        # Stream ledger (observability plane): hop entries move under
+        # the ledger's own lock — ledger writes happen inside relay
+        # loops that must never contend with routing's Gateway._lock.
+        GuardedEntry(
+            attrs=("_entries",),
+            lock="_StreamLedger._llock",
+            classes=("_StreamLedger",)),
+        # SLO tracker (observability plane): the per-objective burn
+        # window deques move under the tracker's own lock.
+        GuardedEntry(
+            attrs=("_samples",),
+            lock="SloTracker._lock",
+            classes=("SloTracker",)),
     ),
     thread_owned=(
         # Scheduler row tables: the decode loop owns them; the prefill
@@ -248,7 +278,7 @@ ENGINE_REGISTRY = Registry(
         ThreadOwnedEntry(
             attrs=("_tables", "_row_blocks", "_row_req", "_row_emitted",
                    "_pending", "_export_waiting", "_hold_cancel_tags",
-                   "_slab_rows"),
+                   "_slab_rows", "_flight_prev", "_flight_miss_window"),
             owner_class="ContinuousGenerator",
             module="tpu_engine.runtime.scheduler",
             entries=("ContinuousGenerator._loop",),
@@ -276,7 +306,7 @@ ENGINE_REGISTRY = Registry(
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
                                  "overload", "migration", "handoff",
-                                 "fleet"}),
+                                 "fleet", "slo"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

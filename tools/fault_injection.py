@@ -2864,13 +2864,248 @@ def run_elastic_standalone() -> int:
                 proc.kill()
 
 
+def stitch_phase(ports, procs, checks: list,
+                 dump_dir: str) -> dict:
+    """Cross-lane trace stitching chaos (--stitch): ONE stream driven
+    through every mobility mechanism the engine has — disagg prefill →
+    decode handoff, then a migrate-mode drain of its decode lane, then
+    kill -9 of the migration destination forcing the replay resume —
+    must come out byte-identical to an unmoved control AND export ONE
+    merged trace via the stitcher whose spans cover every reachable
+    lane that served it, with zero orphaned spans and mobility
+    counters == hop markers. The kill must also leave a flight-recorder
+    postmortem on the resume lane naming the anomaly. ports[0] is the
+    prefill lane, ports[1:4] decode lanes (all with --trace-stitch and
+    the flight recorder armed), ports[4] a plain defaults-off worker
+    (the control oracle and the wire-identity probe)."""
+    import random
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports[:4]],
+                 GatewayConfig(disagg=True, handoff_timeout_s=60.0,
+                               failover_streams=True,
+                               migrate_streams=True,
+                               migrate_timeout_s=60.0,
+                               trace_stitch=True))
+    rid = "st_0"
+    # Long enough that the stream is provably mid-generation through
+    # BOTH moves and the kill (tiny CPU models decode fast).
+    req = {"request_id": rid, "prompt_tokens": [5, 9, 3, 17, 11],
+           "max_new_tokens": 360}
+    try:
+        control = control_oracle(ports[4], [req])
+    except RuntimeError as exc:
+        checks.append(("stitch: control generate", False))
+        return {"error": str(exc)}
+    # Warm every lane's compile cache so the drain and the kill land
+    # mid-decode, not mid-compile.
+    for p in ports[:4]:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+
+    moved = {"drained": None, "killed": None, "kill_port": None}
+
+    def drain_then_kill():
+        # Stage 1: the handoff has landed (>=3 tokens relayed implies
+        # the decode lane owns the stream) — drain that decode lane
+        # with migrate semantics.
+        rec = gw._streams.get(rid)
+        if rec is None:
+            return
+        lane0 = rec.lane
+        moved["drained"] = lane0
+        gw.remove_worker(lane0, drain=True)
+        # Stage 2: wait for the migration splice to land on a new lane.
+        deadline = time.monotonic() + 90
+        lane1 = None
+        while time.monotonic() < deadline:
+            mig = gw.get_stats().get("migration", {})
+            rec = gw._streams.get(rid)
+            if rec is None:
+                return  # stream already finished — too short to kill
+            if (mig.get("streams_migrated", 0) >= 1
+                    and rec.lane and rec.lane != lane0):
+                lane1 = rec.lane
+                break
+            time.sleep(0.05)
+        if lane1 is None:
+            return
+        time.sleep(0.15)  # a few post-migration tokens on the new lane
+        # Stage 3: kill -9 the migration destination mid-stream — the
+        # replay resume is the stream's THIRD serving lane.
+        moved["killed"] = lane1
+        port1 = next(p for p in ports[:4] if lane1.endswith(f":{p}"))
+        moved["kill_port"] = port1
+        procs[ports.index(port1)].send_signal(signal.SIGKILL)
+
+    results, fired = drive_streams_with_kill(
+        gw, [req], {rid}, drain_then_kill, random.Random(11),
+        kill_window_s=300.0)
+    checks.append(("stitch: drain+kill fired mid-stream",
+                   fired and moved["killed"] is not None))
+    toks, final = results[rid]
+    checks.append(("stitch: thrice-moved stream byte-identical to "
+                   "unmoved control",
+                   stream_completed(final) and toks == control[rid]
+                   and final.get("tokens") == control[rid]))
+    stats = gw.get_stats()
+    ho = stats.get("handoff", {})
+    mig = stats.get("migration", {})
+    fo = stats.get("failover", {})
+    checks.append(("stitch: prefill→decode handoff spliced "
+                   f"({ho.get('handoffs_spliced', 0)})",
+                   ho.get("handoffs_spliced", 0) >= 1))
+    checks.append(("stitch: stream migrated off the drained lane "
+                   f"({mig.get('streams_migrated', 0)})",
+                   mig.get("streams_migrated", 0) >= 1))
+    checks.append(("stitch: kill -9 landed on the replay resume "
+                   f"({fo.get('resumes_succeeded', 0)})",
+                   fo.get("resumes_succeeded", 0) >= 1))
+
+    # THE tentpole assertion: one merged tree from /admin/trace/<rid>.
+    stitched = gw.stitched_trace(rid)
+    lanes = set(stitched.get("lanes") or [])
+    hops = stitched.get("hops") or []
+    # Every lane the ledger says served the stream must contribute
+    # spans — except the killed one, whose ring died with its process.
+    served = {h["lane"] for h in hops}
+    reachable = {l for l in served if l != moved["killed"]}
+    checks.append(("stitch: merged trace covers every reachable lane "
+                   f"({sorted(lanes)} ⊇ {sorted(reachable)} + gateway)",
+                   "gateway" in lanes and reachable <= lanes
+                   and len(reachable) >= 2))
+    checks.append(("stitch: zero orphaned spans "
+                   f"({stitched.get('orphans')})",
+                   stitched.get("orphans") == 0))
+    # Mobility counters == hop markers, both in the ledger and in the
+    # span stream (the existing per-mechanism invariants must still
+    # hold on the composed path).
+    kinds: dict = {}
+    for h in hops:
+        kinds[h["kind"]] = kinds.get(h["kind"], 0) + 1
+    checks.append(("stitch: ledger hops == mobility counters "
+                   f"({kinds})",
+                   kinds.get("handoff", 0) == ho.get("handoffs_spliced",
+                                                     -1)
+                   and kinds.get("migrate", 0) == mig.get(
+                       "streams_migrated", -1)
+                   and kinds.get("resume", 0) == fo.get(
+                       "resumes_succeeded", -1)
+                   and kinds.get("admit", 0) == 1))
+    checks.append(("stitch: handoff counters == kv_handoff spans",
+                   _handoff_counters_match_spans(gw)))
+    checks.append(("stitch: migration counters == migration spans",
+                   _migration_counters_match_spans(gw)))
+    resume_spans = [s for s in gw.tracer.snapshot()
+                    if s["op"] == "resume"]
+    checks.append(("stitch: failover counters == resume spans",
+                   len(resume_spans) == fo.get("resumes_attempted", -1)))
+
+    # The kill must have left a black box: the gateway's resume path
+    # asks the resume lane's flight recorder for a postmortem named
+    # for the event.
+    dump_seen = None
+    for p in ports[:4]:
+        if p == moved["kill_port"]:
+            continue
+        try:
+            _, tl = _call(p, "GET", "/admin/timeline", timeout=5.0)
+        except OSError:
+            continue
+        last = (tl.get("flight") or tl).get("last_dump")
+        if last and str(last.get("anomaly", "")).startswith(
+                "failover_resume:"):
+            dump_seen = dict(last, port=p)
+            break
+    checks.append(("stitch: flight-recorder dump fired on the kill "
+                   f"and names the anomaly ({dump_seen})",
+                   dump_seen is not None))
+
+    # Defaults-off wire identity: the plain worker (no new flags) must
+    # expose NO flight block and the armed worker must expose one (the
+    # probe is sensitive); the data plane must be byte-identical
+    # between the two (same model, same request ⇒ same tokens, no new
+    # response keys).
+    # An armed worker that is NEITHER the killed lane (dead) NOR the
+    # drained lane (refusing admissions) serves the probe.
+    dead_or_draining = {moved["kill_port"]}
+    if moved["drained"]:
+        dead_or_draining.add(next(
+            p for p in ports[:4] if moved["drained"].endswith(f":{p}")))
+    armed_port = next(p for p in ports[:4] if p not in dead_or_draining)
+    _, h_plain = _call(ports[4], "GET", "/health", timeout=10)
+    _, h_armed = _call(armed_port, "GET", "/health", timeout=10)
+    plain_flight = (h_plain.get("generator") or {}).get("flight")
+    armed_flight = (h_armed.get("generator") or {}).get("flight")
+    checks.append(("stitch: defaults-off worker has no flight block, "
+                   "armed worker does",
+                   plain_flight is None and armed_flight is not None))
+    probe = {"request_id": "wire_probe", "prompt_tokens": [2, 4, 6],
+             "max_new_tokens": 6}
+    _, r_plain = _call(ports[4], "POST", "/generate", dict(probe),
+                       timeout=600)
+    _, r_armed = _call(armed_port, "POST", "/generate", dict(probe),
+                       timeout=600)
+    checks.append(("stitch: /generate wire schema identical with "
+                   "flags on vs off",
+                   sorted(r_plain) == sorted(r_armed)
+                   and r_plain.get("tokens") == r_armed.get("tokens")))
+    gw.stop()
+    return {"stream": {"tokens": len(toks),
+                       "identical": toks == control[rid]},
+            "moved": moved, "hops": hops,
+            "trace": {"lanes": sorted(lanes),
+                      "spans": len(stitched.get("spans") or []),
+                      "orphans": stitched.get("orphans")},
+            "handoff": ho, "migration": mig, "failover": fo,
+            "flight_dump": dump_seen}
+
+
+def run_stitch_standalone() -> int:
+    import shutil
+    import tempfile
+
+    dump_dir = tempfile.mkdtemp(prefix="flight_stitch_")
+    obs = ("--trace-stitch", "--flight-recorder", "256",
+           "--flight-dump-dir", dump_dir)
+    ports, procs = launch_worker_procs(
+        5, per_worker_args=(("--role", "prefill") + obs,
+                            ("--role", "decode") + obs,
+                            ("--role", "decode") + obs,
+                            ("--role", "decode") + obs,
+                            ("--role", "decode")))
+    checks: list = []
+    try:
+        report = {"mode": "stitch-standalone", "worker_ports": ports,
+                  "phases": {"stitch": stitch_phase(ports, procs,
+                                                    checks, dump_dir)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+
 def run_all_standalone() -> int:
     """--all: every standalone chaos scenario in sequence, each in its
     own interpreter (a wedged scenario cannot poison the next), one JSON
     summary on stdout, nonzero exit when ANY scenario's check fails."""
     flags = ("--mixed", "--spec", "--crash", "--offload", "--quant",
              "--migrate", "--disagg", "--recurrent", "--tp",
-             "--overload", "--elastic")
+             "--overload", "--elastic", "--stitch")
     here = os.path.abspath(__file__)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -3040,6 +3275,22 @@ def main() -> int:
                          "/ drain-wedged degraded states with the fleet "
                          "still serving; fleet counters == fleet spans "
                          "throughout; ignores the other flags")
+    ap.add_argument("--stitch", action="store_true",
+                    help="standalone cross-lane trace-stitching "
+                         "scenario: spawns 1 prefill + 3 decode workers "
+                         "with --trace-stitch and the flight recorder "
+                         "armed (plus one defaults-off control worker), "
+                         "drives ONE stream through handoff + "
+                         "drain-migration + kill -9 resume, and asserts "
+                         "the stream lands byte-identical to the "
+                         "unmoved control, /admin/trace/<rid> returns "
+                         "ONE merged tree covering every reachable "
+                         "lane with zero orphaned spans, mobility "
+                         "counters == hop markers, the kill leaves a "
+                         "flight-recorder postmortem naming the "
+                         "anomaly, and the defaults-off worker's wire "
+                         "surfaces carry no new keys; ignores the "
+                         "other flags")
     ap.add_argument("--all", action="store_true",
                     help="run EVERY standalone chaos scenario in "
                          "sequence, each in its own interpreter, and "
@@ -3051,6 +3302,8 @@ def main() -> int:
         return run_all_standalone()
     if args.elastic:
         return run_elastic_standalone()
+    if args.stitch:
+        return run_stitch_standalone()
     if args.tp:
         return run_tp_standalone()
     if args.disagg:

@@ -418,6 +418,63 @@ def main() -> int:
         return bench.run_drain_ab(n_streams=6 if q else 10,
                                   max_new=24 if q else 48)
 
+    @stage(artifact, out, "profile_capture")
+    def _profile_capture():
+        # Tick-bounded device profiling THROUGH the serving surface
+        # (--profile-dir + POST /admin/profile {"ticks": N}): the
+        # kernel stages above measure ops in isolation; this one
+        # captures the live serving loop's device timeline for exactly
+        # N scheduler ticks and records where the trace landed — the
+        # on-chip truth ROADMAP item 1 wants starts from this capture,
+        # not ad-hoc benchmarks. Launches a server subprocess, so it
+        # runs in the late (server) group.
+        import tempfile
+        import threading
+
+        from tools.fault_injection import _call, launch_worker_procs
+
+        prof_dir = tempfile.mkdtemp(prefix="onchip_profile_")
+        ports, procs = launch_worker_procs(
+            1, extra_args=("--profile-dir", prof_dir))
+        try:
+            port = ports[0]
+            done = threading.Event()
+
+            def drive():
+                i = 0
+                while not done.is_set():
+                    try:
+                        _call(port, "POST", "/generate",
+                              {"request_id": f"prof_{i}",
+                               "prompt_tokens": [5, 9, 3, 17],
+                               "max_new_tokens": 32}, timeout=600)
+                    except OSError:
+                        return
+                    i += 1
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            _, started = _call(port, "POST", "/admin/profile",
+                               {"ticks": 6 if q else 24}, timeout=60)
+            status: dict = {}
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                _, status = _call(port, "GET", "/admin/profile",
+                                  timeout=10)
+                if not status.get("ticks_left"):
+                    break
+                time.sleep(0.5)
+            done.set()
+            t.join(timeout=60)
+            n_files = sum(len(names) for _, _, names
+                          in os.walk(prof_dir))
+            return {"started": started, "final_status": status,
+                    "trace_files": n_files, "profile_dir": prof_dir}
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
     @stage(artifact, out, "tp_serving")
     def _tp_serving():
         # Tensor-parallel continuous serving on-chip: the equal-per-
@@ -442,7 +499,7 @@ def main() -> int:
                _spec_cont, _spec, _kv_quant, _affinity, _migration,
                _tp_serving,
                _prefill_mfu, _compute_sweep, _longctx, _decode_ab,
-               _miss_sweep):
+               _miss_sweep, _profile_capture):
         fn()
     print("[campaign] done", flush=True)
     return 0

@@ -702,13 +702,22 @@ class BlockPool:
                     np.array(self._host_vs[slot])]
         return out
 
-    def export_chain(self, sources: Sequence) -> dict:
+    def export_chain(self, sources: Sequence,
+                     trace: Optional[dict] = None) -> dict:
         """Serialize a block chain. Each source is a device block id
         (int) or a ``_RadixNode`` (demoted nodes export from the host
         tier; resident ones from their device block). Returns the
         JSON-safe wire dict; ``import_chain`` on any same-geometry pool
         reproduces the exact bytes (tested bit-exact for bf16, int8 +
-        scale, and host-demoted chains)."""
+        scale, and host-demoted chains).
+
+        ``trace``: optional trace-context header (cross-lane trace
+        stitching, DESIGN.md "Observability plane") carried as a gated
+        additive ``"trace"`` key — pure telemetry. Import-side
+        validation (``chain_compatible``/``verify_chain``) checks named
+        keys and block payloads only, so traced chains import into
+        un-stitched lanes (and vice versa) unchanged; ``None`` (the
+        default) keeps the wire dict byte-identical to today."""
         # Resolve each source to (device block id | host slot), then read
         # ALL device blocks in one batched gather+transfer per tensor.
         resolved = []
@@ -756,6 +765,8 @@ class BlockPool:
             # (chain_compatible), and the caller's replay fallback
             # recomputes instead.
             out["tp"] = self.tp
+        if trace:
+            out["trace"] = dict(trace)
         return out
 
     def chain_compatible(self, chain: dict) -> Optional[str]:

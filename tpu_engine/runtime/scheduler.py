@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import collections
 import functools
+import json
+import os
 import queue
 import threading
 import time
@@ -740,6 +742,38 @@ class ContinuousGenerator:
         # (WorkerConfig.scheduler_stall_s turns it into unhealthy).
         self._last_tick = time.monotonic()
         self._prefill_busy_since = None
+        # Cross-lane trace stitching (set by the serving worker when
+        # --trace-stitch is on): _do_export snapshots then carry the
+        # stream's trace context (additive "traceparent" snapshot field
+        # + a gated "trace" chain header) so the importing lane
+        # re-parents its spans under the SAME trace. Off = snapshot and
+        # chain wire bytes identical to today.
+        self.trace_stitch = False
+        # Per-tick flight recorder (DESIGN.md "Observability plane"):
+        # a bounded ring of per-tick records — rows by state, token
+        # budget used, dispatch wall time, queue/park/held depths, pool
+        # occupancy — the postmortem black box. Configured
+        # post-construction by the serving worker
+        # (configure_flight_recorder); capacity 0 = off, zero per-tick
+        # work. The ring is written by the decode thread and read by
+        # scrape threads (/admin/timeline), hence the lock.
+        self._flight_capacity = 0
+        self._flight_ring: "collections.deque" = collections.deque(maxlen=1)
+        self._flight_lock = threading.Lock()
+        self._flight_dump_dir = None
+        self._flight_last_dump = None
+        self._flight_dumps = 0
+        self._flight_last_dump_ts = 0.0
+        # Previous cumulative counter readings (per-tick deltas) plus a
+        # rolling 10 s deadline-miss window for burst detection.
+        # Decode-thread-owned.
+        self._flight_prev: dict = {}
+        self._flight_miss_window: "collections.deque" = collections.deque()
+        # jax.profiler capture bounded in scheduler ticks
+        # (start_profile): armed by /admin/profile, counted down at the
+        # top of each decode tick, stopped on reaching zero.
+        self._profile_ticks_left = 0
+        self._profile_result = None
         self._running = True
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="continuous-prefill", daemon=True)
@@ -1899,6 +1933,16 @@ class ContinuousGenerator:
             self._bump_migration("export_refused")
             return {"ok": False, "reason": "row already finishing"}
         pos = int(self._pos[row])
+        # Cross-lane trace stitching (gated on the worker's
+        # --trace-stitch AND the request actually being traced): the
+        # snapshot carries the row's trace context so the importing
+        # lane re-parents its spans under the SAME trace, and the KV
+        # chain carries the matching telemetry header. Both additive;
+        # un-stitched exports keep today's wire bytes exactly.
+        trace_hdr = None
+        if self.trace_stitch and req.sink is not None:
+            trace_hdr = {"trace_id": req.sink.ctx.trace_id,
+                         "parent_id": req.sink.ctx.span_id}
         if self._slab:
             # The whole autoregressive state is ONE slab row — it ships
             # as a one-pseudo-block chain over the same wire format, so
@@ -1908,6 +1952,8 @@ class ContinuousGenerator:
             with self._spool.lock:
                 chain = self._spool.export_row_chain(
                     self._slab_rows[row])
+            if trace_hdr is not None:
+                chain = dict(chain, trace=trace_hdr)
             if req.sink is not None:
                 dur_us = (time.perf_counter() - t0) * 1e6
                 req.sink.stage("state_export", dur_us,
@@ -1919,7 +1965,8 @@ class ContinuousGenerator:
             bs = pool.block_size
             n_chain = (pos - 1) // bs + 1 if pos > 0 else 0
             with pool.lock:
-                chain = pool.export_chain(self._row_blocks[row][:n_chain])
+                chain = pool.export_chain(self._row_blocks[row][:n_chain],
+                                          trace=trace_hdr)
             # The bucket-truncated prompt is what the row's 0-aligned
             # columns actually hold (same formula as admission).
             pb = next((b for b in self._prompt_buckets
@@ -1943,6 +1990,12 @@ class ContinuousGenerator:
             "stop_tokens": [int(t) for t in req.stop_tokens],
             "chain": chain,
         }
+        if trace_hdr is not None:
+            # The importing worker parses this exactly like a request
+            # traceparent (TraceContext.from_request), so the resumed
+            # row's spans join the exporting row's trace tree. Additive:
+            # submit_import tolerates unknown snapshot keys.
+            snap["traceparent"] = req.sink.ctx.to_traceparent()
         exc = StreamMigratedAway(
             f"stream migrated off this lane after {req.streamed} tokens",
             tokens_emitted=req.streamed)
@@ -2095,7 +2148,175 @@ class ContinuousGenerator:
             out["brownout"] = {"budget_frac": self._bo_budget_frac,
                                "spec_suspended": self._bo_spec_off,
                                "swap_in_deferred": self._bo_defer_swap}
+        # Additive, present only with the flight recorder configured
+        # (defaults-off stats bytes unchanged).
+        if self._flight_capacity:
+            with self._flight_lock:
+                ticks_recorded = len(self._flight_ring)
+            fl = {"capacity": self._flight_capacity,
+                  "ticks_recorded": ticks_recorded,
+                  "dumps": self._flight_dumps}
+            last = self._flight_last_dump
+            if last is not None:
+                fl["last_anomaly"] = last["anomaly"]
+            out["flight"] = fl
         return out
+
+    # -- flight recorder / bounded profiler (observability plane) -------------
+
+    def configure_flight_recorder(self, capacity: int,
+                                  dump_dir: Optional[str] = None) -> None:
+        """Arm the per-tick flight recorder (serving worker, at startup —
+        before traffic). capacity = ring length in ticks; 0 keeps it off
+        (zero per-tick work, no /stats block)."""
+        capacity = max(0, int(capacity))
+        with self._flight_lock:
+            self._flight_capacity = capacity
+            self._flight_ring = collections.deque(maxlen=max(1, capacity))
+            self._flight_dump_dir = dump_dir
+
+    def _flight_sample(self, tick_wall_s: float) -> None:
+        """One bounded per-tick record (decode thread). Everything read
+        here is decode-thread-owned or a GIL-atomic scrape; the only
+        lock taken is the ring's (vs /admin/timeline readers)."""
+        st = self._stats
+        cur = {"chunks": st.get("chunks", 0),
+               "admitted": st.get("admitted", 0),
+               "completed": st.get("completed", 0),
+               "deadline_cancelled": st.get("deadline_cancelled", 0)}
+        mixed = st.get("mixed")
+        if mixed:
+            cur["prefill_tokens"] = mixed["prefill_tokens"]
+            cur["decode_tokens"] = mixed["decode_tokens"]
+        prev, self._flight_prev = self._flight_prev, cur
+        rows = self._row_req
+        rec = {"ts": round(time.time(), 6),
+               "tick_wall_ms": round(tick_wall_s * 1e3, 3),
+               "active": int(sum(r is not None for r in rows)),
+               "held": int(sum(1 for h in self._held if h)),
+               "queued": self._queue.qsize(),
+               "ready": self._ready.qsize()}
+        for k, v in cur.items():
+            rec[k] = v - prev.get(k, 0)
+        if self._paged or self._slab:
+            rec["parked"] = len(self._pending)
+        if self._mixed:
+            rec["prefilling"] = int(sum(1 for p in self._prefilling if p))
+        if self._paged:
+            ps = self._pool.stats()
+            pool = {"blocks_free": ps["blocks_free"],
+                    "blocks_total": ps["blocks_total"]}
+            host = ps.get("host")
+            if host:
+                pool["host_blocks_used"] = host["blocks_used"]
+            rec["pool"] = pool
+        elif self._slab:
+            ss = self._spool.stats()
+            rec["pool"] = {"rows_free": ss["rows_free"],
+                           "rows_total": ss["rows_total"]}
+        if self._draining_flag:
+            rec["draining"] = True
+        if self._bo_budget_frac < 1.0 or self._bo_spec_off:
+            rec["brownout_budget_frac"] = self._bo_budget_frac
+        with self._flight_lock:
+            self._flight_ring.append(rec)
+        # Deadline-miss burst: >= 4 misses inside a rolling 10 s window
+        # is an anomaly worth a postmortem artifact, not just a counter.
+        dmiss = rec.get("deadline_cancelled", 0)
+        if dmiss:
+            now_m = time.monotonic()
+            self._flight_miss_window.append((now_m, dmiss))
+            while (self._flight_miss_window
+                   and self._flight_miss_window[0][0] < now_m - 10.0):
+                self._flight_miss_window.popleft()
+            if sum(n for _, n in self._flight_miss_window) >= 4:
+                self._flight_miss_window.clear()
+                self._flight_anomaly("deadline_miss_burst")
+
+    def flight_dump(self, reason: str) -> Optional[dict]:
+        """Force a postmortem dump (gateway degraded-fleet entry, or an
+        operator via POST /admin/timeline). Returns the dump descriptor,
+        or None with the recorder off."""
+        return self._flight_anomaly(str(reason), force=True)
+
+    def _flight_anomaly(self, reason: str,
+                        force: bool = False) -> Optional[dict]:
+        """Dump the ring as a postmortem artifact, named for the anomaly
+        (_recover, deadline_miss_burst, fleet_degraded, operator).
+        Rate-limited to one dump per 10 s unless forced — a crash loop
+        must not turn the dump dir into its own incident."""
+        if not self._flight_capacity:
+            return None
+        now_m = time.monotonic()
+        with self._flight_lock:
+            if not force and now_m - self._flight_last_dump_ts < 10.0:
+                return None
+            self._flight_last_dump_ts = now_m
+            ring = list(self._flight_ring)
+        scalars = {k: v for k, v in dict(self._stats).items()
+                   if not isinstance(v, dict)}
+        dump = {"anomaly": reason, "ts": time.time(),
+                "node": self.trace_node, "ticks": len(ring),
+                "stats": scalars, "timeline": ring}
+        path = None
+        if self._flight_dump_dir:
+            try:
+                os.makedirs(self._flight_dump_dir, exist_ok=True)
+                path = os.path.join(
+                    self._flight_dump_dir,
+                    f"flight_{self.trace_node}_"
+                    f"{int(dump['ts'] * 1e3)}_{reason}.json")
+                with open(path, "w") as f:
+                    json.dump(dump, f)
+            except OSError:
+                path = None  # telemetry must never take down serving
+        last = {"anomaly": reason, "ts": dump["ts"],
+                "ticks": len(ring), "path": path}
+        with self._flight_lock:
+            self._flight_dumps += 1
+            self._flight_last_dump = last
+        return last
+
+    def flight_timeline(self, n: Optional[int] = None) -> dict:
+        """The /admin/timeline payload: ring contents (newest last) plus
+        dump bookkeeping. Read-side; safe from any thread."""
+        with self._flight_lock:
+            ring = list(self._flight_ring)
+        if n:
+            ring = ring[-int(n):]
+        return {"enabled": bool(self._flight_capacity),
+                "capacity": self._flight_capacity,
+                "ticks": len(ring),
+                "dumps": self._flight_dumps,
+                "last_dump": self._flight_last_dump,
+                "timeline": ring}
+
+    def start_profile(self, log_dir: str, ticks: int) -> dict:
+        """jax.profiler capture bounded in SCHEDULER TICKS: start the
+        device trace now; the decode loop stops it after `ticks` more
+        ticks (the serving loop's natural unit — one ragged dispatch per
+        tick in mixed mode), so a capture brackets exactly the dispatch
+        cadence the on-chip campaign wants to study."""
+        from tpu_engine.utils import tracing
+
+        res = tracing.profiler_start(log_dir)
+        if res.get("ok"):
+            self._profile_result = None
+            self._profile_ticks_left = max(1, int(ticks))
+            res["ticks"] = self._profile_ticks_left
+        return res
+
+    def stop_profile(self) -> dict:
+        from tpu_engine.utils import tracing
+
+        self._profile_ticks_left = 0
+        res = tracing.profiler_stop()
+        self._profile_result = res
+        return res
+
+    def profile_status(self) -> dict:
+        return {"ticks_left": self._profile_ticks_left,
+                "last_result": self._profile_result}
 
     def stop(self) -> None:
         self._running = False
@@ -3207,6 +3428,10 @@ class ContinuousGenerator:
         self._tok[:] = 0
         self._done[:] = True
         self._stats["failures"] = self._stats.get("failures", 0) + 1
+        # Postmortem black box: the ticks LEADING UP to a device-step
+        # failure are exactly what a triage needs — dump them now, named
+        # for the recovery, before the rebuild wipes the evidence.
+        self._flight_anomaly(f"recover:{type(exc).__name__}")
         if self._paged:
             # The donated pool buffers may be invalid: rebuild the pool,
             # dropping the radix tree (its blocks died with the pool).
@@ -4038,7 +4263,20 @@ class ContinuousGenerator:
 
     def _loop_body(self) -> None:
         while self._running:
-            self._last_tick = time.monotonic()  # liveness heartbeat
+            now = time.monotonic()
+            if self._flight_capacity:
+                # One bounded record per tick; the wall delta since the
+                # previous heartbeat IS the previous iteration's total
+                # dispatch + bookkeeping time (idle waits included).
+                self._flight_sample(now - self._last_tick)
+            if self._profile_ticks_left > 0:
+                # Tick-bounded jax.profiler capture (start_profile).
+                self._profile_ticks_left -= 1
+                if self._profile_ticks_left == 0:
+                    from tpu_engine.utils import tracing
+
+                    self._profile_result = tracing.profiler_stop()
+            self._last_tick = now  # liveness heartbeat
             # Live rows' block growth outranks new admissions for pool
             # space (an admitted row must never be starved mid-stream by
             # a newcomer).

@@ -24,7 +24,7 @@ from tpu_engine.serving.worker import WorkerNode
 from tpu_engine.utils.config import GatewayConfig, WorkerConfig
 from tpu_engine.utils.deadline import ShedError
 from tpu_engine.utils.metrics import render_prometheus
-from tpu_engine.utils.tracing import export_chrome
+from tpu_engine.utils.tracing import export_chrome, stitch_trace
 
 
 def model_from_path(path_or_name: str) -> str:
@@ -99,6 +99,25 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     server.route("POST", "/admin/role",
                  lambda body: (200, worker.set_role((body or {}).get(
                      "role", ""))))
+    # Observability plane (DESIGN.md): the per-tick flight recorder
+    # (GET = ring contents, POST {"dump": reason} = forced postmortem)
+    # and the tick-bounded jax.profiler capture (needs --profile-dir;
+    # POST {"ticks": N} | {"action": "stop"|"status"}).
+    server.route("GET", "/admin/timeline",
+                 lambda body: (200, worker.handle_timeline(body)))
+    server.route("POST", "/admin/timeline",
+                 lambda body: (200, worker.handle_timeline(body or {})))
+    server.route("POST", "/admin/profile",
+                 lambda body: (200, worker.handle_profile(body or {})))
+    server.route("GET", "/admin/profile",
+                 lambda body: (200, worker.handle_profile(
+                     {"action": "status"})))
+    # Cross-lane stitching, single-lane flavor: only this lane's
+    # fragments (the gateway's /admin/trace merges the whole fleet).
+    server.route_prefix(
+        "GET", "/admin/trace/",
+        lambda _body, rid: (200, stitch_trace(
+            {worker.node_id: worker.tracer.snapshot()}, rid)))
     _print_worker_banner(worker, config)
     server.start(background=background)
     return worker, server
@@ -144,6 +163,19 @@ def serve_gateway(worker_urls: List[str], config: Optional[GatewayConfig] = None
     # non-raising status.
     server.route("POST", "/admin/fleet", lambda body: (
         200, gateway.fleet_admin(body or {})))
+    # Observability plane: the merged cross-lane stitch (fragments
+    # pulled from each lane's /trace/export — best-effort on dead
+    # lanes) and the SLO burn status. Both answer with their flags
+    # off: the stitch falls back to request_id correlation; /admin/slo
+    # names the missing objectives instead of 404ing.
+    server.route_prefix(
+        "GET", "/admin/trace/",
+        lambda _body, rid: (200, gateway.stitched_trace(rid)))
+    server.route("GET", "/admin/slo", lambda _body: (
+        200, gateway.slo_status()
+        or {"error": "no objectives configured "
+                     "(set --slo-ttft-p99-ms / --slo-itl-p99-ms / "
+                     "--slo-completion-p99-ms)"}))
     if config.autoscale or standby_workers:
         gateway.engage_autoscaler(
             provider=StandbyLaneProvider(list(standby_workers or [])))
@@ -554,15 +586,43 @@ def serve_combined(
     def _admin_profile(body):
         from tpu_engine.utils import tracing
 
+        body = body or {}
         if body.get("action") == "start":
             return 200, tracing.profiler_start(body.get("log_dir", "/tmp/tpu_engine_profile"))
         if body.get("action") == "stop":
             return 200, tracing.profiler_stop()
-        return 400, {"error": "action must be start|stop"}
+        # Tick-bounded capture (observability plane): {"ticks": N
+        # [, "node": id]} arms ONE lane's scheduler to stop the trace
+        # after exactly N ticks — the bounded stages onchip_campaign.py
+        # drives (needs the lane's --profile-dir). {"action": "status"}
+        # reports ticks left + the last capture.
+        node = body.get("node")
+        targets = [w for w in workers
+                   if node in (None, "*") or w.node_id == node]
+        if not targets:
+            return 404, {"error": f"unknown node '{node}'"}
+        if body.get("action") == "status" or body.get("ticks"):
+            return 200, targets[0].handle_profile(body)
+        return 400, {"error": "action must be start|stop|status, "
+                              "or pass ticks"}
+
+    # Flight recorder (observability plane): GET = every lane's tick
+    # ring; POST {"dump": reason[, "node": id]} = forced postmortem.
+    def _admin_timeline(body):
+        body = body or {}
+        node = body.get("node")
+        targets = [w for w in workers
+                   if node in (None, "*") or w.node_id == node]
+        if not targets:
+            return 404, {"error": f"unknown node '{node}'"}
+        return 200, {"lanes": {w.node_id: w.handle_timeline(body)
+                               for w in targets}}
 
     routes[("GET", "/trace")] = _trace
     routes[("GET", "/trace/export")] = _trace_export
     routes[("POST", "/admin/profile")] = _admin_profile
+    routes[("GET", "/admin/timeline")] = _admin_timeline
+    routes[("POST", "/admin/timeline")] = _admin_timeline
     def _named_hists():
         named = {}
         for w in workers:
@@ -628,8 +688,19 @@ def serve_combined(
     routes[("POST", "/admin/reload")] = _admin_reload
     routes[("POST", "/score")] = (
         lambda body: (200, gateway.route_score(body)))
+    # Observability plane: SLO burn over the merged lane histograms
+    # (combined mode sees every lane's live TTFT/ITL windows) and the
+    # merged cross-lane stitch (in-process fragments, no HTTP hop).
+    routes[("GET", "/admin/slo")] = lambda _b: (
+        200, gateway.slo_status(_named_hists())
+        or {"error": "no objectives configured "
+                     "(set --slo-ttft-p99-ms / --slo-itl-p99-ms / "
+                     "--slo-completion-p99-ms)"})
+    prefix_routes = {("GET", "/admin/trace/"): (
+        lambda _b, rid: (200, gateway.stitched_trace(rid)))}
 
-    server = _make_front_server(port, routes, workers, gateway, native_front)
+    server = _make_front_server(port, routes, workers, gateway, native_front,
+                                prefix_routes=prefix_routes)
     kind = "native C++ front" if not isinstance(server, JsonHttpServer) else "python front"
     topo = (f"mesh {dict(mesh.shape)}" if mesh is not None
             else f"{n_lanes} lanes over {len(devices)} device(s)")
@@ -648,7 +719,8 @@ def serve_combined(
 
 
 def _make_front_server(port: int, routes: dict, workers, gateway,
-                       native_front: Optional[bool]):
+                       native_front: Optional[bool],
+                       prefix_routes: Optional[dict] = None):
     """Choose the serving edge: the C++ HttpFront (cache hits answered
     without the GIL; misses + misc routes fall back to Python) when the
     native lib and raw-mode lane caches are available, else the Python
@@ -683,6 +755,8 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
         server = JsonHttpServer(port)
         for (method, path), handler in routes.items():
             server.route(method, path, handler)
+        for (method, prefix), handler in (prefix_routes or {}).items():
+            server.route_prefix(method, prefix, handler)
         return server
 
     import json as _json
@@ -691,6 +765,17 @@ def _make_front_server(port: int, routes: dict, workers, gateway,
 
     def fallback(method: str, path: str, body: bytes):
         handler = routes.get((method, path))
+        if handler is None and prefix_routes:
+            # Prefix routes (e.g. /admin/trace/<request_id>): same
+            # longest-prefix-wins contract as JsonHttpServer.
+            for (m, prefix), ph in sorted(prefix_routes.items(),
+                                          key=lambda kv: -len(kv[0][1])):
+                if m == method and path.startswith(prefix) \
+                        and len(path) > len(prefix):
+                    suffix = path[len(prefix):]
+                    handler = (lambda body, _h=ph, _s=suffix:
+                               _h(body, _s))
+                    break
         if handler is None:
             return 404, _json.dumps({"error": f"no route {method} {path}"}).encode()
         try:

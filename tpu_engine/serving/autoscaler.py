@@ -304,6 +304,16 @@ class FleetAutoscaler:
         samples = self.observe()
         lanes = sorted(samples)
         mean = self.fleet_pressure(samples)
+        if getattr(self.config, "autoscale_slo_feed", False):
+            # SLO burn feed (observability plane, opt-in): take the MAX
+            # of lane pressure and the worst objective's burn mapped to
+            # [0, 1]. The feed only ever ADDS pressure — an idle fleet
+            # burning budget (e.g. TTFT blown by compile stalls) scales
+            # up, but a healthy burn can never mask lane saturation.
+            try:
+                mean = max(mean, gw.slo_pressure())
+            except Exception:
+                pass  # telemetry must never wedge the control loop
         gw.fleet_observe(mean)
         blind = sum(1 for v in samples.values() if v is None)
 

@@ -130,6 +130,101 @@ def _apply_autoscale_flags(args, gw_kw: dict) -> None:
         gw_kw["autoscale_rebalance_band"] = args.autoscale_rebalance_band
 
 
+def _add_slo_flags(parser) -> None:
+    """Observability-plane gateway flags shared by ``gateway`` and
+    ``serve`` (DESIGN.md "Observability plane"). All default to None /
+    off so defaults stay wire-byte-identical."""
+    parser.add_argument("--trace-stitch", action="store_true",
+                        help="cross-lane trace stitching: propagate each "
+                             "stream's trace context through every "
+                             "mobility hop (handoff, migration, crash "
+                             "resume) and keep a stream ledger so "
+                             "GET /admin/trace/<request_id> returns ONE "
+                             "merged Perfetto tree covering every lane "
+                             "the stream touched")
+    parser.add_argument("--trace-ledger-capacity", type=int, default=None,
+                        help="streams the stitch ledger remembers "
+                             "(FIFO eviction; default 512)")
+    parser.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                        help="TTFT latency objective in ms: --slo-target "
+                             "of first tokens must land under this; "
+                             "burn rate surfaces at /admin/slo, /stats "
+                             "and tpu_engine_slo_* (0/unset = off)")
+    parser.add_argument("--slo-itl-p99-ms", type=float, default=None,
+                        help="inter-token latency objective in ms "
+                             "(0/unset = off)")
+    parser.add_argument("--slo-completion-p99-ms", type=float,
+                        default=None,
+                        help="full request-completion latency objective "
+                             "in ms, measured at gateway scope — "
+                             "failover/handoff/migration time included "
+                             "(0/unset = off)")
+    parser.add_argument("--slo-target", type=float, default=None,
+                        help="good-sample fraction the objectives "
+                             "demand (default 0.99; error budget = "
+                             "1 - target)")
+    parser.add_argument("--slo-window-s", type=float, default=None,
+                        help="sliding burn-rate window seconds "
+                             "(default 300)")
+    parser.add_argument("--autoscale-slo-feed", action="store_true",
+                        help="feed SLO burn into the elastic-fleet "
+                             "controller: fleet pressure becomes "
+                             "max(lane pressure, worst burn / 2) — the "
+                             "feed only ever ADDS pressure (needs "
+                             "--autoscale and an --slo-* objective)")
+
+
+def _apply_slo_flags(args, gw_kw: dict) -> None:
+    if args.trace_stitch:
+        gw_kw["trace_stitch"] = True
+    if args.trace_ledger_capacity is not None:
+        gw_kw["trace_ledger_capacity"] = args.trace_ledger_capacity
+    if args.slo_ttft_p99_ms is not None:
+        gw_kw["slo_ttft_p99_ms"] = args.slo_ttft_p99_ms
+    if args.slo_itl_p99_ms is not None:
+        gw_kw["slo_itl_p99_ms"] = args.slo_itl_p99_ms
+    if args.slo_completion_p99_ms is not None:
+        gw_kw["slo_completion_p99_ms"] = args.slo_completion_p99_ms
+    if args.slo_target is not None:
+        gw_kw["slo_target"] = args.slo_target
+    if args.slo_window_s is not None:
+        gw_kw["slo_window_s"] = args.slo_window_s
+    if args.autoscale_slo_feed:
+        gw_kw["autoscale_slo_feed"] = True
+
+
+def _add_flight_flags(parser) -> None:
+    """Observability-plane worker flags shared by ``worker_node`` and
+    ``serve``: the per-tick flight recorder and the jax.profiler
+    capture directory."""
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="jax.profiler capture directory: arms "
+                             "POST /admin/profile {\"ticks\": N} to "
+                             "trace exactly N scheduler ticks into "
+                             "this dir (TensorBoard/Perfetto; "
+                             "unset = profiling refused)")
+    parser.add_argument("--flight-recorder", type=int, default=None,
+                        help="per-tick flight recorder: keep a ring of "
+                             "this many per-tick scheduler records "
+                             "(GET /admin/timeline), auto-dumped to a "
+                             "postmortem JSON on anomaly — recover, "
+                             "deadline-miss burst, degraded fleet "
+                             "state (0/unset = off)")
+    parser.add_argument("--flight-dump-dir", type=str, default=None,
+                        help="directory for flight-recorder postmortem "
+                             "dumps (unset = dumps stay in-memory, "
+                             "visible via /admin/timeline last_dump)")
+
+
+def _apply_flight_flags(args, gen_kw: dict) -> None:
+    if args.profile_dir is not None:
+        gen_kw["profile_dir"] = args.profile_dir
+    if args.flight_recorder is not None:
+        gen_kw["flight_recorder"] = args.flight_recorder
+    if args.flight_dump_dir is not None:
+        gen_kw["flight_dump_dir"] = args.flight_dump_dir
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
@@ -228,6 +323,13 @@ def main(argv=None) -> int:
                                  "decode lanes; flippable at runtime "
                                  "via /admin/role (default: both = "
                                  "today's colocated behavior)")
+        parser.add_argument("--trace-stitch", action="store_true",
+                            help="cross-lane trace stitching (worker "
+                                 "side): exported row snapshots and KV "
+                                 "chains carry the stream's trace "
+                                 "context so the importing lane's spans "
+                                 "join the same tree")
+        _add_flight_flags(parser)
         args = parser.parse_args(rest)
         port = args.port
         node_id = args.node_id or f"worker_{port}"
@@ -281,6 +383,9 @@ def main(argv=None) -> int:
             gen_kw["brownout"] = True
         if args.role is not None:
             gen_kw["role"] = args.role
+        if args.trace_stitch:
+            gen_kw["trace_stitch"] = True
+        _apply_flight_flags(args, gen_kw)
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
                            model_path=model_path, **gen_kw)
@@ -386,6 +491,7 @@ def main(argv=None) -> int:
                                  "budget in seconds, clamped to the "
                                  "stream's deadline (default 30)")
         _add_autoscale_flags(parser)
+        _add_slo_flags(parser)
         parser.add_argument("--standby-worker", action="append",
                             default=None, metavar="HOST:PORT",
                             help="pre-launched worker ADDRESS for the "
@@ -406,6 +512,7 @@ def main(argv=None) -> int:
         if args.migrate_streams:
             gw_kw["migrate_streams"] = True
         _apply_autoscale_flags(args, gw_kw)
+        _apply_slo_flags(args, gw_kw)
         if args.migrate_timeout is not None:
             gw_kw["migrate_timeout_s"] = args.migrate_timeout
         if args.drain_timeout is not None:
@@ -779,6 +886,8 @@ def main(argv=None) -> int:
                                  "budget in seconds, clamped to the "
                                  "stream's deadline (default 30)")
         _add_autoscale_flags(parser)
+        _add_slo_flags(parser)
+        _add_flight_flags(parser)
         args = parser.parse_args(rest)
         gw_kw = {}
         if args.breaker_timeout is not None:
@@ -831,6 +940,7 @@ def main(argv=None) -> int:
         if args.handoff_timeout is not None:
             gw_kw["handoff_timeout_s"] = args.handoff_timeout
         _apply_autoscale_flags(args, gw_kw)
+        _apply_slo_flags(args, gw_kw)
         gateway_config = None
         if gw_kw:
             from tpu_engine.utils.config import GatewayConfig
@@ -870,6 +980,12 @@ def main(argv=None) -> int:
             bb_kw["brownout"] = True
         if args.brownout_clamp_tokens is not None:
             bb_kw["brownout_clamp_tokens"] = args.brownout_clamp_tokens
+        # One --trace-stitch flag arms BOTH halves in combined mode: the
+        # gateway's ledger + payload injection and the lanes' snapshot /
+        # chain trace headers.
+        if args.trace_stitch:
+            bb_kw["trace_stitch"] = True
+        _apply_flight_flags(args, bb_kw)
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,
                                      gen_draft_model=args.gen_draft_model,

@@ -129,6 +129,16 @@ class LocalWorkerClient:
     def health(self) -> dict:
         return self.worker.get_health()
 
+    def trace_spans(self) -> list:
+        """The lane's span-ring snapshot (recorder schema) — the
+        gateway's /admin/trace stitcher pulls fragments through this."""
+        return self.worker.tracer.snapshot()
+
+    def flight_dump(self, reason: str):
+        """Force a flight-recorder postmortem dump on the lane (None
+        when the lane runs no recorder)."""
+        return self.worker.flight_dump(reason)
+
 
 def parse_worker_url(url: str, default_port: int = 8080) -> Tuple[str, int]:
     """'host', 'host:port', or 'http://host:port' → (host, port). Default
@@ -418,6 +428,42 @@ class HttpWorkerClient:
 
     def health(self) -> dict:
         return self._request("GET", "/health")
+
+    def trace_spans(self) -> list:
+        """The lane's spans reconstructed from GET /trace/export — the
+        chrome "X" events round-trip back to recorder-snapshot schema
+        (op/start/duration plus the tree ids riding in ``args``), which
+        is all the gateway-side stitcher needs from a remote lane."""
+        data = self._request("GET", "/trace/export")
+        spans = []
+        for ev in data.get("traceEvents") or []:
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            if args.get("evicted_parent"):
+                continue  # synthetic root; re-synthesized at stitch time
+            span = {
+                "request_id": args.get("request_id"),
+                "op": ev.get("name"),
+                "node": self.url,
+                "duration_us": int(ev.get("dur", 0)),
+                "start_ts": float(ev.get("ts", 0)) / 1e6,
+                "ts": (float(ev.get("ts", 0)) + ev.get("dur", 0)) / 1e6,
+            }
+            for k in ("trace_id", "span_id", "parent_id", "cached",
+                      "batch_size"):
+                if k in args:
+                    span[k] = args[k]
+            extra = {k: v for k, v in args.items()
+                     if k not in span and k != "request_id"}
+            if extra:
+                span["attrs"] = extra
+            spans.append(span)
+        return spans
+
+    def flight_dump(self, reason: str) -> dict:
+        """Force a flight-recorder postmortem dump on the lane."""
+        return self._request("POST", "/admin/timeline", {"dump": reason})
 
     def probe_health(self, timeout_s: float = 5.0) -> dict:
         """/health on a DEDICATED short-lived connection, bypassing the

@@ -83,7 +83,16 @@ from tpu_engine.utils.deadline import (
     Overloaded,
     ShedError,
 )
-from tpu_engine.utils.tracing import SpanRecorder, TraceContext
+from tpu_engine.serving.slo import (
+    OBJECTIVE_SOURCES,
+    SloTracker,
+    completion_hists,
+)
+from tpu_engine.utils.tracing import (
+    SpanRecorder,
+    TraceContext,
+    stitch_trace,
+)
 
 
 class GatewayError(Exception):
@@ -260,6 +269,51 @@ class _RouteTrace:
         return self.parent is not None
 
 
+class _StreamLedger:
+    """Which lanes served each request_id, hop by hop — the index the
+    cross-lane trace stitcher (GET /admin/trace/<rid>) walks to know
+    WHOSE ring buffers hold a mobile stream's span fragments. Mobility
+    machinery records one entry per hop (admit / handoff / migrate /
+    resume) at the exact points the stream's serving lane changes;
+    entries OUTLIVE the stream record (stitching is a postmortem read).
+    Bounded FIFO over request_ids; own lock (ledger writes happen inside
+    relay loops that must never contend with routing's _lock)."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = max(1, int(capacity))
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._llock = threading.Lock()
+
+    def hop(self, request_id: str, lane: str, kind: str,
+            trace_id: Optional[str] = None) -> None:
+        with self._llock:
+            ent = self._entries.get(request_id)
+            if ent is None:
+                while len(self._entries) >= self.capacity:
+                    self._entries.popitem(last=False)
+                ent = {"trace_id": trace_id, "hops": []}
+                self._entries[request_id] = ent
+            elif trace_id and not ent["trace_id"]:
+                ent["trace_id"] = trace_id
+            ent["hops"].append({"lane": lane, "kind": kind,
+                                "ts": round(time.time(), 6)})
+
+    def get(self, request_id: str) -> Optional[dict]:
+        with self._llock:
+            ent = self._entries.get(request_id)
+            if ent is None:
+                return None
+            return {"trace_id": ent["trace_id"],
+                    "hops": [dict(h) for h in ent["hops"]]}
+
+    def summary(self) -> dict:
+        with self._llock:
+            return {"streams": len(self._entries),
+                    "capacity": self.capacity,
+                    "hops": sum(len(e["hops"])
+                                for e in self._entries.values())}
+
+
 class Gateway:
     def __init__(self, workers=None, config: Optional[GatewayConfig] = None):
         """``workers``: list of worker URLs (HTTP mode), WorkerNode objects
@@ -378,6 +432,20 @@ class Gateway:
         self._fleet_degraded: Dict[str, str] = {}
         self._fleet_pressure: Optional[float] = None
         self._autoscaler = None
+        # Observability plane (DESIGN.md "Observability plane"; both
+        # default off — absent, /stats and wire bytes stay identical).
+        # The stream ledger records which lanes served each request_id
+        # so /admin/trace/<rid> can stitch a mobile stream's fragments;
+        # the SLO tracker turns the existing TTFT/ITL/completion
+        # histograms into windowed error-budget burn.
+        self._ledger: Optional[_StreamLedger] = (
+            _StreamLedger(getattr(self.config, "trace_ledger_capacity",
+                                  512))
+            if getattr(self.config, "trace_stitch", False) else None)
+        # Bounded lane→client handles kept past removal (drained lanes
+        # stay reachable for postmortem trace stitching).
+        self._retired_clients: Dict[str, object] = {}
+        self._slo = SloTracker.from_config(self.config)
         self._probe_state = ProbeStateMachine(
             self.config.health_probe_failures)
         self._prober_stop = threading.Event()
@@ -659,7 +727,15 @@ class Gateway:
         self._prefill_ring.remove_node(name)
         with self._lock:
             rings = dict(self._model_rings)
-            self._clients.pop(name, None)
+            removed_client = self._clients.pop(name, None)
+            if self._ledger is not None and removed_client is not None:
+                # The stitcher may still need this lane's span fragments
+                # (a drained lane is alive, just not a member): keep a
+                # BOUNDED handle so /admin/trace can reach it postmortem.
+                self._retired_clients[name] = removed_client
+                while len(self._retired_clients) > 8:
+                    self._retired_clients.pop(
+                        next(iter(self._retired_clients)))
             self._breakers.pop(name, None)
             self._latency.pop(name, None)  # stale window must not feed thresholds
             self._lane_recent.pop(name, None)
@@ -723,6 +799,16 @@ class Gateway:
                 return
             self._fleet_degraded[lane] = reason
         self._fleet_count("degraded_entered", lane=lane, reason=reason)
+        # Flight-recorder anomaly hook: entering a degraded fleet state
+        # is exactly the moment an operator wants the last N ticks of
+        # every lane on disk. Best-effort — lanes without a recorder
+        # (or unreachable ones) simply skip.
+        for name, client in self.lane_clients().items():
+            if hasattr(client, "flight_dump"):
+                try:
+                    client.flight_dump(f"fleet_degraded:{reason}")
+                except Exception:
+                    pass
 
     def fleet_clear_degraded(self, lane: str) -> bool:
         """Clear a lane's degraded state (controller recovery sweep or
@@ -751,6 +837,107 @@ class Gateway:
         }
         if pressure is not None:
             out["pressure"] = pressure
+        return out
+
+    # -- observability plane (DESIGN.md "Observability plane") ---------------
+
+    def slo_status(self, named_hists: Optional[dict] = None) -> Optional[dict]:
+        """The /admin/slo payload, or None when no objective is
+        configured. ``named_hists`` is the combined front's merged
+        ``{family: {node: hist}}`` map; without it the gateway gathers
+        what it can reach directly — in-process lanes expose their live
+        ``latency_histograms()``, remote (HTTP) lanes contribute nothing
+        (their TTFT/ITL windows live behind /metrics text, not live
+        objects; the completion objective still covers them because it
+        reads the GATEWAY's own request-level histograms)."""
+        if self._slo is None:
+            return None
+        if named_hists is None:
+            named_hists = {}
+            for lane, client in self.lane_clients().items():
+                w = getattr(client, "worker", None)
+                if w is None or not hasattr(w, "latency_histograms"):
+                    continue
+                for name, by_node in w.latency_histograms().items():
+                    named_hists.setdefault(name, {}).update(by_node)
+        by_objective = {}
+        for name, family in OBJECTIVE_SOURCES.items():
+            if family is None:
+                # "completion" = the gateway's own generate-op spans:
+                # full client-visible latency including failover,
+                # handoff, and migration time.
+                by_objective[name] = completion_hists([self.tracer])
+            else:
+                by_objective[name] = list(
+                    (named_hists.get(family) or {}).values())
+        return self._slo.status(by_objective)
+
+    def slo_pressure(self, named_hists: Optional[dict] = None) -> float:
+        """The autoscaler feed: worst objective burn mapped to [0, 1]
+        (0.0 with no tracker — the feed is strictly additive)."""
+        if self._slo is None:
+            return 0.0
+        status = self.slo_status(named_hists)
+        return SloTracker.pressure(status or {})
+
+    def stitched_trace(self, request_id: str,
+                       fragments: Optional[dict] = None) -> dict:
+        """The /admin/trace/<request_id> body: every lane's span
+        fragments for one (possibly thrice-moved) stream merged into a
+        single tree. The stream ledger supplies the trace_id and the
+        hop history when stitching is on; without a ledger entry (plain
+        deployments, evicted entries) the stitch still works from the
+        request_id + derived trace_id — the ledger is an index, not the
+        data. Lane fragment collection is best-effort: a dead lane
+        contributes nothing rather than failing the whole stitch (its
+        spans died with it; the synthetic ``evicted_parent`` roots keep
+        the surviving tree connected)."""
+        entry = (self._ledger.get(request_id)
+                 if self._ledger is not None else None)
+        if fragments is None:
+            fragments = {"gateway": self.tracer.snapshot()}
+            for lane, client in self.lane_clients().items():
+                if not hasattr(client, "trace_spans"):
+                    continue
+                try:
+                    spans = client.trace_spans()
+                except Exception:
+                    continue
+                if spans:
+                    fragments.setdefault(lane, spans)
+            # A drained lane is alive but no longer a ring member — the
+            # ledger remembers it served this stream, so chase its
+            # fragments through the retired-client handle (kept by
+            # remove_worker) or a fresh HTTP probe (best-effort: a
+            # KILLED lane's spans died with it and simply fail here).
+            with self._lock:
+                retired = dict(self._retired_clients)
+            for hop in (entry or {}).get("hops", ()):
+                lane = hop.get("lane") or ""
+                if not lane or lane in fragments:
+                    continue
+                client = retired.get(lane)
+                if client is None and ":" in lane:
+                    try:
+                        from tpu_engine.serving.clients import (
+                            HttpWorkerClient,
+                        )
+
+                        client = HttpWorkerClient(lane, timeout_s=3.0)
+                    except Exception:
+                        continue
+                if client is None or not hasattr(client, "trace_spans"):
+                    continue
+                try:
+                    spans = client.trace_spans()
+                except Exception:
+                    continue
+                if spans:
+                    fragments.setdefault(lane, spans)
+        out = stitch_trace(fragments, request_id,
+                           trace_id=(entry or {}).get("trace_id"))
+        if entry is not None:
+            out["hops"] = entry["hops"]
         return out
 
     def engage_autoscaler(self, provider=None):
@@ -977,6 +1164,18 @@ class Gateway:
         ctx = (parent.child() if parent is not None
                else TraceContext.root(request_id))
         cfg = self.config
+        ledger = self._ledger
+        t_root = time.time()
+        if ledger is not None:
+            # Cross-lane trace stitching (--trace-stitch): forward the
+            # STREAM-ROOT context in the payload once — the first
+            # dispatch, every replay resume (_resume_payload copies the
+            # payload), and both mobility continuations (built from
+            # record.payload) inherit it, so each segment's
+            # route/attempt/worker spans join ONE tree under the root
+            # span recorded at stream end. Off (default), traceless
+            # payloads keep their wire bytes byte-identical.
+            payload = {**payload, "traceparent": ctx.to_traceparent()}
         # Disaggregated serving: while the fleet is split, the FIRST
         # segment is stamped `handoff` — routed to a prefill-capable
         # lane which parks the row after prefill for the
@@ -993,6 +1192,9 @@ class Gateway:
         # shed/400/no-workers raise here, before the 200 SSE commits.
         first = self._route(dispatch_payload, op="generate_stream",
                             out_info=info)
+        if ledger is not None:
+            ledger.hop(request_id, info.get("lane") or "?", "admit",
+                       ctx.trace_id)
         # Migrate mode (and disagg — the handoff rides the same relay):
         # register the stream so the orchestrator can find it (which
         # lane serves it, its payload and deadline) and hand the relay
@@ -1177,6 +1379,10 @@ class Gateway:
                         lane = new_lane
                         record.lane = new_lane
                         record.spliced_handoff = is_handoff
+                        if ledger is not None:
+                            ledger.hop(request_id, new_lane or "?",
+                                       "handoff" if is_handoff
+                                       else "migrate", ctx.trace_id)
                         if is_handoff:
                             # The steady-state prefill→decode hop
                             # landed: the decode lane adopted the chain
@@ -1268,6 +1474,22 @@ class Gateway:
                 lane = nxt_info.get("lane")
                 self._resume_span(request_id, ctx, resumes, replayed,
                                   "ok", lane)
+                if ledger is not None:
+                    ledger.hop(request_id, lane or "?", "resume",
+                               ctx.trace_id)
+                # A lane death IS an anomaly: ask the resume lane's
+                # flight recorder for a postmortem dump named for the
+                # event (no-op on lanes without the recorder armed) —
+                # the black box fault_injection --stitch checks after
+                # its kill -9.
+                resume_client = self.lane_clients().get(lane or "")
+                if resume_client is not None and hasattr(
+                        resume_client, "flight_dump"):
+                    try:
+                        resume_client.flight_dump(
+                            f"failover_resume:{request_id}")
+                    except Exception:
+                        pass
                 if record is not None:
                     # The replay segment owns the stream now: a LATER
                     # migrate-mode drain of its lane must find it, and
@@ -1279,6 +1501,20 @@ class Gateway:
             try:
                 yield from spliced_inner()
             finally:
+                if ledger is not None:
+                    # The STREAM-ROOT span, recorded at stream end with
+                    # span_id == ctx.span_id: every hop marker
+                    # (migration / kv_handoff / resume) and each
+                    # segment's route span parent here, so the stitched
+                    # tree is orphan-free by construction — the exact
+                    # property fault_injection --stitch asserts.
+                    self.tracer.record(
+                        request_id, "stream", "gateway",
+                        (time.time() - t_root) * 1e6,
+                        trace_id=ctx.trace_id, span_id=ctx.span_id,
+                        parent_id=(parent.span_id
+                                   if parent is not None else None),
+                        start_ts=t_root, attrs={"stitched": True})
                 if record is not None:
                     with self._lock:
                         if self._streams.get(request_id) is record:
@@ -2740,4 +2976,16 @@ class Gateway:
             if fleet_pressure is not None:
                 fl["pressure"] = fleet_pressure
             out["fleet"] = fl
+        # Additive "slo" block (observability plane): present only once
+        # latency objectives are configured — windowed error-budget burn
+        # over the histograms the fleet already keeps, zero new
+        # measurement paths. Defaults-off /stats stays byte-identical.
+        if self._slo is not None:
+            slo = self.slo_status()
+            if slo is not None:
+                out["slo"] = slo
+        # Additive "trace_ledger" block: which streams the stitcher can
+        # currently reassemble (present only with --trace-stitch).
+        if self._ledger is not None:
+            out["trace_ledger"] = self._ledger.summary()
         return out

@@ -79,6 +79,11 @@ def sse_event(payload: dict) -> bytes:
 class JsonHttpServer:
     def __init__(self, port: int, host: str = "0.0.0.0"):
         self._routes: Dict[Tuple[str, str], Handler] = {}
+        # (method, prefix) -> handler(body, suffix). Checked only after
+        # an exact-route miss, longest prefix first, so parameterized
+        # paths (GET /admin/trace/<request_id>) coexist with the exact
+        # table without perturbing any registered route.
+        self._prefix_routes: Dict[Tuple[str, str], Callable] = {}
         self.host = host
         self.port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -87,10 +92,19 @@ class JsonHttpServer:
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
 
+    def route_prefix(self, method: str, prefix: str, handler) -> None:
+        """Register a parameterized route: requests whose path starts with
+        ``prefix`` (and miss the exact table) invoke ``handler(body,
+        suffix)`` where suffix is the remainder of the path."""
+        self._prefix_routes[(method.upper(), prefix)] = handler
+
     # -- lifecycle ------------------------------------------------------------
 
     def _make_handler(self):
         routes = self._routes
+        # Longest prefix first: /admin/trace/raw/ beats /admin/trace/.
+        prefix_routes = sorted(self._prefix_routes.items(),
+                               key=lambda kv: -len(kv[0][1]))
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -159,7 +173,15 @@ class JsonHttpServer:
                     pass  # client went away mid-stream
 
             def _dispatch(self, method: str) -> None:
-                handler = routes.get((method, self.path.split("?", 1)[0]))
+                path = self.path.split("?", 1)[0]
+                handler = routes.get((method, path))
+                if handler is None:
+                    for (pm, prefix), ph in prefix_routes:
+                        if pm == method and path.startswith(prefix):
+                            suffix = path[len(prefix):]
+                            handler = (lambda body, _h=ph, _s=suffix:
+                                       _h(body, _s))
+                            break
                 if handler is None:
                     self._respond(404, {"error": f"no route {method} {self.path}"})
                     return

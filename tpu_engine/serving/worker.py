@@ -452,6 +452,18 @@ class WorkerNode:
                     # Per-tick mixed_step spans land in the lane's ring.
                     self.generator.tracer = self.tracer
                     self.generator.trace_node = self.node_id
+                    # Observability plane (all default off):
+                    # --trace-stitch makes export snapshots carry the
+                    # stream's trace context; --flight-recorder arms the
+                    # per-tick ring behind /admin/timeline.
+                    self.generator.trace_stitch = bool(
+                        getattr(self.config, "trace_stitch", False))
+                    flight = int(getattr(self.config,
+                                         "flight_recorder", 0) or 0)
+                    if flight > 0:
+                        self.generator.configure_flight_recorder(
+                            flight, getattr(self.config,
+                                            "flight_dump_dir", None))
                 else:
                     from tpu_engine.runtime.generator import Generator
 
@@ -1164,6 +1176,69 @@ class WorkerNode:
         out["node_id"] = self.node_id
         return out
 
+    def handle_timeline(self, request: Optional[dict] = None) -> dict:
+        """/admin/timeline: the continuous scheduler's flight-recorder
+        ring (per-tick records, newest last) plus dump bookkeeping.
+        GET reads; POST {"dump": reason} forces a postmortem artifact.
+        With the recorder unconfigured (the default) the payload says so
+        and carries no timeline — the endpoint itself is additive."""
+        gen = self.generator
+        if gen is None or not hasattr(gen, "flight_timeline"):
+            return {"node_id": self.node_id, "enabled": False,
+                    "reason": "this lane has no continuous scheduler"}
+        if request and request.get("dump"):
+            dump = gen.flight_dump(str(request["dump"]))
+            return {"node_id": self.node_id,
+                    "enabled": dump is not None, "dumped": dump}
+        n = int(request.get("n", 0)) if request else 0
+        out = gen.flight_timeline(n or None)
+        out["node_id"] = self.node_id
+        return out
+
+    def flight_dump(self, reason: str) -> Optional[dict]:
+        """Force a flight-recorder dump (gateway degraded-fleet entry
+        trigger). None when the lane has no armed recorder."""
+        gen = self.generator
+        if gen is None or not hasattr(gen, "flight_dump"):
+            return None
+        return gen.flight_dump(reason)
+
+    def handle_profile(self, request: Optional[dict] = None) -> dict:
+        """/admin/profile (worker): jax.profiler capture bounded in
+        scheduler ticks. Requires --profile-dir. POST {"ticks": N}
+        starts a capture the decode loop stops after N ticks;
+        {"action": "stop"} stops early; {"action": "status"} / GET
+        reports the countdown. Lanes without a continuous scheduler
+        fall back to unbounded start/stop."""
+        profile_dir = getattr(self.config, "profile_dir", None)
+        request = request or {}
+        action = request.get("action")
+        gen = self.generator
+        ticked = gen is not None and hasattr(gen, "start_profile")
+        if action == "status":
+            out = {"node_id": self.node_id, "profile_dir": profile_dir}
+            if ticked:
+                out.update(gen.profile_status())
+            return out
+        if action == "stop":
+            from tpu_engine.utils import tracing
+
+            res = gen.stop_profile() if ticked else tracing.profiler_stop()
+            return {"node_id": self.node_id, **res}
+        if not profile_dir:
+            return {"node_id": self.node_id,
+                    "error": "profiling not configured "
+                             "(start the worker with --profile-dir)"}
+        log_dir = request.get("log_dir") or profile_dir
+        ticks = int(request.get("ticks", 0) or 0)
+        if ticks > 0 and ticked:
+            res = gen.start_profile(log_dir, ticks)
+        else:
+            from tpu_engine.utils import tracing
+
+            res = tracing.profiler_start(log_dir)
+        return {"node_id": self.node_id, **res}
+
     def set_role(self, role: str) -> dict:
         """/admin/role: flip this lane's serving role at runtime
         (fleet rebalancing under diurnal load — the gateway rides
@@ -1718,6 +1793,14 @@ class WorkerNode:
         request_id = request["request_id"]
         snap = request["migrate_import"]
         parent = TraceContext.from_request(request)
+        if parent is None and isinstance(snap, dict):
+            # Cross-lane trace stitching: an export snapshot from a
+            # --trace-stitch lane carries the exporting row's trace
+            # context even when the dispatch payload itself is
+            # traceless — the adopted row's spans re-parent under the
+            # SAME trace the source lane recorded (additive snapshot
+            # key; absent on un-stitched exports).
+            parent = TraceContext.from_request(snap)
         tctx = (parent.child() if parent is not None
                 else TraceContext.root(request_id))
         t_start_wall = time.time()
@@ -1754,6 +1837,8 @@ class WorkerNode:
                     try:
                         item = q.get(timeout=600)
                     except queue.Empty:
+                        self._segment_span(request_id, tctx, parent, t0,
+                                           t_start_wall, "stalled")
                         yield sse_event(self._stream_error(
                             RuntimeError("generation stalled (no tokens "
                                          "for 600s)"),
@@ -1767,6 +1852,10 @@ class WorkerNode:
                 try:
                     tokens = fut.result(timeout=10)
                 except Exception as exc:
+                    self._segment_span(
+                        request_id, tctx, parent, t0, t_start_wall,
+                        "exported" if getattr(exc, "migrated", False)
+                        else "error")
                     yield sse_event(self._stream_error(
                         exc, request_id, tctx.trace_id, sent))
                     return
@@ -1789,6 +1878,22 @@ class WorkerNode:
                 if completed and self._aimd is not None:
                     self._aimd.observe(time.perf_counter() - t_admit)
         return events()
+
+    def _segment_span(self, request_id, tctx, parent, t0, t_start_wall,
+                      outcome: str) -> None:
+        """Root span for a stream SEGMENT that did not complete on this
+        lane (exported row, lane fault, stall). The stage spans already
+        recorded under ``tctx.span_id`` must not dangle: a mobile
+        stream's stitched tree needs every serving lane's segment root,
+        and even a single lane's /trace/export should never ship
+        orphans (the completion path records the same span with no
+        ``segment`` attr)."""
+        self.tracer.record(
+            request_id, "generate_stream", self.node_id,
+            (time.perf_counter() - t0) * 1e6,
+            trace_id=tctx.trace_id, span_id=tctx.span_id,
+            parent_id=(parent.span_id if parent is not None else None),
+            start_ts=t_start_wall, attrs={"segment": outcome})
 
     @staticmethod
     def _stream_error(exc: BaseException, request_id: str, trace_id: str,

@@ -201,6 +201,32 @@ class WorkerConfig:
     # On by default — recording is lock-guarded ring writes, ~1 µs/span.
     # 0 disables span recording AND the /metrics stage histograms.
     trace_capacity: int = 2048
+    # Cross-lane trace stitching (--trace-stitch; DESIGN.md
+    # "Observability plane"): export_row snapshots carry the stream's
+    # trace context (one additive "traceparent" snapshot field + a
+    # gated "trace" header on the KV chain), so a stream's spans
+    # re-parent under the SAME trace across handoff / migration /
+    # crash-resume hops and the gateway can stitch one tree. Off
+    # (default) = snapshots and chain wire bytes identical to today.
+    trace_stitch: bool = False
+    # jax.profiler capture directory (--profile-dir): arms
+    # POST /admin/profile on this worker — {"ticks": N} starts a device
+    # trace that the continuous scheduler stops after N ticks (the
+    # on-chip campaign's capture primitive); {"action": "stop"} stops
+    # early. None (default) = endpoint reports unconfigured.
+    profile_dir: Optional[str] = None
+    # Per-tick flight recorder (--flight-recorder; continuous scheduler
+    # only): ring capacity in ticks. Each tick appends one bounded
+    # record (rows by state, token budget used, dispatch wall time,
+    # queue/park/held depths, pool occupancy incl. host tier and slab
+    # rows); /admin/timeline reads the ring and anomalies (_recover,
+    # deadline-miss bursts, degraded fleet entry) auto-dump it as a
+    # postmortem artifact. 0 (default) = off, zero per-tick work.
+    flight_recorder: int = 0
+    # Directory for anomaly postmortem JSON dumps (flight-recorder
+    # ring + anomaly name + scheduler stats). None = keep the dump
+    # in memory only (served by /admin/timeline as "last_dump").
+    flight_dump_dir: Optional[str] = None
     # Scheduler liveness (continuous decode lane): /health reports the
     # decode loop's last-tick age, and when this threshold is > 0 a lane
     # whose loop has not ticked for this many seconds reads unhealthy —
@@ -415,3 +441,41 @@ class GatewayConfig:
     # Tracing ring-buffer capacity for the gateway's own spans (route +
     # per-attempt children + resilience decision markers). 0 disables.
     trace_capacity: int = 2048
+
+    # -- observability plane (DESIGN.md "Observability plane"). All
+    # default off: with defaults, /stats, /health, routing behavior and
+    # wire bytes are byte-identical to the layers above. -----------------
+
+    # Cross-lane trace stitching (--trace-stitch): every
+    # /generate/stream dispatch carries the stream's trace context, the
+    # stream ledger records which lanes served each request_id (admit /
+    # handoff / migrate / resume hops), and GET /admin/trace/<rid>
+    # merges the fragments from every lane's ring into ONE
+    # Perfetto-loadable tree with hop-boundary marker spans. Requires
+    # workers started with --trace-stitch too for snapshot propagation.
+    trace_stitch: bool = False
+    # Stream-ledger capacity: completed request_ids kept for stitching
+    # (bounded FIFO; live streams are never evicted before completion).
+    trace_ledger_capacity: int = 512
+    # SLO objectives (--slo-ttft-p99-ms / --slo-itl-p99-ms /
+    # --slo-completion-p99-ms): declarative per-fleet latency targets in
+    # milliseconds, 0 = objective not set. Burn is computed from the
+    # existing tpu_engine_ttft/itl_seconds histograms (no new
+    # measurement path): violations = samples above the bucket boundary
+    # covering the target, error budget = 1 - slo_target, burn rate =
+    # windowed violation fraction / budget (1.0 = burning exactly the
+    # budget; >1 = on track to exhaust it). Surfaced at /admin/slo, as
+    # an additive /stats "slo" block, and as tpu_engine_slo_* metrics.
+    slo_ttft_p99_ms: float = 0.0
+    slo_itl_p99_ms: float = 0.0
+    slo_completion_p99_ms: float = 0.0
+    # Objective quantile target (0.99 = "99% of samples under the
+    # threshold"), i.e. error budget 1%.
+    slo_target: float = 0.99
+    # Sliding window for burn-rate accounting, seconds.
+    slo_window_s: float = 300.0
+    # Feed SLO burn into FleetAutoscaler pressure (--autoscale-slo-feed;
+    # requires --autoscale and at least one objective): fleet pressure
+    # becomes max(lane pressure, min(1, burn/2)) so a burning error
+    # budget can trigger scale-up even while queue depths look calm.
+    autoscale_slo_feed: bool = False

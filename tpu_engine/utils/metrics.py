@@ -723,6 +723,38 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
                        "Mean fleet pressure the control loop last "
                        "observed (1.0 = lanes saturated)",
                        [({}, fl.get("pressure"))])
+        slo = stats.get("slo")
+        if slo:
+            # SLO burn-rate accounting (the /stats "slo" block; present
+            # once any --slo-*-p99-ms objective is configured). One
+            # sample set per objective, labelled like the latency
+            # histograms the numbers derive from.
+            objectives = slo.get("objectives") or {}
+            rows = sorted(objectives.items())
+            metric("tpu_engine_slo_target", "gauge",
+                   "Configured SLO target (good-sample fraction)",
+                   [({}, slo.get("target"))])
+            metric("tpu_engine_slo_objective_ms", "gauge",
+                   "Configured latency objective per SLO dimension",
+                   [({"objective": name}, obj.get("objective_ms"))
+                    for name, obj in rows])
+            metric("tpu_engine_slo_burn_rate", "gauge",
+                   "Windowed error-budget burn rate (1.0 = budget "
+                   "spent exactly at the sustainable rate)",
+                   [({"objective": name}, obj.get("burn_rate"))
+                    for name, obj in rows])
+            metric("tpu_engine_slo_good_fraction", "gauge",
+                   "Lifetime fraction of samples inside the objective",
+                   [({"objective": name}, obj.get("good_fraction"))
+                    for name, obj in rows])
+            metric("tpu_engine_slo_violations_total", "counter",
+                   "Samples observed over the latency objective",
+                   [({"objective": name}, obj.get("violations"))
+                    for name, obj in rows])
+            metric("tpu_engine_slo_samples_total", "counter",
+                   "Samples evaluated against the latency objective",
+                   [({"objective": name}, obj.get("samples"))
+                    for name, obj in rows])
     if recorders:
         lines.extend(render_stage_histograms(recorders))
     if named_hists:

@@ -252,31 +252,123 @@ def percentile(vals: List, p: float):
     return svals[min(len(svals) - 1, max(0, rank - 1))]
 
 
+def _span_start_ts(s: dict) -> float:
+    start = s.get("start_ts")
+    if start is None:  # legacy rows stamp completion time only
+        start = s["ts"] - s["duration_us"] / 1e6
+    return start
+
+
+def _span_event(s: dict, tid: int) -> dict:
+    args = {"request_id": s["request_id"]}
+    for k in ("trace_id", "span_id", "parent_id", "cached",
+              "batch_size"):
+        if k in s:
+            args[k] = s[k]
+    args.update(s.get("attrs") or {})
+    return {
+        "name": s["op"], "cat": "serving", "ph": "X",
+        "ts": _span_start_ts(s) * 1e6,
+        "dur": max(0, int(s["duration_us"])),
+        "pid": 1, "tid": tid, "args": args,
+    }
+
+
+def _synthesize_evicted_roots(events: List[dict]) -> List[dict]:
+    """Ring-capacity eviction can drop a parent span while its children
+    survive, leaving exported events whose ``parent_id`` matches nothing —
+    Perfetto then renders the children as unrelated top-level rows. For
+    every dangling parent id, emit ONE synthetic zero-duration root event
+    named ``evicted_parent`` (claiming that span_id, anchored at its
+    earliest child's start) so the tree stays connected and the gap is
+    visibly labeled instead of silently flat."""
+    seen = set()
+    for ev in events:
+        sid = ev.get("args", {}).get("span_id")
+        if sid is not None:
+            seen.add(sid)
+    dangling: Dict[str, dict] = {}
+    for ev in events:
+        args = ev.get("args", {})
+        pid = args.get("parent_id")
+        if pid is None or pid in seen:
+            continue
+        prev = dangling.get(pid)
+        if prev is None or ev["ts"] < prev["ts"]:
+            dangling[pid] = {
+                "name": "evicted_parent", "cat": "serving", "ph": "X",
+                "ts": ev["ts"], "dur": 0, "pid": 1, "tid": ev["tid"],
+                "args": {
+                    "request_id": args.get("request_id"),
+                    "span_id": pid,
+                    "evicted_parent": True,
+                    **({"trace_id": args["trace_id"]}
+                       if "trace_id" in args else {}),
+                },
+            }
+    return [dangling[k] for k in sorted(dangling)]
+
+
+def spans_to_chrome(named_spans: Dict[str, List[dict]]) -> dict:
+    """Chrome trace-event JSON from named span lists (recorder-snapshot
+    schema) — one tid per name, metadata thread_name events, synthetic
+    ``evicted_parent`` roots for dangling parent links."""
+    events: List[dict] = []
+    for tid, name in enumerate(sorted(named_spans), start=1):
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": name}})
+        for s in named_spans[name]:
+            events.append(_span_event(s, tid))
+    events.extend(_synthesize_evicted_roots(events))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def export_chrome(recorders: Dict[str, SpanRecorder]) -> dict:
     """Chrome trace-event JSON of every recorder's ring — loadable in
     Perfetto / chrome://tracing. One tid per node (named via metadata
     events); complete ("X") events carry trace_id/span_id/parent_id in
     ``args`` so tooling can rebuild the exact span tree."""
-    events: List[dict] = []
-    for tid, (node, rec) in enumerate(sorted(recorders.items()), start=1):
-        events.append({"ph": "M", "name": "thread_name", "pid": 1,
-                       "tid": tid, "args": {"name": node}})
-        for s in rec.snapshot():
-            start = s.get("start_ts")
-            if start is None:  # legacy rows stamp completion time only
-                start = s["ts"] - s["duration_us"] / 1e6
-            args = {"request_id": s["request_id"]}
-            for k in ("trace_id", "span_id", "parent_id", "cached",
-                      "batch_size"):
-                if k in s:
-                    args[k] = s[k]
-            args.update(s.get("attrs") or {})
-            events.append({
-                "name": s["op"], "cat": "serving", "ph": "X",
-                "ts": start * 1e6, "dur": max(0, int(s["duration_us"])),
-                "pid": 1, "tid": tid, "args": args,
-            })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return spans_to_chrome(
+        {node: rec.snapshot() for node, rec in recorders.items()})
+
+
+def stitch_trace(fragments: Dict[str, List[dict]], request_id: str,
+                 trace_id: Optional[str] = None) -> dict:
+    """Merge per-lane span fragments into ONE trace for a mobile stream.
+
+    ``fragments`` maps lane/node name -> span dicts (recorder-snapshot
+    schema). A span belongs to the stream when its request_id matches, or
+    (when ``trace_id`` is given) when its trace_id matches — hop marker
+    spans and per-attempt children all carry the request_id, so both
+    filters converge on the same tree. Returns the merged span list
+    (start-time ordered), the lanes that contributed, the orphan count
+    BEFORE synthetic-root repair, and a Perfetto-loadable ``chrome``
+    rendering (with ``evicted_parent`` roots synthesized so the tree is
+    always connected)."""
+    tid = trace_id or derive_trace_id(request_id)
+    picked: Dict[str, List[dict]] = {}
+    for lane, spans in fragments.items():
+        mine = [s for s in spans
+                if s.get("request_id") == request_id
+                or s.get("trace_id") == tid]
+        if mine:
+            picked[lane] = mine
+    all_spans = [dict(s, lane=lane)
+                 for lane, spans in sorted(picked.items())
+                 for s in spans]
+    all_spans.sort(key=_span_start_ts)
+    have = {s["span_id"] for s in all_spans if "span_id" in s}
+    orphans = sum(1 for s in all_spans
+                  if s.get("parent_id") is not None
+                  and s["parent_id"] not in have)
+    return {
+        "request_id": request_id,
+        "trace_id": tid,
+        "lanes": sorted(picked),
+        "spans": all_spans,
+        "orphans": orphans,
+        "chrome": spans_to_chrome(picked),
+    }
 
 
 _profile_lock = threading.Lock()
