@@ -3035,6 +3035,240 @@ def run_affinity_ab(model: str = "gpt2-small-test", n_requests: int = 48,
     return results
 
 
+def run_fleet_prefix_ab(model: str = "gpt2-small-test",
+                        n_tenants: int = 6, rounds: int = 4,
+                        prefix_len: int = 96, suffix_len: int = 8,
+                        max_new: int = 8, block_size: int = 16,
+                        lanes: int = 3, slots_per_lane: int = 2,
+                        kv_blocks_per_lane: int = 64, max_seq: int = 256,
+                        quick: bool = False) -> dict:
+    """Fleet-wide KV prefix tier A/B (the PR 18 tentpole): gateway radix
+    directory + peer block fetch vs plain ring routing, on an
+    AFFINITY-DEFEATING workload — prefix affinity stays OFF and every
+    round's request_ids are chosen so the ring lands each tenant's
+    shared prefix on a lane that has never seen it. That is exactly the
+    shape affinity routing cannot fix (unique ids scatter by design)
+    and the directory+fetch tier is built for.
+
+    Workload: ``n_tenants`` shared prefixes (each ``prefix_len`` tokens
+    = full radix blocks), ``rounds`` rounds; round 1 establishes each
+    tenant's owner lane, the middle rounds deliberately ring-route to a
+    lane that has never held the tenant (the cold repeats the fetch
+    tier converts), and the FINAL round revisits a warm lane — the same
+    local radix hit in both arms, so the off arm's baseline is the
+    honest "local hits only" number rather than a degenerate zero.
+    Per-lane pools comfortably hold every tenant (no eviction pressure
+    — the contrast under test is re-prefill vs peer fetch, not
+    capacity). Reported per arm:
+
+    - fleet prefill-skip ratio: (local prefix_hit_tokens +
+      prefill_tokens_skipped_remote) / (those + prefilled_tokens),
+      warmup excluded — the bar: FETCH >= 2x OFF;
+    - client TTFT p50/p99 through /generate/stream (sequential issue —
+      ownership must be established before the next round probes it);
+    - fetch-arm: gateway prefix_directory stats + per-lane prefix_fetch
+      counters (attempted == spliced: no rung ever fires on a healthy
+      fleet); off-arm: /stats carries NO prefix_directory block and no
+      lane grew a prefix_fetch family (defaults-off wire compat).
+
+    Streams must be byte-identical across arms. Runs on the CPU mesh
+    (directory convergence and splice accounting are topology/workload
+    properties, not model-size properties); on-chip rerun pending like
+    r06-r09."""
+    import random
+
+    import jax
+
+    from tpu_engine.models.registry import (_ensure_builtin_models_imported,
+                                            create_model)
+    from tpu_engine.runtime.engine import InferenceEngine
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.serving.worker import WorkerNode
+    from tpu_engine.utils.config import GatewayConfig, WorkerConfig
+    from tpu_engine.utils.tracing import percentile
+
+    _ensure_builtin_models_imported()
+    if quick:
+        n_tenants = 3
+    spec = create_model(model, max_seq=max_seq)
+    params = spec.init(jax.random.PRNGKey(0))
+    rnd = random.Random(18)
+    tenants = [[rnd.randrange(1, 200) for _ in range(prefix_len)]
+               for _ in range(n_tenants)]
+    suffixes = [[rnd.randrange(1, 200) for _ in range(suffix_len)]
+                for _ in range(n_tenants * rounds)]
+    n_requests = n_tenants * rounds
+
+    def make_fleet(fetch: bool):
+        workers = []
+        for i in range(lanes):
+            cfg = WorkerConfig(
+                node_id=f"lane_{i+1}", model=model,
+                gen_max_batch_size=slots_per_lane, gen_step_chunk=8,
+                gen_prefix_cache_mb=0, gen_kv_block_size=block_size,
+                gen_kv_blocks=kv_blocks_per_lane,
+                gen_prefix_fetch=fetch)
+            engine = InferenceEngine(spec, params=params, dtype="float32")
+            workers.append(WorkerNode(cfg, engine=engine))
+        if fetch:
+            by_name = {w.node_id: w for w in workers}
+
+            def transport(hint, payload):
+                return by_name[hint["lane"]].handle_export_prefix(payload)
+            for w in workers:
+                w.set_prefix_fetch_transport(transport)
+        return workers
+
+    def fleet_counters(workers):
+        agg = {"prefix_hit_tokens": 0, "prefilled_tokens": 0,
+               "remote_skipped_tokens": 0, "fetch_attempted": 0,
+               "fetch_spliced": 0, "fetch_blocks": 0}
+        per_lane = {}
+        for w in workers:
+            st = w.generator.stats()
+            pool = st["kv_pool"]
+            pf = st.get("prefix_fetch") or {}
+            row = {"prefix_hit_tokens": pool["prefix_hit_tokens"],
+                   "prefilled_tokens": pool["prefilled_tokens"],
+                   "remote_skipped_tokens":
+                       pf.get("prefill_tokens_skipped_remote", 0),
+                   "fetch_attempted": pf.get("attempted", 0),
+                   "fetch_spliced": pf.get("spliced", 0),
+                   "fetch_blocks": pf.get("blocks_spliced", 0)}
+            per_lane[w.node_id] = row
+            for k in agg:
+                agg[k] += row[k]
+        return per_lane, agg
+
+    def stream_one(gw, req):
+        t0 = time.perf_counter()
+        toks, ttft = [], None
+        for frame in gw.route_generate_stream(dict(req)):
+            evt = _parse_sse(frame)
+            if evt is None or evt.get("done"):
+                continue
+            if ttft is None and evt.get("tokens"):
+                ttft = time.perf_counter() - t0
+            toks.extend(evt.get("tokens", ()))
+        return toks, ttft
+
+    def pick_rid(gw, holders, tag, warm):
+        """A request_id whose ring primary is IN ``holders`` (warm
+        revisit) or NOT in it (the affinity-defeating cold step). Same
+        ring membership both arms, so the chosen ids — and thus the
+        routing — are identical across arms."""
+        for i in range(4000):
+            rid = f"{tag}-{i}"
+            if (gw._ring.get_node(rid) in holders) == warm:
+                return rid
+        return f"{tag}-0"
+
+    def run_arm(fetch: bool) -> tuple:
+        workers = make_fleet(fetch)
+        gw = Gateway(workers, GatewayConfig(prefix_directory=fetch))
+        try:
+            # Warm every lane's compile set on the miss path AND the
+            # block-aligned resumed-window path (the same windows a
+            # splice resumes into), then snapshot counters so measured
+            # ratios exclude warmup.
+            warm_prefix = [rnd.randrange(200, 255)
+                           for _ in range(prefix_len)]
+            for w in workers:
+                for s in ((1, 2, 3, 4), (9, 8, 7)):
+                    w.handle_generate({
+                        "request_id": f"warm-{w.node_id}-{len(s)}",
+                        "prompt_tokens": warm_prefix + list(s),
+                        "max_new_tokens": 2})
+            _, base = fleet_counters(workers)
+
+            streams = {}
+            ttfts = []
+            served_by = {}  # tenant -> lanes that have its prefix
+            wall0 = time.perf_counter()
+            for r in range(rounds):
+                for t in range(n_tenants):
+                    # Middle rounds steer AWAY from every lane that
+                    # already holds this tenant's blocks (each repeat a
+                    # cold lane, the ring at its least favorable); the
+                    # last round revisits a warm one (both arms hit
+                    # locally — the honest shared baseline).
+                    rid = pick_rid(gw, served_by.get(t, set()),
+                                   f"fp-t{t}-r{r}", warm=r == rounds - 1)
+                    prompt = tenants[t] + suffixes[r * n_tenants + t]
+                    toks, ttft = stream_one(
+                        gw, {"request_id": rid, "prompt_tokens": prompt,
+                             "max_new_tokens": max_new})
+                    streams[(t, r)] = toks
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                    served_by.setdefault(t, set()).add(
+                        gw._ring.get_node(rid))
+            wall = time.perf_counter() - wall0
+            ttfts.sort()
+            per_lane, agg = fleet_counters(workers)
+            skip = {k: agg[k] - base[k] for k in agg}
+            gained = (skip["prefix_hit_tokens"]
+                      + skip["remote_skipped_tokens"])
+            filled = skip["prefilled_tokens"]
+            arm = {
+                "prefix_fetch": fetch, "requests": n_requests,
+                "completed": sum(1 for s in streams.values() if s),
+                "wall_s": round(wall, 3),
+                "fleet_prefill_skip_frac": round(
+                    gained / (gained + filled), 4) if gained + filled
+                    else 0.0,
+                "local_hit_tokens": skip["prefix_hit_tokens"],
+                "remote_skipped_tokens": skip["remote_skipped_tokens"],
+                "prefilled_tokens": filled,
+                "fetch_attempted": skip["fetch_attempted"],
+                "fetch_spliced": skip["fetch_spliced"],
+                "fetch_blocks_spliced": skip["fetch_blocks"],
+                "ttft_p50_ms": round(1e3 * (percentile(ttfts, 50) or 0), 2),
+                "ttft_p99_ms": round(1e3 * (percentile(ttfts, 99) or 0), 2),
+                "per_lane": per_lane,
+            }
+            st = gw.get_stats()
+            if fetch:
+                arm["prefix_directory"] = st["prefix_directory"]
+            else:
+                arm["directory_block_absent"] = (
+                    "prefix_directory" not in st)
+                arm["fetch_stats_absent"] = all(
+                    "prefix_fetch" not in w.generator.stats()
+                    for w in workers)
+            return arm, streams
+        finally:
+            gw.stop()
+            for w in workers:
+                w.stop()
+
+    results = {"model": model, "lanes": lanes, "n_requests": n_requests,
+               "n_tenants": n_tenants, "rounds": rounds,
+               "prefix_len": prefix_len, "block_size": block_size,
+               "kv_blocks_per_lane": kv_blocks_per_lane}
+    off, off_streams = run_arm(False)
+    record_partial("fleet_prefix_off", off)
+    on, on_streams = run_arm(True)
+    record_partial("fleet_prefix_on", on)
+    results["fetch_off"], results["fetch_on"] = off, on
+    results["skip_gain"] = round(
+        on["fleet_prefill_skip_frac"]
+        / max(1e-4, off["fleet_prefill_skip_frac"]), 2)
+    results["streams_identical_on_vs_off"] = all(
+        on_streams.get(k) == off_streams.get(k) for k in on_streams)
+    results["checks_passed"] = bool(
+        on["completed"] == n_requests and off["completed"] == n_requests
+        and results["streams_identical_on_vs_off"]
+        and on["fleet_prefill_skip_frac"]
+        >= 2.0 * max(off["fleet_prefill_skip_frac"], 1e-9)
+        and on["fetch_spliced"] > 0
+        and on["fetch_attempted"] == on["fetch_spliced"]
+        and on["prefix_directory"]["hints_attached"] > 0
+        and off["directory_block_absent"]
+        and off["fetch_stats_absent"])
+    return results
+
+
 def run_overload_ab(model: str = "gpt2-small-test", n_requests: int = 60,
                     max_new: int = 16, lanes: int = 3,
                     slots_per_lane: int = 2, block_size: int = 16,
@@ -3655,7 +3889,8 @@ def _main() -> int:
                              "miss-sweep", "paged-ab", "mixed-ab",
                              "crash-ab", "drain-ab", "affinity-ab",
                              "overload-ab", "quant-ab", "disagg-ab",
-                             "recurrent-ab", "tp-ab", "elastic-ab"],
+                             "recurrent-ab", "tp-ab", "elastic-ab",
+                             "fleet-prefix-ab"],
                     default="infer")
     args = ap.parse_args()
     # In-process scenarios (compute / decode-ab) honor the same platform
@@ -3691,7 +3926,7 @@ def _main() -> int:
         args.model = "yolov8n"
     if (args.scenario in ("paged-ab", "mixed-ab", "spec-ab", "affinity-ab",
                           "overload-ab", "quant-ab", "disagg-ab",
-                          "recurrent-ab", "tp-ab")
+                          "recurrent-ab", "tp-ab", "fleet-prefix-ab")
             and args.model == "resnet50"):
         args.model = "gpt2-small-test"
     if _DEVICE_NOTE is not None:
@@ -3835,6 +4070,23 @@ def _main() -> int:
             "vs_baseline": 2.0,
             "ttft_p99_on_ms": result["affinity_on"]["ttft_p99_ms"],
             "ttft_p99_off_ms": result["affinity_off"]["ttft_p99_ms"],
+            **result,
+        })
+        return 0 if result["checks_passed"] else 1
+
+    if args.scenario == "fleet-prefix-ab":
+        # Fleet prefix tier A/B: in-process lanes on the host backend
+        # (directory convergence and splice accounting are the
+        # variables under test, not the chip).
+        result = run_fleet_prefix_ab(model=args.model, quick=args.quick)
+        record_partial("fleet_prefix_ab", result)
+        log(json.dumps(result, indent=2))
+        emit({
+            "metric": "fleet_prefix_skip_gain",
+            "value": result["skip_gain"], "unit": "x",
+            "vs_baseline": 2.0,
+            "remote_skipped_tokens":
+                result["fetch_on"]["remote_skipped_tokens"],
             **result,
         })
         return 0 if result["checks_passed"] else 1

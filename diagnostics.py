@@ -154,8 +154,18 @@ def main() -> int:
                          "the merged /admin/trace/<request_id> tree — "
                          "lanes touched, span count, hop markers, and "
                          "the orphan count (must be zero)")
+    ap.add_argument("--fleet-prefix", action="store_true",
+                    help="step 19: one scripted fleet-prefix fetch "
+                         "against a local worker pair (spawned here) "
+                         "with --prefix-fetch armed: establish one lane "
+                         "as the owner of a shared 48-token prefix, "
+                         "then a hinted request on the OTHER lane must "
+                         "pull the owner's KV chain over HTTP and "
+                         "splice it — blocks spliced, remote prefill "
+                         "tokens skipped, hint bookkeeping, and "
+                         "byte-identity to an unhinted control")
     ap.add_argument("--lint", action="store_true",
-                    help="step 19: engine-lint static-analysis suite "
+                    help="step 20: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -166,7 +176,8 @@ def main() -> int:
               + int(args.ssd_parity) + int(args.tp_parity)
               + int(args.failover) + int(args.migrate)
               + int(args.disagg) + int(args.overload)
-              + int(args.elastic) + int(args.stitch) + int(args.lint))
+              + int(args.elastic) + int(args.stitch)
+              + int(args.fleet_prefix) + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -829,6 +840,73 @@ def main() -> int:
             step(n, "cross-lane stitched trace", ok, detail)
         except Exception as exc:
             step(n, "cross-lane stitched trace", False, f"({exc})")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+    # (--fleet-prefix): one scripted owner→peer KV prefix fetch — the
+    # fleet prefix tier's smoke, live, in one line: lane 0 serves a
+    # shared 48-token prefix (becoming its directory owner), then a
+    # request landing on lane 1 carries the gateway's peer hint and
+    # must SPLICE the owner's chain over HTTP instead of re-prefilling
+    # it, byte-identical to an unhinted control.
+    if args.fleet_prefix:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + int(args.overload)
+             + int(args.elastic) + int(args.stitch) + 1)
+        procs = []
+        try:
+            from tools.fault_injection import (
+                _call,
+                launch_worker_procs,
+                rid_for_lane,
+                victim_lane_for_port,
+            )
+            from tpu_engine.serving.gateway import Gateway
+            from tpu_engine.utils.config import GatewayConfig
+
+            ports, procs = launch_worker_procs(
+                2, extra_args=("--prefix-fetch",))
+            pgw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                          GatewayConfig(prefix_directory=True))
+            lanes = pgw.worker_names()
+            shared = [(17 * j + 5) % 97 + 1 for j in range(48)]
+            own_rid = rid_for_lane(
+                pgw._ring, victim_lane_for_port(lanes, ports[0]), "fpd_o")
+            fetch_rid = rid_for_lane(
+                pgw._ring, victim_lane_for_port(lanes, ports[1]), "fpd_f")
+            own = pgw.route_generate(
+                {"request_id": own_rid, "prompt_tokens": shared + [3, 1],
+                 "max_new_tokens": 8})
+            fetch_req = {"request_id": fetch_rid,
+                         "prompt_tokens": shared + [5, 2],
+                         "max_new_tokens": 8}
+            _, ctl = _call(ports[0], "POST", "/generate",
+                           dict(fetch_req, request_id="fpd_ctl"),
+                           timeout=600)
+            fetched = pgw.route_generate(dict(fetch_req))
+            _, health = _call(ports[1], "GET", "/health", timeout=10)
+            fs = (health.get("generator") or {}).get("prefix_fetch") or {}
+            pd = pgw.get_stats().get("prefix_directory", {})
+            pgw.stop()
+            identical = fetched["tokens"] == ctl["tokens"]
+            ok = (identical and bool(own.get("tokens"))
+                  and fs.get("attempted") == 1 and fs.get("spliced") == 1
+                  and fs.get("blocks_spliced", 0) >= 3
+                  and pd.get("hints_attached", 0) >= 1)
+            step(n, "fleet prefix fetch", ok,
+                 f"({fs.get('blocks_spliced', 0)} blocks spliced, "
+                 f"{fs.get('prefill_tokens_skipped_remote', 0)} remote "
+                 f"prefill tokens skipped, "
+                 f"{pd.get('hints_attached', 0)} hints attached, "
+                 f"{pd.get('entries', 0)} directory entries; "
+                 f"{'byte-identical' if identical else 'DIVERGED'})")
+        except Exception as exc:
+            step(n, "fleet prefix fetch", False, f"({exc})")
         finally:
             for p in procs:
                 if p.poll() is None:

@@ -115,6 +115,8 @@ _RECEIVER_ALIASES = {
     "self.migration": "MigrationCounters",
     "self.handoff": "HandoffCounters",
     "self.fleet": "FleetCounters",
+    "self.prefix_dir": "PrefixDirCounters",
+    "self._prefix_dir": "PrefixDirectory",
     "self._tenant_bucket": "TenantRateLimiter",
     "self._shed_stats": "SheddingStats",
     "self._aimd": "AIMDLimit",
@@ -185,7 +187,8 @@ ENGINE_REGISTRY = Registry(
                    "_total_requests", "_failovers", "_inflight",
                    "_streams", "_roles", "_topology",
                    "_topology_updates", "_fleet_degraded",
-                   "_fleet_pressure", "_retired_clients"),
+                   "_fleet_pressure", "_retired_clients",
+                   "_prefix_dir"),
             lock="Gateway._lock",
             classes=("Gateway",)),
         # Consistent-hash ring internals (vnode map + per-node topology
@@ -299,6 +302,7 @@ ENGINE_REGISTRY = Registry(
     # the analyzer checks their CALL sites instead of their bodies.
     caller_locked=frozenset({"BlockPool.*", "RadixTree.*",
                              "StateSlabPool.*",
+                             "PrefixDirectory.*",
                              "TenantRateLimiter._evict_idle",
                              "SheddingStats._gc",
                              "ConsistentHash._drop_labels",
@@ -306,7 +310,7 @@ ENGINE_REGISTRY = Registry(
     receiver_aliases=_RECEIVER_ALIASES,
     counter_receivers=frozenset({"resilience", "failover", "affinity",
                                  "overload", "migration", "handoff",
-                                 "fleet", "slo"}),
+                                 "fleet", "slo", "prefix_dir"}),
     span_tracer_attrs=frozenset({"tracer", "recorder"}),
     span_sink_attrs=frozenset({"sink"}),
     hot_static_params=frozenset({"cfg", "config", "dtype", "attn_fn",

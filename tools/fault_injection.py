@@ -3099,13 +3099,257 @@ def run_stitch_standalone() -> int:
         shutil.rmtree(dump_dir, ignore_errors=True)
 
 
+def _prefix_dir_counters_match_spans(gw) -> bool:
+    """Gateway prefix-directory counters == ``prefix_dir`` marker spans
+    (``evictions`` is a span-free value counter and excluded by
+    SPAN_FIELDS) — same discipline as `_fleet_counters_match_spans`."""
+    from tpu_engine.serving.resilience import PrefixDirCounters
+
+    pd = gw.get_stats().get("prefix_directory", {})
+    expect = sum(pd.get(f, 0) for f in PrefixDirCounters.SPAN_FIELDS)
+    spans = [s for s in gw.tracer.snapshot() if s["op"] == "prefix_dir"]
+    return len(spans) == expect
+
+
+def fleet_prefix_phase(ports, procs, checks: list) -> dict:
+    """Fleet prefix tier under real fleet faults (--fleet-prefix):
+    3 --prefix-fetch workers behind a --prefix-directory gateway over
+    HTTP. A shared 48-token prefix is established on one lane, then a
+    second lane's hinted request must SPLICE it over the wire (remote
+    prefill skipped, stream byte-identical to an uninterrupted oracle).
+    Then the fallback ladder under faults: a DRAINED owner refuses the
+    export BY NAME and the hinted stream recomputes locally
+    (peer_refused); a kill -9ed owner leaves the fetch dialing a corpse
+    and the stream recomputes locally (peer_unreachable) — every
+    fallback byte-identical, zero KV blocks leaked on the survivors,
+    the prober eject invalidates the dead lane's directory entries, and
+    directory counters == prefix_dir marker spans throughout."""
+    import signal
+
+    from tpu_engine.serving.gateway import Gateway, _parse_sse
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(prefix_directory=True,
+                               health_probe_interval_s=0.5,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    lane = {i: victim_lane_for_port(lanes, p) for i, p in enumerate(ports)}
+
+    def fetch_stats(port):
+        _, health = _call(port, "GET", "/health", timeout=10.0)
+        return (health.get("generator") or {}).get("prefix_fetch") or {}
+
+    # Two disjoint shared prefixes (3 full 16-token blocks each) with
+    # per-request suffix tails — the directory keys on the block-aligned
+    # prefix fingerprint, so every request below shares a chain without
+    # sharing a prompt. Sized to the test model: 48 prefix + 6 suffix +
+    # 8 new tokens stays under gpt2-small-test's 64-position window, so
+    # nothing silently truncates.
+    p1 = [(17 * j + 5) % 97 + 1 for j in range(48)]
+    p2 = [(13 * j + 11) % 89 + 1 for j in range(48)]
+
+    def req(rid, prefix, salt):
+        return {"request_id": rid,
+                "prompt_tokens": prefix + [(salt * 9 + j) % 90 + 1
+                                           for j in range(6)],
+                "max_new_tokens": 8}
+
+    # Warm every lane's compile cache on an UNRELATED prompt so fetch
+    # timings measure the tier, not XLA.
+    for p in ports:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+
+    outputs: dict = {}
+    requests: list = []
+
+    def run_blocking(rid, prefix, salt):
+        r = req(rid, prefix, salt)
+        requests.append(r)
+        outputs[rid] = gw.route_generate(dict(r))["tokens"]
+
+    # 1) Establish lane 0 as the P1 owner (post-completion record).
+    r_own1 = rid_for_lane(gw._ring, lane[0], "fpown1")
+    run_blocking(r_own1, p1, 1)
+    checks.append(("fleet-prefix: owner recorded in the directory",
+                   gw.get_stats().get("prefix_directory", {})
+                   .get("entries", 0) >= 1))
+
+    # 2) Hinted STREAM on lane 1: the gateway stamps the peer hint, the
+    # lane pulls the chain over real HTTP and splices — remote prefill
+    # skipped, one attempt, one splice.
+    i_fetch = 1
+    r_fetch = rid_for_lane(gw._ring, lane[i_fetch], "fpfetch")
+    rf = req(r_fetch, p1, 2)
+    requests.append(rf)
+    toks, final = [], None
+    for frame in gw.route_generate_stream(dict(rf)):
+        evt = _parse_sse(frame)
+        if evt and evt.get("done"):
+            final = evt
+            break
+        if evt and "tokens" in evt:
+            toks.extend(evt["tokens"])
+    outputs[r_fetch] = (final or {}).get("tokens")
+    checks.append(("fleet-prefix: hinted stream completed",
+                   stream_completed(final) and toks == outputs[r_fetch]))
+    fs = fetch_stats(ports[i_fetch])
+    checks.append(("fleet-prefix: peer fetch spliced over HTTP "
+                   f"(attempted={fs.get('attempted')} "
+                   f"spliced={fs.get('spliced')})",
+                   fs.get("attempted") == 1 and fs.get("spliced") == 1
+                   and fs.get("blocks_spliced", 0) >= 3
+                   and fs.get("prefill_tokens_skipped_remote", 0) >= 48))
+
+    # 3) Drained owner refuses BY NAME. The P1 chain now lives on both
+    # lane 0 and lane 1 (and the directory may point at either after a
+    # prober sweep) — drain BOTH so the hint, wherever it lands, meets a
+    # refusal; the hinted request on lane 2 must fall back to local
+    # prefill and still match the oracle.
+    for i in (0, 1):
+        _call(ports[i], "POST", "/admin/drain", {"action": "drain"},
+              timeout=30)
+    _, refused = _call(ports[i_fetch], "POST", "/admin/export_prefix",
+                       {"tokens": p1[:32]}, timeout=30)
+    checks.append(("fleet-prefix: drained owner refuses export by name",
+                   refused.get("ok") is False
+                   and "is draining" in refused.get("reason", "")
+                   and f"w{i_fetch}" in refused.get("reason", "")))
+    r_drain = rid_for_lane(gw._ring, lane[2], "fpdrain")
+    run_blocking(r_drain, p1, 3)
+    for i in (0, 1):
+        _call(ports[i], "POST", "/admin/drain", {"action": "undrain"},
+              timeout=30)
+    fs2 = fetch_stats(ports[2])
+    checks.append(("fleet-prefix: refused fetch fell back to local "
+                   f"prefill (peer_refused={fs2.get('peer_refused')})",
+                   fs2.get("attempted") == 1
+                   and fs2.get("peer_refused") == 1
+                   and fs2.get("spliced", 0) == 0))
+
+    # 4) Kill -9 the owner of a SECOND prefix, then fetch: the hint
+    # dials a corpse, the lane recomputes locally, the stream is still
+    # byte-identical. Lane 2 is the only P2 holder, lane 1 the fetcher.
+    r_own2 = rid_for_lane(gw._ring, lane[2], "fpown2")
+    run_blocking(r_own2, p2, 4)
+    procs[2].send_signal(signal.SIGKILL)
+    procs[2].wait(timeout=10)
+    r_kill = rid_for_lane(gw._ring, lane[i_fetch], "fpkill")
+    run_blocking(r_kill, p2, 5)
+    fs3 = fetch_stats(ports[i_fetch])
+    checks.append(("fleet-prefix: dead-owner fetch fell back to local "
+                   f"prefill (peer_unreachable={fs3.get('peer_unreachable')})",
+                   fs3.get("attempted") == 2
+                   and fs3.get("peer_unreachable") == 1
+                   and fs3.get("spliced") == 1))
+
+    # 5) The prober ejects the corpse and the eject invalidates its
+    # directory entries (a dead lane can't serve a peer fetch).
+    ejected = False
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if lane[2] in gw.ejected_lanes():
+            ejected = True
+            break
+        time.sleep(0.1)
+    pd = gw.get_stats().get("prefix_directory", {})
+    checks.append(("fleet-prefix: prober ejected the dead owner",
+                   ejected))
+    checks.append(("fleet-prefix: eject invalidated the dead lane's "
+                   f"entries (invalidations={pd.get('invalidations')})",
+                   pd.get("invalidations", 0) >= 1))
+    checks.append(("fleet-prefix: prober sweeps seeded the directory "
+                   f"(seeded={pd.get('seeded')})",
+                   pd.get("seeded", 0) >= 1))
+    checks.append(("fleet-prefix: hints attached "
+                   f"({pd.get('hints_attached')})",
+                   pd.get("hints_attached", 0) >= 3))
+
+    # 6) Oracle: every gateway stream vs a blocking control on ONE
+    # surviving worker (identical weights fleet-wide; run LAST so the
+    # control's own radix inserts can't pre-warm the fetch targets).
+    try:
+        control = control_oracle(ports[0], requests)
+    except RuntimeError as exc:
+        checks.append(("fleet-prefix: control generate", False))
+        gw.stop()
+        return {"error": str(exc)}
+    identical = sum(1 for rid, toks in outputs.items()
+                    if toks == control[rid])
+    checks.append(("fleet-prefix: every stream byte-identical to "
+                   f"control ({identical}/{len(outputs)})",
+                   identical == len(outputs) and len(outputs) == 5))
+
+    # 7) Export sanity on a live lane: a real chain for the shared
+    # prefix, a refusal (not an error) for an empty one.
+    _, chain = _call(ports[0], "POST", "/admin/export_prefix",
+                     {"tokens": p1[:32]}, timeout=30)
+    checks.append(("fleet-prefix: live export returns a verifiable chain",
+                   chain.get("ok") is True
+                   and chain.get("blocks", 0) >= 2
+                   and (chain.get("chain") or {}).get("block_size") == 16
+                   and "checksum" in (chain.get("chain") or {})))
+    _, empty = _call(ports[0], "POST", "/admin/export_prefix",
+                     {"tokens": []}, timeout=30)
+    checks.append(("fleet-prefix: empty export refused, not raised",
+                   empty.get("ok") is False
+                   and "no token prefix" in empty.get("reason", "")))
+
+    # 8) Directory counters == prefix_dir marker spans (settle briefly:
+    # the prober bumps the counter before recording its span).
+    agree = False
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if _prefix_dir_counters_match_spans(gw):
+            agree = True
+            break
+        time.sleep(0.1)
+    checks.append(("fleet-prefix: directory counters == prefix_dir "
+                   "spans", agree))
+
+    # 9) Zero KV blocks leaked on the survivors.
+    for p in (ports[0], ports[1]):
+        pool = _worker_pool_clean(p)
+        checks.append((f"fleet-prefix: no KV blocks leaked on :{p}",
+                       pool is not None))
+    gw.stop()
+    return {"prefix_directory": pd,
+            "fetch_lane": {"splice": fs, "after_kill": fs3},
+            "refused_lane": fs2, "drain_refusal": refused,
+            "streams": len(outputs), "identical": identical}
+
+
+def run_fleet_prefix_standalone() -> int:
+    ports, procs = launch_worker_procs(3, extra_args=("--prefix-fetch",))
+    checks: list = []
+    try:
+        report = {"mode": "fleet-prefix-standalone", "worker_ports": ports,
+                  "phases": {"fleet_prefix":
+                             fleet_prefix_phase(ports, procs, checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_all_standalone() -> int:
     """--all: every standalone chaos scenario in sequence, each in its
     own interpreter (a wedged scenario cannot poison the next), one JSON
     summary on stdout, nonzero exit when ANY scenario's check fails."""
     flags = ("--mixed", "--spec", "--crash", "--offload", "--quant",
              "--migrate", "--disagg", "--recurrent", "--tp",
-             "--overload", "--elastic", "--stitch")
+             "--overload", "--elastic", "--stitch", "--fleet-prefix")
     here = os.path.abspath(__file__)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -3291,6 +3535,22 @@ def main() -> int:
                          "anomaly, and the defaults-off worker's wire "
                          "surfaces carry no new keys; ignores the "
                          "other flags")
+    ap.add_argument("--fleet-prefix", action="store_true",
+                    help="standalone fleet-prefix-tier scenario: spawns "
+                         "3 --prefix-fetch workers behind a "
+                         "--prefix-directory gateway, proves a hinted "
+                         "stream splices a shared prefix from its owner "
+                         "over HTTP (remote prefill skipped, "
+                         "byte-identical), then walks the fallback "
+                         "ladder under faults — a DRAINED owner refuses "
+                         "the export by name and a kill -9ed owner "
+                         "leaves the fetch dialing a corpse, with every "
+                         "fallback stream recomputed locally and "
+                         "byte-identical to control, the prober eject "
+                         "invalidating the dead lane's directory "
+                         "entries, directory counters == prefix_dir "
+                         "spans, and zero KV blocks leaked on the "
+                         "survivors; ignores the other flags")
     ap.add_argument("--all", action="store_true",
                     help="run EVERY standalone chaos scenario in "
                          "sequence, each in its own interpreter, and "
@@ -3304,6 +3564,8 @@ def main() -> int:
         return run_elastic_standalone()
     if args.stitch:
         return run_stitch_standalone()
+    if args.fleet_prefix:
+        return run_fleet_prefix_standalone()
     if args.tp:
         return run_tp_standalone()
     if args.disagg:

@@ -137,6 +137,13 @@ class _Request:
     # prefilling (DESIGN.md "Live stream migration").
     tag: Optional[str] = None
     migrate: Optional[dict] = None
+    # Fleet prefix tier (DESIGN.md "Fleet-wide prefix tier"): a
+    # gateway-attached hint naming the lane whose radix tree holds the
+    # deepest known chain for this prompt's fingerprint. A miss with a
+    # hint pulls the chain from that peer on the prefill thread and
+    # splices it through the radix re-adoption path; every failure rung
+    # falls back to local prefill (never strands the stream).
+    prefix_hint: Optional[dict] = None
     # Disaggregated serving (DESIGN.md "Disaggregated serving"): a
     # handoff request PARKS after prefill — the row holds its first
     # token and KV chain, skipping decode ticks, until the gateway's
@@ -749,6 +756,14 @@ class ContinuousGenerator:
         # re-parents its spans under the SAME trace. Off = snapshot and
         # chain wire bytes identical to today.
         self.trace_stitch = False
+        # Fleet prefix tier (set post-construction by the serving
+        # worker when --prefix-fetch is on): a callable
+        # ``(hint, tokens, max_blocks) -> dict | None`` that pulls a
+        # radix chain from the hinted peer — the worker owns transport,
+        # timeout, and the in-flight cap; the scheduler owns
+        # verification, allocation, and the splice. None keeps every
+        # hint inert (defaults-off: zero prefill-path work).
+        self.prefix_fetch = None
         # Per-tick flight recorder (DESIGN.md "Observability plane"):
         # a bounded ring of per-tick records — rows by state, token
         # budget used, dispatch wall time, queue/park/held depths, pool
@@ -1636,7 +1651,8 @@ class ContinuousGenerator:
                deadline: Optional[Deadline] = None,
                sink=None, tag: Optional[str] = None,
                handoff: bool = False,
-               handoff_park_s: float = 5.0) -> Future:
+               handoff_park_s: float = 5.0,
+               prefix_hint: Optional[dict] = None) -> Future:
         """Enqueue one request; resolves to its generated token list.
         `stream`: optional queue.Queue — fresh token lists are pushed as
         they decode (iteration-level granularity), then a None sentinel.
@@ -1652,7 +1668,12 @@ class ContinuousGenerator:
         `handoff_park_s` seconds awaiting an export-after-prefill
         command (export_row(wait_prefill=True)); past the park window
         the row decodes locally like any other (the colocated
-        fallback). Ignored on dense layouts (nothing to export)."""
+        fallback). Ignored on dense layouts (nothing to export).
+        `prefix_hint` (fleet prefix tier): a gateway-attached
+        ``{"lane", "addr", "fingerprint", "blocks"}`` naming the peer
+        whose radix tree holds the deepest known chain for this
+        prompt — inert unless --prefix-fetch installed a fetch
+        callable."""
         if not self._running:
             raise RuntimeError("scheduler stopped")
         pens, stops = expand_stopping_params(1, repetition_penalty,
@@ -1677,6 +1698,8 @@ class ContinuousGenerator:
                        stream=stream, deadline=deadline, sink=sink,
                        t_submit=time.perf_counter(),
                        tag=str(tag) if tag is not None else None,
+                       prefix_hint=dict(prefix_hint)
+                       if isinstance(prefix_hint, dict) else None,
                        handoff=bool(handoff) and (self._paged
                                                   or self._slab),
                        # Clamped: a parked row pins a slot + KV chain,
@@ -1783,6 +1806,49 @@ class ContinuousGenerator:
         self._queue.put(req)
         return req.future
 
+    # -- fleet prefix tier (DESIGN.md "Fleet-wide prefix tier") ----------------
+
+    def export_prefix(self, tokens: Sequence[int],
+                      max_blocks: Optional[int] = None) -> dict:
+        """Serialize the longest radix chain matching ``tokens`` for a
+        peer lane's fetch (/admin/export_prefix): ``chain_nodes`` +
+        ``export_chain`` under ONE pool-lock acquisition — eviction
+        only runs inside alloc under the same lock, so the chain needs
+        no pins, no promotion, no LRU stamping. Device-resident and
+        host-demoted nodes serialize alike (the host tier reads its
+        slab directly); NO stream state ships — this is a cache read,
+        not a migration. Refusals return ``{"ok": False, "reason"}``
+        and never raise (the fetching peer falls back to local
+        prefill)."""
+        if not self._paged or not self._prefix_sharing:
+            return {"ok": False,
+                    "reason": "prefix export requires the paged KV "
+                              "cache with prefix sharing on"}
+        if not self._running:
+            return {"ok": False, "reason": "scheduler stopped"}
+        toks = [int(t) for t in tokens]
+        pool = self._pool
+        with pool.lock:
+            nodes = pool.radix.chain_nodes(toks)
+            if max_blocks is not None:
+                nodes = nodes[:max(0, int(max_blocks))]
+            if not nodes:
+                return {"ok": False, "reason": "no matching prefix chain"}
+            chain = pool.export_chain(nodes)
+        return {"ok": True, "blocks": len(nodes), "chain": chain}
+
+    def prefix_fingerprints(self, top_k: int = 8,
+                            max_tokens: int = 256) -> List[dict]:
+        """Bounded top-K radix chain summaries (deepest first) for the
+        gateway prober's directory seed — ``{"tokens", "blocks"}``
+        entries, never a full-tree dump. Empty off the paged/sharing
+        layouts."""
+        if not self._paged or not self._prefix_sharing:
+            return []
+        pool = self._pool
+        with pool.lock:
+            return pool.radix.top_chains(top_k=top_k, max_tokens=max_tokens)
+
     # -- disaggregated handoff holds (DESIGN.md "Disaggregated serving") -------
 
     def _handoff_stats(self) -> dict:
@@ -1848,6 +1914,29 @@ class ContinuousGenerator:
     def _bump_migration(self, field: str, n: int = 1) -> None:
         with self._stats_lock:
             self._migration_stats()[field] += n
+
+    def _prefix_fetch_stats(self) -> dict:
+        """The additive ``prefix_fetch`` stats block (fleet prefix
+        tier), created on first touch — defaults-off /stats and
+        /health bytes stay identical. Every bump holds ``_stats_lock``
+        (attempts land on the prefill thread, scrapes anywhere). One
+        ``prefix_fetch`` stage span is recorded per attempt
+        (counters==spans: ``attempted`` equals the span count)."""
+        p = self._stats.get("prefix_fetch")
+        if p is None:
+            p = self._stats["prefix_fetch"] = {
+                "attempted": 0, "spliced": 0, "blocks_spliced": 0,
+                "prefill_tokens_skipped_remote": 0,
+                "peer_unreachable": 0, "peer_refused": 0, "timeout": 0,
+                "inflight_capped": 0, "checksum_failed": 0,
+                "geometry_mismatch": 0, "stale_generation": 0,
+                "pool_full": 0, "no_gain": 0,
+            }
+        return p
+
+    def _bump_prefix_fetch(self, field: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._prefix_fetch_stats()[field] += n
 
     def _serve_exports(self) -> None:
         """Drain pending export commands — called by the decode loop at
@@ -2134,6 +2223,11 @@ class ContinuousGenerator:
             ho["held_rows"] = int(sum(  # lint: lockfree-ok GIL-safe scrape
                 1 for h in self._held if h))
             out["handoff"] = ho
+        if "prefix_fetch" in self._stats:
+            # Fleet prefix tier fetch ladder (additive, created on the
+            # first fetch attempt — defaults-off bytes identical).
+            with self._stats_lock:
+                out["prefix_fetch"] = dict(self._stats["prefix_fetch"])
         # Additive, present only while the lane is draining (elastic
         # fleet scale-down watch; defaults-off stats bytes unchanged):
         # live-row occupancy of a lame-duck lane — 0.0 means the drain
@@ -2501,6 +2595,99 @@ class ContinuousGenerator:
                            start_ts=time.time() - dur_us / 1e6,
                            blocks=swapped)
 
+    def _fetch_prefix_splice(self, req: _Request, prompt: List[int],
+                             matched: List[int], pool, gen: int,
+                             pb: int) -> List[int]:
+        """Fleet prefix tier fetch (prefill thread): pull the hinted
+        peer's radix chain for this prompt and splice it PAST the local
+        match through the radix re-adoption path — only the unmatched
+        tail prefills afterward, accounted as
+        ``prefill_tokens_skipped_remote``. Verification (geometry +
+        checksum) runs BEFORE any allocation; the splice itself holds
+        the pool lock once (generation check → live-row reserve →
+        alloc → verbatim import → radix insert). Every failure rung —
+        peer dead/draining/refused/timeout, checksum, stale pool
+        generation, pool full, no gain over the local match — returns
+        the local match unchanged: the stream recomputes locally,
+        never strands. One ``prefix_fetch`` stage span per attempt
+        (counters==spans; ``attempted`` equals the span count)."""
+        hint = req.prefix_hint
+        if not self._prefix_sharing or not isinstance(hint, dict):
+            return matched
+        bs = pool.block_size
+        Leff = max(len(prompt), 1)
+        # The last prompt block always recomputes (sampling params stay
+        # OUT of the radix key), so blocks past (Leff-1)//bs save
+        # nothing — and the row table caps the chain at pb//bs.
+        max_useful = min((Leff - 1) // bs, pb // bs)
+        m = len(matched)
+        promised = int(hint.get("blocks") or 0)
+        if max_useful <= m or (promised and promised <= m):
+            return matched  # a fetch could not add anything: no attempt
+        t0 = time.perf_counter()
+        outcome = "spliced"
+        spliced = 0
+        chain = None
+        try:
+            res = self.prefix_fetch(hint, prompt, max_useful)
+        except Exception:  # transport must never kill the prefill thread
+            res = {"ok": False, "rung": "peer_unreachable"}
+        if res is None:
+            return matched  # self-hint (retry landed on the owner): skip
+        if not res.get("ok"):
+            rung = str(res.get("rung") or "peer_refused")
+            outcome = rung if rung in ("peer_unreachable", "peer_refused",
+                                       "timeout", "inflight_capped") \
+                else "peer_refused"
+        else:
+            chain = res.get("chain")
+            if not isinstance(chain, dict) or "blocks" not in chain:
+                outcome = "geometry_mismatch"
+            elif pool.chain_compatible(chain) is not None:
+                outcome = "geometry_mismatch"
+            elif not pool.verify_chain(chain):
+                outcome = "checksum_failed"
+        if outcome == "spliced":
+            n_fetch = min(len(chain["blocks"]), max_useful)
+            if n_fetch <= m:
+                outcome = "no_gain"
+            else:
+                with pool.lock:
+                    if pool.generation != gen:
+                        outcome = "stale_generation"
+                    elif not pool.can_alloc(n_fetch - m
+                                            + self._promote_reserve()):
+                        outcome = "pool_full"
+                    else:
+                        fresh = pool.alloc(n_fetch - m)
+                        pool.import_chain(chain,
+                                          chain["blocks"][m:n_fetch], fresh)
+                        # Re-adoption path: existing nodes untouched,
+                        # the spliced tail joins the tree (tree's own
+                        # retain) — the row keeps the alloc reference,
+                        # exactly the lookup-pin shape downstream code
+                        # already releases.
+                        pool.radix.insert(prompt[:n_fetch * bs],
+                                          list(matched) + fresh)
+                        matched = list(matched) + fresh
+                        spliced = n_fetch - m
+        dur_us = (time.perf_counter() - t0) * 1e6
+        with self._stats_lock:
+            p = self._prefix_fetch_stats()
+            p["attempted"] += 1
+            if spliced:
+                p["spliced"] += 1
+                p["blocks_spliced"] += spliced
+                p["prefill_tokens_skipped_remote"] += spliced * bs
+            else:
+                p[outcome] += 1
+        if req.sink is not None:
+            req.sink.stage("prefix_fetch", dur_us,
+                           start_ts=time.time() - dur_us / 1e6,
+                           outcome=outcome, blocks=spliced,
+                           peer=str(hint.get("lane") or ""))
+        return matched
+
     def _run_prefill_paged(self, req: _Request):
         """Paged admission prefill: 0-aligned (RIGHT-padded) row cache,
         radix longest-prefix match, prefill resumed mid-prompt past the
@@ -2529,6 +2716,15 @@ class ContinuousGenerator:
                 swapped = pool.swap_ins - si0
         m_tok = len(matched) * bs
         self._record_swap_in(req, swapped, t0)
+        if self.prefix_fetch is not None and req.prefix_hint is not None:
+            # Fleet prefix tier: a gateway hint on a (partial) miss
+            # pulls the peer's deeper chain BEFORE the gather — spliced
+            # blocks ride the row cache like local radix hits. m_tok
+            # keeps the LOCAL match for the radix_lookup span; the
+            # prefix_fetch span accounts for the splice.
+            matched = self._fetch_prefix_splice(req, prompt, matched,
+                                                pool, gen, pb)
+        m_tok_all = len(matched) * bs
         try:
             if matched:
                 # The gather IS the row cache init on a hit: matched
@@ -2571,7 +2767,7 @@ class ContinuousGenerator:
             if not 0 < w < pb:
                 w = pb
             win_exe = self._window()
-            p0 = (min(m_tok, Leff - 1) // bs) * bs
+            p0 = (min(m_tok_all, Leff - 1) // bs) * bs
             logits = None
             w0 = p0
             while w0 <= Leff - 1:
@@ -2628,6 +2824,12 @@ class ContinuousGenerator:
             req.sink.stage("radix_lookup", dur_us,
                            start_ts=time.time() - dur_us / 1e6,
                            matched_tokens=len(matched) * pool.block_size)
+        if self.prefix_fetch is not None and req.prefix_hint is not None:
+            # Fleet prefix tier (mixed mode): the splice extends the
+            # match before batch formation — the ragged tick's resume
+            # point moves exactly like a deeper local hit.
+            matched = self._fetch_prefix_splice(req, prompt, matched,
+                                                pool, gen, pb)
         row_counts = None
         if req.rep_penalty != 1.0 or req.stop_tokens:
             # Prompt-token counts only — the first sampled token joins

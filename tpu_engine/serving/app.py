@@ -94,6 +94,13 @@ def serve_worker(config: WorkerConfig, background: bool = True) -> Tuple[WorkerN
     # continuation rides /generate/stream with a `migrate_import` body.
     server.route("POST", "/admin/migrate",
                  lambda body: (200, worker.handle_migrate_export(body or {})))
+    # Fleet prefix tier (DESIGN.md "Fleet-wide prefix tier"): serve this
+    # lane's longest radix chain matching a peer's token prefix — the
+    # peer verifies checksum + geometry before trusting a byte, so the
+    # export itself never refuses on trust grounds (only on drain /
+    # non-paged / no-match, as named non-raising statuses).
+    server.route("POST", "/admin/export_prefix",
+                 lambda body: (200, worker.handle_export_prefix(body or {})))
     # Disaggregated serving: flip the lane's role at runtime (the
     # gateway's set_worker_role rides drain + migrate around this).
     server.route("POST", "/admin/role",
@@ -361,6 +368,24 @@ def serve_combined(
                 except Exception as exc:  # warmup is best-effort
                     print(f"generate warmup skipped: {exc}")
     gateway = Gateway(workers, gateway_config)
+    # Fleet prefix tier, combined-mode transport: in-process lanes have
+    # no URL to dial, so a peer fetch is a direct handle_export_prefix
+    # call on the owning lane object. Any lookup/shape surprise raises
+    # and the caller classifies it as peer_unreachable (local prefill).
+    prefix_fetch_on = bool(worker_config is not None
+                           and getattr(worker_config,
+                                       "gen_prefix_fetch", False))
+
+    def _peer_export(hint, payload):
+        lane = hint.get("lane")
+        for w in list(workers):
+            if w.node_id == lane:
+                return w.handle_export_prefix(payload)
+        raise KeyError(f"no in-process lane named {lane!r}")
+
+    if prefix_fetch_on:
+        for w in workers:
+            w.set_prefix_fetch_transport(_peer_export)
     if gateway_config.autoscale and mesh is None:
         # Elastic fleet in combined mode: the provider mints fresh
         # in-process lanes with the same config/device round-robin the
@@ -392,6 +417,8 @@ def serve_combined(
                 device=devices[i % len(devices)],
             )
             w = WorkerNode(lane_cfg, engine=engine)
+            if prefix_fetch_on:
+                w.set_prefix_fetch_transport(_peer_export)
             workers.append(w)
             return w
 
@@ -417,7 +444,7 @@ def serve_combined(
         (additive keys; the reference-exact schema is untouched for
         dense deployments)."""
         out = gateway.get_stats()
-        kv, mixed, spec, state = {}, {}, {}, {}
+        kv, mixed, spec, state, pfetch = {}, {}, {}, {}, {}
         for w in workers:
             gen = getattr(w, "generator", None)
             if gen is None or not hasattr(gen, "stats"):
@@ -428,6 +455,11 @@ def serve_combined(
                 continue
             if st.get("kv_pool"):
                 kv[w.node_id] = st["kv_pool"]
+            if st.get("prefix_fetch"):
+                # Fleet prefix tier, lane half: peer-fetch attempts and
+                # fallback rungs per lane (present only once a hint was
+                # acted on — defaults-off /stats is untouched).
+                pfetch[w.node_id] = st["prefix_fetch"]
             if st.get("state_pool"):
                 # state_slab-family lanes (models.ssd): the kv_pool
                 # analog — gated the same way, absent on kv_paged
@@ -447,6 +479,8 @@ def serve_combined(
             out["mixed"] = mixed
         if spec:
             out["spec"] = spec
+        if pfetch:
+            out["prefix_fetch"] = pfetch
         return 200, out
 
     routes[("GET", "/stats")] = _stats

@@ -329,6 +329,24 @@ def main(argv=None) -> int:
                                  "chains carry the stream's trace "
                                  "context so the importing lane's spans "
                                  "join the same tree")
+        parser.add_argument("--prefix-fetch", action="store_true",
+                            help="fleet prefix tier (worker side; needs "
+                                 "--kv-block-size + prefix sharing): "
+                                 "serve /admin/export_prefix to peers, "
+                                 "publish bounded radix summaries in "
+                                 "/health, and pull a gateway-hinted "
+                                 "peer's KV chain before prefilling a "
+                                 "local radix miss — every failure "
+                                 "falls back to local prefill")
+        parser.add_argument("--prefix-fetch-timeout", type=float,
+                            default=None,
+                            help="per-fetch peer budget in seconds "
+                                 "(default 5)")
+        parser.add_argument("--prefix-fetch-inflight", type=int,
+                            default=None,
+                            help="concurrent outbound peer fetches per "
+                                 "lane; excess misses prefill locally "
+                                 "(default 2)")
         _add_flight_flags(parser)
         args = parser.parse_args(rest)
         port = args.port
@@ -385,6 +403,12 @@ def main(argv=None) -> int:
             gen_kw["role"] = args.role
         if args.trace_stitch:
             gen_kw["trace_stitch"] = True
+        if args.prefix_fetch:
+            gen_kw["gen_prefix_fetch"] = True
+        if args.prefix_fetch_timeout is not None:
+            gen_kw["gen_prefix_fetch_timeout_s"] = args.prefix_fetch_timeout
+        if args.prefix_fetch_inflight is not None:
+            gen_kw["gen_prefix_fetch_inflight"] = args.prefix_fetch_inflight
         _apply_flight_flags(args, gen_kw)
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
@@ -464,6 +488,20 @@ def main(argv=None) -> int:
                                  "it is this many recent dispatches hotter "
                                  "than its least-loaded peer (0 = always "
                                  "honor affinity)")
+        parser.add_argument("--prefix-directory", action="store_true",
+                            help="fleet prefix tier (gateway side): keep "
+                                 "a bounded fingerprint->owner-lane "
+                                 "directory (prober /health summaries + "
+                                 "post-completion updates) and stamp "
+                                 "generate-class dispatches with a "
+                                 "prefix_hint so --prefix-fetch lanes "
+                                 "can pull the owner's KV chain instead "
+                                 "of re-prefilling it (works with "
+                                 "affinity off)")
+        parser.add_argument("--prefix-dir-capacity", type=int,
+                            default=None,
+                            help="directory LRU bound in entries "
+                                 "(default 512)")
         parser.add_argument("--overload-control", action="store_true",
                             help="priority-tiered gateway admission "
                                  "(lowest tier sheds first as "
@@ -525,6 +563,10 @@ def main(argv=None) -> int:
             gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
         if args.affinity_max_imbalance is not None:
             gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
+        if args.prefix_directory:
+            gw_kw["prefix_directory"] = True
+        if args.prefix_dir_capacity is not None:
+            gw_kw["prefix_directory_capacity"] = args.prefix_dir_capacity
         if args.disagg:
             gw_kw["disagg"] = True
         if args.handoff_timeout is not None:
@@ -825,6 +867,21 @@ def main(argv=None) -> int:
                                  "mode): shared prompt prefixes reuse "
                                  "already-filled KV blocks and skip their "
                                  "prefill compute")
+        parser.add_argument("--prefix-fetch", action="store_true",
+                            help="fleet-wide prefix tier (needs "
+                                 "--kv-block-size + prefix sharing): the "
+                                 "gateway keeps a fingerprint->owner-lane "
+                                 "directory and stamps generate dispatches "
+                                 "with a prefix_hint; a lane admitting a "
+                                 "local radix miss pulls the owner's KV "
+                                 "chain peer-to-peer (checksum-verified) "
+                                 "instead of re-prefilling — every "
+                                 "failure falls back to local prefill "
+                                 "(bench.py --scenario fleet-prefix-ab)")
+        parser.add_argument("--prefix-fetch-timeout", type=float,
+                            default=None,
+                            help="per-fetch peer budget in seconds "
+                                 "(default 5)")
         parser.add_argument("--mixed-step", action="store_true",
                             help="mixed prefill+decode stepping (needs "
                                  "--kv-block-size): every scheduler tick "
@@ -935,6 +992,15 @@ def main(argv=None) -> int:
                 gw_kw["affinity_prefix_blocks"] = args.affinity_prefix_blocks
             if args.affinity_max_imbalance is not None:
                 gw_kw["affinity_max_imbalance"] = args.affinity_max_imbalance
+        if args.prefix_fetch:
+            # One flag arms BOTH halves in combined mode: the gateway's
+            # directory + hint stamping and the lanes' peer fetch path.
+            gw_kw["prefix_directory"] = True
+            # The directory fingerprints at the lanes' REAL block size
+            # even with affinity routing off — a mismatched granularity
+            # would promise chains the radix trees don't share at.
+            if "affinity_block_size" not in gw_kw and args.kv_block_size > 0:
+                gw_kw["affinity_block_size"] = args.kv_block_size
         if args.disagg:
             gw_kw["disagg"] = True
         if args.handoff_timeout is not None:
@@ -985,6 +1051,10 @@ def main(argv=None) -> int:
         # chain trace headers.
         if args.trace_stitch:
             bb_kw["trace_stitch"] = True
+        if args.prefix_fetch:
+            bb_kw["gen_prefix_fetch"] = True
+        if args.prefix_fetch_timeout is not None:
+            bb_kw["gen_prefix_fetch_timeout_s"] = args.prefix_fetch_timeout
         _apply_flight_flags(args, bb_kw)
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,

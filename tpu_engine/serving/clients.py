@@ -126,6 +126,21 @@ class LocalWorkerClient:
         except Exception as exc:
             raise WorkerError(str(exc)) from exc
 
+    def export_prefix(self, payload: dict,
+                      timeout_s: Optional[float] = None) -> dict:
+        """Pull the longest cached radix chain matching a token prefix
+        (fleet prefix tier; in-process: the worker serializes under its
+        pool lock directly — refusals come back ``ok=False``, never as
+        exceptions)."""
+        try:
+            return self.worker.handle_export_prefix(payload)
+        except (KeyError, TypeError, ValueError):
+            raise
+        except ShedError:
+            raise
+        except Exception as exc:
+            raise WorkerError(str(exc)) from exc
+
     def health(self) -> dict:
         return self.worker.get_health()
 
@@ -425,6 +440,15 @@ class HttpWorkerClient:
         return self._request("POST", "/admin/migrate", payload,
                              timeout_s=(timeout_s if timeout_s is not None
                                         else self._gen_timeout))
+
+    def export_prefix(self, payload: dict,
+                      timeout_s: Optional[float] = None) -> dict:
+        """POST /admin/export_prefix: pull a peer lane's cached radix
+        chain for a token prefix (fleet prefix tier). The chain payload
+        scales with the prefix depth, so the socket timeout is the
+        fetcher's per-fetch budget (--prefix-fetch-timeout)."""
+        return self._request("POST", "/admin/export_prefix", payload,
+                             timeout_s=timeout_s)
 
     def health(self) -> dict:
         return self._request("GET", "/health")

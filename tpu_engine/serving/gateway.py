@@ -64,6 +64,7 @@ from tpu_engine.serving.overload import (
     parse_priority,
     tier_limit,
 )
+from tpu_engine.serving.prefix_directory import PrefixDirectory
 from tpu_engine.serving.resilience import (
     AffinityCounters,
     FailoverCounters,
@@ -71,6 +72,7 @@ from tpu_engine.serving.resilience import (
     HandoffCounters,
     LatencyTracker,
     MigrationCounters,
+    PrefixDirCounters,
     ProbeStateMachine,
     ResilienceCounters,
     RetryBudget,
@@ -398,6 +400,25 @@ class Gateway:
         self.affinity = AffinityCounters()
         self._affinity_assigned: Dict[str, int] = {}
         self._lane_recent: Dict[str, object] = {}  # lane -> deque[ts]
+        # Fleet prefix directory (DESIGN.md "Fleet-wide prefix tier"):
+        # bounded fingerprint -> {lane, blocks, generation} hint cache
+        # keyed by the SAME _affinity_fingerprint the affinity router
+        # hashes — but independent of prefix_affinity (the directory
+        # pays off exactly when routing CAN'T converge shared prefixes
+        # onto one lane). Populated from prober /health summaries and
+        # post-completion updates; entries die by per-lane generation
+        # stamp on removal/drain/eject/recovery. Lives under self._lock;
+        # None at defaults — /stats and wire bytes stay identical.
+        self.prefix_dir = PrefixDirCounters()
+        # _prefix_dir_on is the config-constant hot-path gate (set once
+        # here, never reassigned); the directory itself moves only under
+        # self._lock.
+        self._prefix_dir_on = bool(getattr(self.config,
+                                           "prefix_directory", False))
+        self._prefix_dir: Optional[PrefixDirectory] = (
+            PrefixDirectory(getattr(self.config,
+                                    "prefix_directory_capacity", 512))
+            if self._prefix_dir_on else None)
         # Adaptive overload control (DESIGN.md "Overload control"):
         # priority-tiered admission against the in-flight gauge, the
         # per-tenant token bucket, and the load-derived Retry-After.
@@ -654,6 +675,13 @@ class Gateway:
                     # prober is where TP=4 lanes pick up their per-chip
                     # vnode weight (no-op while the label is unchanged).
                     self._apply_topology(name, body.get("topology"))
+                    # Directory seeding rides the same read: the lane's
+                    # bounded top-K radix summaries (present only with
+                    # --prefix-fetch on worker-side) become fleet-wide
+                    # fingerprint->owner entries.
+                    if self._prefix_dir_on:
+                        self._seed_prefix_dir(
+                            name, body.get("prefix_fingerprints"))
                 except Exception:
                     ok = False  # unreachable = failed probe
                 action = self._probe_state.record(name, ok)
@@ -678,6 +706,15 @@ class Gateway:
                 self.failover.bump("prober_ejections" if action == "eject"
                                    else "prober_restores")
                 self._prober_span(name, action)
+                # Both transitions void the lane's directory entries: an
+                # ejected lane can't serve a peer fetch, and a RECOVERED
+                # lane may have restarted with an empty radix tree — its
+                # chains must be re-learned, not assumed.
+                if self._prefix_dir_on:
+                    with self._lock:
+                        dropped = self._prefix_dir.invalidate_lane(name)
+                    self._prefix_dir_count("invalidations", lane=name,
+                                           action=action, dropped=dropped)
 
     def _prober_span(self, lane: str, action: str) -> None:
         """Zero-duration ``prober`` marker span per eject/restore — the
@@ -743,6 +780,15 @@ class Gateway:
             self._ejected.discard(name)
             self._roles.pop(name, None)
             self._topology.pop(name, None)
+            # Generation-stamp invalidation: the departing lane's radix
+            # tree leaves the fleet with it — every directory entry
+            # naming it is a dead hint (a later lane reusing the name
+            # starts at a fresh generation, so stragglers die lazily).
+            pd_dropped = (self._prefix_dir.invalidate_lane(name)
+                          if self._prefix_dir is not None else None)
+        if pd_dropped is not None:
+            self._prefix_dir_count("invalidations", lane=name,
+                                   action="remove", dropped=pd_dropped)
         # A later lane reusing the name must start with clean probe state.
         self._probe_state.forget(name)
         for ring in rings.values():
@@ -2204,6 +2250,130 @@ class Gateway:
                 self._affinity_assigned.get(lane, 0) + 1)
         return lane
 
+    # -- fleet prefix directory (DESIGN.md "Fleet-wide prefix tier") ----------
+
+    def _prefix_dir_count(self, decision: str,
+                          trace: Optional[_RouteTrace] = None,
+                          **attrs) -> None:
+        """Bump a prefix-directory counter AND drop a zero-duration
+        ``prefix_dir`` marker span — under the request's route span when
+        one exists (hint attachment, lookup misses), else root-context
+        (prober seeds, membership invalidations). Same counters==spans
+        discipline as the affinity/fleet markers; fault_injection
+        --fleet-prefix asserts the two agree."""
+        self.prefix_dir.bump(decision)
+        span_attrs = {"decision": decision,
+                      **{k: v for k, v in attrs.items() if v is not None}}
+        if trace is not None:
+            child = trace.ctx.child()
+            self.tracer.record(
+                trace.request_id, "prefix_dir", "gateway", 0,
+                trace_id=child.trace_id, span_id=child.span_id,
+                parent_id=trace.ctx.span_id, start_ts=time.time(),
+                attrs=span_attrs)
+        else:
+            ctx = TraceContext.root(f"prefix_dir:{decision}").child()
+            self.tracer.record(
+                "prefix_dir", "prefix_dir", "gateway", 0,
+                trace_id=ctx.trace_id, span_id=ctx.span_id,
+                start_ts=time.time(), attrs=span_attrs)
+
+    def _seed_prefix_dir(self, lane: str, summaries) -> None:
+        """Turn one lane's bounded /health radix summaries into
+        directory entries (prober sweep seeding). One ``seeded``
+        bump+span per sweep that changed anything — per-entry spans
+        would drown the recorder at probe cadence; ``evictions`` is a
+        span-free value counter for the same reason."""
+        if not isinstance(summaries, list) or not summaries:
+            return
+        recorded = evicted = deepest = 0
+        for entry in summaries[:32]:
+            if not isinstance(entry, dict):
+                continue
+            fp = self._affinity_fingerprint(
+                {"prompt_tokens": entry.get("tokens")})
+            try:
+                blocks = int(entry.get("blocks", 0))
+            except (TypeError, ValueError):
+                continue
+            if fp is None or blocks <= 0:
+                continue
+            with self._lock:
+                if lane not in self._clients:
+                    return  # removed mid-sweep: nothing to advertise
+                cur = self._prefix_dir.lookup(fp)
+                if (cur is not None and cur["lane"] == lane
+                        and cur["blocks"] >= blocks):
+                    continue  # already known this deep; LRU-touched
+                evicted += self._prefix_dir.record(fp, lane, blocks)
+            recorded += 1
+            deepest = max(deepest, blocks)
+        if evicted:
+            self.prefix_dir.bump("evictions", evicted)
+        if recorded:
+            self._prefix_dir_count("seeded", lane=lane,
+                                   entries=recorded, deepest=deepest)
+
+    def _attach_prefix_hint(self, payload: dict, primary: str,
+                            trace: Optional[_RouteTrace]) -> None:
+        """Stamp the directory's owner lane onto a generate-class
+        payload as ``prefix_hint`` so the SERVING lane — wherever ring
+        order, affinity, or failover actually lands the request — can
+        pull the owner's KV chain peer-to-peer instead of re-prefilling
+        it. No hint when the prompt has no full block, the directory
+        names nobody (or the entry went stale), or the owner IS the
+        chosen primary (the request lands on the blocks already). The
+        hint rides the payload through failover: a retry lane benefits
+        exactly like the primary."""
+        fp = self._affinity_fingerprint(payload)
+        if fp is None:
+            return  # nothing a radix tree could share at block grain
+        with self._lock:
+            entry = self._prefix_dir.lookup(fp)
+            client = (self._clients.get(entry["lane"])
+                      if entry is not None else None)
+        if entry is None or client is None:
+            self._prefix_dir_count("lookup_misses", trace=trace)
+            return
+        if entry["lane"] == primary:
+            return  # affinity already converged us onto the owner
+        hint = {"lane": entry["lane"], "fingerprint": fp,
+                "blocks": int(entry["blocks"])}
+        addr = getattr(client, "url", None)
+        if addr:
+            hint["addr"] = addr
+        payload["prefix_hint"] = hint
+        self._prefix_dir_count("hints_attached", trace=trace,
+                               lane=entry["lane"],
+                               blocks=int(entry["blocks"]))
+
+    def _record_prefix_owner(self, payload: dict, lane: str) -> None:
+        """Post-completion directory update: the lane that just served a
+        generate-class dispatch indexed this prompt in its radix tree at
+        admission, so it now owns the fingerprint's chain. The record
+        keeps a live DEEPER entry on another lane (a prober-seeded deep
+        chain must not be demoted by a shallow completion); an unchanged
+        entry is LRU-touched without a bump (bounded span volume)."""
+        fp = self._affinity_fingerprint(payload)
+        if fp is None:
+            return
+        toks = payload.get("prompt_tokens") or ()
+        bs = max(1, int(self.config.affinity_block_size))
+        blocks = len(toks) // bs
+        if blocks <= 0:
+            return
+        with self._lock:
+            if lane not in self._clients:
+                return
+            cur = self._prefix_dir.lookup(fp)
+            if (cur is not None and cur["lane"] == lane
+                    and cur["blocks"] >= blocks):
+                return
+            evicted = self._prefix_dir.record(fp, lane, blocks)
+        if evicted:
+            self.prefix_dir.bump("evictions", evicted)
+        self._prefix_dir_count("recorded", lane=lane, blocks=blocks)
+
     def _route(self, payload: dict, op: str, skip: tuple = (),
                out_info: Optional[dict] = None) -> dict:
         """``skip``: lanes excluded from dispatch for this route (the
@@ -2345,6 +2515,14 @@ class Gateway:
                 and op in ("generate", "generate_stream")):
             primary = self._affinity_primary(ring, primary, payload,
                                              skip, trace)
+        # Fleet prefix tier: AFTER primary selection (any flavor) the
+        # directory gets one shot at stamping a peer-fetch hint — the
+        # tier is routing-neutral (never changes which lane serves, only
+        # what the serving lane can skip re-prefilling).
+        if (self._prefix_dir_on
+                and op in ("generate", "generate_stream")
+                and "prefix_hint" not in payload):
+            self._attach_prefix_hint(payload, primary, trace)
 
         if skip and primary in skip:
             # The resume path excludes the lane that just failed its
@@ -2819,6 +2997,12 @@ class Gateway:
             if (self.config.prefix_affinity
                     and op in ("generate", "generate_stream")):
                 self._count_lane_dispatch(node)
+            if (self._prefix_dir_on
+                    and op in ("generate", "generate_stream")):
+                # Post-completion update: this lane's radix tree indexed
+                # the prompt at admission — record it as the owner so
+                # the NEXT shared-prefix request can fetch from here.
+                self._record_prefix_owner(payload, node)
             if out_info is not None:
                 out_info["lane"] = node
             return response
@@ -2886,6 +3070,8 @@ class Gateway:
             inflight = self._inflight
             fleet_degraded = dict(self._fleet_degraded)
             fleet_pressure = self._fleet_pressure
+            prefix_dir_state = (self._prefix_dir.stats()
+                                if self._prefix_dir is not None else None)
         out = {
             "total_workers": len(items),
             # Additive fields (reference /stats has only total_workers +
@@ -2954,6 +3140,15 @@ class Gateway:
             aff = self.affinity.as_dict()
             aff["assigned"] = aff_assigned
             out["affinity"] = aff
+        # Additive "prefix_directory" block (fleet prefix tier), same
+        # gating discipline: present only with --prefix-fetch (the
+        # counters can't move while the directory is None), so a
+        # defaults-off /stats stays byte-identical.
+        if prefix_dir_state is not None or self.prefix_dir.any_nonzero():
+            pd = self.prefix_dir.as_dict()
+            if prefix_dir_state is not None:
+                pd.update(prefix_dir_state)
+            out["prefix_directory"] = pd
         # Additive "overload" block (adaptive overload control), same
         # gating discipline: present only once configured or exercised.
         if (self.config.overload_control or self._tenant_bucket is not None

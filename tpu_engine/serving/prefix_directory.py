@@ -1,0 +1,94 @@
+"""Fleet-wide KV prefix directory (DESIGN.md "Fleet-wide prefix tier").
+
+A bounded fingerprint -> {lane, blocks, generation} map the gateway keeps
+beside its rings: which lane's radix tree holds the deepest known KV
+chain for each block-aligned prompt fingerprint. The directory is a
+HINT CACHE, not a source of truth — every consumer (the peer-fetch path
+in the scheduler) verifies checksum + geometry before trusting a byte,
+and every miss/stale/refused outcome falls back to local prefill. That
+is why entries are invalidated by cheap per-lane GENERATION stamps
+instead of eagerly tracked: bumping a lane's generation (removal,
+drain, eject, recovery) voids all of its entries at once, and a voided
+entry found later simply drops out of the map.
+
+All methods assume the caller holds ``Gateway._lock`` — the directory
+is one more piece of routing state under the gateway's single snapshot
+lock (tools/analyze/registry.py pins this).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+
+class PrefixDirectory:
+    """LRU-bounded fingerprint -> owner map with per-lane generation
+    invalidation. Pure state, no threads, no locks of its own."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = max(1, int(capacity))
+        # fp -> {"lane", "blocks", "generation"}; insertion order is the
+        # LRU order (lookups/records move touched entries to the end).
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._lane_gen: dict = {}  # lane -> current generation stamp
+
+    def lane_generation(self, lane: str) -> int:
+        return self._lane_gen.get(lane, 0)
+
+    def record(self, fp: str, lane: str, blocks: int) -> int:
+        """Record (or refresh) the owner for ``fp``. A live existing
+        entry naming a DEEPER chain on another lane is kept — the
+        directory tracks the best-known owner, and post-completion
+        updates must not demote a prober-seeded deep chain to a
+        shallower one. Returns entries evicted by the LRU bound."""
+        blocks = max(0, int(blocks))
+        gen = self._lane_gen.setdefault(lane, 0)
+        cur = self._entries.get(fp)
+        if cur is not None:
+            stale = self._lane_gen.get(cur["lane"], -1) != cur["generation"]
+            if not stale and cur["lane"] != lane \
+                    and cur["blocks"] > blocks:
+                self._entries.move_to_end(fp)
+                return 0
+        self._entries[fp] = {"lane": lane, "blocks": blocks,
+                             "generation": gen}
+        self._entries.move_to_end(fp)
+        evicted = 0
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            evicted += 1
+        return evicted
+
+    def lookup(self, fp: str) -> Optional[dict]:
+        """The live entry for ``fp`` (LRU-touched), or None. A stale
+        entry — its lane's generation moved since it was recorded — is
+        dropped on the way out (lazy invalidation backstop; eager
+        sweeps in ``invalidate_lane`` keep counts honest)."""
+        e = self._entries.get(fp)
+        if e is None:
+            return None
+        if self._lane_gen.get(e["lane"], -1) != e["generation"]:
+            del self._entries[fp]
+            return None
+        self._entries.move_to_end(fp)
+        return dict(e)
+
+    def invalidate_lane(self, lane: str) -> int:
+        """Void every entry naming ``lane`` (removal / drain / eject /
+        recovery — its radix tree can no longer be trusted to hold what
+        the directory promised). Bumps the lane's generation so any
+        entry that escapes the eager sweep dies lazily in ``lookup``.
+        Returns entries dropped."""
+        self._lane_gen[lane] = self._lane_gen.get(lane, 0) + 1
+        dead = [fp for fp, e in self._entries.items() if e["lane"] == lane]
+        for fp in dead:
+            del self._entries[fp]
+        return len(dead)
+
+    def stats(self) -> dict:
+        per_lane: dict = {}
+        for e in self._entries.values():
+            per_lane[e["lane"]] = per_lane.get(e["lane"], 0) + 1
+        return {"entries": len(self._entries), "capacity": self.capacity,
+                "lanes": per_lane}

@@ -309,6 +309,31 @@ class AffinityCounters(ResilienceCounters):
               "imbalance_fallbacks", "resume_skips")
 
 
+class PrefixDirCounters(ResilienceCounters):
+    """Every fleet-prefix-directory decision, counted — the additive
+    ``/stats`` ``prefix_directory`` block and the
+    ``tpu_engine_prefix_dir_*`` Prometheus family. Decision fields pair
+    1:1 with a gateway ``prefix_dir`` marker span
+    (``tools/fault_injection.py --fleet-prefix`` asserts counters ==
+    spans). ``seeded`` — prober /health sweeps that recorded at least
+    one entry from a lane's radix summaries (one span per sweep, not
+    per entry — probe cadence would drown the recorder); ``recorded``
+    — post-completion updates (a lane just served this fingerprint, so
+    it now owns the chain); ``invalidations`` — per-lane generation
+    bumps (removal / drain / eject / recover) that voided entries;
+    ``hints_attached`` — generate-class dispatches stamped with an
+    owner hint; ``lookup_misses`` — fingerprinted dispatches the
+    directory could not name a live owner for. ``evictions`` (LRU
+    capacity drops) is a VALUE counter like ``tokens_replayed`` —
+    span-free by design, excluded from SPAN_FIELDS."""
+
+    FIELDS = ("seeded", "recorded", "evictions", "invalidations",
+              "hints_attached", "lookup_misses")
+
+    SPAN_FIELDS = ("seeded", "recorded", "invalidations",
+                   "hints_attached", "lookup_misses")
+
+
 class ProbeStateMachine:
     """Per-lane eject/restore state from a stream of probe outcomes:
     ``fail_threshold`` CONSECUTIVE failures eject a lane (once — repeat

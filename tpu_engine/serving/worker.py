@@ -26,6 +26,7 @@ import json
 import math
 import os
 import queue
+import socket
 import threading
 import time
 from dataclasses import dataclass
@@ -326,6 +327,17 @@ class WorkerNode:
             raise RuntimeError(
                 f"--kv-quantize must be 'int8', got "
                 f"{self.config.gen_kv_quantize!r}")
+        if self.config.gen_prefix_fetch and (
+                not self._continuous
+                or self.config.gen_kv_block_size <= 0
+                or not self.config.gen_prefix_sharing):
+            # Same loud contract: an operator who asked for the fleet
+            # prefix tier must never get a lane that quietly ignores
+            # every gateway hint and recomputes each shared prefix.
+            raise RuntimeError(
+                "--prefix-fetch requires the continuous scheduler with "
+                "the paged KV cache and prefix sharing on "
+                "(--kv-block-size > 0, --prefix-sharing on)")
         # Serving-state family fences (models.registry declares the
         # family; the worker refuses mismatched machinery LOUDLY — an
         # operator who asked for a kv_paged knob on a recurrent model
@@ -464,6 +476,14 @@ class WorkerNode:
                         self.generator.configure_flight_recorder(
                             flight, getattr(self.config,
                                             "flight_dump_dir", None))
+                    if self.config.gen_prefix_fetch:
+                        # Fleet prefix tier: the scheduler calls this
+                        # on its prefill thread for hinted misses; the
+                        # worker owns transport, the per-lane in-flight
+                        # cap, and the per-fetch timeout — the
+                        # scheduler owns verification and the splice.
+                        self.generator.prefix_fetch = \
+                            self._fetch_prefix_peer
                 else:
                     from tpu_engine.runtime.generator import Generator
 
@@ -504,6 +524,16 @@ class WorkerNode:
         self._total_requests = 0
         self._cache_hits = 0
         self._counter_lock = threading.Lock()
+        # Fleet prefix tier transport state (--prefix-fetch): the
+        # per-lane in-flight cap, a small peer-client cache for the
+        # default HTTP transport, and an optional in-process transport
+        # installed by combined-mode wiring (set_prefix_fetch_transport).
+        self._prefix_fetch_sem = threading.BoundedSemaphore(
+            max(1, int(getattr(self.config,
+                               "gen_prefix_fetch_inflight", 2) or 1)))
+        self._prefix_fetch_transport = None
+        self._prefix_peers: dict = {}
+        self._prefix_peers_lock = threading.Lock()
         # Fault injection (BASELINE config 5): the reference injects faults
         # by killing worker processes (README.md:322-349); in-process lanes
         # need an explicit hook. While set, every request raises — the
@@ -1176,6 +1206,112 @@ class WorkerNode:
         out["node_id"] = self.node_id
         return out
 
+    # -- fleet prefix tier (DESIGN.md "Fleet-wide prefix tier") ----------------
+
+    def handle_export_prefix(self, request: dict) -> dict:
+        """/admin/export_prefix: serve a peer lane's prefix fetch — the
+        longest radix chain matching the requested token prefix,
+        serialized under one pool-lock pass (device-resident and
+        host-demoted blocks alike; NO stream state — this is a cache
+        read, not a migration). Refusals (draining lane, no scheduler,
+        no matching chain) come back ``{"ok": False, "node_id",
+        "reason"}`` and never raise: the fetching peer's fallback is
+        local prefill, which needs nothing from this lane. The drain
+        refusal names this node so a stale directory entry is
+        attributable at the fetcher."""
+        gen = self.generator
+        if gen is None or not hasattr(gen, "export_prefix"):
+            return {"ok": False, "node_id": self.node_id,
+                    "reason": "this lane has no continuous decode "
+                              "scheduler to export from"}
+        if self.draining:
+            return {"ok": False, "node_id": self.node_id,
+                    "reason": f"lane {self.node_id} is draining"}
+        tokens = request.get("tokens")
+        if not isinstance(tokens, list) or not tokens:
+            return {"ok": False, "node_id": self.node_id,
+                    "reason": "request carries no token prefix"}
+        max_blocks = request.get("max_blocks")
+        out = gen.export_prefix(
+            tokens, max_blocks=(int(max_blocks)
+                                if max_blocks is not None else None))
+        out["node_id"] = self.node_id
+        return out
+
+    def set_prefix_fetch_transport(self, fn) -> None:
+        """Install an in-process peer transport (combined mode): a
+        callable ``(hint, payload) -> dict`` replacing the default
+        HTTP POST to the hint's address — in-process lanes have no
+        URL to dial."""
+        self._prefix_fetch_transport = fn
+
+    def _fetch_prefix_peer(self, hint: dict, tokens,
+                           max_blocks: int) -> Optional[dict]:
+        """The fetch callable installed on the scheduler
+        (--prefix-fetch): pull the hinted peer's chain, classifying
+        every transport outcome into the fallback-ladder rung the
+        scheduler counts (``peer_unreachable`` / ``peer_refused`` /
+        ``timeout`` / ``inflight_capped``). Runs on the scheduler's
+        prefill thread; the semaphore acquire is non-blocking so a
+        thundering herd on one hot prefix degrades to local prefill,
+        never a convoy. Returns None for a self-hint (a retry landed
+        the request on the owner itself — nothing to fetch)."""
+        if hint.get("lane") == self.node_id:
+            return None
+        if not self._prefix_fetch_sem.acquire(blocking=False):
+            return {"ok": False, "rung": "inflight_capped"}
+        try:
+            payload = {"tokens": [int(t) for t in tokens],
+                       "max_blocks": int(max_blocks)}
+            timeout_s = max(0.1, float(getattr(
+                self.config, "gen_prefix_fetch_timeout_s", 5.0)))
+            if self._prefix_fetch_transport is not None:
+                try:
+                    out = self._prefix_fetch_transport(hint, payload)
+                except Exception:
+                    return {"ok": False, "rung": "peer_unreachable"}
+            else:
+                addr = hint.get("addr")
+                if not addr:
+                    return {"ok": False, "rung": "peer_unreachable",
+                            "reason": "hint carries no peer address"}
+                try:
+                    out = self._prefix_peer_client(addr).export_prefix(
+                        payload, timeout_s=timeout_s)
+                except (socket.timeout, TimeoutError):
+                    return {"ok": False, "rung": "timeout"}
+                except Exception as exc:
+                    if "timed out" in str(exc).lower():
+                        return {"ok": False, "rung": "timeout"}
+                    return {"ok": False, "rung": "peer_unreachable"}
+            if not isinstance(out, dict) or not out.get("ok"):
+                return {"ok": False, "rung": "peer_refused",
+                        "reason": (out or {}).get("reason")
+                        if isinstance(out, dict) else "malformed reply"}
+            return {"ok": True, "chain": out.get("chain"),
+                    "blocks": out.get("blocks")}
+        finally:
+            self._prefix_fetch_sem.release()
+
+    def _prefix_peer_client(self, addr: str):
+        """One cached HTTP client per peer address (the default fetch
+        transport). Bounded: directory capacity bounds distinct hint
+        addresses far below any worrying count, but cap anyway."""
+        from tpu_engine.serving.clients import HttpWorkerClient
+
+        with self._prefix_peers_lock:
+            client = self._prefix_peers.get(addr)
+            if client is None:
+                if len(self._prefix_peers) >= 64:
+                    self._prefix_peers.clear()
+                client = HttpWorkerClient(
+                    addr, timeout_s=max(0.1, float(getattr(
+                        self.config, "gen_prefix_fetch_timeout_s", 5.0))),
+                    pool_size=max(1, int(getattr(
+                        self.config, "gen_prefix_fetch_inflight", 2) or 1)))
+                self._prefix_peers[addr] = client
+            return client
+
     def handle_timeline(self, request: Optional[dict] = None) -> dict:
         """/admin/timeline: the continuous scheduler's flight-recorder
         ring (per-tick records, newest last) plus dump bookkeeping.
@@ -1614,7 +1750,11 @@ class WorkerNode:
                 deadline=deadline,
                 sink=TraceSink(self.tracer, self.node_id,
                                item.request_id, tctx),
-                tag=item.request_id)
+                tag=item.request_id,
+                # Fleet prefix tier: the gateway-attached hint rides
+                # the payload; inert unless --prefix-fetch is on.
+                prefix_hint=(request.get("prefix_hint")
+                             if self.config.gen_prefix_fetch else None))
             # The scheduler itself cancels expired rows between chunks
             # (the future then raises DeadlineExceeded); the +5 s slack
             # keeps this outer wait a backstop, never the arbiter.
@@ -1769,7 +1909,12 @@ class WorkerNode:
                 repetition_penalty=rep_pen, stop_tokens=stop_toks,
                 min_p=min_p_val, stream=q, deadline=deadline,
                 sink=TraceSink(self.tracer, self.node_id, request_id, tctx),
-                tag=request_id, **handoff_kw)
+                tag=request_id,
+                # Fleet prefix tier: the gateway-attached hint rides
+                # the payload; inert unless --prefix-fetch is on.
+                prefix_hint=(request.get("prefix_hint")
+                             if self.config.gen_prefix_fetch else None),
+                **handoff_kw)
         except BaseException:
             self._admission.release()
             raise
@@ -2055,6 +2200,17 @@ class WorkerNode:
                 if stall > 0 and age is not None and age > stall:
                     out["healthy"] = False
                     out["scheduler_stalled"] = True
+        # Fleet prefix tier seed (additive, gated on --prefix-fetch so
+        # defaults-off /health bytes stay identical): bounded top-K
+        # radix chain summaries the gateway prober turns into directory
+        # entries — never a full-tree dump.
+        if (self.config.gen_prefix_fetch and self.generator is not None
+                and hasattr(self.generator, "prefix_fingerprints")):
+            try:
+                out["prefix_fingerprints"] = \
+                    self.generator.prefix_fingerprints()
+            except Exception:
+                pass
         # Additive, and only once admission control has anything to say
         # (a defaults-only lane keeps the reference-exact key set).
         dropped = self.batch_processor.deadline_dropped

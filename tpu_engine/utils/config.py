@@ -105,6 +105,22 @@ class WorkerConfig:
     # prompts skip their prefill compute and share KV blocks
     # copy-on-write. Off = paging without sharing.
     gen_prefix_sharing: bool = True
+    # Fleet prefix tier (--prefix-fetch; requires continuous + paged +
+    # prefix sharing): a miss whose request carries a gateway-attached
+    # prefix_hint pulls the matched radix chain from the owning peer
+    # (/admin/export_prefix) instead of recomputing it — the per-lane
+    # prefill-skip becomes a fleet property. Every fetch failure falls
+    # back to local prefill. Off (default) = hints inert, wire bytes
+    # identical.
+    gen_prefix_fetch: bool = False
+    # Per-fetch transport budget in seconds: a peer that cannot ship
+    # the chain inside it counts ``timeout`` and the stream recomputes
+    # locally.
+    gen_prefix_fetch_timeout_s: float = 5.0
+    # Per-lane in-flight fetch cap: a thundering herd on one hot prefix
+    # degrades to local prefill (``inflight_capped``), not a convoy of
+    # blocked prefill threads.
+    gen_prefix_fetch_inflight: int = 2
     # Mixed prefill+decode stepping (paged mode only): each scheduler
     # tick forms ONE ragged batch of (decode rows x 1 token) +
     # (admitting rows x a prefill chunk) and issues exactly one device
@@ -375,6 +391,18 @@ class GatewayConfig:
     # convergence must not turn one hot prefix into one dead lane.
     # 0 (default) = always honor affinity.
     affinity_max_imbalance: int = 0
+    # Fleet prefix tier directory (--prefix-fetch on the serve command):
+    # a bounded fingerprint -> {lane, blocks, generation} map seeded
+    # from lane /health radix summaries (prober sweeps) and
+    # post-completion updates; generate-class requests whose
+    # fingerprint names a DIFFERENT lane get a prefix_hint attached so
+    # the serving lane can fetch the chain peer-to-peer. Works with
+    # affinity off (the affinity-defeating-ring case is the point).
+    # Off (default) = no directory, payloads and /stats byte-identical.
+    prefix_directory: bool = False
+    # Directory capacity in fingerprints (LRU beyond it): bounds gateway
+    # memory no matter how many distinct prefixes the fleet sees.
+    prefix_directory_capacity: int = 512
     affinity_window_s: float = 10.0
 
     # -- adaptive overload control (serving/overload.py; DESIGN.md

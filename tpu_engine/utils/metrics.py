@@ -651,6 +651,39 @@ def render_prometheus(healths: List[Dict], stats: Optional[Dict] = None,
                    [({"node": lane}, n)
                     for lane, n in sorted(
                         (aff.get("assigned") or {}).items())])
+        pd = stats.get("prefix_directory")
+        if pd:
+            # Fleet prefix directory (the /stats "prefix_directory"
+            # block; present only with the directory configured).
+            for key, help_text in (
+                    ("seeded",
+                     "Prober sweeps that recorded directory entries "
+                     "from a lane's radix summaries"),
+                    ("recorded",
+                     "Post-completion owner updates (lane served the "
+                     "fingerprint)"),
+                    ("evictions",
+                     "Directory entries dropped by the LRU capacity "
+                     "bound"),
+                    ("invalidations",
+                     "Per-lane generation bumps (removal/eject/recover) "
+                     "voiding entries"),
+                    ("hints_attached",
+                     "Generate dispatches stamped with a peer-fetch "
+                     "owner hint"),
+                    ("lookup_misses",
+                     "Fingerprinted dispatches with no live directory "
+                     "owner")):
+                metric(f"tpu_engine_prefix_dir_{key}_total", "counter",
+                       help_text, [({}, pd.get(key))])
+            metric("tpu_engine_prefix_dir_entries", "gauge",
+                   "Live directory entries (bounded by capacity)",
+                   [({}, pd.get("entries"))])
+            metric("tpu_engine_prefix_dir_lane_entries", "gauge",
+                   "Live directory entries per owner lane",
+                   [({"node": lane}, n)
+                    for lane, n in sorted(
+                        (pd.get("lanes") or {}).items())])
         ovl = stats.get("overload")
         if ovl:
             # Adaptive overload control (the /stats "overload" block;
