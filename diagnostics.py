@@ -20,6 +20,7 @@ import json
 import socket
 import subprocess
 import sys
+import time
 
 OK, FAIL = "✓", "✗"
 _results = []
@@ -164,8 +165,18 @@ def main() -> int:
                          "splice it — blocks spliced, remote prefill "
                          "tokens skipped, hint bookkeeping, and "
                          "byte-identity to an unhinted control")
+    ap.add_argument("--unified", action="store_true",
+                    help="step 20: one scripted unified-pool mixed tick "
+                         "(in-process, no server): a decode stream and "
+                         "concurrent /score requests share ONE "
+                         "continuous scheduler — renders the mixed-row "
+                         "tick live (decode rows beside single-tick "
+                         "score rows in the same scheduler) and checks "
+                         "the scores answer byte-identical to a solo "
+                         "control with ticks == dispatches on the "
+                         "stateless counter block")
     ap.add_argument("--lint", action="store_true",
-                    help="step 20: engine-lint static-analysis suite "
+                    help="step 21: engine-lint static-analysis suite "
                          "over tpu_engine/ (in-process, no server): lock "
                          "discipline, hot-path trace leaks, "
                          "counters==spans pairing, flag discipline — "
@@ -177,7 +188,8 @@ def main() -> int:
               + int(args.failover) + int(args.migrate)
               + int(args.disagg) + int(args.overload)
               + int(args.elastic) + int(args.stitch)
-              + int(args.fleet_prefix) + int(args.lint))
+              + int(args.fleet_prefix) + int(args.unified)
+              + int(args.lint))
     gw = _strip(args.gateway)
     # Accept both bare host:port (reference diagnostics.sh style) and full
     # http:// URLs — same normalization as the gateway address.
@@ -911,6 +923,95 @@ def main() -> int:
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
+
+    if args.unified:
+        n = (6 + int(args.kernel_parity) + int(args.mixed_parity)
+             + int(args.spec_parity) + int(args.quant_parity)
+             + int(args.ssd_parity) + int(args.tp_parity)
+             + int(args.failover) + int(args.migrate)
+             + int(args.disagg) + int(args.overload)
+             + int(args.elastic) + int(args.stitch)
+             + int(args.fleet_prefix) + 1)
+        try:
+            import threading as _threading
+
+            from tpu_engine.serving.worker import WorkerNode
+            from tpu_engine.utils.config import WorkerConfig
+
+            uw = WorkerNode(WorkerConfig(
+                node_id="diag_u", model="gpt2-small-test",
+                dtype="float32", max_batch_size=4))
+            try:
+                score_req = {"prompt_tokens": [1, 2, 3],
+                             "completion_tokens": [4, 5]}
+                control = uw.handle_score(
+                    dict(score_req, request_id="du_ctl"))
+                base = uw.generator.stats()["stateless"]["dispatches"]
+                # Live mixed-tick watcher: sample the scheduler while
+                # the workload runs and keep the first snapshot where
+                # decode rows are resident AND a one-shot dispatch has
+                # landed since the watch began — the mixed-row tick,
+                # caught in the act.
+                live: dict = {}
+                stop_w = _threading.Event()
+
+                def watch():
+                    while not stop_w.is_set():
+                        st = uw.generator.stats()
+                        sl = st.get("stateless", {})
+                        if (st.get("active", 0) > 0
+                                and sl.get("dispatches", 0) > base
+                                and not live):
+                            live.update(
+                                decode_rows=st["active"],
+                                oneshot_dispatches=(sl["dispatches"]
+                                                    - base),
+                                score_rows=sl.get("score_rows", 0))
+                        time.sleep(0.002)
+
+                results: dict = {}
+
+                def drive_gen():
+                    results["g"] = uw.handle_generate(
+                        {"request_id": "du_g",
+                         "prompt_tokens": [1, 2, 3, 4],
+                         "max_new_tokens": 24})
+
+                def drive_score(i):
+                    results[f"s{i}"] = uw.handle_score(
+                        dict(score_req, request_id=f"du_s{i}"))
+
+                wt = _threading.Thread(target=watch, daemon=True)
+                wt.start()
+                gt = _threading.Thread(target=drive_gen)
+                gt.start()
+                time.sleep(0.05)  # let the stream take residency
+                sts = [_threading.Thread(target=drive_score, args=(i,))
+                       for i in range(3)]
+                for t in sts:
+                    t.start()
+                for t in [gt] + sts:
+                    t.join()
+                stop_w.set()
+                wt.join(timeout=5)
+                sl = uw.generator.stats()["stateless"]
+                identical = all(
+                    results[f"s{i}"]["logprobs"] == control["logprobs"]
+                    for i in range(3))
+                ticks_ok = sl["ticks"] == sl["dispatches"]
+                ok = (bool(live) and identical and ticks_ok
+                      and sl["failed"] == 0)
+                step(n, "unified mixed-row tick", ok,
+                     f"({live.get('decode_rows', 0)} decode rows beside "
+                     f"{live.get('oneshot_dispatches', 0)} one-shot "
+                     f"dispatch(es), {sl.get('score_rows', 0)} score "
+                     f"rows total; ticks==dispatches "
+                     f"{'holds' if ticks_ok else 'VIOLATED'}; scores "
+                     f"{'byte-identical' if identical else 'DIVERGED'})")
+            finally:
+                uw.stop()
+        except Exception as exc:
+            step(n, "unified mixed-row tick", False, f"({exc})")
 
     # 12 (--lint): the engine-lint suite, in-process — the same gate
     # tier-1 runs (tests/test_engine_lint.py), surfaced here so an

@@ -323,6 +323,15 @@ ENGINE_REGISTRY = Registry(
         "tpu_engine.runtime.scheduler:ContinuousGenerator._tick_slab",
         "tpu_engine.runtime.scheduler:ContinuousGenerator."
         "_tick_slab_mixed",
+        # Unified stateless serving (PR 20): one-shot rows dispatch from
+        # the same decode loop — the per-tick jit rule covers both the
+        # group collector and the per-kind dispatcher. No new row
+        # tables: stateless admission reuses _row_req/_row_emitted/
+        # _done/_held, already decode-thread-owned above.
+        "tpu_engine.runtime.scheduler:ContinuousGenerator."
+        "_tick_stateless",
+        "tpu_engine.runtime.scheduler:ContinuousGenerator."
+        "_dispatch_oneshot",
     ),
     cli_module="tpu_engine.serving.cli",
     config_module="tpu_engine.utils.config",

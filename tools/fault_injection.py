@@ -3343,13 +3343,240 @@ def run_fleet_prefix_standalone() -> int:
                 proc.kill()
 
 
+def unified_phase(ports, procs, checks: list) -> dict:
+    """Kill -9 one lane serving MIXED generate+score traffic under
+    Poisson load (PR 20's unified stateless pool: scores ride the same
+    continuous scheduler as decode rows). The generative streams must
+    resume byte-identical through the PR 6 replay ladder; an in-flight
+    score against the dead lane FAILS RETRYABLE (blocking op → gateway
+    ring-order failover) and completes byte-identical on a surviving
+    lane; zero KV blocks leak and every stateless row is accounted for
+    (admitted == completed + failed on the survivors); gateway failover
+    counters == resume spans and one score route span per request."""
+    import random
+    import signal
+    import threading
+
+    from tpu_engine.serving.gateway import Gateway
+    from tpu_engine.utils.config import GatewayConfig
+
+    gw = Gateway([f"127.0.0.1:{p}" for p in ports],
+                 GatewayConfig(failover_streams=True,
+                               health_probe_interval_s=0.25,
+                               health_probe_failures=2))
+    lanes = gw.worker_names()
+    victim_lane = victim_lane_for_port(lanes, ports[1])
+    victim_proc = procs[1]
+
+    # Generate mix: greedy and seeded-sampled streams, victim-weighted
+    # (long budgets there so the kill lands mid-stream).
+    gen_requests = []
+    for k in range(8):
+        lane = victim_lane if k % 2 == 0 else lanes[k % len(lanes)]
+        params = ({"temperature": 0.9, "seed": 100 + k}
+                  if k % 2 else {})
+        gen_requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"ug{k}"),
+            "prompt_tokens": [(k * 5 + j) % 90 + 1
+                              for j in range(6 + k % 4)],
+            # Long victim budgets: a warm stream finishes in ~0.1s on
+            # the CPU backend, and drive_streams_with_kill only starts
+            # its kill loop AFTER every arrival has launched — the
+            # victim streams must outlive the arrival phase.
+            "max_new_tokens": 160 if lane == victim_lane else 24,
+            **params})
+    victim_rids = {r["request_id"] for r in gen_requests
+                   if gw._ring.get_node(r["request_id"]) == victim_lane}
+
+    # Score mix: single-tick rows in the same pool, victim-weighted the
+    # same way so some are provably in flight against the dead lane.
+    score_requests = []
+    for k in range(16):
+        lane = victim_lane if k % 2 == 0 else lanes[k % len(lanes)]
+        score_requests.append({
+            "request_id": rid_for_lane(gw._ring, lane, f"us{k}"),
+            "prompt_tokens": [(k * 3 + j) % 90 + 1
+                              for j in range(4 + k % 3)],
+            "completion_tokens": [(k + j) % 90 + 1
+                                  for j in range(3 + k % 2)]})
+
+    # Controls: blocking runs against ONE healthy worker — the oracles
+    # both classes must match byte-for-byte.
+    try:
+        gen_control = control_oracle(ports[0], gen_requests)
+    except RuntimeError as exc:
+        checks.append(("unified: control generate", False))
+        return {"error": str(exc)}
+    score_control = {}
+    for r in score_requests:
+        status, body = _call(ports[0], "POST", "/score",
+                             dict(r, request_id="ctl_" + r["request_id"]),
+                             timeout=600)
+        if status != 200:
+            checks.append(("unified: control score", False))
+            return {"error": f"control score failed ({status}): {body}"}
+        score_control[r["request_id"]] = body["logprobs"]
+    # Warm the other lanes' compile caches (generate AND score buckets)
+    # so the kill lands mid-decode, not mid-compile.
+    for p in ports[1:]:
+        _call(p, "POST", "/generate",
+              {"request_id": f"warm_{p}", "prompt_tokens": [1, 2, 3],
+               "max_new_tokens": 4}, timeout=600)
+        _call(p, "POST", "/score",
+              {"request_id": f"warm_s_{p}", "prompt_tokens": [1, 2, 3],
+               "completion_tokens": [4, 5]}, timeout=600)
+
+    # Score driver: Poisson-fire the score mix through the gateway for
+    # the whole drive window (before, during, and after the kill). A
+    # dead-lane dispatch is a blocking op, so the gateway's ring-order
+    # failover retries it on a survivor transparently — the check is
+    # that EVERY score completes identical to control anyway.
+    score_results: dict = {}
+
+    def drive_scores():
+        rng = random.Random(7)
+        for r in score_requests:
+            time.sleep(rng.expovariate(12.0))
+            rid = r["request_id"]
+            try:
+                out = gw.route_score(dict(r))
+                score_results[rid] = {"ok": True,
+                                      "logprobs": out["logprobs"],
+                                      "node": out.get("node_id")}
+            except Exception as exc:  # recorded, asserted below
+                score_results[rid] = {"ok": False, "error": str(exc)}
+
+    def kill_victim():
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=10)
+
+    score_thread = threading.Thread(target=drive_scores, daemon=True)
+    score_thread.start()
+    results, killed = drive_streams_with_kill(
+        gw, gen_requests, victim_rids, kill_victim, random.Random(0),
+        arrival_rate=24.0)
+    score_thread.join(timeout=600)
+    checks.append(("unified: victim killed mid-stream", killed))
+
+    # Generative class: every stream completed byte-identical to the
+    # unkilled control via the PR 6 resume ladder.
+    complete, identical, resumed = tally_streams(results, gen_control)
+    checks.append(("unified: all generative streams completed "
+                   f"({complete}/{len(gen_requests)})",
+                   complete == len(gen_requests)))
+    checks.append(("unified: generative streams byte-identical "
+                   f"({identical}/{len(gen_requests)})",
+                   identical == len(gen_requests)))
+    checks.append(("unified: at least one stream resumed", resumed >= 1))
+
+    # Score class: every request completed with logprobs identical to
+    # control — including the ones whose ring primary was the corpse.
+    score_ok = sum(1 for rid, r in score_results.items()
+                   if r.get("ok")
+                   and r["logprobs"] == score_control[rid])
+    checks.append(("unified: all scores completed byte-identical "
+                   f"({score_ok}/{len(score_requests)})",
+                   score_ok == len(score_requests)))
+
+    # The retryable contract, demonstrated end-to-end: a DIRECT call to
+    # the dead lane fails with a connection error (what an in-flight
+    # request experiences), and the SAME request through the gateway
+    # completes on a survivor, identical to control.
+    retry_req = {"request_id": "us_retry", "prompt_tokens": [2, 4, 6],
+                 "completion_tokens": [8, 10]}
+    status, ctl = _call(ports[0], "POST", "/score",
+                        dict(retry_req, request_id="ctl_us_retry"),
+                        timeout=600)
+    direct_failed = False
+    try:
+        _call(ports[1], "POST", "/score", dict(retry_req), timeout=5)
+    except OSError:
+        direct_failed = True
+    checks.append(("unified: direct score to dead lane fails retryable",
+                   direct_failed))
+    try:
+        rerouted = gw.route_score(dict(retry_req))
+        checks.append(("unified: retried score completes on a survivor",
+                       rerouted["logprobs"] == ctl["logprobs"]
+                       and rerouted.get("node_id") != "w1"))
+    except Exception:
+        checks.append(("unified: retried score completes on a survivor",
+                       False))
+
+    # Counters == spans: failover counters match resume spans (settle —
+    # the counter bumps before its span lands), and the gateway holds
+    # exactly one route span per score request (+ the retry demo).
+    fo, resume_spans = {}, []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        fo = gw.get_stats().get("failover", {})
+        spans = gw.tracer.snapshot()
+        resume_spans = [s for s in spans if s["op"] == "resume"]
+        if len(resume_spans) == fo.get("resumes_attempted", -1):
+            break
+        time.sleep(0.1)
+    checks.append(("unified: failover counters == resume spans",
+                   len(resume_spans) == fo.get("resumes_attempted", -1)
+                   and fo.get("resumes_attempted", 0) >= 1))
+    score_route_spans = [s for s in gw.tracer.snapshot()
+                         if s["op"] == "route"
+                         and s["request_id"].startswith("us")]
+    checks.append(("unified: one route span per score request",
+                   len(score_route_spans) == len(score_requests) + 1))
+
+    # Zero leaks on the survivors: every KV block accounted for AND
+    # every stateless row retired (admitted == completed + failed; a
+    # leaked row would hold a slot and strand the admitted counter).
+    for p in (ports[0], ports[2]):
+        pool = _worker_pool_clean(p)
+        checks.append((f"unified: no KV blocks leaked on survivor :{p}",
+                       pool is not None))
+        _, health = _call(p, "GET", "/health", timeout=5.0)
+        st = (health.get("generator") or {}).get("stateless") or {}
+        checks.append(
+            (f"unified: stateless rows accounted for on :{p}",
+             st.get("admitted", -1)
+             == st.get("completed", 0) + st.get("failed", 0)
+             and st.get("admitted", 0) > 0))
+    gw.stop()
+    return {"victim": victim_lane,
+            "generate": {"complete": complete, "identical": identical,
+                         "resumed": resumed},
+            "score": {"ok_identical": score_ok,
+                      "total": len(score_requests)},
+            "failover": fo}
+
+
+def run_unified_standalone() -> int:
+    ports, procs = launch_worker_procs(3)
+    checks: list = []
+    try:
+        report = {"mode": "unified-standalone", "worker_ports": ports,
+                  "phases": {"unified": unified_phase(ports, procs,
+                                                      checks)}}
+        report["checks"] = {name: passed for name, passed in checks}
+        report["passed"] = all(p for _, p in checks) and bool(checks)
+        print(json.dumps(report, indent=2))
+        return 0 if report["passed"] else 1
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_all_standalone() -> int:
     """--all: every standalone chaos scenario in sequence, each in its
     own interpreter (a wedged scenario cannot poison the next), one JSON
     summary on stdout, nonzero exit when ANY scenario's check fails."""
     flags = ("--mixed", "--spec", "--crash", "--offload", "--quant",
              "--migrate", "--disagg", "--recurrent", "--tp",
-             "--overload", "--elastic", "--stitch", "--fleet-prefix")
+             "--overload", "--elastic", "--stitch", "--fleet-prefix",
+             "--unified")
     here = os.path.abspath(__file__)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -3551,6 +3778,18 @@ def main() -> int:
                          "entries, directory counters == prefix_dir "
                          "spans, and zero KV blocks leaked on the "
                          "survivors; ignores the other flags")
+    ap.add_argument("--unified", action="store_true",
+                    help="standalone unified-stateless chaos scenario "
+                         "(PR 20): spawns 3 paged workers serving MIXED "
+                         "generate+score traffic from ONE continuous "
+                         "pool, kill -9s a lane under Poisson load, and "
+                         "asserts the generative streams resume "
+                         "byte-identical (PR 6 ladder), in-flight score "
+                         "requests fail retryable and complete "
+                         "byte-identical on a surviving lane, zero KV "
+                         "blocks leak, every stateless row is accounted "
+                         "for, and failover counters == resume spans; "
+                         "ignores the other flags")
     ap.add_argument("--all", action="store_true",
                     help="run EVERY standalone chaos scenario in "
                          "sequence, each in its own interpreter, and "
@@ -3560,6 +3799,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.all:
         return run_all_standalone()
+    if args.unified:
+        return run_unified_standalone()
     if args.elastic:
         return run_elastic_standalone()
     if args.stitch:

@@ -363,6 +363,23 @@ def main() -> int:
             prefill_chunk=64 if q else 256, dtype="bfloat16")
         return res
 
+    @stage(artifact, out, "unified")
+    def _unified():
+        # Unified stateless serving on-chip: the two-lane-split vs
+        # single-pool mixed generate+score A/B (BENCH_r20 ran it on the
+        # CPU mesh). Byte-identity and ticks==dispatches are
+        # backend-independent, but the tail-latency margin is a device
+        # property — on-chip the score forward shares the decode tick's
+        # dispatch queue, so the colocation cost/win must be measured
+        # against real kernel latencies, not the CPU interpreter's.
+        return bench.run_unified_ab(
+            model=model,
+            n_generate=4 if q else 10, n_score=8 if q else 20,
+            max_new=8 if q else 24, mean_gap_ms=12.0,
+            max_seq=128 if q else 256, repeats=1 if q else 2,
+            dtype="bfloat16",
+            model_kwargs={} if model != "gpt2-small-test" else None)
+
     @stage(artifact, out, "kv_quant")
     def _kv_quant():
         # Quantized KV blocks on-chip: (a) Mosaic compile + exactness of

@@ -20,16 +20,20 @@ from typing import Callable, Dict, List, Optional, Tuple
 # do — the scheduler and worker fence mismatches LOUDLY instead of
 # silently degrading). "kv_paged": autoregressive state is a growing KV
 # chain in the block pool; "state_slab": a fixed-size recurrent state
-# slab (O(1) per stream — SSD/Mamba family); "stateless": no generation
-# lane (one-shot /infer only).
+# slab (O(1) per stream — SSD/Mamba family); "stateless": no
+# autoregressive state at all — score/infer/embed requests admit as
+# SINGLE-TICK rows in the continuous scheduler's shared slot pool
+# (DESIGN.md "Unified stateless serving"), so the family has no
+# generation lane but is a first-class scheduler citizen, not a side
+# path.
 FAMILY_CAPABILITIES: Dict[str, Tuple[str, ...]] = {
     "kv_paged": ("generate", "two_path", "mixed_step", "spec_decode",
                  "paged_kv", "prefix_sharing", "kv_quantize",
                  "kv_host_tier", "migration", "handoff",
-                 "tensor_parallel"),
+                 "tensor_parallel", "oneshot_rows"),
     "state_slab": ("generate", "two_path", "mixed_step", "migration",
-                   "handoff"),
-    "stateless": (),
+                   "handoff", "oneshot_rows"),
+    "stateless": ("oneshot_rows",),
 }
 
 # -- tensor-parallel partition rules ------------------------------------------

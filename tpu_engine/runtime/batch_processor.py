@@ -1,4 +1,25 @@
-"""Dynamic batch processor: size-or-timeout batching with blocking futures.
+"""Dynamic batch processor — now a COMPATIBILITY SHIM (PR 20).
+
+Unified stateless serving (DESIGN.md "Unified stateless serving")
+retired this module as the default /infer and /score dispatch path:
+stateless requests now admit as single-tick rows in the continuous
+scheduler's shared slot pool (``runtime.scheduler.ContinuousGenerator
+submit_infer/submit_score``), governed by the same deadlines, AIMD
+admission, brownout tiers, and counters as decode streams. The class
+below is kept because:
+
+* ``--no-unified-stateless`` restores it as the dedicated lane
+  (the worker's ``_dispatch_infer``/``_score_admitted`` seams);
+* non-continuous schedulers (``--gen-scheduler batch|speculative``)
+  still batch generate requests through it (``_gen_processor``);
+* test fakes and engine-less lanes fall back to it automatically;
+* its metrics block remains the wire-exact ``/health``
+  ``batch_processor`` schema — on unified lanes the scheduler's
+  one-shot dispatch counters FOLD into this block, so scrapers see
+  one continuous history across the migration (MIGRATION.md).
+
+Nothing below changed semantically; the text that follows documents
+the original (now fallback) lane.
 
 Capability parity with the reference's header-only template
 (``/root/reference/include/batch_processor.h:1-195``): a single background

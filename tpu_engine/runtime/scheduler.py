@@ -155,6 +155,14 @@ class _Request:
     handoff: bool = False
     park_s: float = 5.0
     park_until: float = 0.0
+    # Unified stateless serving (DESIGN.md "Unified stateless serving"):
+    # a one-shot payload — ("infer", input_data, shape) or
+    # ("score", prompt_tokens, completion_tokens) — admitted as a
+    # single-tick row beside decode rows and prefill chunks. The row
+    # holds no KV/slab state; _tick_stateless runs the grouped forward
+    # and resolves the future with (result, per_request_time_us). None
+    # = a normal generative request.
+    oneshot: Optional[tuple] = None
 
 
 class _StaleAdmission(RuntimeError):
@@ -273,6 +281,8 @@ class ContinuousGenerator:
         state_rows: int = 0,
         tp: int = 1,
         tp_devices=None,
+        infer_engine=None,
+        score_provider=None,
     ):
         """`kv_block_size` > 0 switches the KV cache from one dense
         (L, n_slots, max_seq, H, D) tensor to the PAGED layout: a block
@@ -382,6 +392,15 @@ class ContinuousGenerator:
             fam = ("state_slab" if isinstance(model.config, SSDConfig)
                    else "kv_paged")
         self._slab = fam == "state_slab"
+        # Unified stateless serving (DESIGN.md): score/infer/embed
+        # models admit as SINGLE-TICK rows — no autoregressive state at
+        # all, so every state-machinery branch below is skipped and the
+        # shared layers (admission, deadlines, brownout, tracing,
+        # recovery) serve them unchanged. Generative lanes can ALSO
+        # carry one-shot rows (submit_infer/submit_score beside decode
+        # streams) — that path needs no family branch because one-shot
+        # rows never touch the family's state machinery.
+        self._stateless = fam == "stateless"
         if self._slab:
             if not isinstance(model.config, SSDConfig):
                 # The slab machinery's step functions are the SSD
@@ -392,14 +411,23 @@ class ContinuousGenerator:
                     f"model '{model.name}' declares state family "
                     f"'state_slab' but its config is not an SSDConfig "
                     f"(the slab step functions are models.ssd's)")
-        elif (not isinstance(model.config, TransformerConfig)
-              or not model.config.causal):
+        elif not self._stateless and (
+                not isinstance(model.config, TransformerConfig)
+                or not model.config.causal):
             raise ValueError(f"model '{model.name}' is not a decoder "
                              f"transformer")
         self.spec = model
         self.cfg = model.config
         self._dtype = _DTYPES[dtype]
-        self.max_seq = min(max_seq or self.cfg.max_seq, self.cfg.max_seq)
+        if self._stateless:
+            # One-shot rows have no sequence axis and cfg may be None
+            # entirely (mlp/resnet/ONNX graphs): max_seq survives only
+            # as the prompt-bucket bound of the (never exercised)
+            # generative machinery below.
+            self.max_seq = int(max_seq) if max_seq else 16
+        else:
+            self.max_seq = min(max_seq or self.cfg.max_seq,
+                               self.cfg.max_seq)
         self.n_slots = int(n_slots)
         self._step_chunk = int(step_chunk)
         if prompt_buckets is None:
@@ -488,6 +516,39 @@ class ContinuousGenerator:
                     "speculative decoding (spec_k > 0) requires the "
                     "kv_paged family: the state_slab recurrence has no "
                     "KV verify window")
+        elif self._stateless:
+            # Family fences, loud and specific (MIGRATION.md's
+            # misconfiguration contract): one-shot rows hold NO
+            # autoregressive state, so every generative-state knob is a
+            # refusal, never silently inert.
+            if self._paged or int(kv_blocks) > 0:
+                raise ValueError(
+                    "the stateless family has no KV cache: "
+                    "kv_block_size/kv_blocks apply to kv_paged models")
+            if int(kv_host_blocks) > 0:
+                raise ValueError(
+                    "kv_host_blocks applies to the kv_paged family's "
+                    "block pool; the stateless family holds no KV "
+                    "blocks")
+            if kv_quantize:
+                raise ValueError(
+                    "kv_quantize applies to the kv_paged family's "
+                    "block pool; the stateless family holds no KV "
+                    "blocks")
+            if int(spec_k) > 0:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) requires the "
+                    "kv_paged family: one-shot rows have no decode "
+                    "loop to speculate")
+            if mixed_step:
+                raise ValueError(
+                    "mixed_step merges prefill and decode dispatches; "
+                    "the stateless family has neither (one-shot rows "
+                    "already ride one grouped dispatch per tick)")
+            if int(state_rows) > 0:
+                raise ValueError(
+                    "state_rows applies to the state_slab family; the "
+                    "stateless family has no recurrent state")
         elif int(state_rows) > 0:
             raise ValueError(
                 "state_rows applies to the state_slab family; model "
@@ -559,7 +620,7 @@ class ContinuousGenerator:
             self._pending: "collections.deque" = collections.deque()
             self._gather_exe = {}   # {n_blocks: compiled prefix gather}
             self._scatter_exe = {}  # {n_blocks: compiled block scatter}
-        elif not self._slab:
+        elif not (self._slab or self._stateless):
             self._caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                                        self._dtype)
             if device is not None:
@@ -626,6 +687,38 @@ class ContinuousGenerator:
         # threads; a bare read-modify-write would drop counts under
         # contention. Every other _stats key is decode-thread-only.
         self._stats_lock = threading.Lock()
+        # Unified stateless serving: `infer_engine` (an InferenceEngine,
+        # or any object with batch_predict / batch_submit+batch_collect)
+        # enables submit_infer one-shot rows; `score_provider` (a
+        # callable returning a scoring Generator — callable so hot
+        # reloads refresh params per dispatch) enables submit_score.
+        # Either may ride a GENERATIVE lane too: one-shot rows and
+        # decode rows then share this one slot pool, admission queue,
+        # deadline governance, and brownout ladder. The gated
+        # "stateless" stats block exists iff one-shot rows can — a
+        # generative-only lane's /stats and /health bytes are
+        # unchanged. Created HERE (not on first admission) so no
+        # cross-thread dict mutation ever races stats() scrapes.
+        self._infer_engine = infer_engine
+        self._score_provider = score_provider
+        self._oneshot = (self._stateless or infer_engine is not None
+                         or score_provider is not None)
+        if self._oneshot:
+            self._stats["stateless"] = {
+                "admitted": 0, "completed": 0, "failed": 0,
+                "ticks": 0, "dispatches": 0, "infer_rows": 0,
+                "score_rows": 0, "full_dispatches": 0,
+                "deadline_dropped": 0,
+            }
+        # One-shot staging lane (unbounded): prefilled one-shot requests
+        # wait HERE, not in the slot-bounded _ready queue. They are
+        # transient members of the next tick's grouped dispatch — freed
+        # within the tick — so making them queue FIFO behind generative
+        # admissions (which hold a slot for a whole stream's lifetime)
+        # would starve single-tick work behind multi-second residents
+        # AND clog _ready ahead of decode admissions. Deadlines are
+        # enforced at drain time every tick.
+        self._oneshot_ready: "queue.Queue[_Request]" = queue.Queue()
         self._prefix_cache = _PrefixCache(int(prefix_cache_mb) * (1 << 20))
         # Chunked prefill: prompts longer than this admit via a sequence
         # of window-decode dispatches instead of one monolithic prefill,
@@ -1674,6 +1767,11 @@ class ContinuousGenerator:
         whose radix tree holds the deepest known chain for this
         prompt — inert unless --prefix-fetch installed a fetch
         callable."""
+        if self._stateless:
+            raise RuntimeError(
+                f"model '{self.spec.name}' serves the stateless "
+                f"family: no generation lane (the one-shot surfaces "
+                f"are submit_infer/submit_score)")
         if not self._running:
             raise RuntimeError("scheduler stopped")
         pens, stops = expand_stopping_params(1, repetition_penalty,
@@ -1708,6 +1806,78 @@ class ContinuousGenerator:
                        park_s=min(300.0, max(0.1,
                                              float(handoff_park_s))))
         self._queue.put(req)
+        return req.future
+
+    # -- unified stateless serving (DESIGN.md "Unified stateless serving") -----
+
+    @property
+    def accepts_oneshot(self) -> bool:
+        """True when this scheduler can serve one-shot /infer rows
+        (constructed with an infer_engine)."""
+        return self._infer_engine is not None
+
+    @property
+    def accepts_score(self) -> bool:
+        """True when this scheduler can serve one-shot /score rows
+        (constructed with a score_provider)."""
+        return self._score_provider is not None
+
+    def submit_infer(self, input_data, shape=None,
+                     deadline: Optional[Deadline] = None,
+                     sink=None, tag: Optional[str] = None) -> Future:
+        """Enqueue ONE stateless forward as a single-tick row in the
+        continuous batch: the request rides the same admission queue,
+        deadline checks, brownout ladder, and tracing spans as decode
+        rows, and the tick's grouped dispatch runs the model forward
+        once — no KV/slab allocation. Resolves to
+        ``(output_row, per_request_time_us)``; the output is
+        byte-identical to InferenceEngine.batch_predict's row for the
+        same co-batched inputs (the dispatch IS that engine call)."""
+        if self._infer_engine is None:
+            raise RuntimeError(
+                "submit_infer requires an infer_engine: construct the "
+                "scheduler with infer_engine=<InferenceEngine> "
+                "(DESIGN.md 'Unified stateless serving')")
+        if not self._running:
+            raise RuntimeError("scheduler stopped")
+        req = _Request([], 0, -1, 0.0, 0, 1.0, 0,
+                       deadline=deadline, sink=sink,
+                       t_submit=time.perf_counter(),
+                       tag=str(tag) if tag is not None else None,
+                       oneshot=("infer", input_data,
+                                tuple(int(d) for d in shape)
+                                if shape is not None else None))
+        # Straight to the one-shot staging lane: the prefill thread
+        # contributes nothing to a one-shot (no prompt forward), and
+        # routing through _queue would strand single-tick work behind a
+        # generate admission blocked on a full _ready. queue_wait span
+        # and deadline check happen at drain time (_tick_stateless).
+        self._oneshot_ready.put(req)
+        return req.future
+
+    def submit_score(self, prompt_tokens, completion_tokens,
+                     deadline: Optional[Deadline] = None,
+                     sink=None, tag: Optional[str] = None) -> Future:
+        """Enqueue one teacher-forced scoring request as a single-tick
+        row (per-token log P(completion | prompt), one forward). On a
+        generative lane this shares the decode rows' slot pool — one
+        scheduler, one capacity pool, one set of counters. Resolves to
+        ``(logprobs, per_request_time_us)``."""
+        if self._score_provider is None:
+            raise RuntimeError(
+                "submit_score requires a score_provider: construct "
+                "the scheduler with score_provider=<callable returning "
+                "a scoring Generator>")
+        if not self._running:
+            raise RuntimeError("scheduler stopped")
+        req = _Request([], 0, -1, 0.0, 0, 1.0, 0,
+                       deadline=deadline, sink=sink,
+                       t_submit=time.perf_counter(),
+                       tag=str(tag) if tag is not None else None,
+                       oneshot=("score",
+                                [int(t) for t in prompt_tokens],
+                                [int(t) for t in completion_tokens]))
+        self._oneshot_ready.put(req)  # see submit_infer
         return req.future
 
     # -- live stream migration (DESIGN.md "Live stream migration") -------------
@@ -2192,6 +2362,13 @@ class ContinuousGenerator:
                 round(spec["emitted_tokens"] / spec["row_ticks"], 3)
                 if spec["row_ticks"] else None)
             out["spec"] = spec
+        if self._oneshot:
+            # Unified stateless serving (gated, additive): one-shot row
+            # accounting. Snapshot under the lock — deadline_dropped is
+            # bumped from the prefill thread (same rule as
+            # deadline_cancelled); everything else is decode-thread-only.
+            with self._stats_lock:
+                out["stateless"] = dict(self._stats["stateless"])
         if self._tp > 1:
             # Additive, present ONLY on tensor-parallel lanes
             # (defaults-off /stats and /health bytes stay identical):
@@ -2427,6 +2604,13 @@ class ContinuousGenerator:
             if item is not None:
                 self._discard_item(item)
                 self._fail_request(item[0], RuntimeError("scheduler stopped"))
+        # One-shot staging lane: anything still queued never dispatched.
+        while True:
+            try:
+                req = self._oneshot_ready.get_nowait()
+            except queue.Empty:
+                break
+            self._fail_request(req, RuntimeError("scheduler stopped"))
 
     # -- scheduler loop --------------------------------------------------------
 
@@ -2439,6 +2623,11 @@ class ContinuousGenerator:
         with self._stats_lock:
             self._stats["deadline_cancelled"] = (
                 self._stats.get("deadline_cancelled", 0) + 1)
+            if req.oneshot is not None:
+                # The unified lane's analog of the batch lane's
+                # deadline_dropped counter — the worker folds it into
+                # the wire-compatible /health admission block.
+                self._stats["stateless"]["deadline_dropped"] += 1
         self._fail_request(req, DeadlineExceeded(message))
 
     def _count_admission_dispatch(self, n: int = 1) -> None:
@@ -2496,16 +2685,25 @@ class ContinuousGenerator:
                 except Exception as exc:
                     self._fail_request(req, exc)
                     continue
-                if req.sink is not None and not self._mixed:
+                if (req.sink is not None and not self._mixed
+                        and req.oneshot is None):
                     # Mixed mode records its real (multi-tick) "prefill"
                     # span at prompt completion in _tick_mixed — staging
                     # the batch-formation wrapper here too would
                     # double-count the stage and pollute its histogram
-                    # with ~µs samples.
+                    # with ~µs samples. One-shot rows have no prefill at
+                    # all (their device work is the tick's grouped
+                    # dispatch — batch_form/device_compute spans there).
                     dur_us = (time.perf_counter() - t0) * 1e6
                     req.sink.stage("prefill", dur_us,
                                    start_ts=time.time() - dur_us / 1e6,
                                    prompt_len=len(req.prompt))
+                if req.oneshot is not None:
+                    # Single-tick work stages on its own unbounded lane
+                    # (see _oneshot_ready above) and joins the next
+                    # tick's grouped dispatch directly.
+                    self._oneshot_ready.put(req)
+                    continue
                 # Bounded put with a running check: if the decode loop
                 # already exited, don't block forever on a full queue.
                 placed = False
@@ -2984,6 +3182,13 @@ class ContinuousGenerator:
                 [], prompt, gen)
 
     def _run_prefill(self, req: _Request):
+        if req.oneshot is not None:
+            # One-shot rows carry no prompt forward: the prefill thread
+            # only contributes the queue_wait span and deadline check;
+            # the device work happens in _tick_stateless's grouped
+            # dispatch. Short item — _discard_item's len guard makes
+            # the drain paths safe on it.
+            return (req,)
         if self._slab:
             if req.migrate is not None:
                 return self._run_prefill_import_slab(req)
@@ -3491,6 +3696,12 @@ class ContinuousGenerator:
         into the shared cache and initialise the row's host-side state.
         Family-dispatched: state_slab rows write their computed state
         into one slab row instead of scattering KV into pool blocks."""
+        if item[0].oneshot is not None:
+            # One-shot rows first — family-independent (no blocks, no
+            # slab row, no cache splice), so a generative lane carrying
+            # them never routes one into its state machinery.
+            self._admit_stateless(item[0], row)
+            return
         if self._slab:
             if item[0].migrate is not None:
                 self._admit_import_slab(item, row)
@@ -3557,6 +3768,13 @@ class ContinuousGenerator:
         req = self._row_req[row]
         if req is None:
             return
+        if req.oneshot is not None:
+            # One-shot rows complete ONLY in _tick_stateless: their
+            # budget is trivially met (max_new == 0), so the generative
+            # completion sweep would resolve them empty. _loop_body
+            # ticks them before any generative dispatch, so this guard
+            # is a backstop, not the ordering contract.
+            return
         emitted = self._row_emitted[row]
         hit_eos = req.eos_id >= 0 and req.eos_id in emitted
         budget = len(emitted) >= req.max_new
@@ -3598,6 +3816,159 @@ class ContinuousGenerator:
                 self._done[r] = True
                 self._release_row_blocks(r)
                 self._clear_mixed_row(r)
+
+    # -- unified stateless rows (DESIGN.md "Unified stateless serving") --------
+
+    def _admit_stateless(self, req: _Request, row: int) -> None:
+        """Decode-thread half of one-shot admission: the row just holds
+        the request until this tick's grouped dispatch — no KV splice,
+        no slab write, no sampling vectors. `_done` stays True so the
+        row never enters a generative dispatch mask."""
+        req.t_admit = time.perf_counter()
+        self._row_req[row] = req
+        self._row_emitted[row] = []
+        self._done[row] = True
+        self._held[row] = False
+        self._stats["stateless"]["admitted"] += 1
+        self._stats["admitted"] += 1
+
+    def _free_oneshot_row(self, row: int) -> None:
+        self._row_req[row] = None
+        self._row_emitted[row] = []
+        self._done[row] = True
+        self._held[row] = False
+
+    def _run_infer_batch(self, inputs, shapes):
+        """The one-shot /infer device leg: EXACTLY the engine's batched
+        forward (bucketed pad + split), so unified outputs are
+        byte-identical to the retired batch lane's for the same
+        co-batched inputs. Prefers the split-phase API when the engine
+        has one (same preference the batch lane had)."""
+        eng = self._infer_engine
+        shp = (list(shapes)
+               if any(s is not None for s in shapes) else None)
+        if hasattr(eng, "batch_submit"):
+            return eng.batch_collect(eng.batch_submit(inputs, shapes=shp))
+        return eng.batch_predict(inputs, shapes=shp)
+
+    def _tick_stateless(self) -> None:
+        """One-shot tick: drain this tick's pending one-shot requests
+        (up to a brownout-scaled n_slots budget), group them by kind,
+        and run ONE grouped forward per kind present — infer rows
+        through the infer_engine's bucketed batch, score rows through
+        the score_provider's teacher-forced forward. Members stamp a
+        transient row when one is free (the ragged batch's bookkeeping
+        and counters); overflow members ride the same grouped dispatch
+        rowless. Either way they are freed WITHIN this tick, so
+        single-tick work never queues behind — and never displaces —
+        decode residents that hold slots for a stream's lifetime. Runs
+        BEFORE the generative tick paths each iteration, so a one-shot
+        row never meets _maybe_complete's budget sweep and a mixed
+        generate+score lane finishes its single-tick work before
+        spending the tick's decode dispatch."""
+        st = self._stats["stateless"]
+        budget = self.n_slots
+        frac = self._bo_budget_frac
+        if frac < 1.0:
+            # Brownout: shrink the per-tick one-shot dispatch the same
+            # way the mixed-step token budget shrinks (floored at 1 so
+            # progress survives every stage); deferred requests stay
+            # queued and dispatch next tick.
+            budget = max(1, int(budget * frac))
+        # Stragglers already holding rows (the _ready/_admit fallback
+        # path) dispatch first; the snapshot also shields the second
+        # kind's group from the first kind's row frees.
+        pairs = [(r, self._row_req[r]) for r in range(self.n_slots)
+                 if self._row_req[r] is not None
+                 and self._row_req[r].oneshot is not None]
+        free = self._free_rows() if len(pairs) < budget else []
+        while len(pairs) < budget:
+            try:
+                req = self._oneshot_ready.get_nowait()
+            except queue.Empty:
+                break
+            if req.deadline is not None and req.deadline.expired():
+                self._cancel_deadline(
+                    req, "deadline expired before one-shot dispatch")
+                continue
+            if req.sink is not None:
+                # The prefill thread never sees one-shots, so the
+                # queue_wait span (submit -> drain) stages here.
+                wait_us = (time.perf_counter() - req.t_submit) * 1e6
+                req.sink.stage("queue_wait", wait_us,
+                               start_ts=time.time() - wait_us / 1e6)
+            if free:
+                self._admit_stateless(req, free[0])
+                pairs.append((free.pop(0), req))
+            else:
+                req.t_admit = time.perf_counter()
+                st["admitted"] += 1
+                self._stats["admitted"] += 1
+                pairs.append((None, req))
+        if not pairs:
+            return
+        st["ticks"] += 1
+        for kind in ("infer", "score"):
+            group = [(r, q) for r, q in pairs if q.oneshot[0] == kind]
+            if group:
+                self._dispatch_oneshot(kind, group, st)
+
+    def _dispatch_oneshot(self, kind: str, group, st: dict) -> None:
+        reqs = [q for _r, q in group]
+        t0 = time.perf_counter()
+        try:
+            if kind == "infer":
+                outs = self._run_infer_batch(
+                    [q.oneshot[1] for q in reqs],
+                    [q.oneshot[2] for q in reqs])
+            else:
+                scorer = self._score_provider()
+                outs = scorer.score([q.oneshot[1] for q in reqs],
+                                    [q.oneshot[2] for q in reqs])
+            if len(outs) != len(group):
+                raise RuntimeError(
+                    f"one-shot {kind} dispatch returned {len(outs)} "
+                    f"results for {len(group)} rows")
+        except Exception as exc:
+            # A failed one-shot dispatch poisons exactly its co-batched
+            # group — the retired batch lane's semantics. Nothing is
+            # donated and no shared device state was touched, so the
+            # scheduler keeps serving without a _recover.
+            st["dispatches"] += 1
+            st["failed"] += len(group)
+            for r, q in group:
+                self._fail_request(q, exc)
+                if r is not None:
+                    self._free_oneshot_row(r)
+            return
+        elapsed_us = (time.perf_counter() - t0) * 1e6
+        per_us = max(1, int(elapsed_us / max(1, len(group))))
+        st["dispatches"] += 1
+        st[kind + "_rows"] += len(group)
+        if len(group) >= self.n_slots:
+            st["full_dispatches"] += 1
+        for (r, req), out in zip(group, outs):
+            if req.sink is not None:
+                # Span parity with the retired batch lane (the worker's
+                # _batch_observer/_record_device_spans): batch_form is
+                # this row's admission→dispatch gap, device_compute the
+                # whole group's device leg with the batch_size divisor.
+                bf_us = max(0.0, (t0 - req.t_admit) * 1e6)
+                req.sink.stage(
+                    "batch_form", bf_us,
+                    start_ts=time.time() - (elapsed_us + bf_us) / 1e6,
+                    batch_size=len(group))
+                req.sink.stage(
+                    "device_compute", elapsed_us,
+                    start_ts=time.time() - elapsed_us / 1e6,
+                    batch_size=len(group))
+            req.future.set_result((out, per_us))
+            if req.stream is not None:
+                req.stream.put(None)
+            if r is not None:
+                self._free_oneshot_row(r)
+            st["completed"] += 1
+            self._stats["completed"] += 1
 
     def _recover(self, exc: BaseException) -> None:
         """Device-step failure recovery. The prefill/decode executables
@@ -3684,6 +4055,11 @@ class ContinuousGenerator:
                     + len(violations))
                 print(f"[scheduler] POST-RECOVER INVARIANT VIOLATED: "
                       f"{'; '.join(violations)}", flush=True)
+        elif self._stateless:
+            # One-shot rows hold no donated device state: nothing to
+            # rebuild — failing the in-flight rows above was the whole
+            # recovery.
+            pass
         else:
             caches = init_caches(self.cfg, self.n_slots, self.max_seq,
                                  self._dtype)
@@ -4601,8 +4977,19 @@ class ContinuousGenerator:
                 # Handoff holds past their park window resume decoding
                 # (the colocated fallback — the export never came).
                 self._unpark_expired()
+            if self._oneshot:
+                # One-shot rows dispatch and complete HERE, before the
+                # generative tick paths: their budget rule (max_new ==
+                # 0) must never meet _maybe_complete's sweep, and a
+                # mixed generate+score tick serves its single-tick work
+                # first (the rows free for next tick's admissions).
+                self._tick_stateless()
+            # One-shot rows never enter a generative dispatch: any
+            # still-occupied slot here is a brownout-deferred row
+            # waiting for next tick, not decodable work.
             live = [r for r in range(self.n_slots)
-                    if self._row_req[r] is not None]
+                    if self._row_req[r] is not None
+                    and self._row_req[r].oneshot is None]
             if not live:
                 continue
             if (self._paged or self._slab) and all(self._held[r]

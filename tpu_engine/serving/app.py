@@ -356,6 +356,11 @@ def serve_combined(
         # XLA's compile cache, so this is ~one compile per bucket.
         for w in workers:
             w.engine.warmup()
+            if getattr(w.generator, "_stateless", False):
+                # Stateless-family scheduler: no generation lane to
+                # warm — engine.warmup() above already compiled every
+                # one-shot bucket the single-tick rows dispatch into.
+                continue
             if w.generator is not None:
                 # Also compile the generation lane (smallest prompt bucket
                 # + one decode chunk) — a cold /generate otherwise pays
@@ -445,6 +450,7 @@ def serve_combined(
         dense deployments)."""
         out = gateway.get_stats()
         kv, mixed, spec, state, pfetch = {}, {}, {}, {}, {}
+        stateless = {}
         for w in workers:
             gen = getattr(w, "generator", None)
             if gen is None or not hasattr(gen, "stats"):
@@ -453,6 +459,11 @@ def serve_combined(
                 st = gen.stats()
             except Exception:
                 continue
+            if st.get("stateless", {}).get("dispatches"):
+                # Unified stateless serving: one-shot row counters per
+                # lane, present only once a lane actually dispatched a
+                # single-tick row (defaults-off /stats is untouched).
+                stateless[w.node_id] = st["stateless"]
             if st.get("kv_pool"):
                 kv[w.node_id] = st["kv_pool"]
             if st.get("prefix_fetch"):
@@ -481,6 +492,8 @@ def serve_combined(
             out["spec"] = spec
         if pfetch:
             out["prefix_fetch"] = pfetch
+        if stateless:
+            out["stateless"] = stateless
         return 200, out
 
     routes[("GET", "/stats")] = _stats

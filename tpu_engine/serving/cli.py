@@ -347,6 +347,15 @@ def main(argv=None) -> int:
                             help="concurrent outbound peer fetches per "
                                  "lane; excess misses prefill locally "
                                  "(default 2)")
+        parser.add_argument("--no-unified-stateless", action="store_true",
+                            help="retire the unified stateless lane: "
+                                 "route /predict misses and /score "
+                                 "through the legacy dedicated batch "
+                                 "processor instead of single-tick rows "
+                                 "in the continuous scheduler (default: "
+                                 "unified — one slot pool, one set of "
+                                 "deadlines/brownout/counters for every "
+                                 "request class)")
         _add_flight_flags(parser)
         args = parser.parse_args(rest)
         port = args.port
@@ -409,6 +418,8 @@ def main(argv=None) -> int:
             gen_kw["gen_prefix_fetch_timeout_s"] = args.prefix_fetch_timeout
         if args.prefix_fetch_inflight is not None:
             gen_kw["gen_prefix_fetch_inflight"] = args.prefix_fetch_inflight
+        if args.no_unified_stateless:
+            gen_kw["unified_stateless"] = False
         _apply_flight_flags(args, gen_kw)
         cfg = WorkerConfig(port=port, node_id=node_id,
                            model=model or model_from_path(model_arg),
@@ -775,6 +786,15 @@ def main(argv=None) -> int:
                             help="batch scheduler: whole decode loop as "
                                  "one dispatch (zero per-chunk host "
                                  "syncs; identical streams)")
+        parser.add_argument("--no-unified-stateless", action="store_true",
+                            help="retire the unified stateless lane: "
+                                 "route /predict misses and /score "
+                                 "through the legacy dedicated batch "
+                                 "processor instead of single-tick rows "
+                                 "in the continuous scheduler (default: "
+                                 "unified — one slot pool, one set of "
+                                 "deadlines/brownout/counters for every "
+                                 "request class)")
         parser.add_argument("--gen-prefill-chunk", type=int, default=256,
                             help="chunked prefill window (continuous "
                                  "scheduler): longer prompts admit in "
@@ -1055,6 +1075,8 @@ def main(argv=None) -> int:
             bb_kw["gen_prefix_fetch"] = True
         if args.prefix_fetch_timeout is not None:
             bb_kw["gen_prefix_fetch_timeout_s"] = args.prefix_fetch_timeout
+        if args.no_unified_stateless:
+            bb_kw["unified_stateless"] = False
         _apply_flight_flags(args, bb_kw)
         worker_config = WorkerConfig(shape_buckets=buckets, **bb_kw,
                                      gen_scheduler=args.gen_scheduler,

@@ -2145,7 +2145,14 @@ class Gateway:
         affinity_prefix_blocks — the exact granularity the workers'
         radix trees share at, so two requests with equal fingerprints
         have reusable KV blocks in common. None when the prompt has no
-        full block (or is malformed — the normal path will 400 it)."""
+        full block (or is malformed — the normal path will 400 it).
+
+        Unified stateless serving rides the same rings: stateless
+        payloads (/infer's "input_data", score/embed bodies without
+        prompt_tokens) have no token prefix to fingerprint, so this
+        returns None and the router degrades gracefully to its
+        content-hash / round-robin tiers — no special-case lane class,
+        one routing policy for every request family."""
         toks = payload.get("prompt_tokens")
         if not isinstance(toks, (list, tuple)):
             return None

@@ -294,6 +294,14 @@ class WorkerNode:
         self._gen_processor: Optional[BatchProcessor[_GenItem, _GenResult]] = None
         self._continuous = self.config.gen_scheduler == "continuous"
         self._speculative = self.config.gen_scheduler == "speculative"
+        # Unified stateless serving (DESIGN.md; the fold that retired
+        # the dedicated batch lane): one-shot /infer and /score admit as
+        # single-tick rows in the continuous scheduler — one slot pool,
+        # one admission queue, one set of counters with decode streams.
+        # Continuous-only: any other gen_scheduler keeps the batch lane.
+        self._unified = (bool(getattr(self.config, "unified_stateless",
+                                      True))
+                         and self._continuous)
         if self.config.gen_continuous_spec_k > 0 and not self._continuous:
             # --spec-k is the continuous scheduler's knob; under any other
             # gen_scheduler the flag would build that lane's generator and
@@ -371,6 +379,35 @@ class WorkerNode:
                 f"model "
                 f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
                 f" serves the {model_family or 'kv_paged'} family")
+        if model_family == "stateless":
+            # Stateless-family fences: one-shot rows hold no
+            # autoregressive state, so every generative-state knob is a
+            # LOUD refusal — previously these were silently inert
+            # (the generator was simply never built for config-less
+            # models), which violated the misconfiguration contract.
+            if self.config.gen_continuous_spec_k > 0:
+                # Checked BEFORE the KV fence: an operator who asked for
+                # speculation gets the speculative-lane diagnosis even
+                # when KV knobs are also set (tests pin this wording).
+                raise RuntimeError(
+                    f"speculative lane misconfigured: --spec-k requires "
+                    f"a generation-capable family; model "
+                    f"'{getattr(self.engine.spec, 'name', self.config.model)}'"
+                    f" serves the stateless family (one-shot rows have "
+                    f"no decode loop to speculate)")
+            if (self.config.gen_kv_block_size > 0
+                    or self.config.gen_kv_blocks > 0
+                    or self.config.gen_kv_host_blocks > 0
+                    or self.config.gen_kv_quantize):
+                raise RuntimeError(
+                    "stateless-family models have no KV cache: "
+                    "--kv-block-size/--kv-blocks/--kv-host-blocks/"
+                    "--kv-quantize apply to the kv_paged family")
+            if self.config.gen_mixed_step:
+                raise RuntimeError(
+                    "--mixed-step merges prefill and decode dispatches; "
+                    "stateless-family models have neither (one-shot "
+                    "rows already ride one grouped dispatch per tick)")
         # Tensor-parallel serving fences (the registry declares the
         # partition rule; the worker refuses misconfigurations LOUDLY —
         # an operator who asked for a sharded lane must never get a
@@ -451,6 +488,14 @@ class WorkerNode:
                         mixed_token_budget=(
                             self.config.gen_mixed_token_budget),
                         state_rows=self.config.gen_state_rows,
+                        # Unified stateless serving: one-shot /predict
+                        # and /score requests admit as single-tick rows
+                        # beside this lane's decode streams (one pool,
+                        # one admission queue, one set of counters).
+                        infer_engine=(self.engine if self._unified
+                                      else None),
+                        score_provider=(self._get_scorer
+                                        if self._unified else None),
                         **self._continuous_spec_kwargs(),
                         # TP lanes build their own mesh over THIS
                         # lane's device slice (tp_device_offset keeps
@@ -510,6 +555,31 @@ class WorkerNode:
                     raise RuntimeError(
                         f"speculative lane misconfigured: {e}") from e
                 self.generator = None
+        elif self._unified and model_family == "stateless":
+            # Unified stateless serving: config-less models (mlp/resnet/
+            # onnx graphs) get a continuous scheduler whose rows are ALL
+            # one-shot — /predict misses join the same admission queue,
+            # deadline governance, brownout tiers, and counters as every
+            # generative lane in the fleet. n_slots mirrors the retired
+            # batch lane's max batch so dispatch width is wire-identical.
+            from tpu_engine.runtime.scheduler import ContinuousGenerator
+
+            self.generator = ContinuousGenerator(
+                self.engine.spec,
+                params=getattr(self.engine, "params", None),
+                dtype=self.config.dtype,
+                n_slots=self.config.max_batch_size,
+                prefix_cache_mb=0,
+                infer_engine=self.engine,
+                device=getattr(engine, "_device", None))
+            self.generator.tracer = self.tracer
+            self.generator.trace_node = self.node_id
+            flight = int(getattr(self.config,
+                                 "flight_recorder", 0) or 0)
+            if flight > 0:
+                self.generator.configure_flight_recorder(
+                    flight, getattr(self.config, "flight_dump_dir",
+                                    None))
         elif self.config.gen_continuous_spec_k > 0:
             # Config-less models skip generator construction entirely, so
             # the ValueError conversion above can never fire for them —
@@ -806,10 +876,11 @@ class WorkerNode:
             with self._admitted(deadline, trace=(span.ctx,
                                                  span.request_id),
                                 tier=tier):
-                return self._score_admitted(request, deadline)
+                return self._score_admitted(request, deadline, span.ctx)
 
     def _score_admitted(self, request: dict,
-                        deadline: Optional[Deadline]) -> dict:
+                        deadline: Optional[Deadline],
+                        tctx=None) -> dict:
         with self._counter_lock:
             self._total_requests += 1
         completion = [int(t) for t in request["completion_tokens"]]
@@ -830,7 +901,23 @@ class WorkerNode:
         t0 = time.perf_counter()
         # Concurrent evals requests (the lm-eval-harness shape) batch into
         # one bucketed forward instead of N sequential batch-1 forwards.
-        lps = self._score_processor().process(item, deadline=deadline)
+        if self._score_unified():
+            # Unified stateless serving: the score joins the continuous
+            # scheduler as a single-tick row — same slot pool, deadlines,
+            # brownout, and counters as the lane's decode streams. The
+            # scheduler groups co-pending score rows into ONE bucketed
+            # forward per tick (the retired score-batcher's semantics).
+            sink = (TraceSink(self.tracer, self.node_id,
+                              item.request_id, tctx)
+                    if tctx is not None else None)
+            fut = self.generator.submit_score(
+                item.prompt, item.completion, deadline=deadline,
+                sink=sink, tag=item.request_id)
+            lps, _us = fut.result(
+                timeout=(600.0 if deadline is None
+                         else max(5.0, deadline.remaining_s() + 5.0)))
+        else:
+            lps = self._score_processor().process(item, deadline=deadline)
         return {
             "request_id": item.request_id,
             "logprobs": lps,
@@ -1529,9 +1616,9 @@ class WorkerNode:
 
         try:
             gen0 = self._weights_gen  # stamp BEFORE the compute
-            result = self.batch_processor.process(
+            result = self._dispatch_infer(
                 _BatchItem(request_id, input_data, shape, trace=tctx),
-                deadline=deadline)
+                deadline)
             s0 = time.perf_counter()
             s_start = time.time()
             frag = _encode_output(result.output_data)
@@ -1585,6 +1672,42 @@ class WorkerNode:
                 + b', "node_id": ' + self._node_id_json
                 + b', "cached": ' + (b"true" if cached else b"false")
                 + b', "inference_time_us": ' + str(time_us).encode() + b"}")
+
+    def _infer_unified(self) -> bool:
+        """True when /infer misses ride the continuous scheduler as
+        single-tick rows (unified stateless serving) instead of the
+        legacy batch lane. Requires a scheduler that accepted an
+        infer_engine — test fakes and non-continuous lanes fall back."""
+        gen = self.generator
+        return (self._unified and gen is not None
+                and bool(getattr(gen, "accepts_oneshot", False)))
+
+    def _score_unified(self) -> bool:
+        gen = self.generator
+        return (self._unified and gen is not None
+                and bool(getattr(gen, "accepts_score", False)))
+
+    def _dispatch_infer(self, item: _BatchItem,
+                        deadline: Optional[Deadline]) -> _BatchResult:
+        """Miss-path dispatch seam: the unified lane submits one
+        single-tick scheduler row (one slot pool shared with decode
+        streams — same deadlines, brownout, shedding, counters); legacy
+        lanes keep the dedicated batch processor. Result and exception
+        surface (DeadlineExceeded, engine errors) are identical either
+        way, so the coalescing/cache/EWMA machinery upstream never knows
+        which lane answered."""
+        if not self._infer_unified():
+            return self.batch_processor.process(item, deadline=deadline)
+        sink = (TraceSink(self.tracer, self.node_id, item.request_id,
+                          item.trace)
+                if getattr(item, "trace", None) is not None else None)
+        fut = self.generator.submit_infer(
+            item.input_data, shape=item.shape, deadline=deadline,
+            sink=sink, tag=item.request_id)
+        out, time_us = fut.result(
+            timeout=(600.0 if deadline is None
+                     else max(5.0, deadline.remaining_s() + 5.0)))
+        return _BatchResult(out, time_us)
 
     def _batch_observer(self, items, timing) -> None:
         """BatchProcessor tracing hook (dispatch thread): per-request
@@ -1679,7 +1802,11 @@ class WorkerNode:
         generate_time_us}. No reference counterpart (the reference can only
         run one-shot graphs); field style matches /infer.
         """
-        if self.generator is None:
+        if self.generator is None or getattr(self.generator,
+                                             "_stateless", False):
+            # A stateless-family lane DOES carry a continuous scheduler
+            # (its rows are all one-shot), but that is not a generation
+            # lane — keep the reference wire contract (ValueError → 400).
             raise ValueError(f"model '{self.config.model}' does not support generation")
         if self._injected_fault is not None:
             raise RuntimeError(f"fault injected: {self._injected_fault}")
@@ -1783,7 +1910,10 @@ class WorkerNode:
           data: {"done": true, "request_id", "tokens", "node_id",
                  "generate_time_us"}      terminal summary (or "error")
         """
-        if self.generator is None:
+        if self.generator is None or getattr(self.generator,
+                                             "_stateless", False):
+            # Same contract as handle_generate: a stateless-family
+            # lane's scheduler has no decode loop to stream from.
             raise ValueError(
                 f"model '{self.config.model}' does not support generation")
         if self._injected_fault is not None:
@@ -2142,6 +2272,11 @@ class WorkerNode:
         gen = self.generator
         if gen is None or not hasattr(gen, "ttft_hist"):
             return {}
+        if getattr(gen, "_stateless", False):
+            # One-shot rows have no first-token or inter-token moments;
+            # a stateless-family lane keeps its /metrics text identical
+            # to the retired batch lane's.
+            return {}
         return {
             "tpu_engine_ttft_seconds": {self.node_id: gen.ttft_hist},
             "tpu_engine_itl_seconds": {self.node_id: gen.itl_hist},
@@ -2184,10 +2319,34 @@ class WorkerNode:
         # keys): decode-lane scheduler counters for transformer workers.
         if self.generator is not None and hasattr(self.generator, "stats"):
             try:
-                out["generator"] = self.generator.stats()
+                gstats = self.generator.stats()
             except Exception:
-                pass
-            else:
+                gstats = None
+            if gstats is not None:
+                if getattr(self.generator, "_stateless", False):
+                    # Unified stateless serving on a stateless-family
+                    # lane: the scheduler IS the batch lane now, so its
+                    # one-shot dispatch counters FOLD into the
+                    # wire-exact 4-key batch_processor block instead of
+                    # growing /health a "generator" key the reference
+                    # schema (worker_node.cpp:85-103) never had. A
+                    # defaults-on mlp lane answers byte-compatible.
+                    st = gstats.get("stateless") or {}
+                    bp = out["batch_processor"]
+                    rows = (int(st.get("infer_rows", 0))
+                            + int(st.get("score_rows", 0)))
+                    disp = int(st.get("dispatches", 0))
+                    prev_rows = (float(bp["avg_batch_size"])
+                                 * int(bp["total_batches"]))
+                    bp["total_batches"] = int(bp["total_batches"]) + disp
+                    bp["full_batches"] = (int(bp["full_batches"])
+                                          + int(st.get("full_dispatches",
+                                                       0)))
+                    if bp["total_batches"] > 0:
+                        bp["avg_batch_size"] = ((prev_rows + rows)
+                                                / bp["total_batches"])
+                else:
+                    out["generator"] = gstats
                 # Scheduler liveness: a wedged decode loop (stuck inside a
                 # device dispatch) is process-alive but cannot serve —
                 # last-tick age is the only signal that sees it. With
@@ -2195,7 +2354,7 @@ class WorkerNode:
                 # unhealthy, so the gateway's prober ejects it like a
                 # dead process instead of breakers tripping one victim
                 # request at a time.
-                age = out["generator"].get("last_tick_age_s")
+                age = gstats.get("last_tick_age_s")
                 stall = float(self.config.scheduler_stall_s or 0.0)
                 if stall > 0 and age is not None and age > stall:
                     out["healthy"] = False
@@ -2219,6 +2378,14 @@ class WorkerNode:
         score_proc = getattr(self, "_score_proc", None)
         if score_proc is not None:
             dropped += score_proc.deadline_dropped
+        if self._infer_unified() or self._score_unified():
+            # One-shot rows the scheduler cancelled at their deadline
+            # count exactly like the retired batch lane's drops.
+            try:
+                dropped += int((self.generator.stats().get("stateless")
+                                or {}).get("deadline_dropped", 0))
+            except Exception:
+                pass
         if self._admission.active or dropped:
             adm = self._admission.as_dict()
             adm["deadline_dropped"] = dropped

@@ -148,6 +148,16 @@ class WorkerConfig:
     # Worth enabling where dispatch latency is high; costs one compile per
     # (batch, prompt, output-capacity) bucket triple.
     gen_decode_fused: bool = False
+    # Unified stateless serving (DESIGN.md "Unified stateless serving"):
+    # one-shot /infer and /score requests admit as SINGLE-TICK rows in
+    # the continuous scheduler beside decode rows — one scheduler, one
+    # capacity pool, one set of counters; the legacy batch_processor
+    # lane is a compatibility shim. Wire schemas, outputs, and cache-hit
+    # semantics are byte-identical either way (the tick's dispatch IS
+    # the engine's batched forward). --no-unified-stateless restores the
+    # dedicated batch lane. Requires gen_scheduler=continuous (any
+    # other scheduler keeps the batch lane regardless).
+    unified_stateless: bool = True
     # Recurrent state serving (state_slab family ONLY — SSD/Mamba
     # models): capacity of the fixed-size state slab pool in rows. Each
     # live stream owns exactly ONE (n_layers, state_dim) f32 row for its
